@@ -1,0 +1,169 @@
+"""Accelerator abstraction.
+
+TPU-native analogue of the reference ``accelerator/abstract_accelerator.py``
+(``DeepSpeedAccelerator`` ABC :10-306, ~60 abstract methods). The JAX execution
+model removes the need for explicit stream/event management (XLA orders all
+dispatched work per device), so the stream/event surface collapses to no-ops
+retained for API compatibility; memory stats map to ``Device.memory_stats()``.
+"""
+
+import abc
+from abc import ABC
+
+
+class DeepSpeedAccelerator(ABC):
+    def __init__(self):
+        self._name = None
+        self._communication_backend_name = None
+
+    # ---- device APIs ----
+    @abc.abstractmethod
+    def device_name(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def device(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def device_count(self):
+        ...
+
+    @abc.abstractmethod
+    def current_device(self):
+        ...
+
+    def set_device(self, device_index):
+        # JAX places computations by sharding, not a thread-local device.
+        pass
+
+    def current_device_name(self):
+        return self.device_name(self.current_device())
+
+    @abc.abstractmethod
+    def is_available(self):
+        ...
+
+    # ---- RNG APIs (functional in JAX: key-splitting, see runtime/rng) ----
+    def manual_seed(self, seed):
+        pass
+
+    def initial_seed(self):
+        return 0
+
+    # ---- synchronization ----
+    @abc.abstractmethod
+    def synchronize(self, device_index=None):
+        ...
+
+    # streams/events are no-ops: XLA async dispatch is program-ordered
+    def stream(self, stream):
+        import contextlib
+
+        return contextlib.nullcontext()
+
+    def current_stream(self, device_index=None):
+        return None
+
+    def default_stream(self, device_index=None):
+        return None
+
+    class Event:
+        def __init__(self, enable_timing=False):
+            import time
+
+            self._t = time.time
+
+        def record(self, stream=None):
+            self.t0 = self._t()
+
+        def synchronize(self):
+            pass
+
+        def elapsed_time(self, other):
+            return (other.t0 - self.t0) * 1000.0
+
+    # ---- memory APIs ----
+    @abc.abstractmethod
+    def memory_stats(self, device_index=None):
+        ...
+
+    def memory_allocated(self, device_index=None):
+        return self.memory_stats(device_index).get("bytes_in_use", 0)
+
+    def max_memory_allocated(self, device_index=None):
+        return self.memory_stats(device_index).get("peak_bytes_in_use", 0)
+
+    def memory_reserved(self, device_index=None):
+        return self.memory_stats(device_index).get("bytes_reserved", self.memory_allocated(device_index))
+
+    def max_memory_reserved(self, device_index=None):
+        return self.max_memory_allocated(device_index)
+
+    def total_memory(self, device_index=None):
+        return self.memory_stats(device_index).get("bytes_limit", 0)
+
+    def available_memory(self, device_index=None):
+        return self.total_memory(device_index) - self.memory_allocated(device_index)
+
+    def empty_cache(self):
+        pass
+
+    def reset_peak_memory_stats(self, device_index=None):
+        pass
+
+    # ---- dtype support ----
+    @abc.abstractmethod
+    def is_bf16_supported(self):
+        ...
+
+    @abc.abstractmethod
+    def is_fp16_supported(self):
+        ...
+
+    def supported_dtypes(self):
+        import jax.numpy as jnp
+
+        dtypes = [jnp.float32]
+        if self.is_fp16_supported():
+            dtypes.append(jnp.float16)
+        if self.is_bf16_supported():
+            dtypes.append(jnp.bfloat16)
+        return dtypes
+
+    def preferred_dtype(self):
+        import jax.numpy as jnp
+
+        return jnp.bfloat16 if self.is_bf16_supported() else jnp.float32
+
+    # ---- comm backend ----
+    @abc.abstractmethod
+    def communication_backend_name(self):
+        ...
+
+    # ---- graphs: jit is the TPU analogue of CUDA graphs ----
+    def is_triton_supported(self):
+        return False
+
+    def create_graph(self):
+        return None
+
+    def capture_to_graph(self, graph, pool=None, stream=None):
+        import contextlib
+
+        return contextlib.nullcontext()
+
+    def replay_graph(self, graph):
+        pass
+
+    # ---- op builder (Pallas kernel registry; reference :276-282) ----
+    @abc.abstractmethod
+    def create_op_builder(self, op_name):
+        ...
+
+    @abc.abstractmethod
+    def get_op_builder(self, op_name):
+        ...
+
+    def on_accelerator(self, tensor):
+        return True

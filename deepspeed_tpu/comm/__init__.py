@@ -1,0 +1,15 @@
+from deepspeed_tpu.comm.comm import *  # noqa: F401,F403
+from deepspeed_tpu.comm.comm import (
+    ReduceOp,
+    all_gather,
+    all_reduce,
+    all_to_all,
+    barrier,
+    broadcast,
+    get_rank,
+    get_world_size,
+    init_distributed,
+    is_initialized,
+    ppermute,
+    reduce_scatter,
+)

@@ -1,0 +1,131 @@
+"""Communication logging.
+
+Analogue of the reference ``deepspeed/utils/comms_logging.py`` (``CommsLogger``
+:67) fed by ``@timed_op`` wrappers (``comm/comm.py:102``). On TPU, collectives
+are compiled into the XLA program, so per-call device timing is not observable
+from Python; the logger records trace-time call counts, message sizes, and
+algorithmic bandwidth estimates (when given measured wall time from eager
+calls), and defers intra-program timing to the profiler (xprof) integration.
+"""
+
+import math
+from collections import defaultdict
+
+from deepspeed_tpu.utils.logging import log_dist
+
+
+def get_caller_func(frame_depth=3):
+    import sys
+
+    frame = sys._getframe(frame_depth)
+    return frame.f_code.co_name
+
+
+def convert_size(size_bytes):
+    if size_bytes == 0:
+        return "0B"
+    size_name = ("B", "KB", "MB", "GB", "TB", "PB")
+    i = int(math.floor(math.log(size_bytes, 1024)))
+    p = math.pow(1024, i)
+    s = round(size_bytes / p, 2)
+    return f"{s} {size_name[i]}"
+
+
+def calc_bw_log(comm_op, size, duration, n):
+    """Algorithmic/bus bandwidth for a collective (reference comms_logging.py)."""
+    duration = max(duration, 1e-12)
+    if comm_op in ("all_to_all", "all_to_all_single"):
+        tput = size / duration
+        busbw = (size / duration) * ((n - 1) / n)
+    elif comm_op in ("all_gather", "all_gather_into_tensor", "reduce_scatter", "reduce_scatter_tensor"):
+        size *= n
+        tput = size / duration
+        busbw = (size / duration) * ((n - 1) / n)
+    elif comm_op == "all_reduce":
+        tput = size * 2 / duration
+        busbw = (size / duration) * (2 * (n - 1) / n)
+    else:  # broadcast/reduce/send/recv/ppermute
+        tput = size / duration
+        busbw = tput
+    tput /= 1e9
+    busbw /= 1e9
+    return tput, busbw
+
+
+class CommsLogger:
+    def __init__(self, config=None):
+        self.enabled = bool(getattr(config, "enabled", False))
+        self.verbose = bool(getattr(config, "verbose", False))
+        self.prof_all = bool(getattr(config, "prof_all", True))
+        self.prof_ops = list(getattr(config, "prof_ops", []) or [])
+        self.debug = bool(getattr(config, "debug", False))
+        self.comms_dict = defaultdict(lambda: defaultdict(lambda: [0, [], [], []]))
+
+    def configure(self, config):
+        self.enabled = config.enabled
+        self.verbose = config.verbose
+        self.prof_all = config.prof_all
+        self.prof_ops = list(config.prof_ops or [])
+        self.debug = config.debug
+
+    def start_profiling_comms(self):
+        self.enabled = True
+        self.prof_all = True
+
+    def stop_profiling_comms(self):
+        self.enabled = False
+
+    def append(self, raw_name, record_name, latency, msg_size, world_size):
+        """Record one collective call (latency in seconds; 0 when traced-only)."""
+        if not self.enabled:
+            return
+        if not self.prof_all and raw_name not in self.prof_ops:
+            return
+        algbw, busbw = calc_bw_log(raw_name, msg_size, latency, max(world_size, 1)) if latency > 0 else (0.0, 0.0)
+        rec = self.comms_dict[record_name][msg_size]
+        rec[0] += 1
+        rec[1].append(latency * 1000.0)
+        rec[2].append(algbw)
+        rec[3].append(busbw)
+        if self.verbose:
+            log_dist(
+                f"comm op: {record_name} | msg size: {convert_size(msg_size)} | "
+                f"time (ms): {latency * 1000.0:.2f} | algbw (Gbps): {algbw * 8:.2f} | busbw (Gbps): {busbw * 8:.2f}",
+                ranks=[0],
+            )
+
+    def log_all(self, print_log=True, show_straggler=False):
+        from deepspeed_tpu.utils.timer import trim_mean
+
+        summary = {}
+        for record_name, sizes in self.comms_dict.items():
+            summary[record_name] = {}
+            if print_log:
+                log_dist(f"Comm. Op: {record_name}", ranks=[0])
+            for msg_size, (count, latencies, algbws, busbws) in sorted(sizes.items()):
+                avg_lat = trim_mean(latencies, 0.1)
+                avg_alg = trim_mean(algbws, 0.1)
+                avg_bus = trim_mean(busbws, 0.1)
+                summary[record_name][msg_size] = {
+                    "count": count,
+                    "avg_latency_ms": avg_lat,
+                    "algbw_GBps": avg_alg,
+                    "busbw_GBps": avg_bus,
+                }
+                if print_log:
+                    log_dist(
+                        f"    msg size: {convert_size(msg_size)} | count: {count} | "
+                        f"avg lat (ms): {avg_lat:.2f} | algbw (GB/s): {avg_alg:.2f} | busbw (GB/s): {avg_bus:.2f}",
+                        ranks=[0],
+                    )
+        return summary
+
+
+_comms_logger = None
+
+
+def get_comms_logger() -> CommsLogger:
+    global _comms_logger
+    if _comms_logger is None:
+        _comms_logger = CommsLogger()
+    return _comms_logger

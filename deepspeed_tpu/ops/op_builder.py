@@ -1,0 +1,67 @@
+"""Op registry.
+
+TPU-native analogue of the reference ``op_builder/`` system (``OpBuilder`` ABC
+builder.py:116, reflection enumeration all_ops.py:22-32). There is no JIT
+C++ compilation step on TPU — "ops" are Pallas kernels (or fused XLA
+subgraphs) registered here and loaded lazily via
+``get_accelerator().create_op_builder(name)``.
+"""
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class OpBuilder:
+    """Base class: a named, lazily-loaded op implementation."""
+
+    NAME = "base_op"
+
+    def __init__(self):
+        self._loaded = None
+
+    def is_compatible(self, verbose=False):
+        return True
+
+    def load(self, verbose=True):
+        if self._loaded is None:
+            self._loaded = self._build()
+            if verbose:
+                logger.info(f"Loaded TPU op: {self.NAME}")
+        return self._loaded
+
+    def _build(self):
+        raise NotImplementedError
+
+
+class PallasOpBuilder(OpBuilder):
+    """An op backed by a Pallas TPU kernel with a jnp reference fallback on CPU."""
+
+    def _build(self):
+        raise NotImplementedError
+
+
+# Populated by kernel modules at import time via register_op.
+ALL_OPS = {}
+
+
+def register_op(builder_cls):
+    ALL_OPS[builder_cls.NAME] = builder_cls
+    return builder_cls
+
+
+def _register_builtin_ops():
+    """Import kernel modules so their builders self-register."""
+    import importlib
+
+    for mod in (
+        "deepspeed_tpu.ops.adam.fused_adam",
+        "deepspeed_tpu.ops.attention.flash_attention",
+        "deepspeed_tpu.ops.normalization.fused_norm",
+        "deepspeed_tpu.ops.quantizer.quantizer",
+    ):
+        try:
+            importlib.import_module(mod)
+        except ImportError:
+            pass
+
+
+_register_builtin_ops()

@@ -1,0 +1,13 @@
+from deepspeed_tpu.parallel.topology import (
+    BATCH_AXES,
+    DATA_AXIS,
+    EXPERT_AXIS,
+    MESH_AXES,
+    MODEL_AXIS,
+    PIPE_AXIS,
+    SEQUENCE_AXIS,
+    Topology,
+    get_topology,
+    reset_topology,
+    set_topology,
+)

@@ -1,0 +1,150 @@
+"""Device-mesh topology: the TPU-native parallelism grid.
+
+Analogue of the reference's process-group algebra
+(``deepspeed/utils/groups.py`` — data/model/sequence/expert groups,
+``runtime/pipe/topology.py`` — ``ProcessTopology``/``PipelineParallelGrid``).
+Instead of materializing torch process groups per parallel dimension, a single
+``jax.sharding.Mesh`` with named axes carries the whole grid; XLA compiles
+collectives over whichever axis subset an op names, so every reference
+"group" becomes an axis name (or tuple of names).
+
+Axis order (outermost→innermost) is chosen for ICI locality: the ``model``
+(tensor-parallel) axis is innermost so its per-layer collectives ride the
+fastest ICI links; ``data`` is outermost so it can span DCN on multi-slice.
+This mirrors the sharding recipe of the public scaling literature rather than
+the reference's rank-arithmetic (groups.py:315 ``_get_expert_parallel_ranks``).
+
+Batch (DP) arithmetic: the global batch is sharded over ``data``×``expert``;
+the ``sequence`` axis shards the *sequence* dimension of each example
+(Ulysses), and ``pipe``/``model`` hold replicas of the batch.
+"""
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# Canonical axis names, outermost first.
+PIPE_AXIS = "pipe"
+DATA_AXIS = "data"
+EXPERT_AXIS = "expert"
+SEQUENCE_AXIS = "sequence"
+MODEL_AXIS = "model"
+MESH_AXES = (PIPE_AXIS, DATA_AXIS, EXPERT_AXIS, SEQUENCE_AXIS, MODEL_AXIS)
+
+# Axis set that jointly shards the batch dimension (DP world).
+BATCH_AXES = (DATA_AXIS, EXPERT_AXIS)
+# Axis that ZeRO partitions parameters/optimizer state over.
+ZERO_AXES = (DATA_AXIS,)
+
+
+class Topology:
+    """A named-axis device mesh with DeepSpeed-style size queries."""
+
+    def __init__(
+        self,
+        data: int = 0,
+        model: int = 1,
+        pipe: int = 1,
+        sequence: int = 1,
+        expert: int = 1,
+        devices: Optional[Sequence] = None,
+    ):
+        if devices is None:
+            devices = jax.devices()
+        n = len(devices)
+        fixed = model * pipe * sequence * expert
+        if n % fixed != 0:
+            raise ValueError(
+                f"device count {n} not divisible by model*pipe*sequence*expert={fixed}"
+            )
+        if data in (0, None):
+            data = n // fixed
+        if data * fixed != n:
+            raise ValueError(
+                f"mesh sizes pipe={pipe} data={data} expert={expert} sequence={sequence} "
+                f"model={model} do not multiply to device count {n}"
+            )
+        self.sizes = {
+            PIPE_AXIS: pipe,
+            DATA_AXIS: data,
+            EXPERT_AXIS: expert,
+            SEQUENCE_AXIS: sequence,
+            MODEL_AXIS: model,
+        }
+        shape = tuple(self.sizes[a] for a in MESH_AXES)
+        device_array = np.asarray(devices).reshape(shape)
+        self.mesh = Mesh(device_array, MESH_AXES)
+
+    # ---- reference groups.py-style queries ----
+    @property
+    def world_size(self) -> int:
+        return int(np.prod([self.sizes[a] for a in MESH_AXES]))
+
+    def axis_size(self, axis: str) -> int:
+        return self.sizes[axis]
+
+    @property
+    def dp_world_size(self) -> int:
+        """Data-parallel world (batch shards): data × expert axes."""
+        return self.sizes[DATA_AXIS] * self.sizes[EXPERT_AXIS]
+
+    @property
+    def data_parallel_size(self) -> int:
+        return self.sizes[DATA_AXIS]
+
+    @property
+    def model_parallel_size(self) -> int:
+        return self.sizes[MODEL_AXIS]
+
+    tensor_parallel_size = model_parallel_size
+
+    @property
+    def pipe_parallel_size(self) -> int:
+        return self.sizes[PIPE_AXIS]
+
+    @property
+    def sequence_parallel_size(self) -> int:
+        return self.sizes[SEQUENCE_AXIS]
+
+    @property
+    def expert_parallel_size(self) -> int:
+        return self.sizes[EXPERT_AXIS]
+
+    # ---- sharding constructors ----
+    def sharding(self, *spec) -> NamedSharding:
+        """NamedSharding over this mesh; spec entries are axis names/None/tuples."""
+        return NamedSharding(self.mesh, PartitionSpec(*spec))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    def batch_sharding(self, extra_leading: Tuple = ()) -> NamedSharding:
+        """Sharding for a [batch, ...] array: batch over data×expert."""
+        return NamedSharding(self.mesh, PartitionSpec(*extra_leading, BATCH_AXES))
+
+    def __repr__(self):
+        live = {a: s for a, s in self.sizes.items() if s > 1}
+        return f"Topology(world={self.world_size}, {live or 'single-device'})"
+
+
+_TOPOLOGY: Optional[Topology] = None
+
+
+def set_topology(topo: Topology):
+    global _TOPOLOGY
+    _TOPOLOGY = topo
+
+
+def get_topology() -> Topology:
+    global _TOPOLOGY
+    if _TOPOLOGY is None:
+        _TOPOLOGY = Topology()
+    return _TOPOLOGY
+
+
+def reset_topology():
+    global _TOPOLOGY
+    _TOPOLOGY = None

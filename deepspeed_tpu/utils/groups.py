@@ -1,0 +1,115 @@
+"""Parallel-group query API.
+
+Mirror of the reference ``deepspeed/utils/groups.py`` query surface
+(``_get_data_parallel_world_size`` etc., groups.py:57-759). On TPU the
+"groups" are named mesh axes of the global :class:`Topology`; the rank-list
+algebra (``_get_expert_parallel_ranks`` groups.py:315) is subsumed by the
+mesh's coordinate system.
+"""
+
+from deepspeed_tpu.parallel.topology import (
+    DATA_AXIS,
+    EXPERT_AXIS,
+    MODEL_AXIS,
+    PIPE_AXIS,
+    SEQUENCE_AXIS,
+    get_topology,
+)
+
+_mesh_device = None
+
+
+def initialize(ep_size=1, mpu=None):
+    """Reference groups.initialize — EP groups are created lazily from the
+    mesh's expert axis; nothing to materialize here."""
+
+
+# ---- world sizes ----
+def get_data_parallel_world_size():
+    return get_topology().dp_world_size
+
+
+def get_model_parallel_world_size():
+    return get_topology().model_parallel_size
+
+
+get_tensor_model_parallel_world_size = get_model_parallel_world_size
+
+
+def get_pipe_parallel_world_size():
+    return get_topology().pipe_parallel_size
+
+
+def get_sequence_parallel_world_size():
+    return get_topology().sequence_parallel_size
+
+
+def get_expert_parallel_world_size(group_name=None):
+    return get_topology().expert_parallel_size
+
+
+def get_expert_data_parallel_world_size(group_name=None):
+    return get_topology().data_parallel_size
+
+
+def get_world_size():
+    return get_topology().world_size
+
+
+# ---- group handles: axis names stand in for torch process groups ----
+def get_data_parallel_group():
+    return DATA_AXIS
+
+
+def get_model_parallel_group():
+    return MODEL_AXIS
+
+
+get_tensor_model_parallel_group = get_model_parallel_group
+
+
+def get_pipe_parallel_group():
+    return PIPE_AXIS
+
+
+def get_sequence_parallel_group():
+    return SEQUENCE_AXIS
+
+
+def get_expert_parallel_group(group_name=None):
+    return EXPERT_AXIS
+
+
+def get_expert_data_parallel_group(group_name=None):
+    return DATA_AXIS
+
+
+def get_zero_param_intra_parallel_group():
+    """hpZ secondary-partition group (reference groups.py:702); collapses to
+    the data axis until hierarchical partitioning is configured."""
+    return DATA_AXIS
+
+
+# ---- in-trace ranks (valid inside shard_map) ----
+def get_data_parallel_rank():
+    from jax import lax
+
+    return lax.axis_index(DATA_AXIS)
+
+
+def get_model_parallel_rank():
+    from jax import lax
+
+    return lax.axis_index(MODEL_AXIS)
+
+
+def get_sequence_parallel_rank():
+    from jax import lax
+
+    return lax.axis_index(SEQUENCE_AXIS)
+
+
+def get_expert_parallel_rank(group_name=None):
+    from jax import lax
+
+    return lax.axis_index(EXPERT_AXIS)

@@ -1,0 +1,47 @@
+"""Test harness configuration.
+
+TPU-native analogue of the reference distributed test harness
+(``tests/unit/common.py`` ``DistributedTest`` + forked subprocess launch,
+common.py:134,265): instead of forking one process per rank, the whole suite
+runs single-process on a virtual 8-device CPU mesh
+(``--xla_force_host_platform_device_count=8``), which exercises real SPMD
+partitioning + collectives cluster-free, exactly like the reference's
+CPU/gloo CI lane proves the suite without GPUs.
+"""
+
+import os
+
+# Force the CPU backend with 8 virtual devices. Env vars alone are not enough
+# when site customization imports jax at interpreter start, so use the config
+# API (effective until backends are initialized).
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("DS_ACCELERATOR", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_topology():
+    """Fresh topology per test (analogue of dist-env teardown in common.py)."""
+    yield
+    from deepspeed_tpu.parallel.topology import reset_topology
+
+    reset_topology()
+
+
+@pytest.fixture
+def devices8():
+    import jax
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return devs[:8]

@@ -1,0 +1,115 @@
+"""Collective-wrapper tests over the virtual 8-device mesh
+(analogue of reference tests/unit/comm/test_dist.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+import deepspeed_tpu.comm as dist
+from deepspeed_tpu.parallel.topology import Topology, set_topology
+
+
+@pytest.fixture
+def topo(devices8):
+    t = Topology(data=8, devices=devices8)
+    set_topology(t)
+    return t
+
+
+def _run(topo, fn, x, in_spec, out_spec):
+    return shard_map(fn, mesh=topo.mesh, in_specs=in_spec, out_specs=out_spec, check_vma=False)(x)
+
+
+def test_all_reduce_sum(topo):
+    x = jnp.arange(8.0)
+    out = _run(topo, lambda v: dist.all_reduce(v, axis="data"), x, P("data"), P("data"))
+    np.testing.assert_allclose(out, jnp.full(8, x.sum()))
+
+
+def test_all_reduce_max(topo):
+    x = jnp.arange(8.0)
+    out = _run(topo, lambda v: dist.all_reduce(v, axis="data", op=dist.ReduceOp.MAX), x, P("data"), P("data"))
+    np.testing.assert_allclose(out, jnp.full(8, 7.0))
+
+
+def test_all_gather(topo):
+    x = jnp.arange(8.0)
+    out = _run(topo, lambda v: dist.all_gather(v, axis="data"), x, P("data"), P(None))
+    np.testing.assert_allclose(out, x)
+
+
+def test_reduce_scatter(topo):
+    x = jnp.ones((8, 8))
+    out = _run(topo, lambda v: dist.reduce_scatter(v, axis="data"), x, P(None, None), P("data", None))
+    np.testing.assert_allclose(out, 8 * jnp.ones((8, 8)))
+
+
+def test_all_to_all(topo):
+    # transpose of blocks: shard [8] over data, all_to_all a [8, 4] per-shard array
+    x = jnp.arange(64.0).reshape(8, 8)
+    out = _run(
+        topo,
+        lambda v: dist.all_to_all(v, axis="data", split_dim=1, concat_dim=0),
+        x,
+        P("data", None),
+        P(None, "data"),
+    )
+    np.testing.assert_allclose(out, x.T.reshape(8, 8).T)  # all_to_all of blocks == global transpose of block layout
+
+
+def test_broadcast(topo):
+    x = jnp.arange(8.0)
+    out = _run(topo, lambda v: dist.broadcast(v, src=3, axis="data"), x, P("data"), P("data"))
+    np.testing.assert_allclose(out, jnp.full(8, 3.0))
+
+
+def test_ppermute_shift(topo):
+    from deepspeed_tpu.comm.comm import send_recv_next
+
+    x = jnp.arange(8.0)
+    out = _run(topo, lambda v: send_recv_next(v, axis="data"), x, P("data"), P("data"))
+    np.testing.assert_allclose(out, jnp.array([0.0, 0, 1, 2, 3, 4, 5, 6]))
+
+
+def test_barrier(topo):
+    dist.barrier()
+
+
+def test_world_size(topo):
+    assert dist.get_world_size() == 8
+    assert dist.get_world_size("data") == 8
+
+
+def test_comms_logger_records(topo):
+    from deepspeed_tpu.comm.logging import get_comms_logger
+
+    clog = get_comms_logger()
+    clog.enabled = True
+    clog.prof_all = True
+    x = jnp.arange(8.0)
+    _run(topo, lambda v: dist.all_reduce(v, axis="data"), x, P("data"), P("data"))
+    clog.enabled = False
+    assert "all_reduce" in clog.comms_dict
+    summary = clog.log_all(print_log=False)
+    assert summary["all_reduce"]
+    clog.comms_dict.clear()
+
+
+def test_topology_2d(devices8):
+    t = Topology(data=4, model=2, devices=devices8)
+    assert t.world_size == 8
+    assert t.dp_world_size == 4
+    assert t.model_parallel_size == 2
+    x = jnp.arange(8.0)
+
+    def f(v):
+        s = dist.all_reduce(v, axis="model")
+        return dist.all_reduce(s, axis="data")
+
+    from jax import shard_map
+
+    out = shard_map(f, mesh=t.mesh, in_specs=P(("data", "model")), out_specs=P(("data", "model")))(x)
+    np.testing.assert_allclose(out, jnp.full(8, 28.0))

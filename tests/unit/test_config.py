@@ -1,0 +1,98 @@
+"""Config-system tests (analogue of reference tests/unit/runtime/test_ds_config_dict.py)."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.config_utils import ConfigError
+
+
+def test_batch_arithmetic_all_given():
+    cfg = DeepSpeedConfig.load(
+        {"train_batch_size": 16, "train_micro_batch_size_per_gpu": 4, "gradient_accumulation_steps": 2},
+        dp_world_size=2,
+    )
+    assert cfg.train_batch_size == 16
+    assert cfg.train_micro_batch_size_per_gpu == 4
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_batch_arithmetic_infer_gas():
+    cfg = DeepSpeedConfig.load(
+        {"train_batch_size": 16, "train_micro_batch_size_per_gpu": 4}, dp_world_size=2
+    )
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_batch_arithmetic_infer_train_batch():
+    cfg = DeepSpeedConfig.load(
+        {"train_micro_batch_size_per_gpu": 4, "gradient_accumulation_steps": 2}, dp_world_size=4
+    )
+    assert cfg.train_batch_size == 32
+
+
+def test_batch_arithmetic_micro_only():
+    cfg = DeepSpeedConfig.load({"train_micro_batch_size_per_gpu": 4}, dp_world_size=2)
+    assert cfg.train_batch_size == 8
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_batch_arithmetic_mismatch_raises():
+    with pytest.raises(ConfigError):
+        DeepSpeedConfig.load(
+            {"train_batch_size": 10, "train_micro_batch_size_per_gpu": 4, "gradient_accumulation_steps": 2},
+            dp_world_size=2,
+        )
+
+
+def test_no_batch_info_raises():
+    with pytest.raises(ConfigError):
+        DeepSpeedConfig.load({}, dp_world_size=1)
+
+
+def test_zero_config():
+    cfg = DeepSpeedConfig.load(
+        {
+            "train_batch_size": 8,
+            "zero_optimization": {"stage": 3, "offload_optimizer": {"device": "cpu"}},
+            "bf16": {"enabled": True},
+        },
+        dp_world_size=1,
+    )
+    assert cfg.zero_optimization.stage == 3
+    assert cfg.zero_optimization.offload_optimizer.device == "cpu"
+    assert cfg.precision_dtype == "bfloat16"
+
+
+def test_fp16_bf16_mutually_exclusive():
+    with pytest.raises(ConfigError):
+        DeepSpeedConfig.load(
+            {"train_batch_size": 8, "fp16": {"enabled": True}, "bf16": {"enabled": True}},
+            dp_world_size=1,
+        )
+
+
+def test_invalid_zero_stage():
+    with pytest.raises(ConfigError):
+        DeepSpeedConfig.load({"train_batch_size": 8, "zero_optimization": {"stage": 5}}, dp_world_size=1)
+
+
+def test_json_file_load(tmp_path):
+    p = tmp_path / "ds_config.json"
+    p.write_text(json.dumps({"train_batch_size": 4, "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}}))
+    cfg = DeepSpeedConfig.load(str(p), dp_world_size=1)
+    assert cfg.optimizer.type == "Adam"
+    assert cfg.optimizer.params["lr"] == 1e-3
+
+
+def test_duplicate_keys_raise(tmp_path):
+    p = tmp_path / "dup.json"
+    p.write_text('{"train_batch_size": 4, "train_batch_size": 8}')
+    with pytest.raises(ConfigError):
+        DeepSpeedConfig.load(str(p), dp_world_size=1)
+
+
+def test_unknown_key_warns_not_raises():
+    cfg = DeepSpeedConfig.load({"train_batch_size": 4, "no_such_key": 1}, dp_world_size=1)
+    assert cfg.train_batch_size == 4
