@@ -1,0 +1,123 @@
+"""Checkpoint save/load.
+
+Analogue of the reference checkpoint machinery (engine.save_checkpoint
+engine.py:3560, ``CheckpointEngine`` ABC runtime/checkpoint_engine/, and the
+universal-checkpoint reshape pipeline checkpoint/ds_to_universal.py).
+
+TPU-native design: checkpoints are orbax sharded array stores. Because orbax
+saves *global* arrays with their own metadata and reshards on load to
+whatever sharding the restore target declares, every checkpoint is already a
+"universal checkpoint" — resuming at a different dp/tp/pp world size is the
+default behavior, not an offline conversion (reference bolted this on via
+``ds_to_universal.py``; SURVEY.md §7 called for building it in from day one).
+
+Layout (mirrors the reference's tag-directory scheme):
+    <save_dir>/<tag>/state/...       orbax store (params/opt_state/scaler)
+    <save_dir>/<tag>/client_state.json
+    <save_dir>/latest                text file naming the newest tag
+"""
+
+import json
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+def _ocp():
+    import orbax.checkpoint as ocp
+
+    return ocp
+
+
+def save_checkpoint(save_dir, tag, params, opt_state, scaler_state, client_state, save_latest=True):
+    ocp = _ocp()
+    path = os.path.abspath(os.path.join(save_dir, str(tag)))
+    os.makedirs(path, exist_ok=True)
+    state = {"params": params, "opt_state": opt_state, "scaler_state": scaler_state}
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.join(path, "state"), state, force=True)
+    if jax.process_index() == 0:
+        with open(os.path.join(path, "client_state.json"), "w") as f:
+            json.dump(client_state, f, default=str)
+        if save_latest:
+            with open(os.path.join(save_dir, "latest"), "w") as f:
+                f.write(str(tag))
+    log_dist(f"Saved checkpoint {path}", ranks=[0])
+    return path
+
+
+def _read_latest(load_dir):
+    latest = os.path.join(load_dir, "latest")
+    if os.path.exists(latest):
+        with open(latest) as f:
+            return f.read().strip()
+    return None
+
+
+def load_checkpoint(load_dir, tag, params_template, opt_state_template=None, scaler_template=None):
+    ocp = _ocp()
+    tag = tag or _read_latest(load_dir)
+    if tag is None:
+        logger.warning(f"No 'latest' file found in {load_dir}; cannot auto-resume")
+        return None
+    path = os.path.abspath(os.path.join(load_dir, str(tag)))
+    if not os.path.exists(os.path.join(path, "state")):
+        logger.warning(f"Checkpoint {path} not found")
+        return None
+    target = {
+        "params": params_template,
+        "opt_state": opt_state_template,
+        "scaler_state": scaler_template,
+    }
+    # Restore against abstract shardings of the current topology: this IS the
+    # universal-checkpoint reshape (orbax reads the global layout and
+    # redistributes to the target shardings).
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(x, "sharding", None))
+        if hasattr(x, "shape")
+        else x,
+        target,
+    )
+    with ocp.StandardCheckpointer() as ckptr:
+        restored = ckptr.restore(os.path.join(path, "state"), abstract)
+    client_state = {}
+    cs_path = os.path.join(path, "client_state.json")
+    if os.path.exists(cs_path):
+        with open(cs_path) as f:
+            client_state = json.load(f)
+    log_dist(f"Loaded checkpoint {path}", ranks=[0])
+    return {
+        "params": restored["params"],
+        "opt_state": restored["opt_state"],
+        "scaler_state": restored["scaler_state"],
+        "client_state": client_state,
+        "load_path": path,
+    }
+
+
+def save_16bit_model(save_dir, save_filename, params):
+    """Consolidated single-file export (reference save_16bit_model :4135):
+    gather every shard to host, save one .npz."""
+    os.makedirs(save_dir, exist_ok=True)
+    host_params = jax.tree.map(lambda p: np.asarray(jax.device_get(p)), params)
+    flat = {}
+
+    def flatten(prefix, tree):
+        if isinstance(tree, dict):
+            for k, v in tree.items():
+                flatten(f"{prefix}.{k}" if prefix else str(k), v)
+        elif isinstance(tree, (list, tuple)):
+            for i, v in enumerate(tree):
+                flatten(f"{prefix}.{i}", v)
+        else:
+            flat[prefix] = tree
+
+    flatten("", host_params)
+    out = os.path.join(save_dir, save_filename.replace(".bin", ".npz") if save_filename.endswith(".bin") else save_filename)
+    np.savez(out, **flat)
+    log_dist(f"Saved 16-bit model to {out}", ranks=[0])
+    return out

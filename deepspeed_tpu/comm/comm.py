@@ -9,13 +9,16 @@ mesh — process groups are axis names.
 
 Two call modes:
 
-* **Traced** (inside ``jit``/``shard_map``): the functions lower to
-  ``jax.lax`` collectives (``psum``/``all_gather``/``psum_scatter``/
-  ``all_to_all``/``ppermute``) — this is the hot path; XLA schedules and
-  overlaps them (the reference hand-builds this with NCCL streams + bucketing).
-* **Eager** (host level, global arrays): used for control-plane ops (loss
-  aggregation, barriers, bootstrap); wall-clock timed and fed to the
-  CommsLogger like the reference's ``@timed_op`` wrappers (comm.py:102-135).
+* **Traced axis collectives** (``all_reduce``/``all_gather``/…): valid only
+  inside ``jit``/``shard_map`` where their named axis is bound. They lower to
+  ``jax.lax`` collectives — the hot path; XLA schedules and overlaps them
+  (the reference hand-builds this with NCCL streams + bucketing). Trace-time
+  calls are recorded by the CommsLogger with counts/bytes (device timing
+  comes from the profiler, not Python).
+* **Host control-plane ops** (``barrier``/``bcast_object_list``/
+  ``log_summary``): eager, wall-clock timed, operating on host objects or
+  global arrays — the analogue of the reference's ``@timed_op`` wrappers
+  (comm.py:102-135) for bootstrap/coordination traffic.
 """
 
 import functools
@@ -160,7 +163,13 @@ def timed_op(fn):
         if not clog.enabled:
             return fn(*args, **kwargs)
         tensor = args[0] if args else None
-        axis = kwargs.get("axis", args[1] if len(args) > 1 else DATA_AXIS)
+        # axis comes from the 'axis' kwarg or a *string* positional (broadcast/
+        # reduce put src/dst at position 1, which must not be mistaken for it)
+        axis = kwargs.get("axis")
+        if axis is None and len(args) > 1 and isinstance(args[1], str):
+            axis = args[1]
+        if axis is None:
+            axis = DATA_AXIS
         n = 1
         try:
             n = get_topology().axis_size(axis) if isinstance(axis, str) else get_topology().world_size
@@ -210,6 +219,8 @@ def all_reduce(tensor, axis=DATA_AXIS, op=ReduceOp.SUM, group=None, async_op=Fal
 
 def inference_all_reduce(tensor, axis=MODEL_AXIS, op=ReduceOp.SUM):
     """Latency-oriented allreduce for TP inference (reference comm.py:658)."""
+    if _resolve_op(op) != ReduceOp.SUM:
+        raise ValueError(f"inference_all_reduce supports SUM only, got {op!r}")
     return lax.psum(tensor, axis)
 
 
@@ -226,9 +237,13 @@ def allgather_fn(output_tensor, input_tensor, group=None, async_op=False):
 
 @timed_op
 def reduce_scatter(tensor, axis=DATA_AXIS, op=ReduceOp.SUM, group=None, async_op=False, scatter_dim=0):
-    """Reduce-scatter along scatter_dim (reference reduce_scatter_tensor/fn)."""
+    """Reduce-scatter along scatter_dim (reference reduce_scatter_tensor/fn).
+    Only SUM/AVG lower to the native psum_scatter collective."""
+    op = _resolve_op(op)
+    if op not in (ReduceOp.SUM, ReduceOp.AVG):
+        raise ValueError(f"reduce_scatter supports SUM/AVG only, got {op!r}")
     res = lax.psum_scatter(tensor, axis, scatter_dimension=scatter_dim, tiled=True)
-    if _resolve_op(op) == ReduceOp.AVG:
+    if op == ReduceOp.AVG:
         res = res / axis_size(axis)
     return res
 

@@ -1,0 +1,110 @@
+"""Experiment monitoring.
+
+Analogue of the reference ``deepspeed/monitor/`` (``MonitorMaster``
+monitor.py:30 fanning out to TensorBoard/W&B/Comet/CSV writers). Events are
+``(name, value, global_sample)`` triples (reference ``write_events``).
+"""
+
+import csv
+import os
+from typing import List, Tuple
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class Monitor:
+    def __init__(self, config):
+        self.enabled = bool(getattr(config, "enabled", False))
+
+    def write_events(self, event_list: List[Tuple]):
+        raise NotImplementedError
+
+
+class TensorBoardMonitor(Monitor):
+    """Backed by torch.utils.tensorboard (torch-cpu is available in-image)."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.summary_writer = None
+        if self.enabled:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                log_dir = os.path.join(config.output_path or "./runs", config.job_name)
+                self.summary_writer = SummaryWriter(log_dir=log_dir)
+            except Exception as e:
+                logger.warning(f"TensorBoard monitor unavailable: {e}")
+                self.enabled = False
+
+    def write_events(self, event_list, flush=True):
+        if self.summary_writer is None:
+            return
+        for name, value, step in event_list:
+            self.summary_writer.add_scalar(name, value, step)
+        if flush:
+            self.summary_writer.flush()
+
+
+class WandbMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self._wandb = None
+        if self.enabled:
+            try:
+                import wandb
+
+                wandb.init(project=config.project, group=config.group, entity=config.team)
+                self._wandb = wandb
+            except Exception as e:
+                logger.warning(f"W&B monitor unavailable: {e}")
+                self.enabled = False
+
+    def write_events(self, event_list):
+        if self._wandb is None:
+            return
+        for name, value, step in event_list:
+            self._wandb.log({name: value}, step=step)
+
+
+class csvMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self.filenames = {}
+        self.output_path = None
+        if self.enabled:
+            self.output_path = os.path.join(config.output_path or "./csv_logs", config.job_name)
+            os.makedirs(self.output_path, exist_ok=True)
+
+    def write_events(self, event_list):
+        if not self.enabled:
+            return
+        for name, value, step in event_list:
+            fname = os.path.join(self.output_path, name.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as f:
+                w = csv.writer(f)
+                if new:
+                    w.writerow(["step", name])
+                w.writerow([step, value])
+
+
+class MonitorMaster(Monitor):
+    """Fan-out to every enabled writer; rank-0 only (reference monitor.py:30)."""
+
+    def __init__(self, ds_config):
+        import jax
+
+        self.tb_monitor = TensorBoardMonitor(ds_config.tensorboard)
+        self.wandb_monitor = WandbMonitor(ds_config.wandb)
+        self.csv_monitor = csvMonitor(ds_config.csv_monitor)
+        self._rank0 = jax.process_index() == 0
+        self.enabled = self._rank0 and (
+            self.tb_monitor.enabled or self.wandb_monitor.enabled or self.csv_monitor.enabled
+        )
+
+    def write_events(self, event_list):
+        if not self.enabled:
+            return
+        for m in (self.tb_monitor, self.wandb_monitor, self.csv_monitor):
+            if m.enabled:
+                m.write_events(event_list)

@@ -1,0 +1,93 @@
+"""Muon optimizer core: Newton–Schulz momentum orthogonalization.
+
+Analogue of the reference ``runtime/zero/muon/original_muon.py`` /
+``muon_optimizer.py``: SGD-momentum whose 2-D updates are orthogonalized by a
+quintic Newton–Schulz iteration. The NS iteration is 5 matmuls of the
+parameter's own shape — ideal MXU work, done in bf16 like the reference does
+on tensor cores. Non-2D params (embeddings flattened? no — biases, norms)
+route to Adam, matching the reference's `use_muon` routing.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+NS_COEFFS = (3.4445, -4.7750, 2.0315)
+
+
+def newton_schulz_orthogonalize(g: jnp.ndarray, steps: int = 5, eps: float = 1e-7) -> jnp.ndarray:
+    """Quintic Newton–Schulz iteration producing an approximate orthogonal
+    factor of g (reference original_muon.py zeropower_via_newtonschulz5)."""
+    a, b, c = NS_COEFFS
+    transposed = g.shape[-2] > g.shape[-1]
+    x = g.astype(jnp.bfloat16)
+    if transposed:
+        x = jnp.swapaxes(x, -1, -2)
+    x = x / (jnp.linalg.norm(x) + eps)
+
+    def body(x, _):
+        gram = x @ jnp.swapaxes(x, -1, -2)
+        update = b * gram + c * (gram @ gram)
+        return a * x + update @ x, None
+
+    x, _ = jax.lax.scan(body, x, None, length=steps)
+    if transposed:
+        x = jnp.swapaxes(x, -1, -2)
+    return x.astype(g.dtype)
+
+
+class MuonState(NamedTuple):
+    momentum: any
+    adam_mu: any
+    adam_nu: any
+    count: jnp.ndarray
+
+
+def _is_matrix(p):
+    return p.ndim == 2 and min(p.shape) > 1
+
+
+def muon_transform(beta=0.95, ns_steps=5, weight_decay=0.0, adam_betas=(0.9, 0.95), eps=1e-8, adam_lr_ratio=0.1):
+    """Muon for 2-D params, Adam for the rest; lr injected at update time."""
+
+    def init(params):
+        zeros = lambda: jax.tree.map(jnp.zeros_like, params)
+        return MuonState(momentum=zeros(), adam_mu=zeros(), adam_nu=zeros(), count=jnp.zeros((), jnp.int32))
+
+    def update(grads, state, params=None, *, lr):
+        count = state.count + 1
+        b1, b2 = adam_betas
+
+        def upd(g, mom, mu, nu, p):
+            if _is_matrix(g):
+                new_mom = beta * mom + g
+                ortho = newton_schulz_orthogonalize(beta * new_mom + g, steps=ns_steps)
+                # scale to match RMS of Adam-style updates (reference 0.2*sqrt(max dim))
+                scale = 0.2 * jnp.sqrt(jnp.float32(max(g.shape)))
+                u = -lr * (ortho * scale + (weight_decay * p if weight_decay else 0.0))
+                return u, new_mom, mu, nu
+            new_mu = b1 * mu + (1 - b1) * g
+            new_nu = b2 * nu + (1 - b2) * jnp.square(g)
+            mu_hat = new_mu / (1 - b1**count.astype(jnp.float32))
+            nu_hat = new_nu / (1 - b2**count.astype(jnp.float32))
+            u = -lr * adam_lr_ratio * (mu_hat / (jnp.sqrt(nu_hat) + eps) + (weight_decay * p if weight_decay else 0.0))
+            return u, mom, new_mu, new_nu
+
+        flat_g, treedef = jax.tree_util.tree_flatten(grads)
+        flat_mom = treedef.flatten_up_to(state.momentum)
+        flat_mu = treedef.flatten_up_to(state.adam_mu)
+        flat_nu = treedef.flatten_up_to(state.adam_nu)
+        flat_p = treedef.flatten_up_to(params) if params is not None else [jnp.zeros(()) for _ in flat_g]
+        out = [upd(g, m, mu, nu, p) for g, m, mu, nu, p in zip(flat_g, flat_mom, flat_mu, flat_nu, flat_p)]
+        updates = treedef.unflatten([o[0] for o in out])
+        new_state = MuonState(
+            momentum=treedef.unflatten([o[1] for o in out]),
+            adam_mu=treedef.unflatten([o[2] for o in out]),
+            adam_nu=treedef.unflatten([o[3] for o in out]),
+            count=count,
+        )
+        return updates, new_state
+
+    return optax.GradientTransformation(init, update)

@@ -1,0 +1,190 @@
+"""Optimizer construction and mixed-precision master-weight handling.
+
+Analogue of the reference optimizer stack:
+  * basic optimizer selection (``engine._configure_basic_optimizer``
+    engine.py:1519 — Adam/AdamW/FusedAdam/CPUAdam/Lamb/Lion/Adagrad/Muon)
+  * fp32 master weights + half params (``BF16_Optimizer``
+    runtime/bf16_optimizer.py:35, ``FP16_Optimizer`` fp16/fused_optimizer.py:33)
+
+Design: a :class:`DeepSpeedOptimizer` holds an optax transformation over an
+fp32 master copy of the (possibly bf16/fp16) model params. ``init`` builds
+master + inner state; ``step`` consumes fp32 grads and returns *new half
+params* directly (not deltas — adding a bf16 delta to bf16 params would
+reintroduce rounding error the master copy exists to avoid). All of it runs
+inside jit, sharded by the ZeRO plan.
+"""
+
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from deepspeed_tpu.utils.logging import logger
+
+ADAM_OPTIMIZER = "adam"
+ADAMW_OPTIMIZER = "adamw"
+FUSED_ADAM = "fusedadam"
+CPU_ADAM = "deepspeedcpuadam"
+LAMB_OPTIMIZER = "lamb"
+FUSED_LAMB = "fusedlamb"
+LION_OPTIMIZER = "lion"
+FUSED_LION = "fusedlion"
+ADAGRAD_OPTIMIZER = "adagrad"
+SGD_OPTIMIZER = "sgd"
+MUON_OPTIMIZER = "muon"
+ONEBIT_ADAM_OPTIMIZER = "onebitadam"
+ZERO_ONE_ADAM_OPTIMIZER = "zerooneadam"
+ONEBIT_LAMB_OPTIMIZER = "onebitlamb"
+
+
+class OptState(NamedTuple):
+    master: Any  # fp32 master params (== params when training fp32)
+    inner: Any  # optax inner state over master
+
+
+class DeepSpeedOptimizer:
+    """Functional optimizer with fp32 master weights.
+
+    ``step(grads_fp32, state, params) -> (new_params, new_state)``
+    """
+
+    def __init__(self, tx: optax.GradientTransformation, name: str, defaults: dict, keep_master: bool = True):
+        self.tx = tx
+        self.name = name
+        self.defaults = dict(defaults)
+        self.keep_master = keep_master
+        self._lr = defaults.get("lr", 1e-3)
+
+    # imperative LR hook used by the reference-style schedulers
+    def set_lr(self, lr):
+        self._lr = lr
+
+    def get_lr(self):
+        return self._lr
+
+    @property
+    def param_groups(self):
+        """Minimal param_groups facade for reference-API parity."""
+        return [{"lr": self._lr, **self.defaults}]
+
+    def init(self, params) -> OptState:
+        if self.keep_master:
+            master = jax.tree.map(lambda p: p.astype(jnp.float32), params)
+        else:
+            master = params
+        return OptState(master=master, inner=self.tx.init(master))
+
+    def step(self, grads, state: OptState, params, lr):
+        """Apply one update. ``lr`` is a traced scalar (schedules never retrace)."""
+        grads32 = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        updates, new_inner = self.tx.update(grads32, state.inner, state.master, lr=lr)
+        new_master = optax.apply_updates(state.master, updates)
+        new_params = jax.tree.map(lambda m, p: m.astype(p.dtype), new_master, params)
+        return new_params, OptState(master=new_master, inner=new_inner)
+
+
+class _InjectLR:
+    """Wrap an optax factory so the scale-by-lr stage reads a runtime scalar."""
+
+    @staticmethod
+    def wrap(factory: Callable[..., optax.GradientTransformation], **kw) -> optax.GradientTransformation:
+        base = factory(learning_rate=1.0, **kw)
+
+        def init(params):
+            return base.init(params)
+
+        def update(grads, state, params=None, *, lr):
+            updates, state = base.update(grads, state, params)
+            updates = jax.tree.map(lambda u: u * lr, updates)
+            return updates, state
+
+        return optax.GradientTransformation(init, update)
+
+
+def _muon(beta=0.95, ns_steps=5, weight_decay=0.0, adam_betas=(0.9, 0.95), eps=1e-8):
+    """Momentum-orthogonalized Muon (reference runtime/zero/muon/). 2-D params
+    get Newton–Schulz-orthogonalized momentum updates (runs on the MXU);
+    others fall back to Adam, matching the reference's param routing."""
+    from deepspeed_tpu.ops.muon import muon_transform
+
+    return muon_transform(beta=beta, ns_steps=ns_steps, weight_decay=weight_decay, adam_betas=adam_betas, eps=eps)
+
+
+def build_optimizer(opt_config, precision_dtype: str = "float32") -> DeepSpeedOptimizer:
+    """Map a DeepSpeed ``optimizer`` config section to a DeepSpeedOptimizer
+    (reference engine._configure_basic_optimizer engine.py:1519)."""
+    name = (opt_config.type or ADAMW_OPTIMIZER).lower()
+    params = dict(opt_config.params or {})
+    lr = params.pop("lr", 1e-3)
+    weight_decay = params.pop("weight_decay", 0.0)
+    betas = tuple(params.pop("betas", (0.9, 0.999)))
+    eps = params.pop("eps", 1e-8)
+    adam_w_mode = params.pop("adam_w_mode", True)
+    params.pop("torch_adam", None)  # [compat] no torch on the TPU path
+    params.pop("fused", None)
+    momentum = params.pop("momentum", 0.0)
+
+    if name in (ADAM_OPTIMIZER, FUSED_ADAM, CPU_ADAM, ADAMW_OPTIMIZER, "zenflowselectiveadam"):
+        is_adamw = name == ADAMW_OPTIMIZER or adam_w_mode
+        if is_adamw:
+            tx = _InjectLR.wrap(optax.adamw, b1=betas[0], b2=betas[1], eps=eps, weight_decay=weight_decay)
+        else:
+            tx = _InjectLR.wrap(optax.adam, b1=betas[0], b2=betas[1], eps=eps)
+            if weight_decay:
+                tx = optax.chain(optax.add_decayed_weights(weight_decay), tx)
+        canonical = "adamw" if is_adamw else "adam"
+    elif name in (LAMB_OPTIMIZER, FUSED_LAMB, ONEBIT_LAMB_OPTIMIZER):
+        tx = _InjectLR.wrap(optax.lamb, b1=betas[0], b2=betas[1], eps=eps, weight_decay=weight_decay)
+        canonical = "lamb"
+    elif name in (LION_OPTIMIZER, FUSED_LION):
+        b = betas if len(betas) == 2 else (0.9, 0.99)
+        tx = _InjectLR.wrap(optax.lion, b1=b[0], b2=b[1], weight_decay=weight_decay)
+        canonical = "lion"
+    elif name == ADAGRAD_OPTIMIZER:
+        tx = _InjectLR.wrap(optax.adagrad, eps=max(eps, 1e-10))
+        canonical = "adagrad"
+    elif name == SGD_OPTIMIZER:
+        tx = _InjectLR.wrap(optax.sgd, momentum=momentum or None, nesterov=params.pop("nesterov", False))
+        if weight_decay:
+            tx = optax.chain(optax.add_decayed_weights(weight_decay), tx)
+        canonical = "sgd"
+    elif name == MUON_OPTIMIZER:
+        tx = _muon(beta=params.pop("momentum", 0.95), weight_decay=weight_decay, adam_betas=betas, eps=eps)
+        canonical = "muon"
+    elif name in (ONEBIT_ADAM_OPTIMIZER, ZERO_ONE_ADAM_OPTIMIZER):
+        from deepspeed_tpu.runtime.fp16.onebit import onebit_adam_transform
+
+        tx = onebit_adam_transform(
+            b1=betas[0],
+            b2=betas[1],
+            eps=eps,
+            weight_decay=weight_decay,
+            freeze_step=params.pop("freeze_step", 100000),
+        )
+        canonical = name
+    else:
+        raise ValueError(f"Unknown optimizer type {opt_config.type}")
+
+    logger.info(f"Using optimizer: {canonical} (lr={lr}, wd={weight_decay})")
+    opt = DeepSpeedOptimizer(tx, canonical, {"lr": lr, "betas": betas, "eps": eps, "weight_decay": weight_decay})
+    opt.set_lr(lr)
+    return opt
+
+
+def global_grad_norm(grads) -> jnp.ndarray:
+    """Global L2 norm over the whole grad pytree (reference
+    runtime/utils.py get_global_norm / clip_grad_norm_); under GSPMD a single
+    jnp reduction spans all shards."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    sq = sum(jnp.sum(jnp.square(leaf.astype(jnp.float32))) for leaf in leaves)
+    return jnp.sqrt(sq)
+
+
+def clip_by_global_norm(grads, max_norm, norm=None):
+    if norm is None:
+        norm = global_grad_norm(grads)
+    scale = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree.map(lambda g: g * scale.astype(g.dtype), grads), norm
