@@ -1,0 +1,34 @@
+"""First-class TPU model family (llama/gpt2/mixtral-style decoders).
+
+The reference reaches models through HF + injection policies
+(module_inject/containers/); the TPU build ships the architectures natively
+as pure-functional JAX with declarative sharding.
+"""
+
+from deepspeed_tpu.models.transformer import (
+    PRESETS,
+    TransformerConfig,
+    decode_step,
+    flops_per_token,
+    forward,
+    get_config,
+    init_kv_cache,
+    init_params,
+    make_loss_fn,
+    num_params,
+    param_partition_specs,
+)
+
+__all__ = [
+    "PRESETS",
+    "TransformerConfig",
+    "decode_step",
+    "flops_per_token",
+    "forward",
+    "get_config",
+    "init_kv_cache",
+    "init_params",
+    "make_loss_fn",
+    "num_params",
+    "param_partition_specs",
+]

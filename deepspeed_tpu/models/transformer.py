@@ -1,0 +1,472 @@
+"""TPU-native decoder-only transformer family (the flagship model).
+
+The reference framework wraps user torch models; the TPU build additionally
+ships a first-class model family (the analogue of the model zoo the reference
+targets through HF + module_inject containers: llama/gpt2/opt/bloom — see
+deepspeed/module_inject/containers/). One config covers:
+
+  * Llama-style: RMSNorm, RoPE, SwiGLU, grouped-query attention
+  * GPT-2-style: LayerNorm, learned positions, GELU MLP, tied embeddings
+
+TPU-first design decisions:
+  * Layer parameters are STACKED along a leading [n_layers, ...] dim and the
+    forward is a single ``lax.scan`` over layers — compile time is flat in
+    depth and XLA pipelines the layer loop.
+  * All weights live in a flat dict pytree; sharding is declared as a
+    parallel pytree of ``PartitionSpec`` (``param_partition_specs``) that
+    composes Megatron-style tensor parallelism (``model`` axis) with ZeRO
+    (``data`` axis added by runtime/zero/partition.py) — the AutoTP analogue
+    (module_inject/auto_tp.py:193) done declaratively.
+  * Activations carry ``with_sharding_constraint`` on [batch, seq, hidden]:
+    batch over data/expert, seq over sequence (Ulysses), hidden replicated.
+  * Attention dispatches to the Pallas flash kernel (ops/attention) on TPU.
+  * ``remat``: per-layer ``jax.checkpoint`` with a dots-saveable policy —
+    the activation-checkpointing analogue (runtime/activation_checkpointing/
+    checkpointing.py:488) without RNG state juggling (jax threads RNG keys).
+  * Sequence parallelism: when the mesh's ``sequence`` axis > 1 the attention
+    runs under Ulysses all-to-all (parallel/sequence/ulysses.py), scattering
+    heads and gathering sequence exactly like the reference
+    ``DistributedAttention`` (sequence/layer.py:331).
+"""
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.ops.attention import attention as attention_op
+from deepspeed_tpu.parallel.topology import (
+    BATCH_AXES,
+    MODEL_AXIS,
+    SEQUENCE_AXIS,
+    constrain,
+    get_topology,
+)
+
+DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16}
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    """Architecture config. Defaults give a Llama-style decoder."""
+
+    vocab_size: int = 32000
+    hidden_size: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: Optional[int] = None  # None → MHA; < n_heads → GQA
+    ffn_hidden_size: Optional[int] = None  # None → 4x (gelu) / 8/3x rounded (swiglu)
+    max_seq_len: int = 2048
+    norm: str = "rmsnorm"  # rmsnorm | layernorm
+    activation: str = "swiglu"  # swiglu | gelu
+    position: str = "rope"  # rope | learned
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    dtype: str = "bfloat16"
+    remat: bool = True
+    # MoE (0 → dense). When n_experts > 0 the MLP becomes a top-k gated MoE
+    # over the `expert` mesh axis (parallel/moe/).
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_loss_coef: float = 0.01
+    vocab_parallel: bool = True  # shard embedding/lm_head vocab dim on `model`
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    @property
+    def head_dim(self) -> int:
+        assert self.hidden_size % self.n_heads == 0
+        return self.hidden_size // self.n_heads
+
+    @property
+    def ffn_dim(self) -> int:
+        if self.ffn_hidden_size:
+            return self.ffn_hidden_size
+        if self.activation == "swiglu":
+            # llama-style 2/3 * 4h rounded up to a multiple of 256
+            d = int(8 * self.hidden_size / 3)
+            return ((d + 255) // 256) * 256
+        return 4 * self.hidden_size
+
+
+# Presets roughly tracking the reference's benchmark targets (BASELINE.json).
+PRESETS: Dict[str, Dict[str, Any]] = {
+    "tiny": dict(vocab_size=512, hidden_size=128, n_layers=2, n_heads=4, max_seq_len=256),
+    "gpt2-small": dict(
+        vocab_size=50257, hidden_size=768, n_layers=12, n_heads=12, max_seq_len=1024,
+        norm="layernorm", activation="gelu", position="learned", tie_embeddings=True,
+    ),
+    "llama-7b": dict(
+        vocab_size=32000, hidden_size=4096, n_layers=32, n_heads=32, max_seq_len=4096,
+        ffn_hidden_size=11008,
+    ),
+    "llama-1b": dict(
+        vocab_size=32000, hidden_size=2048, n_layers=16, n_heads=16, n_kv_heads=8,
+        max_seq_len=4096, ffn_hidden_size=5632,
+    ),
+    "mixtral-tiny": dict(
+        vocab_size=1024, hidden_size=256, n_layers=2, n_heads=4, n_kv_heads=2,
+        max_seq_len=512, n_experts=4, moe_top_k=2,
+    ),
+}
+
+
+def get_config(preset: str = "tiny", **overrides) -> TransformerConfig:
+    kw = dict(PRESETS[preset])
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+# ---------------------------------------------------------------------------
+# parameter init
+# ---------------------------------------------------------------------------
+def init_params(config: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
+    """Initialize the parameter pytree. Layer weights are stacked on a leading
+    [n_layers] dim for the scan-based forward."""
+    c = config
+    dtype = DTYPES[c.dtype]
+    h, d, nh, nkv = c.hidden_size, c.head_dim, c.n_heads, c.kv_heads
+    ffn = c.ffn_dim
+    L = c.n_layers
+    keys = iter(jax.random.split(key, 16))
+
+    def dense(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32) * (1.0 / math.sqrt(fan_in))).astype(dtype)
+
+    layers: Dict[str, Any] = {
+        "attn_norm": jnp.ones((L, h), dtype),
+        "wq": dense(next(keys), (L, h, nh * d), h),
+        "wk": dense(next(keys), (L, h, nkv * d), h),
+        "wv": dense(next(keys), (L, h, nkv * d), h),
+        "wo": dense(next(keys), (L, nh * d, h), nh * d),
+        "mlp_norm": jnp.ones((L, h), dtype),
+    }
+    if c.norm == "layernorm":
+        layers["attn_norm_b"] = jnp.zeros((L, h), dtype)
+        layers["mlp_norm_b"] = jnp.zeros((L, h), dtype)
+    if c.n_experts > 0:
+        E = c.n_experts
+        layers["router"] = dense(next(keys), (L, h, E), h)
+        layers["w_up"] = dense(next(keys), (L, E, h, ffn), h)
+        layers["w_down"] = dense(next(keys), (L, E, ffn, h), ffn)
+        if c.activation == "swiglu":
+            layers["w_gate"] = dense(next(keys), (L, E, h, ffn), h)
+    else:
+        layers["w_up"] = dense(next(keys), (L, h, ffn), h)
+        layers["w_down"] = dense(next(keys), (L, ffn, h), ffn)
+        if c.activation == "swiglu":
+            layers["w_gate"] = dense(next(keys), (L, h, ffn), h)
+
+    params: Dict[str, Any] = {
+        "embed": (jax.random.normal(next(keys), (c.vocab_size, h), jnp.float32) * 0.02).astype(dtype),
+        "final_norm": jnp.ones((h,), dtype),
+        "layers": layers,
+    }
+    if c.norm == "layernorm":
+        params["final_norm_b"] = jnp.zeros((h,), dtype)
+    if c.position == "learned":
+        params["pos_embed"] = (
+            jax.random.normal(next(keys), (c.max_seq_len, h), jnp.float32) * 0.02
+        ).astype(dtype)
+    if not c.tie_embeddings:
+        params["lm_head"] = dense(next(keys), (h, c.vocab_size), h)
+    return params
+
+
+def param_partition_specs(config: TransformerConfig) -> Dict[str, Any]:
+    """Tensor-parallel PartitionSpecs (the declarative AutoTP): Megatron
+    column/row sharding over the ``model`` axis. Leading layer-stack dim is
+    never sharded. ZeRO later adds the ``data`` axis on free dims
+    (runtime/zero/partition.py choose_zero_spec)."""
+    c = config
+    m = MODEL_AXIS
+    layers: Dict[str, Any] = {
+        "attn_norm": P(None, None),
+        "wq": P(None, None, m),  # column-parallel: shard heads
+        "wk": P(None, None, m),
+        "wv": P(None, None, m),
+        "wo": P(None, m, None),  # row-parallel
+        "mlp_norm": P(None, None),
+    }
+    if c.norm == "layernorm":
+        layers["attn_norm_b"] = P(None, None)
+        layers["mlp_norm_b"] = P(None, None)
+    if c.n_experts > 0:
+        from deepspeed_tpu.parallel.topology import EXPERT_AXIS
+
+        e = EXPERT_AXIS
+        layers["router"] = P(None, None, None)
+        layers["w_up"] = P(None, e, None, m)
+        layers["w_down"] = P(None, e, m, None)
+        if c.activation == "swiglu":
+            layers["w_gate"] = P(None, e, None, m)
+    else:
+        layers["w_up"] = P(None, None, m)
+        layers["w_down"] = P(None, m, None)
+        if c.activation == "swiglu":
+            layers["w_gate"] = P(None, None, m)
+
+    vocab_spec = P(m, None) if c.vocab_parallel else P(None, None)
+    specs: Dict[str, Any] = {
+        "embed": vocab_spec,
+        "final_norm": P(None),
+        "layers": layers,
+    }
+    if c.norm == "layernorm":
+        specs["final_norm_b"] = P(None)
+    if c.position == "learned":
+        specs["pos_embed"] = P(None, None)
+    if not c.tie_embeddings:
+        specs["lm_head"] = P(None, m) if c.vocab_parallel else P(None, None)
+    return specs
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+def _norm(x, w, b, kind, eps):
+    xf = x.astype(jnp.float32)
+    if kind == "rmsnorm":
+        y = xf * jax.lax.rsqrt(jnp.mean(jnp.square(xf), axis=-1, keepdims=True) + eps)
+    else:
+        mu = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.var(xf, axis=-1, keepdims=True)
+        y = (xf - mu) * jax.lax.rsqrt(var + eps)
+    y = y.astype(x.dtype) * w
+    if b is not None:
+        y = y + b
+    return y
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding on [b, h, s, d] given positions [b, s] or [s]."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    if positions.ndim == 1:
+        positions = positions[None, :]
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [b, s, d/2]
+    cos = jnp.cos(angles)[:, None]  # [b, 1, s, d/2]
+    sin = jnp.sin(angles)[:, None]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _act_constraint(x, seq_sharded=True):
+    """Sharding constraint for [b, s, h] activations."""
+    topo = get_topology()
+    seq = SEQUENCE_AXIS if (seq_sharded and topo.sequence_parallel_size > 1) else None
+    return constrain(x, BATCH_AXES, seq, None)
+
+
+def _attention_block(c: TransformerConfig, lp, x, positions, segment_ids, kv_cache=None):
+    """Self-attention for one layer. x: [b, s, h]."""
+    b, s, h = x.shape
+    nh, nkv, d = c.n_heads, c.kv_heads, c.head_dim
+    q = (x @ lp["wq"]).reshape(b, s, nh, d).transpose(0, 2, 1, 3)
+    k = (x @ lp["wk"]).reshape(b, s, nkv, d).transpose(0, 2, 1, 3)
+    v = (x @ lp["wv"]).reshape(b, s, nkv, d).transpose(0, 2, 1, 3)
+    if c.position == "rope":
+        q = _rope(q, positions, c.rope_theta)
+        k = _rope(k, positions, c.rope_theta)
+
+    new_cache = None
+    if kv_cache is not None:
+        # decode: append to cache along seq
+        ck, cv, clen = kv_cache  # [b, nkv, S, d], [b, nkv, S, d], scalar
+        ck = jax.lax.dynamic_update_slice_in_dim(ck, k, clen, axis=2)
+        cv = jax.lax.dynamic_update_slice_in_dim(cv, v, clen, axis=2)
+        k, v = ck, cv
+        new_cache = (ck, cv, clen + s)
+        S = ck.shape[2]
+        # causal within the new block AND bounded by the filled cache: query i
+        # (global position clen+i) sees keys at positions <= clen+i only.
+        q_glob = clen + jnp.arange(s)  # [s]
+        kpos = jnp.arange(S)  # [S]
+        mask_bias = jnp.where(kpos[None, :] <= q_glob[:, None], 0.0, -1e30).astype(jnp.float32)
+        out = attention_op(q, k, v, causal=False, bias=mask_bias[None, None])
+    else:
+        topo = get_topology()
+        if topo.sequence_parallel_size > 1:
+            from deepspeed_tpu.parallel.sequence import ulysses_attention
+
+            out = ulysses_attention(q, k, v, causal=True, segment_ids=segment_ids)
+        else:
+            out = attention_op(q, k, v, causal=True, segment_ids=segment_ids)
+    out = out.transpose(0, 2, 1, 3).reshape(b, s, nh * d)
+    return out @ lp["wo"], new_cache
+
+
+def _mlp_block(c: TransformerConfig, lp, x):
+    if c.n_experts > 0:
+        from deepspeed_tpu.parallel.moe import moe_mlp
+
+        return moe_mlp(c, lp, x)
+    up = x @ lp["w_up"]
+    if c.activation == "swiglu":
+        act = jax.nn.silu(x @ lp["w_gate"]) * up
+    else:
+        act = jax.nn.gelu(up)
+    return act @ lp["w_down"], jnp.float32(0.0)
+
+
+def _layer(c: TransformerConfig, lp, x, positions, segment_ids):
+    a = _norm(x, lp["attn_norm"], lp.get("attn_norm_b"), c.norm, c.norm_eps)
+    attn_out, _ = _attention_block(c, lp, a, positions, segment_ids)
+    x = x + attn_out
+    x = _act_constraint(x)
+    m = _norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"), c.norm, c.norm_eps)
+    mlp_out, aux_loss = _mlp_block(c, lp, m)
+    x = x + mlp_out
+    x = _act_constraint(x)
+    return x, aux_loss
+
+
+def forward(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    config: TransformerConfig,
+    positions: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Full forward: tokens [b, s] int32 → (logits [b, s, vocab], aux_loss).
+
+    Layers run under ``lax.scan`` over the stacked layer pytree; with
+    ``config.remat`` each layer is rematerialized (dots saveable) so
+    activation memory is O(1) in depth.
+    """
+    c = config
+    b, s = tokens.shape
+    if positions is None:
+        positions = jnp.arange(s, dtype=jnp.int32)
+    x = params["embed"].astype(DTYPES[c.dtype])[tokens]
+    if c.position == "learned":
+        x = x + params["pos_embed"][positions][None] if positions.ndim == 1 else x + params["pos_embed"][positions]
+    x = _act_constraint(x)
+
+    layer_fn = partial(_layer, c)
+    if c.remat:
+        layer_fn = jax.checkpoint(
+            layer_fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        )
+
+    def scan_body(carry, lp):
+        x = carry
+        x, aux = layer_fn(lp, x, positions, segment_ids)
+        return x, aux
+
+    x, aux_losses = jax.lax.scan(scan_body, x, params["layers"])
+    x = _norm(x, params["final_norm"], params.get("final_norm_b"), c.norm, c.norm_eps)
+    if c.tie_embeddings:
+        logits = x @ params["embed"].astype(x.dtype).T
+    else:
+        logits = x @ params["lm_head"]
+    return logits, jnp.sum(aux_losses)
+
+
+def decode_step(params, tokens, config, kv_caches, positions):
+    """Single decode step with KV caches (inference path).
+
+    tokens: [b, t] new tokens; kv_caches: per-layer list of (k, v, len).
+    Returns (logits [b, t, vocab], new_caches). Runs layers as a Python loop
+    over unstacked weights (decode graphs are small; scan would force cache
+    stacking anyway, which we do — caches are stacked [L, ...]).
+    """
+    c = config
+    b, t = tokens.shape
+    x = params["embed"].astype(DTYPES[c.dtype])[tokens]
+    if c.position == "learned":
+        x = x + params["pos_embed"][positions]
+
+    def scan_body(x, inputs):
+        lp, cache = inputs
+        a = _norm(x, lp["attn_norm"], lp.get("attn_norm_b"), c.norm, c.norm_eps)
+        attn_out, new_cache = _attention_block(c, lp, a, positions, None, kv_cache=cache)
+        x = x + attn_out
+        m = _norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"), c.norm, c.norm_eps)
+        mlp_out, _ = _mlp_block(c, lp, m)
+        return x + mlp_out, new_cache
+
+    x, new_caches = jax.lax.scan(scan_body, x, (params["layers"], kv_caches))
+    x = _norm(x, params["final_norm"], params.get("final_norm_b"), c.norm, c.norm_eps)
+    if c.tie_embeddings:
+        logits = x @ params["embed"].astype(x.dtype).T
+    else:
+        logits = x @ params["lm_head"]
+    return logits, new_caches
+
+
+def init_kv_cache(config: TransformerConfig, batch: int, max_len: int):
+    """Stacked per-layer KV cache pytree for decode_step."""
+    c = config
+    dtype = DTYPES[c.dtype]
+    shape = (c.n_layers, batch, c.kv_heads, max_len, c.head_dim)
+    return (
+        jnp.zeros(shape, dtype),
+        jnp.zeros(shape, dtype),
+        jnp.zeros((c.n_layers,), jnp.int32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+def make_loss_fn(config: TransformerConfig):
+    """Causal-LM loss over a batch dict {'input_ids': [b, s] (, 'labels',
+    'segment_ids', 'positions')}. Next-token prediction; labels default to
+    input_ids shifted. Matches the engine's loss_fn(params, batch) contract."""
+
+    def loss_fn(params, batch):
+        tokens = batch["input_ids"]
+        labels = batch.get("labels")
+        mask = batch.get("loss_mask")
+        if labels is None:
+            labels = tokens[:, 1:]
+            inputs = tokens[:, :-1]
+            if mask is not None and mask.shape[1] == tokens.shape[1]:
+                mask = mask[:, 1:]  # align with shifted labels
+        else:
+            inputs = tokens
+        logits, aux = forward(
+            params,
+            inputs,
+            config,
+            positions=batch.get("positions"),
+            segment_ids=batch.get("segment_ids"),
+        )
+        logits = logits.astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        if mask is not None:
+            loss = -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        else:
+            loss = -jnp.mean(ll)
+        return loss + config.moe_aux_loss_coef * aux if config.n_experts > 0 else loss
+
+    return loss_fn
+
+
+def num_params(params) -> int:
+    return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
+
+
+def flops_per_token(config: TransformerConfig, seq_len: Optional[int] = None) -> float:
+    """Approximate training FLOPs per token (6ND rule + attention term)."""
+    c = config
+    s = seq_len or c.max_seq_len
+    n_dense = (
+        c.hidden_size * (c.n_heads + 2 * c.kv_heads) * c.head_dim  # qkv
+        + c.n_heads * c.head_dim * c.hidden_size  # out proj
+        + c.hidden_size * c.ffn_dim * (3 if c.activation == "swiglu" else 2)
+    ) * c.n_layers + c.vocab_size * c.hidden_size * (1 if c.tie_embeddings else 2)
+    attn = 2 * c.n_layers * s * c.hidden_size
+    return 6.0 * (n_dense + attn / 2)

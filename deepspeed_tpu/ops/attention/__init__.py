@@ -1,0 +1,13 @@
+"""Attention ops: TPU flash attention (Pallas) with a jnp reference fallback.
+
+TPU-native replacement for the reference's attention kernel zoo
+(csrc/transformer/inference softmax/attention kernels, evoformer_attn,
+blocked/flash attention in inference/v2/kernels/ragged_ops). One public
+entry point ``attention`` dispatches to the best implementation for the
+platform; numerics are validated against ``mha_reference`` in
+tests/unit/ops/test_attention.py.
+"""
+
+from deepspeed_tpu.ops.attention.core import attention, mha_reference
+
+__all__ = ["attention", "mha_reference"]

@@ -1,0 +1,106 @@
+"""Core attention dispatch.
+
+``attention(q, k, v, causal=..., segment_ids=...)`` picks the best backend:
+  * TPU: Pallas flash attention (ops/attention/flash_pallas.py) when shapes
+    allow tiling onto the MXU (head_dim and block sizes aligned),
+  * otherwise: a numerically-stable jnp implementation that XLA fuses well.
+
+Shapes follow the TPU-friendly layout [batch, num_heads, seq, head_dim]
+(q) / [batch, num_kv_heads, seq, head_dim] (k, v); grouped-query attention
+(num_heads a multiple of num_kv_heads) is handled in all backends.
+
+Reference parity: the fused softmax/attention CUDA ops of
+csrc/transformer/inference/csrc/pt_binding.cpp (softmax_context etc.) and the
+blocked flash kernels of deepspeed/inference/v2/kernels/ragged_ops.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
+    """Expand kv heads for grouped-query attention: [b, h_kv, s, d] -> [b, h, s, d]."""
+    if n_rep == 1:
+        return k
+    b, h_kv, s, d = k.shape
+    return jnp.broadcast_to(k[:, :, None], (b, h_kv, n_rep, s, d)).reshape(b, h_kv * n_rep, s, d)
+
+
+def mha_reference(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,
+    bias: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Numerically-stable reference attention in jnp (fp32 softmax).
+
+    q: [b, h, sq, d]; k, v: [b, h_kv, sk, d]. Returns [b, h, sq, d].
+    """
+    b, h, sq, d = q.shape
+    h_kv = k.shape[1]
+    k = _repeat_kv(k, h // h_kv)
+    v = _repeat_kv(v, h // h_kv)
+    scale = scale if scale is not None else (1.0 / (d ** 0.5))
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    sk = k.shape[2]
+    if causal:
+        # offset so the last q position attends to all sk keys (decode-friendly)
+        q_pos = jnp.arange(sq)[:, None] + (sk - sq)
+        k_pos = jnp.arange(sk)[None, :]
+        mask = q_pos >= k_pos
+        logits = jnp.where(mask[None, None], logits, jnp.float32(-1e30))
+    if segment_ids is not None:
+        # segment_ids: [b, s] per position; requires sq == sk (training path)
+        seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
+        logits = jnp.where(seg_mask[:, None], logits, jnp.float32(-1e30))
+    weights = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights.astype(v.dtype), v)
+
+
+@functools.lru_cache(maxsize=1)
+def _flash_available() -> bool:
+    if jax.default_backend() != "tpu":
+        return False
+    try:
+        from deepspeed_tpu.ops.attention import flash_pallas  # noqa: F401
+
+        return True
+    except Exception:
+        return False
+
+
+def attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,
+    bias: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+    impl: Optional[str] = None,
+) -> jax.Array:
+    """Dispatching attention entry point. ``impl`` forces 'flash' or 'reference'."""
+    d = q.shape[-1]
+    sq, sk = q.shape[2], k.shape[2]
+    use_flash = impl == "flash" or (
+        impl is None
+        and _flash_available()
+        and bias is None
+        and d in (64, 128, 256)
+        and sq % 128 == 0
+        and sk % 128 == 0
+        and sq == sk  # self-attention training path; decode uses reference
+    )
+    if use_flash:
+        from deepspeed_tpu.ops.attention.flash_pallas import flash_attention
+
+        return flash_attention(q, k, v, causal=causal, segment_ids=segment_ids, scale=scale)
+    return mha_reference(q, k, v, causal=causal, segment_ids=segment_ids, bias=bias, scale=scale)

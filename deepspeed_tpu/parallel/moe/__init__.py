@@ -1,0 +1,24 @@
+"""Mixture-of-Experts, TPU-native expert parallelism.
+
+Reference: ``MoE`` (deepspeed/moe/layer.py:17), ``MOELayer.forward``
+(moe/sharded_moe.py:589 — gate → dispatch einsum → all-to-all → expert →
+all-to-all → combine), ``TopKGate`` (:452) with top1/top2/topk gating
+(:183,290,374), capacity factor, jitter, random-token-selection, drop-tokens.
+
+TPU-first: the dispatch/combine einsums ARE the reference's form (it took
+them from GShard/Mesh-TF, which were TPU designs). The explicit
+``all_to_all_single`` calls become a sharding round-trip: expert-capacity
+buffers constrained to the ``expert`` mesh axis make GSPMD emit the
+all-to-all over ICI. Static capacity keeps every shape compile-time constant.
+"""
+
+from deepspeed_tpu.parallel.moe.sharded_moe import (
+    MoE,
+    TopKGate,
+    moe_mlp,
+    top1gating,
+    top2gating,
+    topkgating,
+)
+
+__all__ = ["MoE", "TopKGate", "moe_mlp", "top1gating", "top2gating", "topkgating"]

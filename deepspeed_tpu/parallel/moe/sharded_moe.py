@@ -1,0 +1,227 @@
+"""Top-k gated MoE with capacity-padded einsum dispatch.
+
+Semantics match the reference gate functions (moe/sharded_moe.py):
+  * capacity = ceil(tokens_per_expert * capacity_factor) (:120 _capacity)
+  * top1gating (:183): optional jitter noise, load-balancing aux loss
+    l_aux = E * mean(gate_prob_per_expert) . mean(token_fraction_per_expert)
+  * top2gating (:290): second expert with normalized weights
+  * topkgating (:374): general k, capacity-aware token dropping
+  * tokens over capacity are dropped (their combine weights zero out)
+
+Dispatch uses the GShard einsum form the reference itself adopted
+(sharded_moe.py:589): dispatch_mask [s, e, c] one-hot scatters tokens into
+[e, c, m] buffers; expert compute runs with e sharded over the ``expert``
+mesh axis (GSPMD inserts the all-to-all); combine_weights gather back.
+"""
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.parallel.topology import EXPERT_AXIS, MODEL_AXIS, constrain, get_topology
+
+
+def _capacity(num_tokens: int, num_experts: int, capacity_factor: float, min_capacity: int = 4) -> int:
+    """Reference _capacity (sharded_moe.py:167): ceil(tokens * cf / experts)."""
+    cap = math.ceil(num_tokens * capacity_factor / num_experts)
+    return max(cap, min_capacity)
+
+
+def _one_hot(x, n):
+    return jax.nn.one_hot(x, n, dtype=jnp.float32)
+
+
+def _position_in_expert(expert_mask: jax.Array) -> jax.Array:
+    """Cumulative position of each token within its chosen expert.
+    expert_mask: [s, e] one-hot. Returns [s, e] positions (0-based)."""
+    return jnp.cumsum(expert_mask, axis=0) - expert_mask
+
+
+def top1gating(
+    logits: jax.Array,
+    capacity_factor: float = 1.0,
+    min_capacity: int = 4,
+    noisy_gate_policy: Optional[str] = None,
+    rng: Optional[jax.Array] = None,
+    drop_tokens: bool = True,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Reference top1gating (sharded_moe.py:183).
+
+    logits: [s, e]. Returns (l_aux, combine_weights [s,e,c], dispatch_mask
+    [s,e,c], exp_counts [e]).
+    """
+    s, e = logits.shape
+    # drop_tokens=False must keep every token: capacity becomes the static
+    # worst case (all tokens to one expert). The reference grows capacity to
+    # max(exp_counts) at runtime (sharded_moe.py:215); under jit shapes are
+    # static, so the worst-case bound is the shape-safe equivalent.
+    c = s if not drop_tokens else _capacity(s, e, capacity_factor, min_capacity)
+    if noisy_gate_policy == "RSample" and rng is not None:
+        logits_w_noise = logits + jax.random.gumbel(rng, logits.shape, logits.dtype)
+    else:
+        logits_w_noise = logits
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    indices1 = jnp.argmax(logits_w_noise, axis=-1)  # [s]
+    mask1 = _one_hot(indices1, e)  # [s, e]
+
+    exp_counts = jnp.sum(mask1, axis=0)
+    # load-balancing loss (sharded_moe.py:249): E * <gates_e> . <frac_e>
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    l_aux = jnp.sum(me * ce) * e
+
+    locations1 = _position_in_expert(mask1)  # [s, e]
+    if drop_tokens:
+        mask1 = mask1 * (locations1 < c).astype(mask1.dtype)
+    pos = jnp.sum(locations1 * mask1, axis=-1).astype(jnp.int32)  # [s]
+
+    gates1 = jnp.sum(gates * mask1, axis=-1)  # [s] gate value of kept tokens
+    combine = gates1[:, None, None] * mask1[:, :, None] * _one_hot(pos, c)[:, None, :]
+    dispatch = combine > 0
+    return l_aux, combine, dispatch, exp_counts
+
+
+def top2gating(
+    logits: jax.Array,
+    capacity_factor: float = 1.0,
+    min_capacity: int = 4,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Reference top2gating (sharded_moe.py:290): capacity 2·cf·s/e, which
+    topkgating's k-token scaling (_capacity(s·k, e, cf)) already yields."""
+    return topkgating(logits, k=2, capacity_factor=capacity_factor, min_capacity=min_capacity)
+
+
+def topkgating(
+    logits: jax.Array,
+    k: int,
+    capacity_factor: float = 1.0,
+    min_capacity: int = 4,
+    drop_tokens: bool = True,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Reference topkgating (sharded_moe.py:374): general top-k with
+    normalized combine weights and per-expert capacity dropping."""
+    s, e = logits.shape
+    c = s * k if not drop_tokens else _capacity(s * k, e, capacity_factor, min_capacity)
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # [s, e]
+
+    topk_vals, topk_idx = jax.lax.top_k(gates, k)  # [s, k]
+
+    # aux loss over the top-k mask (reference: uses full mask counts)
+    mask = jnp.sum(_one_hot(topk_idx, e), axis=1)  # [s, e] (0/1, k ones)
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask, axis=0)
+    l_aux = jnp.sum(me * ce) * e / k
+    exp_counts = jnp.sum(mask, axis=0)
+
+    # positions: process the k choices in priority order so the 1st choice
+    # wins capacity slots before 2nd (reference ordering semantics). Combine
+    # weights are renormalized over SURVIVING experts only (reference top2
+    # denom over post-drop gates, sharded_moe.py:356) — accumulate raw gate
+    # values first, normalize at the end.
+    combine = jnp.zeros((s, e, c), jnp.float32)
+    base_counts = jnp.zeros((e,), jnp.float32)
+    kept_total = jnp.zeros((s,), jnp.float32)
+    for j in range(k):
+        mask_j = _one_hot(topk_idx[:, j], e)  # [s, e]
+        loc_j = _position_in_expert(mask_j) + base_counts[None, :]
+        if drop_tokens:
+            mask_j = mask_j * (loc_j < c).astype(mask_j.dtype)
+        pos_j = jnp.sum(loc_j * mask_j, axis=-1).astype(jnp.int32)
+        kept_j = jnp.sum(mask_j, axis=-1)  # [s] 1 if this choice survived
+        w_j = topk_vals[:, j] * kept_j
+        kept_total = kept_total + w_j
+        combine = combine + w_j[:, None, None] * mask_j[:, :, None] * _one_hot(pos_j, c)[:, None, :]
+        base_counts = base_counts + jnp.sum(mask_j, axis=0)
+    combine = combine / jnp.maximum(kept_total, 1e-9)[:, None, None]
+    dispatch = combine > 0
+    return l_aux, combine, dispatch, exp_counts
+
+
+class TopKGate:
+    """Object wrapper mirroring reference TopKGate (sharded_moe.py:452)."""
+
+    def __init__(
+        self,
+        k: int = 1,
+        capacity_factor: float = 1.0,
+        eval_capacity_factor: float = 1.0,
+        min_capacity: int = 4,
+        noisy_gate_policy: Optional[str] = None,
+        drop_tokens: bool = True,
+    ):
+        self.k = k
+        self.capacity_factor = capacity_factor
+        self.eval_capacity_factor = eval_capacity_factor
+        self.min_capacity = min_capacity
+        self.noisy_gate_policy = noisy_gate_policy
+        self.drop_tokens = drop_tokens
+
+    def __call__(self, logits, train: bool = True, rng=None):
+        cf = self.capacity_factor if train else self.eval_capacity_factor
+        if self.k == 1:
+            return top1gating(
+                logits, cf, self.min_capacity,
+                self.noisy_gate_policy if train else None, rng, self.drop_tokens,
+            )
+        return topkgating(logits, self.k, cf, self.min_capacity, self.drop_tokens)
+
+
+def _expert_sharded(x, spec):
+    return constrain(x, *spec)
+
+
+def moe_mlp(config, lp, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """MoE MLP block used by models/transformer.py.
+
+    lp: layer params with router [h,E], w_up [E,h,f], w_down [E,f,h]
+    (+ w_gate [E,h,f] for swiglu). x: [b, s, h].
+    Returns (out [b, s, h], aux_loss scalar).
+
+    The einsum pipeline (reference MOELayer.forward, sharded_moe.py:589):
+      gate → dispatch [s,e,c] → expert buffers [e,c,h] (GSPMD all-to-all as
+      e is expert-sharded) → per-expert MLP → combine back.
+    """
+    b, s, h = x.shape
+    tokens = x.reshape(b * s, h)
+    logits = tokens @ lp["router"]
+    l_aux, combine, dispatch, _counts = topkgating(
+        logits, k=config.moe_top_k, capacity_factor=config.moe_capacity_factor
+    )
+    # dispatch: [t, e, c] bool; tokens: [t, h] → expert buffers [e, c, h]
+    expert_in = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), tokens)
+    expert_in = _expert_sharded(expert_in, P(EXPERT_AXIS, None, None))
+
+    # per-expert FFN, e sharded over the expert axis, f over model axis
+    up = jnp.einsum("ech,ehf->ecf", expert_in, lp["w_up"])
+    if config.activation == "swiglu":
+        gate = jnp.einsum("ech,ehf->ecf", expert_in, lp["w_gate"])
+        act = jax.nn.silu(gate) * up
+    else:
+        act = jax.nn.gelu(up)
+    act = _expert_sharded(act, P(EXPERT_AXIS, None, MODEL_AXIS))
+    expert_out = jnp.einsum("ecf,efh->ech", act, lp["w_down"])
+    expert_out = _expert_sharded(expert_out, P(EXPERT_AXIS, None, None))
+
+    # combine back to tokens (reverse all-to-all via resharding)
+    out = jnp.einsum("tec,ech->th", combine.astype(x.dtype), expert_out)
+    return out.reshape(b, s, h), l_aux
+
+
+class MoE:
+    """API-parity layer object (reference deepspeed/moe/layer.py:17): wraps an
+    expert MLP param set and exposes forward(x) -> (out, l_aux, exp_counts).
+
+    For the functional training path prefer building the model with
+    ``TransformerConfig(n_experts=...)`` which routes through ``moe_mlp``.
+    """
+
+    def __init__(self, config, layer_params):
+        self.config = config
+        self.lp = layer_params
+
+    def __call__(self, x):
+        out, l_aux = moe_mlp(self.config, self.lp, x)
+        return out, l_aux, None

@@ -1,0 +1,37 @@
+"""Sequence parallelism (Ulysses), TPU-native.
+
+Reference: ``DistributedAttention`` (deepspeed/sequence/layer.py:331) —
+all-to-all that scatters attention heads and gathers sequence before the
+attention kernel, then the inverse after (``single_all_to_all`` :221,
+``_SeqAllToAll`` autograd :277). The HF-generic ALST variant
+(runtime/sequence_parallel/ulysses_sp.py:49) adds dataloader sharding and
+tiled MLP/logits compute.
+
+TPU-first: the all-to-all is *declared, not written*. Activations enter
+sharded [b, h, s/SP, d] on the ``sequence`` axis; a
+``with_sharding_constraint`` to [b, h/SP, s, d] makes GSPMD emit exactly the
+head-scatter/seq-gather all-to-all over ICI, and the inverse constraint after
+attention emits the reverse. Gradients get the transposed collectives
+automatically — no autograd function needed. Uneven heads (sequence/layer.py
+:111) are handled by XLA's general all-to-all lowering.
+"""
+
+from deepspeed_tpu.parallel.sequence.ulysses import (
+    UlyssesAttention,
+    ulysses_attention,
+    shard_batch_along_sequence,
+)
+from deepspeed_tpu.parallel.sequence.tiled import (
+    tiled_compute,
+    tiled_mlp,
+    tiled_logits_loss,
+)
+
+__all__ = [
+    "UlyssesAttention",
+    "ulysses_attention",
+    "shard_batch_along_sequence",
+    "tiled_compute",
+    "tiled_mlp",
+    "tiled_logits_loss",
+]

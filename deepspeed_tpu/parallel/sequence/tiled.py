@@ -1,0 +1,82 @@
+"""Tiled compute along the sequence axis (ALST memory reducers).
+
+Reference: ``SequenceTiledCompute`` (runtime/sequence_parallel/ulysses_sp.py
+:669), ``TiledMLP`` (:838), ``TiledFusedLogitsLoss`` (:960) — autograd
+functions that chunk the sequence dim so MLP/logits activations never
+materialize for the full sequence.
+
+TPU-first: a ``lax.scan`` over sequence tiles under ``jax.checkpoint`` gives
+the same activation-memory bound, and XLA pipelines the tile loop. No custom
+VJPs needed — scan differentiates tile-by-tile.
+"""
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def tiled_compute(fn: Callable, x: jax.Array, num_tiles: int, axis: int = 1) -> jax.Array:
+    """Apply ``fn`` over ``num_tiles`` chunks of ``x`` along ``axis``.
+
+    fn must be shape-preserving on the tiled axis (elementwise over sequence,
+    like an MLP applied per position). Activation memory is 1/num_tiles of
+    the untiled call; backward rematerializes per tile.
+    """
+    size = x.shape[axis]
+    if num_tiles <= 1 or size % num_tiles != 0:
+        return fn(x)
+    x_t = jnp.moveaxis(x, axis, 0)
+    tiles = x_t.reshape((num_tiles, size // num_tiles) + x_t.shape[1:])
+
+    @jax.checkpoint
+    def body(_, tile):
+        # tile is [chunk, ...] in axis-0 layout; restore the caller's layout
+        # for fn, then move back for stacking.
+        out = fn(jnp.moveaxis(tile, 0, axis))
+        return None, jnp.moveaxis(out, axis, 0)
+
+    _, out = jax.lax.scan(body, None, tiles)
+    out = out.reshape((size,) + x_t.shape[1:])
+    return jnp.moveaxis(out, 0, axis)
+
+
+def tiled_mlp(mlp_fn: Callable, x: jax.Array, num_tiles: int = 4) -> jax.Array:
+    """Reference TiledMLP (ulysses_sp.py:838): shard the [b, s, h] input into
+    sequence tiles and run the MLP per tile."""
+    return tiled_compute(mlp_fn, x, num_tiles, axis=1)
+
+
+def tiled_logits_loss(
+    loss_of_logits: Callable,
+    hidden: jax.Array,
+    lm_head: jax.Array,
+    labels: jax.Array,
+    num_tiles: int = 8,
+):
+    """Reference TiledFusedLogitsLoss (ulysses_sp.py:960): never materialize
+    [b, s, vocab] logits — compute the loss per sequence tile and reduce.
+
+    loss_of_logits(logits, labels) -> (sum_loss, count)
+    Returns mean loss over all positions.
+    """
+    b, s, h = hidden.shape
+    if num_tiles <= 1 or s % num_tiles != 0:
+        logits = hidden @ lm_head
+        total, count = loss_of_logits(logits, labels)
+        return total / jnp.maximum(count, 1.0)
+    tile = s // num_tiles
+    hid_t = hidden.reshape(b, num_tiles, tile, h).transpose(1, 0, 2, 3)
+    lab_t = labels.reshape(b, num_tiles, tile).transpose(1, 0, 2)
+
+    @jax.checkpoint
+    def body(carry, xs):
+        total, count = carry
+        h_tile, l_tile = xs
+        logits = h_tile @ lm_head
+        t, c = loss_of_logits(logits, l_tile)
+        return (total + t, count + c), None
+
+    (total, count), _ = jax.lax.scan(body, (jnp.float32(0.0), jnp.float32(0.0)), (hid_t, lab_t))
+    return total / jnp.maximum(count, 1.0)

@@ -1,0 +1,107 @@
+"""Ulysses attention: head-scatter / sequence-gather via sharding constraints.
+
+The reference moves tensors through two explicit all-to-alls
+(sequence/layer.py:221 ``single_all_to_all`` pre/post attention). Here the
+same data movement is declared as a layout change and GSPMD compiles it to
+ICI all-to-alls, overlapping with attention compute where possible.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.ops.attention import attention as attention_op
+from deepspeed_tpu.parallel.topology import (
+    BATCH_AXES,
+    SEQUENCE_AXIS,
+    constrain as _topo_constrain,
+    get_topology,
+)
+
+
+def _constrain(x, spec):
+    return _topo_constrain(x, *spec)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Attention with the Ulysses layout dance.
+
+    Inputs arrive logically [b, h, s, d] with s sharded over the ``sequence``
+    mesh axis (each device holds s/SP of the sequence, all heads). The
+    constraint to head-sharded layout triggers the scatter-heads /
+    gather-sequence all-to-all; attention then sees the FULL sequence for its
+    h/SP local heads — exactly the reference semantics (sequence/layer.py:367).
+    """
+    topo = get_topology()
+    sp = topo.sequence_parallel_size
+    if sp <= 1:
+        return attention_op(q, k, v, causal=causal, segment_ids=segment_ids, scale=scale)
+
+    seq_layout = P(BATCH_AXES, None, SEQUENCE_AXIS, None)
+    head_layout = P(BATCH_AXES, SEQUENCE_AXIS, None, None)
+
+    # pre-attention all-to-all: [b, h, s/SP, d] -> [b, h/SP, s, d]
+    q = _constrain(_constrain(q, seq_layout), head_layout)
+    k = _constrain(_constrain(k, seq_layout), head_layout)
+    v = _constrain(_constrain(v, seq_layout), head_layout)
+    out = attention_op(q, k, v, causal=causal, segment_ids=segment_ids, scale=scale)
+    # post-attention inverse all-to-all back to sequence-sharded
+    return _constrain(_constrain(out, head_layout), seq_layout)
+
+
+class UlyssesAttention:
+    """Object-style wrapper mirroring the reference ``DistributedAttention``
+    (sequence/layer.py:331): wraps any local attention callable.
+
+    >>> dist_attn = UlyssesAttention(my_attention)
+    >>> out = dist_attn(q, k, v, causal=True)
+    """
+
+    def __init__(self, local_attention=None, scatter_idx: int = 1, gather_idx: int = 2):
+        # scatter_idx/gather_idx kept for API parity; the layout constants
+        # below implement the canonical (heads=1, seq=2) case.
+        self.local_attn = local_attention or attention_op
+        self.scatter_idx = scatter_idx
+        self.gather_idx = gather_idx
+
+    def __call__(self, query, key, value, *args, **kwargs):
+        topo = get_topology()
+        if topo.sequence_parallel_size <= 1:
+            return self.local_attn(query, key, value, *args, **kwargs)
+        seq_layout = P(BATCH_AXES, None, SEQUENCE_AXIS, None)
+        head_layout = P(BATCH_AXES, SEQUENCE_AXIS, None, None)
+        q = _constrain(_constrain(query, seq_layout), head_layout)
+        k = _constrain(_constrain(key, seq_layout), head_layout)
+        v = _constrain(_constrain(value, seq_layout), head_layout)
+        out = self.local_attn(q, k, v, *args, **kwargs)
+        return _constrain(_constrain(out, head_layout), seq_layout)
+
+
+def shard_batch_along_sequence(batch, seq_axis: int = 1):
+    """Device-put a host batch with its sequence dim sharded over the
+    ``sequence`` mesh axis (the UlyssesSPDataLoaderAdapter analogue,
+    runtime/sequence_parallel/ulysses_sp.py:471 — there it physically splits
+    the batch per rank; here the sharding does)."""
+    topo = get_topology()
+    mesh = topo.mesh
+
+    def put(x):
+        nd = getattr(x, "ndim", 0)
+        if nd <= seq_axis:
+            return jax.device_put(x, NamedSharding(mesh, P()))
+        spec = [None] * nd
+        spec[0] = BATCH_AXES
+        if x.shape[seq_axis] % topo.sequence_parallel_size == 0:
+            spec[seq_axis] = SEQUENCE_AXIS
+        return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+
+    return jax.tree.map(put, batch)

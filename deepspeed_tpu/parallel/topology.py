@@ -130,6 +130,19 @@ class Topology:
         return f"Topology(world={self.world_size}, {live or 'single-device'})"
 
 
+def constrain(x, *spec):
+    """``with_sharding_constraint`` over the ambient topology's mesh, degrading
+    to identity when the mesh cannot shard that way (e.g. axis missing under a
+    test mesh). Shared helper for model/MoE/sequence activation constraints."""
+    topo = get_topology()
+    try:
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(topo.mesh, PartitionSpec(*spec))
+        )
+    except ValueError:
+        return x
+
+
 _TOPOLOGY: Optional[Topology] = None
 
 

@@ -1,0 +1,71 @@
+"""Flash-attention kernel numerics vs jnp reference (interpret mode on CPU).
+Analogue of reference tests/unit/ops kernel-vs-torch numerics tests."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.attention import mha_reference
+from deepspeed_tpu.ops.attention.flash_pallas import flash_attention
+
+
+def _qkv(b=2, h=4, h_kv=None, s=256, d=64, dtype=jnp.float32, seed=0):
+    kq, kk, kv = jax.random.split(jax.random.key(seed), 3)
+    h_kv = h_kv or h
+    q = jax.random.normal(kq, (b, h, s, d), dtype)
+    k = jax.random.normal(kk, (b, h_kv, s, d), dtype)
+    v = jax.random.normal(kv, (b, h_kv, s, d), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_forward_matches_reference(causal):
+    q, k, v = _qkv()
+    out = flash_attention(q, k, v, causal, None, None, True)
+    ref = mha_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_gqa_forward():
+    q, k, v = _qkv(h=8, h_kv=2)
+    out = flash_attention(q, k, v, True, None, None, True)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_grads_match_reference(causal):
+    q, k, v = _qkv(b=1, h=2, s=128, d=64)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(jnp.square(flash_attention(q, k, v, causal, None, None, True)))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.square(mha_reference(q, k, v, causal=causal)))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b_), rtol=5e-4, atol=5e-4, err_msg=f"d{name}"
+        )
+
+
+def test_gqa_grads():
+    q, k, v = _qkv(b=1, h=4, h_kv=2, s=128, d=64)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(jnp.square(flash_attention(q, k, v, True, None, None, True)))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.square(mha_reference(q, k, v, causal=True)))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b_), rtol=5e-4, atol=5e-4, err_msg=f"d{name}"
+        )
