@@ -14,9 +14,11 @@ Design:
     block index, so programs skip fully-masked blocks (the 2× win).
   * fp32 accumulators; the MXU sees bf16 inputs with
     ``preferred_element_type=jnp.float32``.
+  * LSE is stored lane-broadcast as [b, h, s, LANES] to satisfy the TPU
+    (8, 128) tiling rule for output blocks.
   * Backward: standard flash recompute — per-block p = exp(qk·scale − lse),
-    two passes (dq over q blocks; dk/dv over kv blocks), delta = Σ do·o
-    computed outside.
+    two passes (dq over q blocks; dk/dv over kv blocks); delta = Σ do·o is
+    computed in-kernel from the saved output.
   * GQA: kv-head index map h → h // (nh/nkv); no head replication in HBM.
 
 Numerics validated against ops.attention.mha_reference in
@@ -31,10 +33,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 NEG_INF = -1e30
+LANES = 128
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq, bk):
-    # q_ref: [bq, d]; k_ref/v_ref: [s, d]; o_ref: [bq, d]; lse_ref: [bq]
+    # q_ref: [bq, d]; k_ref/v_ref: [s, d]; o_ref: [bq, d]; lse_ref: [bq, LANES]
     qi = pl.program_id(2)
     s = k_ref.shape[0]
     d = q_ref.shape[1]
@@ -75,10 +78,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq, bk):
     m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[:] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[:] = m + jnp.log(l_safe)
+    lse_ref[:] = jnp.broadcast_to((m + jnp.log(l_safe))[:, None], (bq, LANES))
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, scale, causal, bq, bk):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, *, scale, causal, bq, bk):
     qi = pl.program_id(2)
     s = k_ref.shape[0]
     d = q_ref.shape[1]
@@ -86,8 +89,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, s
 
     q = q_ref[:].astype(jnp.float32) * scale
     do = do_ref[:].astype(jnp.float32)
-    lse = lse_ref[:]
-    delta = delta_ref[:]
+    lse = lse_ref[:, 0]
+    delta = jnp.sum(do * o_ref[:].astype(jnp.float32), axis=-1)  # [bq]
     q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
 
     def body(ki, dq):
@@ -114,7 +117,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, s
 
 
 def _bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, scale, causal, bq, bk
+    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref, *, scale, causal, bq, bk
 ):
     ki = pl.program_id(2)
     sq = q_ref.shape[0]
@@ -129,8 +132,9 @@ def _bwd_dkv_kernel(
         dk, dv = carry
         q = q_ref[pl.ds(qj * bq, bq), :].astype(jnp.float32) * scale
         do = do_ref[pl.ds(qj * bq, bq), :].astype(jnp.float32)
-        lse = lse_ref[pl.ds(qj * bq, bq)]
-        delta = delta_ref[pl.ds(qj * bq, bq)]
+        o = o_ref[pl.ds(qj * bq, bq), :].astype(jnp.float32)
+        lse = lse_ref[pl.ds(qj * bq, bq), 0]
+        delta = jnp.sum(do * o, axis=-1)  # [bq]
         logits = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [bq, bk]
@@ -168,12 +172,6 @@ def _pick_block(s, target=256):
     return max(b, 1)
 
 
-def _group_index_maps(group):
-    q_map = lambda b, h, i: (b, h, i, 0)
-    kv_map = lambda b, h, i: (b, h // group, 0, 0)
-    return q_map, kv_map
-
-
 @functools.partial(
     jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6)
 )
@@ -202,31 +200,27 @@ def _flash_call(q, k, v, causal, scale, interpret):
     scale = scale if scale is not None else d ** -0.5
     bq = _pick_block(s)
     bk = _pick_block(s)
-    q_map, kv_map = _group_index_maps(group)
 
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk)
 
-    def block2(spec, imap):
-        return pl.BlockSpec(spec, imap)
-
     out, lse = pl.pallas_call(
-        # refs arrive with the leading (1, 1) block dims squeezed by index_map
+        # refs arrive with the leading (1, 1) block dims squeezed via .at
         lambda qr, kr, vr, orf, lr: kernel(
             qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], orf.at[0, 0], lr.at[0, 0]
         ),
         grid=(b, h, s // bq),
         in_specs=[
-            block2((1, 1, bq, d), q_map),
-            block2((1, 1, s, d), kv_map),
-            block2((1, 1, s, d), kv_map),
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda b_, h_, i: (b_, h_ // group, 0, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda b_, h_, i: (b_, h_ // group, 0, 0)),
         ],
         out_specs=[
-            block2((1, 1, bq, d), q_map),
-            pl.BlockSpec((1, 1, bq), lambda b_, h_, i: (b_, h_, i)),
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, bq, LANES), lambda b_, h_, i: (b_, h_, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((b, h, s), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, s, LANES), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v)
@@ -247,34 +241,32 @@ def _flash_bwd(causal, segment_ids, scale, interpret, res, g):
     scale_v = scale if scale is not None else d ** -0.5
     bq = _pick_block(s)
     bk = _pick_block(s)
-    q_map, kv_map = _group_index_maps(group)
-
-    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)  # [b, h, s]
 
     dq_kernel = functools.partial(_bwd_dq_kernel, scale=scale_v, causal=causal, bq=bq, bk=bk)
     dq = pl.pallas_call(
-        lambda qr, kr, vr, dor, lr, der, dqr: dq_kernel(
-            qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], dor.at[0, 0], lr.at[0, 0], der.at[0, 0], dqr.at[0, 0]
+        lambda qr, kr, vr, orf, dor, lr, dqr: dq_kernel(
+            qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], orf.at[0, 0], dor.at[0, 0], lr.at[0, 0],
+            dqr.at[0, 0],
         ),
         grid=(b, h, s // bq),
         in_specs=[
-            pl.BlockSpec((1, 1, bq, d), q_map),
-            pl.BlockSpec((1, 1, s, d), kv_map),
-            pl.BlockSpec((1, 1, s, d), kv_map),
-            pl.BlockSpec((1, 1, bq, d), q_map),
-            pl.BlockSpec((1, 1, bq), lambda b_, h_, i: (b_, h_, i)),
-            pl.BlockSpec((1, 1, bq), lambda b_, h_, i: (b_, h_, i)),
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda b_, h_, i: (b_, h_ // group, 0, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda b_, h_, i: (b_, h_ // group, 0, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, bq, LANES), lambda b_, h_, i: (b_, h_, i, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, bq, d), q_map),
+        out_specs=pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=interpret,
-    )(q, k, v, g, lse, delta)
+    )(q, k, v, out, g, lse)
 
     # dk/dv computed per q-head then reduced over the GQA group
     dkv_kernel = functools.partial(_bwd_dkv_kernel, scale=scale_v, causal=causal, bq=bq, bk=bk)
     dk_h, dv_h = pl.pallas_call(
-        lambda qr, kr, vr, dor, lr, der, dkr, dvr: dkv_kernel(
-            qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], dor.at[0, 0], lr.at[0, 0], der.at[0, 0],
+        lambda qr, kr, vr, orf, dor, lr, dkr, dvr: dkv_kernel(
+            qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], orf.at[0, 0], dor.at[0, 0], lr.at[0, 0],
             dkr.at[0, 0], dvr.at[0, 0],
         ),
         grid=(b, h, s // bk),
@@ -283,8 +275,8 @@ def _flash_bwd(causal, segment_ids, scale, interpret, res, g):
             pl.BlockSpec((1, 1, bk, d), lambda b_, h_, i: (b_, h_ // group, i, 0)),
             pl.BlockSpec((1, 1, bk, d), lambda b_, h_, i: (b_, h_ // group, i, 0)),
             pl.BlockSpec((1, 1, s, d), lambda b_, h_, i: (b_, h_, 0, 0)),
-            pl.BlockSpec((1, 1, s), lambda b_, h_, i: (b_, h_, 0)),
-            pl.BlockSpec((1, 1, s), lambda b_, h_, i: (b_, h_, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda b_, h_, i: (b_, h_, 0, 0)),
+            pl.BlockSpec((1, 1, s, LANES), lambda b_, h_, i: (b_, h_, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bk, d), lambda b_, h_, i: (b_, h_, i, 0)),
@@ -295,7 +287,7 @@ def _flash_bwd(causal, segment_ids, scale, interpret, res, g):
             jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
         ],
         interpret=interpret,
-    )(q, k, v, g, lse, delta)
+    )(q, k, v, out, g, lse)
 
     if group > 1:
         dk = jnp.sum(dk_h.reshape(b, h_kv, group, s, d).astype(jnp.float32), axis=2).astype(k.dtype)

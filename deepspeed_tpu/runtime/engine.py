@@ -597,6 +597,7 @@ class DeepSpeedEngine:
 
     def _after_step(self, loss, grad_norm, overflow):
         self.global_steps += 1
+        self._last_loss = loss
         self._last_grad_norm = grad_norm
         self._last_overflow = overflow
         if self.config.steps_per_print and self.global_steps % self.config.steps_per_print == 0:
