@@ -420,36 +420,67 @@ def init_kv_cache(config: TransformerConfig, batch: int, max_len: int):
 # ---------------------------------------------------------------------------
 # losses
 # ---------------------------------------------------------------------------
+def split_lm_batch(batch):
+    """Normalize a causal-LM batch dict to (inputs, labels, loss_mask,
+    positions, segment_ids); labels default to shifted input_ids."""
+    tokens = batch["input_ids"]
+    labels = batch.get("labels")
+    mask = batch.get("loss_mask")
+    if labels is None:
+        labels = tokens[:, 1:]
+        inputs = tokens[:, :-1]
+        if mask is not None and mask.shape[1] == tokens.shape[1]:
+            mask = mask[:, 1:]  # align with shifted labels
+    else:
+        inputs = tokens
+    return inputs, labels, mask, batch.get("positions"), batch.get("segment_ids")
+
+
+def embed_tokens(params, tokens, positions, config: TransformerConfig):
+    """Embedding (+ learned positions) — the model's stem, shared by the
+    dense and pipelined paths."""
+    x = params["embed"].astype(DTYPES[config.dtype])[tokens]
+    if config.position == "learned":
+        pe = params["pos_embed"][positions]
+        x = x + (pe[None] if positions.ndim == 1 else pe)
+    return x
+
+
+def nll_loss(logits, labels, mask=None):
+    """Masked next-token NLL from full logits."""
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    if mask is not None:
+        return -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return -jnp.mean(ll)
+
+
+def lm_head_loss(params, x, labels, mask, config: TransformerConfig, aux=None):
+    """Final norm → logits → masked NLL (+ MoE aux) — the model's head,
+    shared by the dense and pipelined paths."""
+    c = config
+    x = _norm(x, params["final_norm"], params.get("final_norm_b"), c.norm, c.norm_eps)
+    if c.tie_embeddings:
+        logits = x @ params["embed"].astype(x.dtype).T
+    else:
+        logits = x @ params["lm_head"]
+    loss = nll_loss(logits, labels, mask)
+    if c.n_experts > 0 and aux is not None:
+        loss = loss + c.moe_aux_loss_coef * aux
+    return loss
+
+
 def make_loss_fn(config: TransformerConfig):
     """Causal-LM loss over a batch dict {'input_ids': [b, s] (, 'labels',
-    'segment_ids', 'positions')}. Next-token prediction; labels default to
-    input_ids shifted. Matches the engine's loss_fn(params, batch) contract."""
+    'loss_mask', 'segment_ids', 'positions')}. Next-token prediction; labels
+    default to input_ids shifted. Matches the engine's loss_fn(params, batch)
+    contract."""
 
     def loss_fn(params, batch):
-        tokens = batch["input_ids"]
-        labels = batch.get("labels")
-        mask = batch.get("loss_mask")
-        if labels is None:
-            labels = tokens[:, 1:]
-            inputs = tokens[:, :-1]
-            if mask is not None and mask.shape[1] == tokens.shape[1]:
-                mask = mask[:, 1:]  # align with shifted labels
-        else:
-            inputs = tokens
-        logits, aux = forward(
-            params,
-            inputs,
-            config,
-            positions=batch.get("positions"),
-            segment_ids=batch.get("segment_ids"),
-        )
-        logits = logits.astype(jnp.float32)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-        if mask is not None:
-            loss = -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
-        else:
-            loss = -jnp.mean(ll)
+        inputs, labels, mask, positions, segment_ids = split_lm_batch(batch)
+        logits, aux = forward(params, inputs, config, positions=positions, segment_ids=segment_ids)
+        loss = nll_loss(logits, labels, mask)
         return loss + config.moe_aux_loss_coef * aux if config.n_experts > 0 else loss
 
     return loss_fn

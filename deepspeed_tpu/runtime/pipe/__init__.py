@@ -1,0 +1,57 @@
+"""Pipeline parallelism (reference runtime/pipe/ + deepspeed/pipe/)."""
+
+from deepspeed_tpu.runtime.pipe.module import (
+    LayerSpec,
+    PipelineModule,
+    TiedLayerSpec,
+    partition_balanced,
+    partition_uniform,
+)
+from deepspeed_tpu.runtime.pipe.pipeline import (
+    make_pipelined_loss_fn,
+    pipeline_apply,
+    pipeline_partition_specs,
+)
+from deepspeed_tpu.runtime.pipe.schedule import (
+    BackwardPass,
+    DataParallelSchedule,
+    ForwardPass,
+    InferenceSchedule,
+    LoadMicroBatch,
+    OptimizerStep,
+    PipeInstruction,
+    PipeSchedule,
+    RecvActivation,
+    RecvGrad,
+    ReduceGrads,
+    ReduceTiedGrads,
+    SendActivation,
+    SendGrad,
+    TrainSchedule,
+)
+
+__all__ = [
+    "LayerSpec",
+    "PipelineModule",
+    "TiedLayerSpec",
+    "partition_balanced",
+    "partition_uniform",
+    "make_pipelined_loss_fn",
+    "pipeline_apply",
+    "pipeline_partition_specs",
+    "PipeSchedule",
+    "TrainSchedule",
+    "InferenceSchedule",
+    "DataParallelSchedule",
+    "PipeInstruction",
+    "ForwardPass",
+    "BackwardPass",
+    "SendActivation",
+    "RecvActivation",
+    "SendGrad",
+    "RecvGrad",
+    "LoadMicroBatch",
+    "ReduceGrads",
+    "ReduceTiedGrads",
+    "OptimizerStep",
+]

@@ -1,0 +1,255 @@
+"""PipelineModule: user-facing stage composition & partitioning.
+
+Reference: ``PipelineModule``/``LayerSpec``/``TiedLayerSpec``
+(runtime/pipe/module.py:86,30,77) and the layer partitioner
+``_partition_layers`` (:393) with methods uniform / parameters / type:regex.
+
+TPU adaptation: layers are (init_fn, apply_fn) pairs over param pytrees
+rather than nn.Modules. Two execution modes:
+  * ``forward`` — host-sequential apply (any layer mix), used for numerics
+    references and single-stage runs;
+  * ``to_pipeline()`` — for a homogeneous layer stack (identical param
+    structure + one shared apply_fn), returns ``(stage_fn, stage_params)``
+    for the SPMD executor ``runtime/pipe/pipeline.pipeline_apply``.
+Tied layers share one param entry (the reference's tied-weight broadcast/
+allreduce becomes plain GSPMD replication — every stage reads the same array
+and the gradient psum falls out of AD).
+"""
+
+import re
+
+import jax.numpy as jnp
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+class LayerSpec:
+    """Deferred layer: built lazily at partition time (reference module.py:30)."""
+
+    def __init__(self, typename: Callable, *args, **kwargs):
+        self.typename = typename
+        self.args = args
+        self.kwargs = kwargs
+
+    def build(self):
+        return self.typename(*self.args, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerSpec({getattr(self.typename, '__name__', self.typename)})"
+
+
+class TiedLayerSpec(LayerSpec):
+    """Layer sharing params with all other layers of the same key
+    (reference module.py:77)."""
+
+    def __init__(self, key: str, typename: Callable, *args, forward_fn=None, **kwargs):
+        super().__init__(typename, *args, **kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+
+
+def partition_uniform(num_items: int, num_parts: int) -> List[int]:
+    """Balanced contiguous split bounds (len num_parts+1)."""
+    bounds = [0]
+    for p in range(1, num_parts + 1):
+        bounds.append(round(p * num_items / num_parts))
+    return bounds
+
+
+def partition_balanced(weights: Sequence[float], num_parts: int) -> List[int]:
+    """Contiguous partition minimizing the max part weight (the reference's
+    ds_utils.partition_balanced used for method='parameters'): binary search
+    on the bottleneck + greedy packing."""
+    weights = list(weights)
+    n = len(weights)
+    if num_parts >= n:
+        return list(range(n + 1)) + [n] * (num_parts - n)
+    lo = max(weights)
+    hi = sum(weights)
+
+    def feasible(cap):
+        parts, acc = 1, 0.0
+        for w in weights:
+            if acc + w > cap:
+                parts += 1
+                acc = w
+                if parts > num_parts:
+                    return False
+            else:
+                acc += w
+        return True
+
+    for _ in range(60):
+        mid = (lo + hi) / 2
+        if feasible(mid):
+            hi = mid
+        else:
+            lo = mid
+    cap = hi
+    bounds = [0]
+    acc = 0.0
+    for i, w in enumerate(weights):
+        if acc + w > cap and len(bounds) < num_parts:
+            bounds.append(i)
+            acc = w
+        else:
+            acc += w
+    bounds.append(n)
+    while len(bounds) < num_parts + 1:
+        bounds.insert(-1, bounds[-2])
+    return bounds
+
+
+class PipelineModule:
+    """Compose layers into pipeline stages.
+
+    layers: list of LayerSpec / (init_fn, apply_fn) / callables.
+    Built layers are (params_pytree, apply_fn(params, x) -> x) pairs.
+    """
+
+    def __init__(
+        self,
+        layers: Sequence,
+        num_stages: Optional[int] = None,
+        topology=None,
+        loss_fn: Optional[Callable] = None,
+        partition_method: str = "parameters",
+        seed: int = 0,
+    ):
+        from deepspeed_tpu.parallel.topology import get_topology
+
+        self.topo = topology or get_topology()
+        self.num_stages = num_stages or self.topo.pipe_parallel_size
+        self.loss_fn = loss_fn
+        self.partition_method = partition_method
+        self._specs = list(layers)
+        self._key = jax.random.key(seed)
+        self.tied_params: Dict[str, Any] = {}
+        self._build()
+        self._partition()
+
+    def _build(self):
+        self.layer_params: List[Any] = []
+        self.layer_fns: List[Callable] = []
+        self.layer_names: List[str] = []
+        keys = jax.random.split(self._key, max(len(self._specs), 1))
+        for i, spec in enumerate(self._specs):
+            if isinstance(spec, TiedLayerSpec):
+                built = spec.build()
+                params, fn = self._as_layer(built, keys[i])
+                if spec.key not in self.tied_params:
+                    self.tied_params[spec.key] = params
+                self.layer_params.append({"__tied__": spec.key})
+                self.layer_fns.append(spec.forward_fn or fn)
+                self.layer_names.append(f"tied:{spec.key}")
+            elif isinstance(spec, LayerSpec):
+                built = spec.build()
+                params, fn = self._as_layer(built, keys[i])
+                self.layer_params.append(params)
+                self.layer_fns.append(fn)
+                self.layer_names.append(getattr(spec.typename, "__name__", str(i)))
+            else:
+                params, fn = self._as_layer(spec, keys[i])
+                self.layer_params.append(params)
+                self.layer_fns.append(fn)
+                self.layer_names.append(getattr(spec, "__name__", str(i)))
+
+    @staticmethod
+    def _as_layer(obj, key):
+        """Normalize a layer object to (params, apply_fn)."""
+        if isinstance(obj, tuple) and len(obj) == 2 and callable(obj[0]) and callable(obj[1]):
+            init_fn, apply_fn = obj
+            return init_fn(key), apply_fn
+        if hasattr(obj, "init") and hasattr(obj, "apply"):
+            return obj.init(key), obj.apply
+        if callable(obj):  # parameterless layer (e.g. activation)
+            return {}, (lambda params, x, _f=obj: _f(x))
+        raise TypeError(f"Cannot interpret pipeline layer {obj!r}")
+
+    def _layer_weights(self):
+        out = []
+        for p in self.layer_params:
+            if isinstance(p, dict) and "__tied__" in p:
+                p = self.tied_params[p["__tied__"]]
+            out.append(sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(p)) or 1)
+        return out
+
+    def _partition(self):
+        n = len(self.layer_fns)
+        method = self.partition_method.lower()
+        if method == "uniform":
+            self.parts = partition_uniform(n, self.num_stages)
+        elif method == "parameters":
+            self.parts = partition_balanced(self._layer_weights(), self.num_stages)
+        elif method.startswith("type:"):
+            pat = method.split(":", 1)[1]
+            w = [1 if re.search(pat, nm, re.IGNORECASE) else 0 for nm in self.layer_names]
+            if sum(w) == 0:
+                w = [1] * n
+            self.parts = partition_balanced([x or 1e-9 for x in w], self.num_stages)
+        else:
+            raise ValueError(f"unknown partition_method {self.partition_method}")
+
+    def stage_layers(self, stage_id: int):
+        lo, hi = self.parts[stage_id], self.parts[stage_id + 1]
+        return list(range(lo, hi))
+
+    def stage_of_layer(self, layer_idx: int) -> int:
+        for s in range(self.num_stages):
+            if self.parts[s] <= layer_idx < self.parts[s + 1]:
+                return s
+        return self.num_stages - 1
+
+    def params(self):
+        """Full params pytree: per-layer list + tied table."""
+        return {"layers": self.layer_params, "tied": self.tied_params}
+
+    def forward(self, params, x):
+        """Sequential (un-pipelined) forward — the reference ``PipelineModule``
+        is also runnable as a plain module; used for numerics tests and
+        single-stage runs."""
+        for p, fn in zip(params["layers"], self.layer_fns):
+            if isinstance(p, dict) and "__tied__" in p:
+                p = params["tied"][p["__tied__"]]
+            x = fn(p, x)
+        return x
+
+    __call__ = forward
+
+    def to_pipeline(self):
+        """Stack a homogeneous layer list for the SPMD executor.
+
+        Requires every layer to share one apply_fn and identical param
+        structure (the transformer case), and len(layers) % num_stages == 0.
+        Returns (stage_fn, stage_params) for ``pipeline_apply``:
+        stage_params leaves are [num_stages, layers_per_stage, ...].
+        """
+        n = len(self.layer_fns)
+        if n == 0 or n % self.num_stages != 0:
+            raise ValueError(f"{n} layers not divisible by {self.num_stages} stages")
+        fn0 = self.layer_fns[0]
+        if any(f is not fn0 for f in self.layer_fns) or self.tied_params:
+            raise ValueError(
+                "to_pipeline() requires a homogeneous untied layer stack; "
+                "heterogeneous/tied modules run via forward() or the "
+                "transformer path (make_pipelined_loss_fn)"
+            )
+        treedef0 = jax.tree_util.tree_structure(self.layer_params[0])
+        if any(jax.tree_util.tree_structure(p) != treedef0 for p in self.layer_params):
+            raise ValueError("layer param structures differ; cannot stack")
+        stacked = jax.tree.map(lambda *ls: jnp.stack(ls), *self.layer_params)
+        lps = n // self.num_stages
+        stage_params = jax.tree.map(
+            lambda l: l.reshape((self.num_stages, lps) + l.shape[1:]), stacked
+        )
+
+        def stage_fn(params, x, *extra):
+            def body(h, lp):
+                return fn0(lp, h, *extra), None
+
+            y, _ = jax.lax.scan(body, x, params)
+            return y
+
+        return stage_fn, stage_params

@@ -1,0 +1,218 @@
+"""SPMD pipeline parallelism: microbatch rotation over the ``pipe`` mesh axis.
+
+The TPU-native execution model replacing the reference's host-driven
+instruction dispatch (``PipelineEngine._exec_schedule`` runtime/pipe/
+engine.py:1354 + ``p2p.send/recv`` runtime/pipe/p2p.py): every stage runs the
+same compiled program; activations rotate between neighbor stages with
+``jax.lax.ppermute`` (ICI neighbor exchange) inside a ``lax.scan`` whose trip
+count is ``n_micro + n_stages - 1`` (fill + steady + drain). Reverse-mode AD
+through the scan/ppermute yields the backward pipeline automatically — the
+reference's SendGrad/RecvGrad instructions are the transpose XLA derives.
+
+The pipeline body is manual only over ``pipe`` (shard_map axis_names); data/
+model/sequence axes stay in GSPMD auto mode, so ZeRO and TP compose unchanged.
+"""
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel.topology import PIPE_AXIS, Topology, get_topology
+
+
+def _tree_index(tree, i):
+    return jax.tree.map(lambda l: jax.lax.dynamic_index_in_dim(l, i, keepdims=False), tree)
+
+
+def _tree_update(tree, val, i):
+    return jax.tree.map(
+        lambda l, v: jax.lax.dynamic_update_index_in_dim(l, v, i, 0), tree, val
+    )
+
+
+def _tree_where(pred, a, b):
+    return jax.tree.map(lambda x, y: jnp.where(pred, x, y), a, b)
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stage_params: Any,
+    x_micro: Any,
+    *extra_args,
+    topo: Topology = None,
+) -> Any:
+    """Run microbatches through pipeline stages.
+
+    stage_fn(params_one_stage, x, *extra_args) -> y, where x/y are pytrees of
+    the SAME structure & shapes (the rotating state — e.g. (activations,
+    running_aux_loss)).
+    stage_params: pytree, every leaf leading dim = n_stages (sharded on pipe)
+    x_micro: pytree with leading [n_micro, ...] on every leaf.
+    Returns outputs of the last stage, leading dim [n_micro, ...].
+    """
+    topo = topo or get_topology()
+    S = topo.pipe_parallel_size
+    if S <= 1:
+        def body(carry, x):
+            p = jax.tree.map(lambda l: l[0], stage_params)
+            return carry, stage_fn(p, x, *extra_args)
+
+        _, y = jax.lax.scan(body, None, x_micro)
+        return y
+
+    leaves = jax.tree_util.tree_leaves(x_micro)
+    n_micro = leaves[0].shape[0]
+    total = n_micro + S - 1
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def per_stage(params, x_micro, *extra):
+        # params leaves: [1, ...] (this stage's slice); x_micro leaves: [n_micro, ...]
+        params = jax.tree.map(lambda l: l[0], params)
+        stage_id = jax.lax.axis_index(PIPE_AXIS)
+        is_first = stage_id == 0
+        is_last = stage_id == S - 1
+
+        state0 = jax.tree.map(lambda l: jnp.zeros_like(l[0]), x_micro)
+        out_buf0 = jax.tree.map(jnp.zeros_like, x_micro)
+
+        def body(carry, i):
+            state, out_buf = carry
+            x_i = _tree_index(x_micro, jnp.clip(i, 0, n_micro - 1))
+            inp = _tree_where(is_first, x_i, state)
+            out = stage_fn(params, inp, *extra)
+            # last stage emits microbatch i-(S-1) when in range
+            mb_out = jnp.clip(i - (S - 1), 0, n_micro - 1)
+            emit = jnp.logical_and(is_last, i >= S - 1)
+            cur = _tree_index(out_buf, mb_out)
+            new = _tree_where(emit, out, cur)
+            out_buf = _tree_update(out_buf, new, mb_out)
+            state = jax.tree.map(lambda l: jax.lax.ppermute(l, PIPE_AXIS, perm), out)
+            return (state, out_buf), None
+
+        (_, out_buf), _ = jax.lax.scan(body, (state0, out_buf0), jnp.arange(total))
+        # out_buf is valid only on the last stage; make it uniform across the
+        # pipe axis so downstream GSPMD code sees one logical value. psum of
+        # the masked buffer = broadcast from last stage.
+        out_buf = _tree_where(is_last, out_buf, jax.tree.map(jnp.zeros_like, out_buf))
+        return jax.tree.map(lambda l: jax.lax.psum(l, PIPE_AXIS), out_buf)
+
+    in_specs = (
+        jax.tree.map(lambda _: P(PIPE_AXIS), stage_params),
+        jax.tree.map(lambda _: P(), x_micro),  # replicated over pipe (data/seq stay auto)
+    ) + tuple(P() for _ in extra_args)
+    fn = jax.shard_map(
+        per_stage,
+        mesh=topo.mesh,
+        in_specs=in_specs,
+        out_specs=jax.tree.map(lambda _: P(), x_micro),
+        axis_names={PIPE_AXIS},
+        check_vma=False,
+    )
+    return fn(stage_params, x_micro, *extra_args)
+
+
+def _stack_stages(layer_tree: Any, n_stages: int) -> Any:
+    """[L, ...] stacked layer params -> [S, L/S, ...]."""
+
+    def reshape(l):
+        L = l.shape[0]
+        assert L % n_stages == 0, f"layers {L} not divisible by stages {n_stages}"
+        return l.reshape((n_stages, L // n_stages) + l.shape[1:])
+
+    return jax.tree.map(reshape, layer_tree)
+
+
+def make_pipelined_loss_fn(config, micro_batches: int, topo: Topology = None):
+    """Causal-LM loss with the transformer layer stack pipelined over ``pipe``.
+
+    Embedding and the LM head run outside the pipeline (replicated over the
+    pipe axis, sharded over data/model as usual) through the same
+    ``embed_tokens``/``lm_head_loss`` helpers as the dense path; the layer
+    scan is split into contiguous stages (the reference's uniform
+    partition_method, runtime/pipe/module.py:393). Honors labels/loss_mask/
+    positions/segment_ids batch keys and threads the MoE aux loss through the
+    rotating state.
+    """
+    from deepspeed_tpu.models import transformer as T
+
+    topo = topo or get_topology()
+    S = topo.pipe_parallel_size
+    c = config
+
+    def stage_fn(stage_layers, state, positions, segment_ids):
+        x, aux = state
+        layer = functools.partial(T._layer, c)
+        if c.remat:
+            layer = jax.checkpoint(
+                layer, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            )
+
+        def body(carry, lp):
+            h, a = carry
+            h, a_l = layer(lp, h, positions, segment_ids)
+            return (h, a + a_l), None
+
+        (x, aux), _ = jax.lax.scan(body, (x, aux), stage_layers)
+        return x, aux
+
+    def loss_fn(params, batch):
+        inputs, labels, mask, positions, segment_ids = T.split_lm_batch(batch)
+        b, s = inputs.shape
+        assert b % micro_batches == 0, f"batch {b} not divisible by micro_batches {micro_batches}"
+        if positions is None:
+            positions = jnp.arange(s, dtype=jnp.int32)
+
+        x = T.embed_tokens(params, inputs, positions, c)
+        mb = b // micro_batches
+        x_micro = x.reshape((micro_batches, mb) + x.shape[1:])
+        aux_micro = jnp.zeros((micro_batches,), jnp.float32)
+        seg_micro = (
+            segment_ids.reshape((micro_batches, mb) + segment_ids.shape[1:])
+            if segment_ids is not None
+            else None
+        )
+        stage_params = _stack_stages(params["layers"], S)
+
+        if seg_micro is None:
+            y_micro, aux_out = pipeline_apply(
+                lambda p, st, pos: stage_fn(p, st, pos, None),
+                stage_params, (x_micro, aux_micro), positions, topo=topo,
+            )
+        else:
+            # segment ids travel with their microbatch as rotating state
+            def stage_seg(p, st, pos):
+                (x, aux), seg = st[0], st[1]
+                y, a = stage_fn(p, (x, aux), pos, seg)
+                return (y, a), seg
+
+            (y_micro, aux_out), _ = pipeline_apply(
+                stage_seg, stage_params, ((x_micro, aux_micro), seg_micro), positions, topo=topo,
+            )
+
+        y = y_micro.reshape((b,) + y_micro.shape[2:])
+        # per-microbatch aux losses are means over that microbatch's tokens;
+        # average them so the scale matches the dense (one-gating-call) path
+        aux = jnp.sum(aux_out) / micro_batches
+        return T.lm_head_loss(params, y, labels, mask, c, aux=aux)
+
+    return loss_fn
+
+
+def pipeline_partition_specs(config, topo: Topology = None) -> Any:
+    """Param PartitionSpecs for the pipelined transformer: layer-stack leading
+    dim sharded over ``pipe``, composed with the TP specs."""
+    from deepspeed_tpu.models import param_partition_specs
+
+    specs = param_partition_specs(config)
+
+    def add_pipe(spec):
+        rest = tuple(spec)[1:] if len(spec) else ()
+        return P(PIPE_AXIS, *rest)
+
+    specs["layers"] = jax.tree.map(
+        add_pipe, specs["layers"], is_leaf=lambda x: isinstance(x, P)
+    )
+    return specs

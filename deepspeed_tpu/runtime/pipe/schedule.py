@@ -1,0 +1,193 @@
+"""Pipeline schedules: instruction streams for pipelined execution.
+
+Reference: ``runtime/pipe/schedule.py`` — ``PipeSchedule`` base (:189
+``TrainSchedule``, :135 ``InferenceSchedule``, :301 ``DataParallelSchedule``)
+yielding per-step ``PipeInstruction`` lists that ``PipelineEngine
+._exec_schedule`` (engine.py:1354) dispatches.
+
+On TPU the executing path is the SPMD rotation pipeline
+(runtime/pipe/pipeline.py) — one compiled program, no host instruction
+dispatch. The instruction stream remains first-class for:
+  * schedule correctness tests (1F1B ordering/liveness invariants),
+  * a future host-driven multi-slice executor over DCN,
+  * parity with the reference API (custom ``PipeSchedule`` subclasses).
+"""
+
+from typing import Iterator, List
+
+
+class PipeInstruction:
+    """Base instruction (reference schedule.py PipeInstruction)."""
+
+    def __init__(self, **kwargs):
+        self.kwargs = kwargs
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def __repr__(self):
+        args = ", ".join(f"{k}={v}" for k, v in self.kwargs.items())
+        return f"{type(self).__name__}({args})"
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.kwargs == other.kwargs
+
+
+class OptimizerStep(PipeInstruction):
+    pass
+
+
+class ReduceGrads(PipeInstruction):
+    pass
+
+
+class ReduceTiedGrads(PipeInstruction):
+    pass
+
+
+class LoadMicroBatch(PipeInstruction):
+    def __init__(self, buffer_id):
+        super().__init__(buffer_id=buffer_id)
+
+
+class BufferOpInstruction(PipeInstruction):
+    def __init__(self, buffer_id):
+        super().__init__(buffer_id=buffer_id)
+
+
+class ForwardPass(BufferOpInstruction):
+    pass
+
+
+class BackwardPass(BufferOpInstruction):
+    pass
+
+
+class SendActivation(BufferOpInstruction):
+    pass
+
+
+class RecvActivation(BufferOpInstruction):
+    pass
+
+
+class SendGrad(BufferOpInstruction):
+    pass
+
+
+class RecvGrad(BufferOpInstruction):
+    pass
+
+
+class PipeSchedule:
+    """Schedule over micro_batches for one (stage_id of stages) rank."""
+
+    def __init__(self, micro_batches: int, stages: int, stage_id: int):
+        assert 0 <= stage_id < stages
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+
+    @property
+    def is_first_stage(self):
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self):
+        return self.stage_id == self.stages - 1
+
+    def steps(self) -> Iterator[List[PipeInstruction]]:
+        raise NotImplementedError
+
+    def __iter__(self):
+        return self.steps()
+
+    def num_pipe_buffers(self) -> int:
+        return self.micro_batches
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only fill-drain (reference schedule.py:135)."""
+
+    def steps(self):
+        total = self.micro_batches + self.stages - 1
+        for step_id in range(total):
+            mb = step_id - self.stage_id
+            cmds: List[PipeInstruction] = []
+            if 0 <= mb < self.micro_batches:
+                if self.is_first_stage:
+                    cmds.append(LoadMicroBatch(mb))
+                else:
+                    cmds.append(RecvActivation(mb))
+                cmds.append(ForwardPass(mb))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(mb))
+            yield cmds
+
+    def num_pipe_buffers(self):
+        return 2
+
+
+class TrainSchedule(PipeSchedule):
+    """1F1B (reference schedule.py:189): warmup forwards, steady-state
+    alternating fwd/bwd, cooldown backwards, then grad reduce + step.
+
+    In-flight microbatches per stage never exceed ``stages - stage_id``,
+    bounding activation liveness — the property the reference schedule's
+    even/odd step arithmetic encodes."""
+
+    def steps(self):
+        M, S, s = self.micro_batches, self.stages, self.stage_id
+        warmup = min(M, S - s - 1)
+        fwd = 0
+        bwd = 0
+
+        def fwd_cmds(mb):
+            cmds = []
+            if self.is_first_stage:
+                cmds.append(LoadMicroBatch(mb))
+            else:
+                cmds.append(RecvActivation(mb))
+            cmds.append(ForwardPass(mb))
+            if not self.is_last_stage:
+                cmds.append(SendActivation(mb))
+            return cmds
+
+        def bwd_cmds(mb):
+            cmds = []
+            if not self.is_last_stage:
+                cmds.append(RecvGrad(mb))
+            cmds.append(BackwardPass(mb))
+            if not self.is_first_stage:
+                cmds.append(SendGrad(mb))
+            return cmds
+
+        # warmup forwards
+        for _ in range(warmup):
+            yield fwd_cmds(fwd)
+            fwd += 1
+        # steady state: 1F1B
+        while fwd < M:
+            yield fwd_cmds(fwd)
+            fwd += 1
+            yield bwd_cmds(bwd)
+            bwd += 1
+        # cooldown backwards
+        while bwd < M:
+            yield bwd_cmds(bwd)
+            bwd += 1
+        yield [ReduceTiedGrads(), ReduceGrads(), OptimizerStep()]
+
+    def num_pipe_buffers(self):
+        return max(2, min(self.micro_batches, self.stages - self.stage_id))
+
+
+class DataParallelSchedule(PipeSchedule):
+    """Degenerate single-stage schedule (reference schedule.py:301)."""
+
+    def steps(self):
+        for mb in range(self.micro_batches):
+            yield [LoadMicroBatch(mb), ForwardPass(mb), BackwardPass(mb)]
+        yield [ReduceGrads(), OptimizerStep()]
+
+    def num_pipe_buffers(self):
+        return 1
