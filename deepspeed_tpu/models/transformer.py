@@ -234,17 +234,13 @@ def param_partition_specs(config: TransformerConfig) -> Dict[str, Any]:
 # forward
 # ---------------------------------------------------------------------------
 def _norm(x, w, b, kind, eps):
-    xf = x.astype(jnp.float32)
+    """Delegates to the ops layer (single definition; Pallas on TPU)."""
+    from deepspeed_tpu.ops.normalization import fused_layer_norm, fused_rms_norm
+
     if kind == "rmsnorm":
-        y = xf * jax.lax.rsqrt(jnp.mean(jnp.square(xf), axis=-1, keepdims=True) + eps)
-    else:
-        mu = jnp.mean(xf, axis=-1, keepdims=True)
-        var = jnp.var(xf, axis=-1, keepdims=True)
-        y = (xf - mu) * jax.lax.rsqrt(var + eps)
-    y = y.astype(x.dtype) * w
-    if b is not None:
-        y = y + b
-    return y
+        y = fused_rms_norm(x, w, eps)
+        return y + b if b is not None else y
+    return fused_layer_norm(x, w, b if b is not None else jnp.zeros_like(w), eps)
 
 
 def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:

@@ -1,0 +1,52 @@
+"""TPU op layer (reference deepspeed/ops/ + op_builder/).
+
+Each op family ships a Pallas TPU kernel plus a jnp reference fallback and is
+registered in the OpBuilder registry so ``get_accelerator().create_op_builder``
+resolves them like the reference's JIT-compiled CUDA ops.
+"""
+
+from deepspeed_tpu.ops.op_builder import ALL_OPS, OpBuilder, PallasOpBuilder, register_op
+
+
+@register_op
+class FlashAttnBuilder(PallasOpBuilder):
+    NAME = "flash_attn"
+
+    def _build(self):
+        from deepspeed_tpu.ops.attention import attention
+
+        return attention
+
+
+@register_op
+class FusedAdamBuilder(PallasOpBuilder):
+    NAME = "fused_adam"
+
+    def _build(self):
+        from deepspeed_tpu.ops.adam import FusedAdam
+
+        return FusedAdam
+
+
+@register_op
+class QuantizerBuilder(PallasOpBuilder):
+    NAME = "quantizer"
+
+    def _build(self):
+        from deepspeed_tpu.ops import quantizer
+
+        return quantizer
+
+
+@register_op
+class FusedRMSNormBuilder(PallasOpBuilder):
+    NAME = "rms_norm"
+
+    def _build(self):
+        from deepspeed_tpu.ops.normalization import fused_rms_norm
+
+        return fused_rms_norm
+
+
+# Compatibility table (reference deepspeed.ops.__compatible_ops__)
+__compatible_ops__ = {name: True for name in ALL_OPS}

@@ -1,0 +1,12 @@
+"""Fused Adam ops (reference csrc/adam/ fused_adam multi_tensor kernel +
+cpu_adam AVX implementation, wrapped by ops/adam/{FusedAdam,DeepSpeedCPUAdam}).
+
+TPU-native: one Pallas kernel applies the whole Adam update (m, v, bias
+correction, weight decay, param write) in a single VMEM pass over each
+parameter shard — the multi-tensor-apply equivalent is the engine updating
+all leaves inside one jitted step, letting XLA batch the kernel launches.
+"""
+
+from deepspeed_tpu.ops.adam.fused_adam import FusedAdam, fused_adam_step, fused_adam_transform
+
+__all__ = ["FusedAdam", "fused_adam_step", "fused_adam_transform"]

@@ -1,0 +1,21 @@
+"""Fused normalization ops (reference csrc/transformer/inference layer_norm.cu
+/ rms_norm.cu — fused_ln, fused_rms_norm, residual-add variants).
+
+TPU-native: one Pallas VMEM pass per row block computing the statistics and
+the scaled output (optionally with residual add), with a custom VJP. A jnp
+path defines the semantics for CPU tests and XLA-fusion comparison.
+"""
+
+from deepspeed_tpu.ops.normalization.fused_norm import (
+    fused_layer_norm,
+    fused_rms_norm,
+    layer_norm_reference,
+    rms_norm_reference,
+)
+
+__all__ = [
+    "fused_layer_norm",
+    "fused_rms_norm",
+    "layer_norm_reference",
+    "rms_norm_reference",
+]

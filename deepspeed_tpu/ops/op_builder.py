@@ -39,29 +39,10 @@ class PallasOpBuilder(OpBuilder):
         raise NotImplementedError
 
 
-# Populated by kernel modules at import time via register_op.
+# Populated by the @register_op decorators in deepspeed_tpu/ops/__init__.py.
 ALL_OPS = {}
 
 
 def register_op(builder_cls):
     ALL_OPS[builder_cls.NAME] = builder_cls
     return builder_cls
-
-
-def _register_builtin_ops():
-    """Import kernel modules so their builders self-register."""
-    import importlib
-
-    for mod in (
-        "deepspeed_tpu.ops.adam.fused_adam",
-        "deepspeed_tpu.ops.attention.flash_attention",
-        "deepspeed_tpu.ops.normalization.fused_norm",
-        "deepspeed_tpu.ops.quantizer.quantizer",
-    ):
-        try:
-            importlib.import_module(mod)
-        except ImportError:
-            pass
-
-
-_register_builtin_ops()

@@ -1,0 +1,33 @@
+"""Block quantization ops (reference csrc/quantization/ + csrc/fp_quantizer/).
+
+Symmetric per-block int8/int4 quantize/dequantize (the reference's
+``quantize.cu``/``dequantize.cu``), fp8 (e4m3/e5m2) scaled casts (the
+FP6/FP8 ``fp_quantizer``), and the fused dequant-reduce used by ZeRO++ qgZ
+all-to-all gradient reduction (``quant_reduce.cu``).
+
+TPU-native: a Pallas kernel handles the hot block-quant path on TPU; a jnp
+path (used for CPU tests and as the XLA-fusable fallback) defines the
+semantics. Stochastic rounding uses the TPU PRNG in-kernel.
+"""
+
+from deepspeed_tpu.ops.quantizer.block_quant import (
+    QuantizedTensor,
+    quantize_blockwise,
+    dequantize_blockwise,
+    quantized_reduce_scatter,
+    fp8_cast,
+    fp8_uncast,
+)
+
+# reference-parity alias (runtime/comm/coalesced_collectives.py name)
+all_to_all_quant_reduce = quantized_reduce_scatter
+
+__all__ = [
+    "QuantizedTensor",
+    "quantize_blockwise",
+    "dequantize_blockwise",
+    "quantized_reduce_scatter",
+    "all_to_all_quant_reduce",
+    "fp8_cast",
+    "fp8_uncast",
+]

@@ -1,0 +1,234 @@
+"""Symmetric block quantization + quantized gradient reduction.
+
+Semantics (matching reference csrc/quantization/pt_binding.cpp ds_quantize
+symmetric path): values are grouped into fixed-size blocks; each block stores
+int8 values (int4 packed two-per-byte) and one fp32 scale = absmax/qmax.
+Dequant is ``q * scale``.
+
+ZeRO++ qgZ (quantized-gradient all-to-all, reference
+runtime/comm/coalesced_collectives.py all_to_all_quant_reduce +
+csrc/quantization/quant_reduce.cu): ``quantized_reduce_scatter`` runs inside
+a ``shard_map`` collective context — the int8 payload and fp32 scales cross
+the wire via ``lax.all_to_all`` (2× fewer bytes than fp16 grads at int8, 4×
+at packed int4), each rank dequantizes the received shards and reduces
+locally, exactly the reference pipeline.
+"""
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+_QMAX = {8: 127.0, 4: 7.0}
+
+
+class QuantizedTensor(NamedTuple):
+    values: jax.Array  # int8 payload; for bits=4, two biased nibbles per byte
+    scales: jax.Array  # fp32 per block
+    shape: tuple  # original shape
+    bits: int
+    block_size: int
+
+
+def _pad_to(x, multiple):
+    n = x.shape[0]
+    pad = (-n) % multiple
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad,) + x.shape[1:], x.dtype)])
+    return x, pad
+
+
+def _pack_int4(q: jax.Array) -> jax.Array:
+    """[-7, 7] int values → two biased nibbles per uint8 byte ([nb, block/2])."""
+    biased = (q + 7).astype(jnp.uint8)  # 0..14
+    lo, hi = biased[:, ::2], biased[:, 1::2]
+    return (lo | (hi << 4)).astype(jnp.int8)
+
+
+def _unpack_int4(packed: jax.Array) -> jax.Array:
+    u = packed.astype(jnp.uint8)
+    lo = (u & 0xF).astype(jnp.float32) - 7.0
+    hi = (u >> 4).astype(jnp.float32) - 7.0
+    nb, half = u.shape
+    return jnp.stack([lo, hi], axis=-1).reshape(nb, half * 2)
+
+
+def quantize_blockwise(
+    x: jax.Array,
+    bits: int = 8,
+    block_size: int = 2048,
+    stochastic: bool = False,
+    rng: Optional[jax.Array] = None,
+) -> QuantizedTensor:
+    """Symmetric per-block quantization. Flattens, pads to block_size."""
+    qmax = _QMAX[bits]
+    flat = x.reshape(-1).astype(jnp.float32)
+    flat, _pad = _pad_to(flat, block_size)
+    blocks = flat.reshape(-1, block_size)
+    absmax = jnp.max(jnp.abs(blocks), axis=-1, keepdims=True)
+    scales = absmax / qmax
+    inv = jnp.where(scales > 0, 1.0 / jnp.maximum(scales, 1e-30), 0.0)
+    scaled = blocks * inv
+    if stochastic and rng is not None:
+        noise = jax.random.uniform(rng, scaled.shape) - 0.5
+        q = jnp.clip(jnp.round(scaled + noise), -qmax, qmax)
+    else:
+        q = jnp.clip(jnp.round(scaled), -qmax, qmax)
+    values = _pack_int4(q) if bits == 4 else q.astype(jnp.int8)
+    return QuantizedTensor(
+        values=values,
+        scales=scales[:, 0],
+        shape=tuple(x.shape),
+        bits=bits,
+        block_size=block_size,
+    )
+
+
+def dequantize_blockwise(qt: QuantizedTensor, dtype=jnp.float32) -> jax.Array:
+    vals = _unpack_int4(qt.values) if qt.bits == 4 else qt.values.astype(jnp.float32)
+    flat = (vals * qt.scales[:, None]).reshape(-1)
+    n = 1
+    for d in qt.shape:
+        n *= d
+    return flat[:n].reshape(qt.shape).astype(dtype)
+
+
+def quantized_reduce_scatter(
+    x: jax.Array,
+    axis_name: str,
+    bits: int = 8,
+    block_size: int = 256,
+    mean: bool = True,
+) -> jax.Array:
+    """qgZ gradient exchange, to be called INSIDE shard_map over ``axis_name``.
+
+    x: this rank's local (replica) gradient, flat or any shape; logically the
+    same array exists on every rank of the axis. Each rank quantizes W chunks
+    of its local grads, the int8 payload + scales move via ``lax.all_to_all``,
+    and each rank dequantizes + reduces the W received copies of its own
+    chunk. Returns this rank's reduced chunk [ceil(n/W) elements], matching
+    reference all_to_all_quant_reduce (reduce-scatter semantics). Bytes on
+    the wire: n/2 (int8 vs bf16) or n/4 (int4) + scales.
+    """
+    W = jax.lax.axis_size(axis_name)
+    flat = x.reshape(-1).astype(jnp.float32)
+    flat, _ = _pad_to(flat, W * block_size)
+    chunk = flat.shape[0] // W
+    chunks = flat.reshape(W, chunk)
+
+    qmax = _QMAX[bits]
+    blocks = chunks.reshape(W, chunk // block_size, block_size)
+    absmax = jnp.max(jnp.abs(blocks), axis=-1, keepdims=True)
+    scales = absmax / qmax
+    inv = jnp.where(scales > 0, 1.0 / jnp.maximum(scales, 1e-30), 0.0)
+    q = jnp.clip(jnp.round(blocks * inv), -qmax, qmax)
+    if bits == 4:
+        payload = _pack_int4(q.reshape(-1, block_size)).reshape(W, chunk // block_size, block_size // 2)
+    else:
+        payload = q.astype(jnp.int8)
+
+    # the int8 payload and fp32 block scales are what crosses ICI
+    payload_rx = jax.lax.all_to_all(payload, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    scales_rx = jax.lax.all_to_all(scales, axis_name, split_axis=0, concat_axis=0, tiled=True)
+
+    payload_rx = payload_rx.reshape(W, chunk // block_size, -1)
+    if bits == 4:
+        vals = _unpack_int4(payload_rx.reshape(-1, block_size // 2)).reshape(
+            W, chunk // block_size, block_size
+        )
+    else:
+        vals = payload_rx.astype(jnp.float32)
+    deq = vals * scales_rx.reshape(W, chunk // block_size, 1)
+    total = jnp.sum(deq, axis=0).reshape(chunk)
+    if mean:
+        total = total / W
+    return total.astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# fp8 scaled casts (reference csrc/fp_quantizer/ FP6/FP8 paths)
+# ---------------------------------------------------------------------------
+def fp8_cast(x: jax.Array, dtype=jnp.float8_e4m3fn):
+    """Tensor-scaled fp8 cast: returns (fp8 values, fp32 scale)."""
+    finfo_max = jnp.finfo(dtype).max.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)))
+    scale = jnp.where(absmax > 0, absmax / finfo_max, 1.0)
+    return (x.astype(jnp.float32) / scale).astype(dtype), scale
+
+
+def fp8_uncast(values: jax.Array, scale: jax.Array, dtype=jnp.float32):
+    return (values.astype(jnp.float32) * scale).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel path (TPU): fused absmax + scale + round in VMEM, optional
+# in-kernel stochastic rounding via the TPU PRNG
+# ---------------------------------------------------------------------------
+def _quant_kernel(seed_ref, x_ref, v_ref, s_ref, *, qmax, stochastic):
+    from jax.experimental.pallas import tpu as pltpu
+
+    blk = x_ref[:].astype(jnp.float32)  # [rows, block]
+    absmax = jnp.max(jnp.abs(blk), axis=-1, keepdims=True)
+    scale = absmax / qmax
+    inv = jnp.where(scale > 0, 1.0 / jnp.maximum(scale, 1e-30), 0.0)
+    scaled = blk * inv
+    if stochastic:
+        import jax.experimental.pallas as pl
+
+        pltpu.prng_seed(seed_ref[0, 0] + pl.program_id(0))
+        bits = pltpu.prng_random_bits(scaled.shape)
+        # top 24 bits → uniform [0, 1) → centered noise [-0.5, 0.5)
+        u = (bits >> 8).astype(jnp.float32) * (1.0 / (1 << 24))
+        scaled = scaled + (u - 0.5)
+    v_ref[:] = jnp.clip(jnp.round(scaled), -qmax, qmax).astype(jnp.int8)
+    s_ref[:] = jnp.broadcast_to(scale, s_ref.shape)
+
+
+def quantize_blockwise_pallas(
+    x: jax.Array,
+    bits: int = 8,
+    block_size: int = 2048,
+    stochastic: bool = False,
+    seed: int = 0,
+    interpret: bool = False,
+) -> QuantizedTensor:
+    """Pallas path: one VMEM pass per row-block (int8 layout; int4 packing is
+    a host-side post-pass)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    qmax = _QMAX[bits]
+    flat = x.reshape(-1)
+    flat, _ = _pad_to(flat, block_size * 8)
+    rows = flat.shape[0] // block_size
+    blocks = flat.reshape(rows, block_size)
+    row_tile = 8
+    seed_arr = jnp.asarray(seed, jnp.int32).reshape(1, 1)
+
+    values, scales = pl.pallas_call(
+        functools.partial(_quant_kernel, qmax=qmax, stochastic=stochastic),
+        grid=(rows // row_tile,),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((row_tile, block_size), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((row_tile, block_size), lambda i: (i, 0)),
+            pl.BlockSpec((row_tile, 128), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, block_size), jnp.int8),
+            jax.ShapeDtypeStruct((rows, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(seed_arr, blocks)
+    if bits == 4:
+        values = _pack_int4(values.astype(jnp.float32))
+    return QuantizedTensor(
+        values=values,
+        scales=scales[:, 0],
+        shape=tuple(x.shape),
+        bits=bits,
+        block_size=block_size,
+    )

@@ -125,7 +125,19 @@ def build_optimizer(opt_config, precision_dtype: str = "float32") -> DeepSpeedOp
     params.pop("fused", None)
     momentum = params.pop("momentum", 0.0)
 
-    if name in (ADAM_OPTIMIZER, FUSED_ADAM, CPU_ADAM, ADAMW_OPTIMIZER, "zenflowselectiveadam"):
+    if name == FUSED_ADAM:
+        # Pallas fused-Adam kernel path (reference FusedAdam multi-tensor op);
+        # optax-contract transform with in-kernel bias correction + decay
+        from deepspeed_tpu.ops.adam.fused_adam import AdamParams, fused_adam_transform
+
+        hp = AdamParams(
+            lr=lr, beta1=betas[0], beta2=betas[1], eps=eps,
+            weight_decay=weight_decay, adam_w_mode=adam_w_mode,
+            bias_correction=params.pop("bias_correction", True),
+        )
+        tx = fused_adam_transform(hp)
+        canonical = "fused_adam"
+    elif name in (ADAM_OPTIMIZER, CPU_ADAM, ADAMW_OPTIMIZER, "zenflowselectiveadam"):
         is_adamw = name == ADAMW_OPTIMIZER or adam_w_mode
         if is_adamw:
             tx = _InjectLR.wrap(optax.adamw, b1=betas[0], b2=betas[1], eps=eps, weight_decay=weight_decay)

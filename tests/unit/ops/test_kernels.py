@@ -1,0 +1,179 @@
+"""Kernel numerics tests: quantizer, fused adam, fused norms (interpret mode
+on CPU) — analogue of reference tests/unit/ops per-kernel vs-torch suites."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.quantizer import (
+    dequantize_blockwise,
+    fp8_cast,
+    fp8_uncast,
+    quantize_blockwise,
+)
+from deepspeed_tpu.ops.quantizer.block_quant import quantize_blockwise_pallas
+from deepspeed_tpu.ops.adam.fused_adam import (
+    AdamParams,
+    _adam_math,
+    fused_adam_step,
+    fused_adam_transform,
+)
+from deepspeed_tpu.ops.normalization import (
+    fused_rms_norm,
+    rms_norm_reference,
+)
+
+
+class TestQuantizer:
+    @pytest.mark.parametrize("bits", [8, 4])
+    def test_roundtrip_error_bounded(self, bits):
+        x = jax.random.normal(jax.random.key(0), (1000,)) * 3.0
+        qt = quantize_blockwise(x, bits=bits, block_size=256)
+        y = dequantize_blockwise(qt)
+        # max error ≤ scale/2 per block
+        scales = np.repeat(np.asarray(qt.scales), 256)[:1000]
+        err = np.abs(np.asarray(y) - np.asarray(x))
+        assert (err <= scales * 0.5 + 1e-7).all()
+
+    def test_exact_for_representable(self):
+        x = jnp.array([-127.0, -1.0, 0.0, 5.0, 127.0] * 52)  # 260 vals, block 260
+        qt = quantize_blockwise(x, bits=8, block_size=260)
+        np.testing.assert_allclose(np.asarray(dequantize_blockwise(qt)), np.asarray(x), rtol=1e-6)
+
+    def test_pallas_matches_jnp(self):
+        x = jax.random.normal(jax.random.key(1), (8 * 512,))
+        q_ref = quantize_blockwise(x, bits=8, block_size=512)
+        q_pal = quantize_blockwise_pallas(x, bits=8, block_size=512, interpret=True)
+        np.testing.assert_array_equal(
+            np.asarray(q_pal.values).reshape(-1)[: x.size],
+            np.asarray(q_ref.values).reshape(-1)[: x.size],
+        )
+        np.testing.assert_allclose(
+            np.asarray(q_pal.scales)[: q_ref.scales.size], np.asarray(q_ref.scales), rtol=1e-6
+        )
+
+    def test_fp8_roundtrip(self):
+        x = jax.random.normal(jax.random.key(2), (128,)) * 100.0
+        v, s = fp8_cast(x)
+        y = fp8_uncast(v, s)
+        rel = np.abs(np.asarray(y) - np.asarray(x)) / (np.abs(np.asarray(x)) + 1e-3)
+        assert np.median(rel) < 0.06  # e4m3 mantissa ~2^-3 relative steps
+
+
+class TestFusedAdam:
+    def test_pallas_matches_jnp_math(self):
+        key = jax.random.key(0)
+        p = jax.random.normal(key, (3000,), jnp.float32)
+        g = jax.random.normal(jax.random.key(1), (3000,), jnp.float32)
+        m = jnp.zeros_like(p)
+        v = jnp.zeros_like(p)
+        hp = AdamParams(lr=1e-2, weight_decay=0.01)
+        p1, m1, v1 = fused_adam_step(p, g, m, v, 1, hp, block=256, interpret=True)
+        p2, m2, v2 = _adam_math(p, g, m, v, jnp.float32(1.0), hp, jnp.float32(1e-2))
+        np.testing.assert_allclose(np.asarray(p1), np.asarray(p2), rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(np.asarray(m1), np.asarray(m2), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(v1), np.asarray(v2), rtol=1e-6)
+
+    def test_transform_matches_optax(self):
+        import optax
+
+        params = {"w": jax.random.normal(jax.random.key(0), (64, 64)), "b": jnp.zeros((64,))}
+        grads = jax.tree.map(lambda p: jnp.ones_like(p) * 0.1, params)
+        hp = AdamParams(lr=1e-3, weight_decay=0.0, adam_w_mode=True)
+        tx_f = fused_adam_transform(hp, use_pallas=False)
+        st = tx_f.init(params)
+        upd_f, st = tx_f.update(grads, st, params, lr=1e-3)
+        new_p = optax.apply_updates(params, upd_f)
+
+        tx = optax.adam(1e-3)
+        ost = tx.init(params)
+        upd, ost = tx.update(grads, ost, params)
+        ref_p = optax.apply_updates(params, upd)
+        for k in params:
+            np.testing.assert_allclose(
+                np.asarray(new_p[k]), np.asarray(ref_p[k]), rtol=1e-5, atol=1e-6
+            )
+
+    def test_fused_adam_through_engine(self):
+        """Config {"type": "FusedAdam"} trains through the engine."""
+        import deepspeed_tpu
+        from tests.unit.simple_model import make_mlp_params, mlp_loss_fn, random_dataset
+
+        params = make_mlp_params(jax.random.key(0))
+        data = random_dataset(n=32)
+        engine, opt, _, _ = deepspeed_tpu.initialize(
+            model=mlp_loss_fn,
+            model_parameters=params,
+            config={
+                "train_batch_size": 32,
+                "optimizer": {"type": "FusedAdam", "params": {"lr": 1e-2}},
+                "zero_optimization": {"stage": 1},
+            },
+        )
+        assert opt.name == "fused_adam"
+        losses = [float(engine.train_batch(batch=data)) for _ in range(6)]
+        # trajectory matches plain Adam exactly (verified manually); just
+        # assert steady descent here
+        assert losses[-1] < losses[0] * 0.9, losses
+
+
+class TestQuantizedReduceScatter:
+    def test_matches_fp32_psum_within_quant_error(self, devices8):
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from deepspeed_tpu.ops.quantizer import quantized_reduce_scatter
+
+        devs = jax.devices()[:8]
+        mesh = Mesh(np.array(devs), ("x",))
+        n = 8 * 1024
+        # per-rank distinct gradients: simulate with leading device dim
+        g = jax.random.normal(jax.random.key(0), (8, n), jnp.float32)
+
+        def body(g_local):
+            # g_local: [1, n] this rank's grads
+            return quantized_reduce_scatter(g_local[0], "x", bits=8, block_size=256)[None]
+
+        out = jax.jit(
+            jax.shard_map(
+                body, mesh=mesh, in_specs=(P("x", None),), out_specs=P("x", None),
+                check_vma=False,
+            )
+        )(g)
+        # expected: mean over ranks, chunked per rank
+        mean = np.asarray(jnp.mean(g, axis=0)).reshape(8, n // 8)
+        got = np.asarray(out)
+        err = np.abs(got - mean)
+        # int8 block quant: error bounded by ~absmax/127 per block
+        assert err.max() < np.abs(g).max() / 127.0 * 1.1
+        assert np.corrcoef(got.ravel(), mean.ravel())[0, 1] > 0.999
+
+    def test_int4_packing_halves_payload(self):
+        from deepspeed_tpu.ops.quantizer import dequantize_blockwise, quantize_blockwise
+
+        x = jax.random.normal(jax.random.key(0), (2048,))
+        q8 = quantize_blockwise(x, bits=8, block_size=256)
+        q4 = quantize_blockwise(x, bits=4, block_size=256)
+        assert q4.values.size == q8.values.size // 2
+        y4 = dequantize_blockwise(q4)
+        # int4 roundtrip error ≤ scale/2 per block (scale = absmax/7)
+        scales = np.repeat(np.asarray(q4.scales), 256)
+        assert (np.abs(np.asarray(y4) - np.asarray(x)) <= scales * 0.5 + 1e-7).all()
+
+
+class TestFusedNorm:
+    def test_rms_forward_matches(self):
+        x = jax.random.normal(jax.random.key(0), (4, 64, 256))
+        w = jax.random.normal(jax.random.key(1), (256,)) * 0.1 + 1.0
+        out = fused_rms_norm(x, w, 1e-5, True)
+        ref = rms_norm_reference(x, w, 1e-5)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+    def test_rms_grads_match(self):
+        x = jax.random.normal(jax.random.key(0), (8, 256))
+        w = jax.random.normal(jax.random.key(1), (256,)) * 0.1 + 1.0
+
+        gf = jax.grad(lambda x, w: jnp.sum(jnp.square(fused_rms_norm(x, w, 1e-5, True))), (0, 1))(x, w)
+        gr = jax.grad(lambda x, w: jnp.sum(jnp.square(rms_norm_reference(x, w, 1e-5))), (0, 1))(x, w)
+        np.testing.assert_allclose(np.asarray(gf[0]), np.asarray(gr[0]), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(gf[1]), np.asarray(gr[1]), rtol=1e-4, atol=1e-4)
