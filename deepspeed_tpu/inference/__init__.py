@@ -1,0 +1,17 @@
+"""Inference runtimes (reference deepspeed/inference/ v1 + v2/FastGen)."""
+
+from deepspeed_tpu.inference.config import (
+    DeepSpeedInferenceConfig,
+    KVCacheConfig,
+    RaggedInferenceEngineConfig,
+    StateManagerConfig,
+)
+from deepspeed_tpu.inference.engine import InferenceEngine
+
+__all__ = [
+    "DeepSpeedInferenceConfig",
+    "InferenceEngine",
+    "KVCacheConfig",
+    "RaggedInferenceEngineConfig",
+    "StateManagerConfig",
+]

@@ -1,0 +1,73 @@
+"""Inference configs.
+
+Reference: ``DeepSpeedInferenceConfig`` (inference/config.py — dtype,
+tensor_parallel.tp_size, replace_with_kernel_inject, max_out_tokens, ...)
+and ``RaggedInferenceEngineConfig`` (inference/v2/config_v2.py — state
+manager + memory config for FastGen).
+"""
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from deepspeed_tpu.runtime.config_utils import DSConfigModel, submodel
+
+
+@dataclass
+class TPConfig(DSConfigModel):
+    tp_size: int = 1
+    enabled: bool = True
+
+
+@dataclass
+class DeepSpeedInferenceConfig(DSConfigModel):
+    """v1 engine config (reference inference/config.py)."""
+
+    dtype: str = "bfloat16"
+    tensor_parallel: Optional[TPConfig] = submodel(TPConfig)
+    max_out_tokens: int = 1024
+    min_out_tokens: int = 1
+    max_tokens: int = 4096  # prompt + generation budget
+    replace_with_kernel_inject: bool = True  # flash/fused kernels on TPU
+    enable_cuda_graph: bool = False  # [compat] jit IS the graph on TPU
+    checkpoint: Optional[str] = None
+    zero_inference: bool = False
+    temperature: float = 1.0
+    top_k: int = 0
+    greedy: bool = True
+
+    @classmethod
+    def from_dict(cls, d=None, strict: bool = False):
+        d = dict(d or {})
+        tp = d.get("tensor_parallel")
+        if isinstance(tp, int):  # convenience: tensor_parallel: 4
+            d["tensor_parallel"] = {"tp_size": tp}
+        if "dtype" in d and not isinstance(d["dtype"], str):
+            d["dtype"] = str(d["dtype"]).replace("torch.", "").replace("jnp.", "")
+        return super().from_dict(d, strict=strict)
+
+
+@dataclass
+class KVCacheConfig(DSConfigModel):
+    block_size: int = 128  # tokens per KV block (reference v2 kv block)
+    num_blocks: int = 256
+    max_blocks_per_seq: int = 32
+
+
+@dataclass
+class StateManagerConfig(DSConfigModel):
+    """Reference DSStateManagerConfig (inference/v2/ragged/manager_configs.py)."""
+
+    max_tracked_sequences: int = 64
+    max_ragged_batch_size: int = 512  # token budget per engine step
+    max_ragged_sequence_count: int = 16
+    max_context: int = 4096
+
+
+@dataclass
+class RaggedInferenceEngineConfig(DSConfigModel):
+    """v2 (FastGen) engine config (reference inference/v2/config_v2.py)."""
+
+    dtype: str = "bfloat16"
+    tp_size: int = 1
+    kv_cache: Optional[KVCacheConfig] = submodel(KVCacheConfig)
+    state_manager: Optional[StateManagerConfig] = submodel(StateManagerConfig)

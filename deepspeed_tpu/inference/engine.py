@@ -1,0 +1,185 @@
+"""Inference engine v1 (reference ``InferenceEngine`` inference/engine.py:40).
+
+TPU-native mapping of the reference surface:
+  * kernel injection (``replace_with_kernel_inject``) → the model family's
+    flash-attention/fused-norm dispatch (always on for TPU);
+  * TP sharding (policy/AutoTP) → ``param_partition_specs`` placement over
+    the ``model`` mesh axis;
+  * CUDA-graph capture (engine.py:496) → jit: prefill and decode compile to
+    fixed-shape programs, bucketed by prompt length;
+  * ``generate()`` guard rails (engine.py:585) → max_tokens checks.
+
+The engine holds a contiguous KV cache (models.init_kv_cache) sized to
+``max_tokens``; the v2 engine (inference/v2) replaces it with paged blocks +
+continuous batching.
+"""
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+from deepspeed_tpu.models import transformer as T
+from deepspeed_tpu.parallel.topology import Topology, get_topology, set_topology
+from deepspeed_tpu.utils.logging import log_dist
+
+
+def _bucket(n: int, buckets=(32, 64, 128, 256, 512, 1024, 2048, 4096)) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return ((n + 127) // 128) * 128
+
+
+def _sample(logits_row, rng, temperature, greedy):
+    """logits_row: [b, vocab] fp32."""
+    return jnp.where(
+        greedy,
+        jnp.argmax(logits_row, axis=-1),
+        jax.random.categorical(rng, logits_row / jnp.maximum(temperature, 1e-4)),
+    ).astype(jnp.int32)
+
+
+class InferenceEngine:
+    """Generate-capable wrapper around a model-family config + params.
+
+    model: either a TransformerConfig (params passed separately) or a tuple
+    (config, params).
+    """
+
+    def __init__(self, model, config: DeepSpeedInferenceConfig, params: Any = None, topology: Optional[Topology] = None):
+        if isinstance(model, tuple):
+            self.model_config, params = model
+        else:
+            self.model_config = model
+        assert params is not None, "InferenceEngine needs model params"
+        self._config = config
+        tp = config.tensor_parallel.tp_size if config.tensor_parallel else 1
+        self.topo = topology or (get_topology() if tp <= 1 else Topology(model=tp, data=0))
+        set_topology(self.topo)
+
+        dtype = T.DTYPES.get(config.dtype, jnp.bfloat16)
+        params = jax.tree.map(
+            lambda p: p.astype(dtype) if jnp.issubdtype(p.dtype, jnp.floating) else p, params
+        )
+        # TP placement (the AutoTP/injection analogue)
+        if self.topo.model_parallel_size > 1:
+            specs = T.param_partition_specs(self.model_config)
+            shardings = jax.tree.map(
+                lambda s: jax.sharding.NamedSharding(self.topo.mesh, s),
+                specs,
+                is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec),
+            )
+            params = jax.device_put(params, shardings)
+        self.params = params
+        self._prefill_jit = None
+        self._decode_jit = None
+        self._mc = self.model_config
+        log_dist(
+            f"InferenceEngine: tp={self.topo.model_parallel_size} dtype={config.dtype} "
+            f"max_tokens={config.max_tokens}",
+            ranks=[0],
+        )
+
+    # -- reference API surface ------------------------------------------------
+    def forward(self, tokens):
+        """Plain forward → logits (reference engine.forward :556)."""
+        logits, _ = jax.jit(lambda p, t: T.forward(p, t, self._mc))(self.params, jnp.asarray(tokens))
+        return logits
+
+    __call__ = forward
+
+    @property
+    def module(self):
+        return self._mc
+
+    def _build_steps(self):
+        mc = self._mc
+
+        def prefill(params, tokens, caches, positions, last_idx, rng, temperature, greedy):
+            logits, caches = T.decode_step(params, tokens, mc, caches, positions)
+            # sample at each sequence's true last prompt position
+            last = jnp.take_along_axis(
+                logits.astype(jnp.float32), last_idx[:, None, None], axis=1
+            )[:, 0]
+            return _sample(last, rng, temperature, greedy), caches
+
+        def decode(params, tokens, caches, positions, rng, temperature, greedy):
+            logits, caches = T.decode_step(params, tokens, mc, caches, positions)
+            return _sample(logits[:, -1].astype(jnp.float32), rng, temperature, greedy), caches
+
+        self._prefill_jit = jax.jit(prefill, donate_argnums=(2,))
+        self._decode_jit = jax.jit(decode, donate_argnums=(2,))
+
+    def generate(
+        self,
+        input_ids,
+        max_new_tokens: Optional[int] = None,
+        temperature: Optional[float] = None,
+        greedy: Optional[bool] = None,
+        eos_token_id: Optional[int] = None,
+        seed: int = 0,
+    ):
+        """Batched autoregressive generation (reference generate :585).
+
+        input_ids: [b, s]; right-padded ragged prompts supported via
+        ``prompt_lengths`` inferred from trailing ``pad_token`` runs is NOT
+        done here — pass equal-length prompts or pre-pad and give the true
+        lengths via the (batch,) ``lengths`` kwarg pattern of v2. Returns
+        np.ndarray [b, s + new].
+        """
+        mc = self._mc
+        cfg = self._config
+        max_new = max_new_tokens or cfg.max_out_tokens
+        temperature = cfg.temperature if temperature is None else temperature
+        greedy = cfg.greedy if greedy is None else greedy
+
+        toks = np.asarray(input_ids, np.int32)
+        if toks.ndim == 1:
+            toks = toks[None]
+        b, s = toks.shape
+        total = s + max_new
+        if total > cfg.max_tokens:
+            raise ValueError(
+                f"prompt {s} + max_new {max_new} exceeds max_tokens {cfg.max_tokens} "
+                "(reference engine guard)"
+            )
+        if self._prefill_jit is None:
+            self._build_steps()
+        cache_len = _bucket(total)
+        caches = T.init_kv_cache(mc, b, cache_len)
+
+        sb = _bucket(s)
+        prompt = np.pad(toks, ((0, 0), (0, sb - s)))
+        rng = jax.random.key(seed)
+        positions = jnp.arange(sb, dtype=jnp.int32)[None].repeat(b, 0)
+        last_idx = jnp.full((b,), s - 1, jnp.int32)
+        cur, caches = self._prefill_jit(
+            self.params, jnp.asarray(prompt), caches, positions, last_idx,
+            rng, jnp.float32(temperature), jnp.bool_(greedy),
+        )
+        # pad positions [s, sb) were written to the cache but stay masked
+        # (attention sees kpos <= clen+i); reset clen so decode overwrites them
+        caches = (caches[0], caches[1], jnp.full_like(caches[2], s))
+
+        out = [toks]
+        done = np.zeros((b,), bool)
+        for i in range(max_new):
+            tok_np = np.asarray(cur).reshape(b, 1)
+            if eos_token_id is not None:
+                tok_np = np.where(done[:, None], eos_token_id, tok_np)
+                done |= tok_np[:, 0] == eos_token_id
+            out.append(tok_np)
+            if eos_token_id is not None and done.all():
+                break
+            if i == max_new - 1:
+                break
+            step_rng = jax.random.fold_in(rng, i)
+            positions = jnp.full((b, 1), s + i, jnp.int32)
+            cur, caches = self._decode_jit(
+                self.params, jnp.asarray(tok_np), caches, positions,
+                step_rng, jnp.float32(temperature), jnp.bool_(greedy),
+            )
+        return np.concatenate(out, axis=1)

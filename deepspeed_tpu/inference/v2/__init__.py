@@ -1,0 +1,21 @@
+"""Inference v2 (FastGen analogue): paged KV cache + continuous batching.
+
+Reference: deepspeed/inference/v2/ — ``InferenceEngineV2`` (engine_v2.py:30),
+``DSStateManager`` (ragged/ragged_manager.py), ``BlockedAllocator``
+(ragged/blocked_allocator.py), Dynamic SplitFuse scheduling
+(``RaggedBatchWrapper``).
+"""
+
+from deepspeed_tpu.inference.v2.blocked_allocator import BlockedAllocator
+from deepspeed_tpu.inference.v2.ragged_manager import DSSequenceDescriptor, DSStateManager
+from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+from deepspeed_tpu.inference.v2.scheduler import RaggedBatch, RaggedScheduler
+
+__all__ = [
+    "BlockedAllocator",
+    "DSSequenceDescriptor",
+    "DSStateManager",
+    "InferenceEngineV2",
+    "RaggedBatch",
+    "RaggedScheduler",
+]

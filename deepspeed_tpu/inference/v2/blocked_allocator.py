@@ -1,0 +1,48 @@
+"""KV block allocator (reference inference/v2/ragged/blocked_allocator.py).
+
+Free-list over a fixed pool of KV blocks; host-side numpy (allocation is a
+scheduling decision, not device work).
+"""
+
+from typing import Iterable, List
+
+import numpy as np
+
+
+class BlockedAllocator:
+    def __init__(self, num_blocks: int):
+        if num_blocks < 1:
+            raise ValueError(f"need at least 1 block, got {num_blocks}")
+        self._num_blocks = num_blocks
+        # free list as a linked list in an array (reference implementation
+        # shape) — O(1) allocate/free of arbitrary block sets
+        self._next = np.arange(1, num_blocks + 1, dtype=np.int64)
+        self._head = 0
+        self._free = num_blocks
+
+    @property
+    def free_blocks(self) -> int:
+        return self._free
+
+    @property
+    def total_blocks(self) -> int:
+        return self._num_blocks
+
+    def allocate(self, num_blocks: int) -> np.ndarray:
+        if num_blocks > self._free:
+            raise ValueError(f"cannot allocate {num_blocks} blocks ({self._free} free)")
+        out = np.empty(num_blocks, np.int64)
+        for i in range(num_blocks):
+            out[i] = self._head
+            self._head = self._next[self._head]
+        self._free -= num_blocks
+        return out
+
+    def free(self, blocks: Iterable[int]) -> None:
+        blocks = list(int(b) for b in np.atleast_1d(np.asarray(blocks, np.int64)))
+        for b in blocks:
+            if not (0 <= b < self._num_blocks):
+                raise ValueError(f"invalid block {b}")
+            self._next[b] = self._head
+            self._head = b
+        self._free += len(blocks)

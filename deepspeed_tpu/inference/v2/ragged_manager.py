@@ -1,0 +1,91 @@
+"""Sequence state tracking for ragged batching.
+
+Reference: ``DSStateManager``/``DSSequenceDescriptor``
+(inference/v2/ragged/ragged_manager.py, sequence_descriptor.py): per-sequence
+seen-token counts and KV block tables, backed by the BlockedAllocator.
+
+The paged KV cache itself lives on device as
+  k/v: [n_layers, num_blocks, block_size, n_kv_heads, head_dim]
+and each sequence owns an ordered list of block ids; token t of a sequence
+lives in block ``table[t // block_size]`` at row ``t % block_size``.
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.inference.v2.blocked_allocator import BlockedAllocator
+
+
+@dataclass
+class DSSequenceDescriptor:
+    uid: int
+    seen_tokens: int = 0  # tokens already in the KV cache
+    tokens: List[int] = field(default_factory=list)  # full history (host)
+    block_table: List[int] = field(default_factory=list)
+    finished: bool = False
+
+    @property
+    def cur_allocated_blocks(self) -> int:
+        return len(self.block_table)
+
+
+class DSStateManager:
+    def __init__(self, config, kv_config):
+        self._config = config
+        self._kv = kv_config
+        self._alloc = BlockedAllocator(kv_config.num_blocks)
+        self._seqs: Dict[int, DSSequenceDescriptor] = {}
+
+    # -- reference API --------------------------------------------------------
+    @property
+    def free_blocks(self) -> int:
+        return self._alloc.free_blocks
+
+    @property
+    def n_tracked_sequences(self) -> int:
+        return len(self._seqs)
+
+    def get_sequence(self, uid: int) -> Optional[DSSequenceDescriptor]:
+        return self._seqs.get(uid)
+
+    def get_or_create_sequence(self, uid: int) -> DSSequenceDescriptor:
+        if uid in self._seqs:
+            return self._seqs[uid]
+        if len(self._seqs) >= self._config.max_tracked_sequences:
+            raise RuntimeError(
+                f"tracked sequences exceed max_tracked_sequences="
+                f"{self._config.max_tracked_sequences}"
+            )
+        seq = DSSequenceDescriptor(uid=uid)
+        self._seqs[uid] = seq
+        return seq
+
+    def blocks_needed(self, seq: DSSequenceDescriptor, new_tokens: int) -> int:
+        bs = self._kv.block_size
+        total = seq.seen_tokens + new_tokens
+        need = (total + bs - 1) // bs
+        return max(0, need - len(seq.block_table))
+
+    def extend(self, seq: DSSequenceDescriptor, new_tokens: int) -> bool:
+        """Reserve blocks for new_tokens; False if pool exhausted."""
+        need = self.blocks_needed(seq, new_tokens)
+        if need > self._alloc.free_blocks:
+            return False
+        if len(seq.block_table) + need > self._kv.max_blocks_per_seq:
+            return False
+        if need:
+            seq.block_table.extend(int(b) for b in self._alloc.allocate(need))
+        return True
+
+    def flush_sequence(self, uid: int) -> None:
+        """Release a finished sequence's blocks (reference flush)."""
+        seq = self._seqs.pop(uid, None)
+        if seq is not None and seq.block_table:
+            self._alloc.free(seq.block_table)
+
+    def block_table_array(self, seq: DSSequenceDescriptor) -> np.ndarray:
+        out = np.zeros((self._kv.max_blocks_per_seq,), np.int32)
+        out[: len(seq.block_table)] = seq.block_table
+        return out

@@ -1,0 +1,122 @@
+"""Continuous-batching scheduler (Dynamic SplitFuse analogue).
+
+Reference: the FastGen scheduling policy (inference/v2 blogs + MII): each
+engine step packs a token budget (``max_ragged_batch_size``) with
+  1. one next-token per running (decode) sequence, then
+  2. chunks of pending prompts (prefill), splitting long prompts across
+     steps — the "split" — and fusing prompt chunks with decode tokens in
+     one batch — the "fuse".
+
+TPU adaptation: the packed batch is padded to static shapes
+(max_ragged_sequence_count rows × per-row token buckets) so every engine
+step hits a small set of compiled programs.
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+@dataclass
+class RaggedBatch:
+    """One engine step's work: for each row, a (uid, tokens, start_pos) unit."""
+
+    uids: List[int]
+    tokens: List[np.ndarray]  # per-row new tokens
+    start_positions: List[int]  # first position of those tokens in the sequence
+    is_prompt_chunk: List[bool]  # True if more of this prompt remains after the step
+
+    @property
+    def total_tokens(self):
+        return sum(len(t) for t in self.tokens)
+
+    def __len__(self):
+        return len(self.uids)
+
+
+class RaggedScheduler:
+    """Tracks pending prompt queues + running sequences and emits RaggedBatches."""
+
+    def __init__(self, config, manager):
+        self._config = config
+        self._mgr = manager
+        self._pending: List[Tuple[int, np.ndarray]] = []  # (uid, remaining prompt)
+        self._running: List[int] = []  # uids with a sampled next token to feed
+        self._next_token: Dict[int, int] = {}
+
+    def submit(self, uid: int, prompt_tokens) -> None:
+        seq = self._mgr.get_or_create_sequence(uid)
+        toks = np.asarray(prompt_tokens, np.int32).reshape(-1)
+        seq.tokens.extend(int(t) for t in toks)
+        self._pending.append((uid, toks))
+
+    def feedback(self, uid: int, sampled_token: int) -> None:
+        """Engine reports the sampled next token for a running sequence."""
+        seq = self._mgr.get_sequence(uid)
+        if seq is None or seq.finished:
+            return
+        seq.tokens.append(int(sampled_token))
+        self._next_token[uid] = int(sampled_token)
+        if uid not in self._running:
+            self._running.append(uid)
+
+    def finish(self, uid: int) -> None:
+        seq = self._mgr.get_sequence(uid)
+        if seq is not None:
+            seq.finished = True
+        self._next_token.pop(uid, None)
+        if uid in self._running:
+            self._running.remove(uid)
+        self._mgr.flush_sequence(uid)
+
+    def has_work(self) -> bool:
+        return bool(self._pending or self._running)
+
+    def next_batch(self) -> Optional[RaggedBatch]:
+        budget = self._config.max_ragged_batch_size
+        max_rows = self._config.max_ragged_sequence_count
+        uids, tokens, starts, chunked = [], [], [], []
+
+        # 1. decode tokens for running sequences (fuse)
+        for uid in list(self._running):
+            if len(uids) >= max_rows or budget <= 0:
+                break
+            seq = self._mgr.get_sequence(uid)
+            tok = self._next_token.get(uid)
+            if seq is None or tok is None:
+                continue
+            if not self._mgr.extend(seq, 1):
+                continue  # no memory: sequence waits this step
+            uids.append(uid)
+            tokens.append(np.asarray([tok], np.int32))
+            starts.append(seq.seen_tokens)
+            chunked.append(False)
+            self._running.remove(uid)
+            self._next_token.pop(uid, None)
+            budget -= 1
+
+        # 2. prompt chunks (split)
+        still_pending = []
+        for uid, remaining in self._pending:
+            if len(uids) >= max_rows or budget <= 0:
+                still_pending.append((uid, remaining))
+                continue
+            seq = self._mgr.get_sequence(uid)
+            take = min(budget, len(remaining))
+            if take == 0 or not self._mgr.extend(seq, take):
+                still_pending.append((uid, remaining))
+                continue
+            chunk, rest = remaining[:take], remaining[take:]
+            uids.append(uid)
+            tokens.append(chunk)
+            starts.append(seq.seen_tokens)
+            chunked.append(len(rest) > 0)
+            budget -= take
+            if len(rest):
+                still_pending.append((uid, rest))
+        self._pending = still_pending
+
+        if not uids:
+            return None
+        return RaggedBatch(uids=uids, tokens=tokens, start_positions=starts, is_prompt_chunk=chunked)

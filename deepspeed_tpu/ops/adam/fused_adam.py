@@ -127,7 +127,12 @@ def fused_adam_transform(hp: AdamParams = AdamParams(), use_pallas: bool = None)
     import optax
 
     if use_pallas is None:
-        use_pallas = jax.default_backend() == "tpu"
+        # pallas_call is opaque to GSPMD — under a multi-device mesh the
+        # jnp path keeps ZeRO-sharded optimizer state partitioned; the
+        # kernel serves single-chip and the host-offload tier
+        from deepspeed_tpu.parallel.topology import get_topology
+
+        use_pallas = jax.default_backend() == "tpu" and get_topology().world_size == 1
 
     def init(params):
         z = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)

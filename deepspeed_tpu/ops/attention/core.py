@@ -77,6 +77,54 @@ def _flash_available() -> bool:
         return False
 
 
+def _flash_sharded(q, k, v, causal, segment_ids, scale):
+    """Run the Pallas flash kernel under a multi-device mesh.
+
+    pallas_call is opaque to the GSPMD partitioner — invoked bare inside jit
+    it would force an all-gather of every operand. Batch and heads are
+    embarrassingly parallel for self-attention, so we pin the canonical
+    layout (batch over data/expert, heads over model+sequence — the TP and
+    post-Ulysses placements) and run the kernel under fully-manual shard_map;
+    each device computes its local (batch, head) slab over the full sequence.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from deepspeed_tpu.ops.attention.flash_pallas import flash_attention
+    from deepspeed_tpu.parallel.topology import (
+        BATCH_AXES,
+        MODEL_AXIS,
+        SEQUENCE_AXIS,
+        get_topology,
+    )
+
+    topo = get_topology()
+    if topo.world_size == 1:
+        return flash_attention(q, k, v, causal=causal, segment_ids=segment_ids, scale=scale)
+
+    b, h, s, d = q.shape
+    h_kv = k.shape[1]
+    batch_div = topo.data_parallel_size * topo.expert_parallel_size
+    head_div = topo.model_parallel_size * topo.sequence_parallel_size
+    if b % batch_div or h % head_div or h_kv % head_div:
+        return None  # caller falls back to the reference impl
+    if (h // h_kv) > 1 and (h // head_div) % (h // h_kv) != 0:
+        return None  # GQA group would straddle a head shard
+    head_axes = (MODEL_AXIS, SEQUENCE_AXIS)
+    spec = P(BATCH_AXES, head_axes, None, None)
+    sharding = jax.sharding.NamedSharding(topo.mesh, spec)
+    q, k, v = (jax.lax.with_sharding_constraint(x, sharding) for x in (q, k, v))
+
+    fn = jax.shard_map(
+        lambda q_, k_, v_: flash_attention(q_, k_, v_, causal=causal, segment_ids=None, scale=scale),
+        mesh=topo.mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        axis_names=set(topo.mesh.axis_names),
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
 def attention(
     q: jax.Array,
     k: jax.Array,
@@ -94,13 +142,14 @@ def attention(
         impl is None
         and _flash_available()
         and bias is None
+        and segment_ids is None
         and d in (64, 128, 256)
         and sq % 128 == 0
         and sk % 128 == 0
         and sq == sk  # self-attention training path; decode uses reference
     )
     if use_flash:
-        from deepspeed_tpu.ops.attention.flash_pallas import flash_attention
-
-        return flash_attention(q, k, v, causal=causal, segment_ids=segment_ids, scale=scale)
+        out = _flash_sharded(q, k, v, causal, segment_ids, scale)
+        if out is not None:
+            return out
     return mha_reference(q, k, v, causal=causal, segment_ids=segment_ids, bias=bias, scale=scale)

@@ -54,7 +54,15 @@ def fused_rms_norm(x, w, eps=1e-5, interpret=False):
 
 
 def _use_pallas(interpret):
-    return interpret or jax.default_backend() == "tpu"
+    if interpret:
+        return True
+    if jax.default_backend() != "tpu":
+        return False
+    # pallas_call is opaque to GSPMD: on a multi-device mesh the jnp path
+    # (fully partitionable, XLA-fused) wins; the kernel serves single-chip
+    from deepspeed_tpu.parallel.topology import get_topology
+
+    return get_topology().world_size == 1
 
 
 def _rows_view(x):

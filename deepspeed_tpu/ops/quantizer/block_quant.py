@@ -70,7 +70,9 @@ def quantize_blockwise(
     scales = absmax / qmax
     inv = jnp.where(scales > 0, 1.0 / jnp.maximum(scales, 1e-30), 0.0)
     scaled = blocks * inv
-    if stochastic and rng is not None:
+    if stochastic:
+        if rng is None:
+            raise ValueError("stochastic=True requires an rng key (silent deterministic fallback would bias gradients)")
         noise = jax.random.uniform(rng, scaled.shape) - 0.5
         q = jnp.clip(jnp.round(scaled + noise), -qmax, qmax)
     else:

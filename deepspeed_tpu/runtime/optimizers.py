@@ -126,16 +126,17 @@ def build_optimizer(opt_config, precision_dtype: str = "float32") -> DeepSpeedOp
     momentum = params.pop("momentum", 0.0)
 
     if name == FUSED_ADAM:
-        # Pallas fused-Adam kernel path (reference FusedAdam multi-tensor op);
-        # optax-contract transform with in-kernel bias correction + decay
-        from deepspeed_tpu.ops.adam.fused_adam import AdamParams, fused_adam_transform
+        # Pallas fused-Adam kernel path (reference FusedAdam multi-tensor op)
+        from deepspeed_tpu.ops.adam import FusedAdam
 
-        hp = AdamParams(
-            lr=lr, beta1=betas[0], beta2=betas[1], eps=eps,
-            weight_decay=weight_decay, adam_w_mode=adam_w_mode,
+        fa = FusedAdam(
+            lr=lr, betas=betas, eps=eps, weight_decay=weight_decay,
+            adam_w_mode=adam_w_mode,
             bias_correction=params.pop("bias_correction", True),
         )
-        tx = fused_adam_transform(hp)
+        import optax as _optax
+
+        tx = _optax.GradientTransformation(fa.init, fa.update)
         canonical = "fused_adam"
     elif name in (ADAM_OPTIMIZER, CPU_ADAM, ADAMW_OPTIMIZER, "zenflowselectiveadam"):
         is_adamw = name == ADAMW_OPTIMIZER or adam_w_mode

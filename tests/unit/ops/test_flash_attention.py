@@ -54,6 +54,32 @@ def test_grads_match_reference(causal):
         )
 
 
+def test_sharded_flash_matches_reference(devices8):
+    """Flash under fully-manual shard_map (batch over data, heads over model)
+    — the multi-device dispatch path of ops.attention.core._flash_sharded."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from deepspeed_tpu.parallel.topology import Topology, reset_topology, set_topology
+
+    reset_topology()
+    topo = Topology(data=2, model=4)
+    set_topology(topo)
+    q, k, v = _qkv(b=2, h=4, s=256, d=64)
+    spec = P(("data", "expert"), ("model", "sequence"), None, None)
+    fn = jax.shard_map(
+        lambda q_, k_, v_: flash_attention(q_, k_, v_, True, None, None, True),
+        mesh=topo.mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        axis_names=set(topo.mesh.axis_names),
+        check_vma=False,
+    )
+    out = jax.jit(fn)(q, k, v)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+    reset_topology()
+
+
 def test_gqa_grads():
     q, k, v = _qkv(b=1, h=4, h_kv=2, s=128, d=64)
 
