@@ -15,8 +15,25 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-# bf16 peak FLOPs by platform (v5e ~197 TF; CPU fallback nominal)
-PEAK_FLOPS = {"tpu": 197e12, "cpu": 1e12}
+# bf16 peak FLOPs by TPU device kind (public spec sheets); CPU nominal.
+PEAK_FLOPS_BY_KIND = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,  # v5e
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,  # Trillium
+    "TPU v6e": 918e12,
+}
+
+
+def peak_flops(platform: str) -> float:
+    if platform == "tpu":
+        kind = jax.devices()[0].device_kind
+        for prefix, peak in PEAK_FLOPS_BY_KIND.items():
+            if kind.startswith(prefix):
+                return peak
+        return 197e12  # unknown TPU: assume v5e class
+    return 1e12  # CPU / non-TPU: nominal figure, MFU not meaningful
 
 
 def main():
@@ -69,7 +86,7 @@ def main():
     tokens_per_step = bsz * seq
     tok_s = tokens_per_step * steps / dt
     achieved = tok_s * flops_per_token(cfg, seq)
-    mfu = achieved / PEAK_FLOPS.get(platform, 1e12)
+    mfu = achieved / peak_flops(platform)
     print(json.dumps({
         "metric": f"llama-dense train MFU ({platform}, {tok_s:.0f} tok/s, loss={loss:.3f})",
         "value": round(mfu * 100, 2),

@@ -52,6 +52,7 @@ class InferenceEngineV2:
         self._k_cache = jnp.zeros(shape, dtype)
         self._v_cache = jnp.zeros(shape, dtype)
         self._row_jit = {}
+        self.last_scheduled_tokens = 0
         log_dist(
             f"InferenceEngineV2: {kv.num_blocks} KV blocks × {kv.block_size} tokens, "
             f"budget {self.config.state_manager.max_ragged_batch_size} tok/step",
@@ -138,6 +139,7 @@ class InferenceEngineV2:
 
     def step(self) -> Dict[int, np.ndarray]:
         batch = self.scheduler.next_batch()
+        self.last_scheduled_tokens = batch.total_tokens if batch is not None else 0
         if batch is None:
             return {}
         results: Dict[int, np.ndarray] = {}
@@ -177,6 +179,16 @@ class InferenceEngineV2:
         outputs = {uid: list(np.asarray(p, np.int32).reshape(-1)) for uid, p in zip(uids, prompts)}
         while self.scheduler.has_work():
             results = self.step()
+            # Liveness: if nothing was scheduled and work remains, no call we
+            # make below can change scheduler state — fail loudly instead of
+            # busy-looping (e.g. KV pool too fragmented for any pending
+            # prompt with no running sequence left to free blocks).
+            if self.last_scheduled_tokens == 0 and self.scheduler.has_work():
+                raise RuntimeError(
+                    "scheduler deadlock: work pending but nothing schedulable "
+                    f"(free KV blocks={self.state_manager.free_blocks}); "
+                    "increase kv_cache.num_blocks or reduce concurrency"
+                )
             for uid, logits in results.items():
                 nxt = int(np.argmax(logits))
                 outputs[uid].append(nxt)

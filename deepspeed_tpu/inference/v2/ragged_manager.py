@@ -68,6 +68,26 @@ class DSStateManager:
         need = (total + bs - 1) // bs
         return max(0, need - len(seq.block_table))
 
+    def seq_capped(self, seq: DSSequenceDescriptor, new_tokens: int) -> bool:
+        """True if the per-sequence block cap makes this growth PERMANENTLY
+        impossible (vs transient pool exhaustion, which frees up later)."""
+        need = self.blocks_needed(seq, new_tokens)
+        return len(seq.block_table) + need > self._kv.max_blocks_per_seq
+
+    def check_admissible(self, total_tokens: int) -> None:
+        """Raise if a sequence of this TOTAL length (prior + new tokens)
+        could never be scheduled, even with the whole pool free (liveness
+        guard at submit time)."""
+        bs = self._kv.block_size
+        need = (total_tokens + bs - 1) // bs
+        limit = min(self._kv.max_blocks_per_seq, self._kv.num_blocks)
+        if need > limit:
+            raise ValueError(
+                f"prompt needs {need} KV blocks but at most {limit} are "
+                f"usable (max_blocks_per_seq={self._kv.max_blocks_per_seq}, "
+                f"pool={self._kv.num_blocks})"
+            )
+
     def extend(self, seq: DSSequenceDescriptor, new_tokens: int) -> bool:
         """Reserve blocks for new_tokens; False if pool exhausted."""
         need = self.blocks_needed(seq, new_tokens)

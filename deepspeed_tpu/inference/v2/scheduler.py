@@ -44,11 +44,31 @@ class RaggedScheduler:
         self._pending: List[Tuple[int, np.ndarray]] = []  # (uid, remaining prompt)
         self._running: List[int] = []  # uids with a sampled next token to feed
         self._next_token: Dict[int, int] = {}
+        # uids force-finished because they hit max_context / max_blocks_per_seq
+        # (the decode analogue of a max-length stop); cleared on re-submit
+        self.capped: set = set()
 
     def submit(self, uid: int, prompt_tokens) -> None:
-        seq = self._mgr.get_or_create_sequence(uid)
         toks = np.asarray(prompt_tokens, np.int32).reshape(-1)
+        # Liveness guard: reject sequences that could never be scheduled —
+        # otherwise next_batch() returns None forever while has_work() stays
+        # True and callers busy-loop (enforces StateManagerConfig.max_context
+        # at submit, per reference max-length admission). Totals include any
+        # tokens the uid already holds (continuation submits).
+        if len(toks) == 0:
+            raise ValueError("empty prompt: nothing to schedule")
+        existing = self._mgr.get_sequence(uid)
+        prior = len(existing.tokens) if existing is not None else 0
+        total = prior + len(toks)
+        if total > self._config.max_context:
+            raise ValueError(
+                f"sequence would reach {total} tokens, exceeding max_context="
+                f"{self._config.max_context}"
+            )
+        self._mgr.check_admissible(total)
+        seq = self._mgr.get_or_create_sequence(uid)
         seq.tokens.extend(int(t) for t in toks)
+        self.capped.discard(uid)  # a fresh submit supersedes old capped state
         self._pending.append((uid, toks))
 
     def feedback(self, uid: int, sampled_token: int) -> None:
@@ -85,6 +105,15 @@ class RaggedScheduler:
             seq = self._mgr.get_sequence(uid)
             tok = self._next_token.get(uid)
             if seq is None or tok is None:
+                continue
+            # Permanently unschedulable: context or per-sequence block cap
+            # reached. Finish (max-length-style stop) instead of spinning.
+            if (
+                seq.seen_tokens + 1 > self._config.max_context
+                or self._mgr.seq_capped(seq, 1)
+            ):
+                self.capped.add(uid)
+                self.finish(uid)
                 continue
             if not self._mgr.extend(seq, 1):
                 continue  # no memory: sequence waits this step

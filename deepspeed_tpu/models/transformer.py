@@ -490,10 +490,12 @@ def flops_per_token(config: TransformerConfig, seq_len: Optional[int] = None) ->
     """Approximate training FLOPs per token (6ND rule + attention term)."""
     c = config
     s = seq_len or c.max_seq_len
+    # vocab term counts the lm_head matmul once; the input embedding is a
+    # gather, not a matmul, so tying does not change matmul FLOPs.
     n_dense = (
         c.hidden_size * (c.n_heads + 2 * c.kv_heads) * c.head_dim  # qkv
         + c.n_heads * c.head_dim * c.hidden_size  # out proj
         + c.hidden_size * c.ffn_dim * (3 if c.activation == "swiglu" else 2)
-    ) * c.n_layers + c.vocab_size * c.hidden_size * (1 if c.tie_embeddings else 2)
+    ) * c.n_layers + c.vocab_size * c.hidden_size
     attn = 2 * c.n_layers * s * c.hidden_size
     return 6.0 * (n_dense + attn / 2)

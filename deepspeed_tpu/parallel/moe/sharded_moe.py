@@ -90,8 +90,23 @@ def top2gating(
     min_capacity: int = 4,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Reference top2gating (sharded_moe.py:290): capacity 2·cf·s/e, which
-    topkgating's k-token scaling (_capacity(s·k, e, cf)) already yields."""
-    return topkgating(logits, k=2, capacity_factor=capacity_factor, min_capacity=min_capacity)
+    topkgating's k-token scaling (_capacity(s·k, e, cf)) already yields.
+
+    Aux loss follows the reference top2 convention — mean(me·ce1)·e² over the
+    FIRST-choice mask only, no /k — which is ~2× topkgating's k=2 value."""
+    # reference top2 drops by position with 1st choices outranking 2nd
+    # (locations2 offset by sum(mask1)), not by gate value
+    l_aux_k, combine, dispatch, exp_counts = topkgating(
+        logits, k=2, capacity_factor=capacity_factor, min_capacity=min_capacity,
+        drop_policy="choice_priority",
+    )
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    e = logits.shape[1]
+    mask1 = _one_hot(jnp.argmax(logits, axis=-1), e)
+    me = jnp.mean(gates, axis=0)
+    ce1 = jnp.mean(mask1, axis=0)
+    l_aux = jnp.sum(me * ce1) * e
+    return l_aux, combine, dispatch, exp_counts
 
 
 def topkgating(
@@ -100,9 +115,20 @@ def topkgating(
     capacity_factor: float = 1.0,
     min_capacity: int = 4,
     drop_tokens: bool = True,
+    drop_policy: str = "probs",
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Reference topkgating (sharded_moe.py:374): general top-k with
-    normalized combine weights and per-expert capacity dropping."""
+    normalized combine weights and per-expert capacity dropping.
+
+    drop_policy (reference default "probs"): which tokens lose when an
+    expert's capacity overflows —
+      * "probs": each expert keeps its top-capacity tokens by gate value;
+      * "position": capacity slots are filled in token order over the union
+        top-k mask (reference topkgating cumsum-over-tokens semantics);
+      * "choice_priority": all 1st choices outrank all 2nd choices, etc.,
+        then token order within a choice (reference top2gating's
+        locations2 += sum(mask1) offset semantics).
+    """
     s, e = logits.shape
     c = s * k if not drop_tokens else _capacity(s * k, e, capacity_factor, min_capacity)
     gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # [s, e]
@@ -116,25 +142,44 @@ def topkgating(
     l_aux = jnp.sum(me * ce) * e / k
     exp_counts = jnp.sum(mask, axis=0)
 
-    # positions: process the k choices in priority order so the 1st choice
-    # wins capacity slots before 2nd (reference ordering semantics). Combine
-    # weights are renormalized over SURVIVING experts only (reference top2
-    # denom over post-drop gates, sharded_moe.py:356) — accumulate raw gate
-    # values first, normalize at the end.
+    # Per-(token, expert) capacity slot + survival, by policy. Both produce
+    # pos_full [s, e] (slot index within the expert) and keep [s, e] (0/1).
+    if drop_policy == "probs":
+        # rank tokens within each expert column by gate value, descending
+        # (double argsort = inverse permutation = rank); keep ranks < c.
+        masked_gates = jnp.where(mask > 0, gates, -jnp.inf)
+        order = jnp.argsort(-masked_gates, axis=0)
+        pos_full = jnp.argsort(order, axis=0).astype(jnp.float32)
+    elif drop_policy == "position":
+        pos_full = jnp.cumsum(mask, axis=0) - 1.0
+    elif drop_policy == "choice_priority":
+        # choice-major slot order: expert e's slots go to 1st-choice tokens
+        # first (in token order), then 2nd-choice, ... — each choice's
+        # locations are offset by the cumulative count of earlier choices.
+        pos_full = jnp.zeros((s, e), jnp.float32)
+        base_counts = jnp.zeros((e,), jnp.float32)
+        for j in range(k):
+            oh_j = _one_hot(topk_idx[:, j], e)
+            loc_j = (jnp.cumsum(oh_j, axis=0) - 1.0 + base_counts[None, :]) * oh_j
+            pos_full = pos_full + loc_j
+            base_counts = base_counts + jnp.sum(oh_j, axis=0)
+    else:
+        raise ValueError(f"unknown drop_policy {drop_policy!r}")
+    keep = mask * (pos_full < c).astype(mask.dtype) if drop_tokens else mask
+
+    # Combine weights are renormalized over SURVIVING experts only (reference
+    # top2 denom over post-drop gates, sharded_moe.py:356) — accumulate raw
+    # gate values first, normalize at the end.
     combine = jnp.zeros((s, e, c), jnp.float32)
-    base_counts = jnp.zeros((e,), jnp.float32)
     kept_total = jnp.zeros((s,), jnp.float32)
     for j in range(k):
-        mask_j = _one_hot(topk_idx[:, j], e)  # [s, e]
-        loc_j = _position_in_expert(mask_j) + base_counts[None, :]
-        if drop_tokens:
-            mask_j = mask_j * (loc_j < c).astype(mask_j.dtype)
-        pos_j = jnp.sum(loc_j * mask_j, axis=-1).astype(jnp.int32)
+        oh_j = _one_hot(topk_idx[:, j], e)  # [s, e]
+        mask_j = oh_j * keep
+        pos_j = jnp.sum(pos_full * oh_j, axis=-1).astype(jnp.int32)
         kept_j = jnp.sum(mask_j, axis=-1)  # [s] 1 if this choice survived
         w_j = topk_vals[:, j] * kept_j
         kept_total = kept_total + w_j
         combine = combine + w_j[:, None, None] * mask_j[:, :, None] * _one_hot(pos_j, c)[:, None, :]
-        base_counts = base_counts + jnp.sum(mask_j, axis=0)
     combine = combine / jnp.maximum(kept_total, 1e-9)[:, None, None]
     dispatch = combine > 0
     return l_aux, combine, dispatch, exp_counts
@@ -151,6 +196,7 @@ class TopKGate:
         min_capacity: int = 4,
         noisy_gate_policy: Optional[str] = None,
         drop_tokens: bool = True,
+        drop_policy: str = "probs",
     ):
         self.k = k
         self.capacity_factor = capacity_factor
@@ -158,6 +204,7 @@ class TopKGate:
         self.min_capacity = min_capacity
         self.noisy_gate_policy = noisy_gate_policy
         self.drop_tokens = drop_tokens
+        self.drop_policy = drop_policy
 
     def __call__(self, logits, train: bool = True, rng=None):
         cf = self.capacity_factor if train else self.eval_capacity_factor
@@ -166,7 +213,9 @@ class TopKGate:
                 logits, cf, self.min_capacity,
                 self.noisy_gate_policy if train else None, rng, self.drop_tokens,
             )
-        return topkgating(logits, self.k, cf, self.min_capacity, self.drop_tokens)
+        return topkgating(
+            logits, self.k, cf, self.min_capacity, self.drop_tokens, self.drop_policy
+        )
 
 
 def _expert_sharded(x, spec):

@@ -153,3 +153,42 @@ class TestInferenceV2:
         free0 = engine.state_manager.free_blocks
         engine.generate([np.arange(1, 20)], max_new_tokens=3)
         assert engine.state_manager.free_blocks == free0
+
+    def test_inadmissible_prompt_rejected_at_submit(self, tiny_model):
+        """Liveness: a prompt that could never fit (per-seq block cap) raises
+        at submit instead of busy-looping generate() forever."""
+        cfg, params = tiny_model
+        engine = self._engine(cfg, params)  # 8 blocks x 16 = 128-token cap
+        with pytest.raises(ValueError):
+            engine.scheduler.submit(0, np.arange(1, 200, dtype=np.int32))
+
+    def test_max_context_enforced_at_submit(self, tiny_model):
+        cfg, params = tiny_model
+        rc = RaggedInferenceEngineConfig.from_dict(
+            {
+                "dtype": "float32",
+                "kv_cache": {"block_size": 16, "num_blocks": 64, "max_blocks_per_seq": 8},
+                "state_manager": {"max_context": 32},
+            }
+        )
+        engine = InferenceEngineV2(cfg, params, rc)
+        with pytest.raises(ValueError, match="max_context"):
+            engine.scheduler.submit(0, np.arange(1, 40, dtype=np.int32))
+
+    def test_decode_capped_at_block_limit_finishes(self, tiny_model):
+        """A sequence whose decode hits max_blocks_per_seq ends like a
+        max-length stop; generate() terminates and reports it as capped."""
+        cfg, params = tiny_model
+        rc = RaggedInferenceEngineConfig.from_dict(
+            {
+                "dtype": "float32",
+                "kv_cache": {"block_size": 16, "num_blocks": 64, "max_blocks_per_seq": 1},
+                "state_manager": {"max_ragged_batch_size": 64},
+            }
+        )
+        engine = InferenceEngineV2(cfg, params, rc)
+        prompt = np.arange(1, 11, dtype=np.int32)  # 10 tokens; block cap = 16
+        out = engine.generate([prompt], max_new_tokens=50)
+        # 16-token block fills: 10 prompt + 6 generated, then capped stop
+        assert len(out[0]) <= 16 + 1  # +1: last sampled token is host-side
+        assert 0 in engine.scheduler.capped

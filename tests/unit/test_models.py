@@ -120,6 +120,41 @@ class TestMoE:
         w = np.asarray(jnp.sum(combine, axis=(1, 2)))
         np.testing.assert_allclose(w, np.ones(16), rtol=1e-5)
 
+    def test_top2_aux_loss_reference_scale(self):
+        """top2gating aux = mean(me*ce1)*e^2 over the FIRST-choice mask, no
+        /k (reference sharded_moe.py:290 convention, vs topkgating's :374)."""
+        from deepspeed_tpu.parallel.moe import top2gating
+
+        logits = jax.random.normal(jax.random.key(1), (64, 4))
+        aux2, _, _, _ = top2gating(logits, capacity_factor=4.0)
+        gates = jax.nn.softmax(logits, axis=-1)
+        mask1 = jax.nn.one_hot(jnp.argmax(logits, axis=-1), 4)
+        expected = jnp.mean(jnp.mean(gates, 0) * jnp.mean(mask1, 0)) * 16
+        np.testing.assert_allclose(float(aux2), float(expected), rtol=1e-5)
+
+    def test_drop_policy_probs_keeps_highest_gates(self):
+        """With capacity 4 and 8 tokens on one expert, 'probs' keeps the 4
+        highest-gate tokens while 'position' keeps the first 4 by position."""
+        from deepspeed_tpu.parallel.moe import topkgating
+
+        # 8 tokens, 2 experts; everyone's 1st choice is expert 0 with
+        # increasing confidence by token index. k=2 -> capacity(16,2,.25)=4... use
+        # explicit small capacity via capacity_factor.
+        strength = jnp.linspace(1.0, 3.0, 8)
+        logits = jnp.stack([strength, -strength], axis=1)  # top1 = expert 0 for all
+        _, comb_probs, disp_probs, _ = topkgating(
+            logits, k=2, capacity_factor=0.25, min_capacity=4, drop_policy="probs"
+        )
+        _, comb_pos, disp_pos, _ = topkgating(
+            logits, k=2, capacity_factor=0.25, min_capacity=4, drop_policy="position"
+        )
+        kept_probs = np.asarray(jnp.sum(disp_probs[:, 0, :], axis=-1))  # expert 0
+        kept_pos = np.asarray(jnp.sum(disp_pos[:, 0, :], axis=-1))
+        # probs: last 4 tokens (highest gate) survive on expert 0
+        np.testing.assert_array_equal(kept_probs, [0, 0, 0, 0, 1, 1, 1, 1])
+        # position: first 4 tokens survive on expert 0
+        np.testing.assert_array_equal(kept_pos, [1, 1, 1, 1, 0, 0, 0, 0])
+
 
 class TestShardedModel:
     def test_tp_sharded_forward_matches_single(self, devices8):
