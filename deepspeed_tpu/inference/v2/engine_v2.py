@@ -177,8 +177,10 @@ class InferenceEngineV2:
             self.scheduler.submit(uid, p)
         remaining = {uid: max_new_tokens for uid in uids}
         outputs = {uid: list(np.asarray(p, np.int32).reshape(-1)) for uid, p in zip(uids, prompts)}
+        self.last_capped = set()
         while self.scheduler.has_work():
             results = self.step()
+            self.last_capped |= self.scheduler.drain_capped()
             # Liveness: if nothing was scheduled and work remains, no call we
             # make below can change scheduler state — fail loudly instead of
             # busy-looping (e.g. KV pool too fragmented for any pending

@@ -68,6 +68,15 @@ class RaggedScheduler:
         self._mgr.check_admissible(total)
         seq = self._mgr.get_or_create_sequence(uid)
         seq.tokens.extend(int(t) for t in toks)
+        # Continuation while a decode token is outstanding: fold the pending
+        # sampled token (already in seq.tokens via feedback()) into this
+        # prompt chunk — otherwise next_batch() would emit a decode row AND a
+        # prompt row at the same start position, double-writing the KV cache.
+        if uid in self._running:
+            self._running.remove(uid)
+            pending = self._next_token.pop(uid, None)
+            if pending is not None:
+                toks = np.concatenate([np.asarray([pending], np.int32), toks])
         self.capped.discard(uid)  # a fresh submit supersedes old capped state
         self._pending.append((uid, toks))
 
@@ -89,6 +98,13 @@ class RaggedScheduler:
         if uid in self._running:
             self._running.remove(uid)
         self._mgr.flush_sequence(uid)
+
+    def drain_capped(self) -> set:
+        """Return and clear the capped-uid set (bounds its growth in
+        long-lived engines; callers accumulate if they need history)."""
+        out = self.capped
+        self.capped = set()
+        return out
 
     def has_work(self) -> bool:
         return bool(self._pending or self._running)

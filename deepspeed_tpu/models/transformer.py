@@ -235,10 +235,10 @@ def param_partition_specs(config: TransformerConfig) -> Dict[str, Any]:
 # ---------------------------------------------------------------------------
 def _norm(x, w, b, kind, eps):
     """Delegates to the ops layer (single definition; Pallas on TPU)."""
-    from deepspeed_tpu.ops.normalization import fused_layer_norm, fused_rms_norm
+    from deepspeed_tpu.ops.normalization import fused_layer_norm, rms_norm
 
     if kind == "rmsnorm":
-        y = fused_rms_norm(x, w, eps)
+        y = rms_norm(x, w, eps)
         return y + b if b is not None else y
     return fused_layer_norm(x, w, b if b is not None else jnp.zeros_like(w), eps)
 

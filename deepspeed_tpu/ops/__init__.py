@@ -43,9 +43,11 @@ class FusedRMSNormBuilder(PallasOpBuilder):
     NAME = "rms_norm"
 
     def _build(self):
-        from deepspeed_tpu.ops.normalization import fused_rms_norm
+        # mesh-aware entry: per-shard Pallas under multi-device topologies
+        # (the raw fused_rms_norm kernel is GSPMD-opaque)
+        from deepspeed_tpu.ops.normalization import rms_norm
 
-        return fused_rms_norm
+        return rms_norm
 
 
 # Compatibility table (reference deepspeed.ops.__compatible_ops__)

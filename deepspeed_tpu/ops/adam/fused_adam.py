@@ -118,21 +118,87 @@ class FusedAdamState(NamedTuple):
     count: jnp.ndarray
 
 
-def fused_adam_transform(hp: AdamParams = AdamParams(), use_pallas: bool = None):
+def _spec_axes(spec):
+    """Flat tuple of mesh axis names appearing in a PartitionSpec."""
+    axes = []
+    for entry in tuple(spec or ()):
+        if entry is None:
+            continue
+        axes.extend(entry if isinstance(entry, (tuple, list)) else (entry,))
+    return tuple(axes)
+
+
+def _shardable(shape, spec, mesh) -> bool:
+    """Every sharded dim must divide evenly for shard_map."""
+    for i, entry in enumerate(tuple(spec)):
+        if entry is None:
+            continue
+        k = 1
+        for a in entry if isinstance(entry, (tuple, list)) else (entry,):
+            k *= mesh.shape[a]
+        if i >= len(shape) or shape[i] % k:
+            return False
+    return True
+
+
+def _sharded_adam_step(p, g, m, v, count, hp, lr, spec, mesh, interpret):
+    """Per-shard Pallas update under partial-manual shard_map: each device
+    runs the fused kernel on its local slice of the ZeRO-partitioned
+    p/g/m/v (the TPU form of the reference's per-partition multi_tensor
+    update, stage_1_and_2.py step). Axes not in ``spec`` stay automatic,
+    so this composes with the surrounding GSPMD program."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, spec)
+    p, g, m, v = (jax.lax.with_sharding_constraint(x, sharding) for x in (p, g, m, v))
+    fn = jax.shard_map(
+        lambda p_, g_, m_, v_, c_, lr_: fused_adam_step(
+            p_, g_, m_, v_, c_, hp, lr_, interpret=interpret
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec, spec, P(), P()),
+        out_specs=(spec, spec, spec),
+        axis_names=set(_spec_axes(spec)),
+        check_vma=False,
+    )
+    return fn(p, g, m, v, count, jnp.asarray(lr, jnp.float32))
+
+
+def fused_adam_transform(
+    hp: AdamParams = AdamParams(),
+    use_pallas: bool = None,
+    master_specs=None,
+    mesh=None,
+    interpret: bool = False,
+):
     """optax-contract transformation: ``update(grads, state, params, lr) ->
     (updates, new_state)`` where ``params + updates`` is the fused-Adam
     result — pluggable into DeepSpeedOptimizer.step's ``apply_updates`` flow.
-    The Pallas kernel handles large flat leaves on TPU; the jnp path (XLA-
-    fused) defines the semantics elsewhere."""
+
+    Single device: the Pallas kernel runs on whole leaves. Multi-device mesh
+    with ``master_specs``/``mesh`` provided (the engine plumbs its ZeRO
+    plan): the kernel runs per-shard under shard_map on each leaf's own
+    partition layout — no gather, optimizer state stays ZeRO-partitioned.
+    The jnp path (XLA-fused) defines the semantics everywhere else."""
     import optax
 
     if use_pallas is None:
-        # pallas_call is opaque to GSPMD — under a multi-device mesh the
-        # jnp path keeps ZeRO-sharded optimizer state partitioned; the
-        # kernel serves single-chip and the host-offload tier
+        use_pallas = interpret or jax.default_backend() == "tpu"
+    single_device = True
+    if mesh is not None:
+        single_device = mesh.size == 1
+    else:
         from deepspeed_tpu.parallel.topology import get_topology
 
-        use_pallas = jax.default_backend() == "tpu" and get_topology().world_size == 1
+        single_device = get_topology().world_size == 1
+    sharded = use_pallas and not single_device and master_specs is not None and mesh is not None
+
+    flat_specs = None
+    if sharded:
+        from jax.sharding import PartitionSpec
+
+        is_spec = lambda x: x is None or isinstance(x, PartitionSpec)
+        flat_specs = jax.tree_util.tree_leaves(master_specs, is_leaf=is_spec)
 
     def init(params):
         z = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
@@ -143,16 +209,37 @@ def fused_adam_transform(hp: AdamParams = AdamParams(), use_pallas: bool = None)
         count = state.count + 1
         stepf = count.astype(jnp.float32)
 
-        def leaf(p, g, m, v):
+        def leaf(p, g, m, v, spec=None):
             if use_pallas and p.size >= 1 << 16:
-                p_new, m_new, v_new = fused_adam_step(p, g, m, v, count, hp, lr)
+                if (
+                    sharded
+                    and spec is not None
+                    and _spec_axes(spec)
+                    and _shardable(p.shape, spec, mesh)
+                ):
+                    p_new, m_new, v_new = _sharded_adam_step(
+                        p, g, m, v, count, hp, lr, spec, mesh, interpret
+                    )
+                elif single_device:
+                    p_new, m_new, v_new = fused_adam_step(
+                        p, g, m, v, count, hp, lr, interpret=interpret
+                    )
+                else:  # multi-device but this leaf has no usable spec
+                    p_new, m_new, v_new = _adam_math(p, g.astype(jnp.float32), m, v, stepf, hp, lr)
             else:
                 p_new, m_new, v_new = _adam_math(p, g.astype(jnp.float32), m, v, stepf, hp, lr)
             return (p_new - p).astype(p.dtype), m_new, v_new
 
-        out = jax.tree.map(leaf, params, grads, state.m, state.v)
         treedef = jax.tree_util.tree_structure(params)
-        flat = treedef.flatten_up_to(out)
+        flat_p = treedef.flatten_up_to(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_m = treedef.flatten_up_to(state.m)
+        flat_v = treedef.flatten_up_to(state.v)
+        specs = flat_specs if flat_specs is not None else [None] * len(flat_p)
+        flat = [
+            leaf(p, g, m, v, s)
+            for p, g, m, v, s in zip(flat_p, flat_g, flat_m, flat_v, specs)
+        ]
         updates = treedef.unflatten([o[0] for o in flat])
         new_m = treedef.unflatten([o[1] for o in flat])
         new_v = treedef.unflatten([o[2] for o in flat])
@@ -167,11 +254,14 @@ class FusedAdam:
     for config ``{"optimizer": {"type": "FusedAdam"}}``."""
 
     def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
-                 adam_w_mode=True, bias_correction=True):
+                 adam_w_mode=True, bias_correction=True, master_specs=None,
+                 mesh=None, interpret=False):
         self.hp = AdamParams(
             lr=lr, beta1=betas[0], beta2=betas[1], eps=eps,
             weight_decay=weight_decay, adam_w_mode=adam_w_mode,
             bias_correction=bias_correction,
         )
-        tx = fused_adam_transform(self.hp)
+        tx = fused_adam_transform(
+            self.hp, master_specs=master_specs, mesh=mesh, interpret=interpret
+        )
         self.init, self.update = tx.init, tx.update

@@ -10,6 +10,7 @@ from deepspeed_tpu.ops.normalization.fused_norm import (
     fused_layer_norm,
     fused_rms_norm,
     layer_norm_reference,
+    rms_norm,
     rms_norm_reference,
 )
 
@@ -17,5 +18,6 @@ __all__ = [
     "fused_layer_norm",
     "fused_rms_norm",
     "layer_norm_reference",
+    "rms_norm",
     "rms_norm_reference",
 ]

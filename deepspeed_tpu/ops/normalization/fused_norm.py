@@ -54,15 +54,9 @@ def fused_rms_norm(x, w, eps=1e-5, interpret=False):
 
 
 def _use_pallas(interpret):
-    if interpret:
-        return True
-    if jax.default_backend() != "tpu":
-        return False
-    # pallas_call is opaque to GSPMD: on a multi-device mesh the jnp path
-    # (fully partitionable, XLA-fused) wins; the kernel serves single-chip
-    from deepspeed_tpu.parallel.topology import get_topology
-
-    return get_topology().world_size == 1
+    # single-shard gate only: multi-device dispatch happens in rms_norm(),
+    # which runs this kernel per-shard under shard_map
+    return interpret or jax.default_backend() == "tpu"
 
 
 def _rows_view(x):
@@ -134,6 +128,70 @@ def _rms_bwd(eps, interpret, res, g):
 
 
 fused_rms_norm.defvjp(_rms_fwd, _rms_bwd)
+
+_SHARDED_FALLBACK_WARNED = False
+
+
+def rms_norm(x, w, eps=1e-5, interpret=False):
+    """Mesh-aware RMSNorm entry point (the one model code should call).
+
+    Single device: the Pallas kernel directly. Multi-device mesh: pallas_call
+    is opaque to GSPMD, so the activation is pinned to the canonical layout
+    (batch over data/expert, seq over sequence, h replicated) and the kernel
+    runs per-shard under partial-manual shard_map — same pattern as
+    ops/attention/core._flash_sharded. shard_map is differentiable: w enters
+    replicated (P()), so its cotangent is psum'd across shards by the
+    transpose, and dx stays in the activation layout. Falls back to the jnp
+    reference whenever the layout preconditions don't hold.
+    """
+    if not _use_pallas(interpret):
+        return rms_norm_reference(x, w, eps)
+
+    from deepspeed_tpu.parallel.topology import get_topology
+
+    topo = get_topology()
+    if topo.world_size == 1:
+        return fused_rms_norm(x, w, eps, interpret)
+    if x.ndim != 3:
+        return rms_norm_reference(x, w, eps)
+    b, s, _h = x.shape
+    batch_div = topo.data_parallel_size * topo.expert_parallel_size
+    seq_div = topo.sequence_parallel_size
+    if b % batch_div or s % seq_div:
+        return rms_norm_reference(x, w, eps)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from deepspeed_tpu.parallel.topology import BATCH_AXES, SEQUENCE_AXIS
+
+    spec = P(BATCH_AXES, SEQUENCE_AXIS, None)
+    x = jax.lax.with_sharding_constraint(x, NamedSharding(topo.mesh, spec))
+    fn = jax.shard_map(
+        lambda x_, w_: fused_rms_norm(x_, w_, eps, interpret),
+        mesh=topo.mesh,
+        in_specs=(spec, P()),
+        out_specs=spec,
+        axis_names={*BATCH_AXES, SEQUENCE_AXIS},
+        check_vma=False,
+    )
+    try:
+        return fn(x, w)
+    except Exception as e:
+        # e.g. nested-manual-axis contexts the current JAX can't compose;
+        # trace-time failure, so the jnp path is a safe same-semantics swap —
+        # but say so once, or a dead kernel path hides as an MFU regression
+        global _SHARDED_FALLBACK_WARNED
+        if not _SHARDED_FALLBACK_WARNED:
+            _SHARDED_FALLBACK_WARNED = True
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "sharded rms_norm kernel dispatch failed (%s: %s); "
+                "falling back to the jnp reference path",
+                type(e).__name__,
+                e,
+            )
+        return rms_norm_reference(x, w, eps)
 
 
 def fused_layer_norm(x, w, b, eps=1e-5):

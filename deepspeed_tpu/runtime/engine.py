@@ -240,7 +240,12 @@ class DeepSpeedEngine:
             raise ValueError(
                 "No optimizer: pass `optimizer=` to initialize() or set the config 'optimizer' section"
             )
-        return build_optimizer(config.optimizer, config.precision_dtype)
+        return build_optimizer(
+            config.optimizer,
+            config.precision_dtype,
+            master_specs=self.plan.master_specs,
+            mesh=self.plan.topology.mesh,
+        )
 
     def _configure_lr_scheduler(self, client_scheduler, config):
         if client_scheduler is not None:

@@ -111,9 +111,18 @@ def _muon(beta=0.95, ns_steps=5, weight_decay=0.0, adam_betas=(0.9, 0.95), eps=1
     return muon_transform(beta=beta, ns_steps=ns_steps, weight_decay=weight_decay, adam_betas=adam_betas, eps=eps)
 
 
-def build_optimizer(opt_config, precision_dtype: str = "float32") -> DeepSpeedOptimizer:
+def build_optimizer(
+    opt_config,
+    precision_dtype: str = "float32",
+    master_specs=None,
+    mesh=None,
+) -> DeepSpeedOptimizer:
     """Map a DeepSpeed ``optimizer`` config section to a DeepSpeedOptimizer
-    (reference engine._configure_basic_optimizer engine.py:1519)."""
+    (reference engine._configure_basic_optimizer engine.py:1519).
+
+    ``master_specs``/``mesh`` (the engine's ZeRO plan) let spec-aware
+    optimizers (FusedAdam) run their Pallas kernels per-shard under
+    multi-device meshes instead of falling back to the jnp path."""
     name = (opt_config.type or ADAMW_OPTIMIZER).lower()
     params = dict(opt_config.params or {})
     lr = params.pop("lr", 1e-3)
@@ -133,6 +142,7 @@ def build_optimizer(opt_config, precision_dtype: str = "float32") -> DeepSpeedOp
             lr=lr, betas=betas, eps=eps, weight_decay=weight_decay,
             adam_w_mode=adam_w_mode,
             bias_correction=params.pop("bias_correction", True),
+            master_specs=master_specs, mesh=mesh,
         )
         import optax as _optax
 

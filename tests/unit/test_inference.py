@@ -154,6 +154,28 @@ class TestInferenceV2:
         engine.generate([np.arange(1, 20)], max_new_tokens=3)
         assert engine.state_manager.free_blocks == free0
 
+    def test_continuation_submit_while_running(self, tiny_model):
+        """Submitting more tokens for a uid with an outstanding decode token
+        folds the pending token into the prompt chunk (no double KV write)."""
+        cfg, params = tiny_model
+        engine = self._engine(cfg, params)
+        prompt = np.arange(1, 9, dtype=np.int32)
+        res = engine.put([0], [prompt])  # prefill -> logits for uid 0
+        nxt = int(np.argmax(res[0]))
+        engine.scheduler.feedback(0, nxt)  # uid 0 now running
+        extra = np.arange(11, 15, dtype=np.int32)
+        res2 = engine.put([0], [extra])  # continuation while running
+        assert 0 in res2
+        seq = engine.state_manager.get_sequence(0)
+        # KV holds prompt + pending + extra exactly once
+        assert seq.seen_tokens == len(prompt) + 1 + len(extra)
+        # matches the dense reference over the same token history
+        full = np.concatenate([prompt, [nxt], extra])
+        ref = _greedy_reference(cfg, params, full, 1)
+        np.testing.assert_array_equal(
+            np.concatenate([full, [int(np.argmax(res2[0]))]]), ref
+        )
+
     def test_inadmissible_prompt_rejected_at_submit(self, tiny_model):
         """Liveness: a prompt that could never fit (per-seq block cap) raises
         at submit instead of busy-looping generate() forever."""
@@ -191,4 +213,4 @@ class TestInferenceV2:
         out = engine.generate([prompt], max_new_tokens=50)
         # 16-token block fills: 10 prompt + 6 generated, then capped stop
         assert len(out[0]) <= 16 + 1  # +1: last sampled token is host-side
-        assert 0 in engine.scheduler.capped
+        assert 0 in engine.last_capped
