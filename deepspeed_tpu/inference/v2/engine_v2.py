@@ -53,6 +53,7 @@ class InferenceEngineV2:
         self._v_cache = jnp.zeros(shape, dtype)
         self._row_jit = {}
         self.last_scheduled_tokens = 0
+        self.last_capped = set()
         log_dist(
             f"InferenceEngineV2: {kv.num_blocks} KV blocks × {kv.block_size} tokens, "
             f"budget {self.config.state_manager.max_ragged_batch_size} tok/step",
@@ -140,6 +141,7 @@ class InferenceEngineV2:
     def step(self) -> Dict[int, np.ndarray]:
         batch = self.scheduler.next_batch()
         self.last_scheduled_tokens = batch.total_tokens if batch is not None else 0
+        self.last_capped |= self.scheduler.drain_capped()
         if batch is None:
             return {}
         results: Dict[int, np.ndarray] = {}
@@ -180,7 +182,6 @@ class InferenceEngineV2:
         self.last_capped = set()
         while self.scheduler.has_work():
             results = self.step()
-            self.last_capped |= self.scheduler.drain_capped()
             # Liveness: if nothing was scheduled and work remains, no call we
             # make below can change scheduler state — fail loudly instead of
             # busy-looping (e.g. KV pool too fragmented for any pending

@@ -26,9 +26,13 @@ class AdamParams(NamedTuple):
     bias_correction: bool = True
 
 
-def _adam_math(p, g, m, v, step, hp: AdamParams, lr):
+def _adam_math(p, g, m, v, step, hp: AdamParams, lr, c1=None, c2=None):
     """The update shared by every path (matches reference Adam semantics:
-    adam_w_mode=True → AdamW decoupled decay, else L2-into-grad)."""
+    adam_w_mode=True → AdamW decoupled decay, else L2-into-grad).
+
+    ``c1``/``c2`` optionally carry precomputed bias corrections — the Pallas
+    kernel passes them in because Mosaic cannot lower a traced-exponent
+    ``pow`` inside the kernel body."""
     g = g.astype(jnp.float32)
     p32 = p.astype(jnp.float32)
     if not hp.adam_w_mode and hp.weight_decay:
@@ -36,8 +40,10 @@ def _adam_math(p, g, m, v, step, hp: AdamParams, lr):
     m_new = hp.beta1 * m + (1 - hp.beta1) * g
     v_new = hp.beta2 * v + (1 - hp.beta2) * jnp.square(g)
     if hp.bias_correction:
-        c1 = 1 - hp.beta1 ** step
-        c2 = 1 - hp.beta2 ** step
+        if c1 is None:
+            c1 = 1 - hp.beta1 ** step
+        if c2 is None:
+            c2 = 1 - hp.beta2 ** step
         update = (m_new / c1) / (jnp.sqrt(v_new / c2) + hp.eps)
     else:
         update = m_new / (jnp.sqrt(v_new) + hp.eps)
@@ -46,10 +52,12 @@ def _adam_math(p, g, m, v, step, hp: AdamParams, lr):
     return (p32 - lr * update).astype(p.dtype), m_new, v_new
 
 
-def _fused_kernel(step_ref, lr_ref, p_ref, g_ref, m_ref, v_ref, po_ref, mo_ref, vo_ref, *, hp):
-    step = step_ref[0, 0].astype(jnp.float32)
+def _fused_kernel(lr_ref, c1_ref, c2_ref, p_ref, g_ref, m_ref, v_ref, po_ref, mo_ref, vo_ref, *, hp):
     lr = lr_ref[0, 0]
-    p_new, m_new, v_new = _adam_math(p_ref[:], g_ref[:], m_ref[:], v_ref[:], step, hp, lr)
+    p_new, m_new, v_new = _adam_math(
+        p_ref[:], g_ref[:], m_ref[:], v_ref[:], None, hp, lr,
+        c1=c1_ref[0, 0], c2=c2_ref[0, 0],
+    )
     po_ref[:] = p_new
     mo_ref[:] = m_new
     vo_ref[:] = v_new
@@ -71,7 +79,9 @@ def fused_adam_step(
     from jax.experimental.pallas import tpu as pltpu
 
     lr = jnp.asarray(hp.lr if lr is None else lr, jnp.float32).reshape((1, 1))
-    step = jnp.asarray(step, jnp.int32).reshape((1, 1))
+    stepf = jnp.asarray(step, jnp.float32).reshape((1, 1))
+    c1 = 1.0 - hp.beta1 ** stepf  # bias corrections computed outside the
+    c2 = 1.0 - hp.beta2 ** stepf  # kernel (Mosaic can't lower traced pow)
     orig_shape = params.shape
     n = params.size
     flat = lambda a, dt: a.reshape(-1).astype(dt)
@@ -91,6 +101,7 @@ def fused_adam_step(
         in_specs=[
             pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec((8, block), lambda i: (i, 0)),
             pl.BlockSpec((8, block), lambda i: (i, 0)),
             pl.BlockSpec((8, block), lambda i: (i, 0)),
@@ -107,7 +118,7 @@ def fused_adam_step(
             jax.ShapeDtypeStruct(shape2, jnp.float32),
         ],
         interpret=interpret,
-    )(step, lr, p, g, mm, vv)
+    )(lr, c1, c2, p, g, mm, vv)
     unflat = lambda a: a.reshape(-1)[:n].reshape(orig_shape)
     return unflat(p_new), unflat(m_new), unflat(v_new)
 

@@ -122,9 +122,18 @@ class DeepSpeedEngine:
             {"fp32": "float32", "fp16": "float16", "bf16": "bfloat16", None: "float32"}[grad_accum]
         ]
 
-        # ZeRO plan
+        # ZeRO plan (+ offload tiers: reference offload_config.py — optimizer
+        # state / params in host memory; nvme maps to the host tier until a
+        # DeepNVMe analogue exists)
         zcfg = config.zero_optimization
         self.zero_stage = zcfg.stage
+        offload_opt = zcfg.offload_optimizer.device != "none"
+        offload_par = zcfg.offload_param.device != "none"
+        if zcfg.offload_optimizer.device == "nvme" or zcfg.offload_param.device == "nvme":
+            log_dist(
+                "offload device 'nvme' maps to the host-memory tier on TPU "
+                "(no NVMe swap yet)", ranks=[0],
+            )
         params = _snapshot_cast(params, self.compute_dtype)
         self.plan: ZeroShardingPlan = build_zero_plan(
             stage=self.zero_stage,
@@ -132,16 +141,35 @@ class DeepSpeedEngine:
             params=params,
             persistence_threshold=zcfg.param_persistence_threshold if self.zero_stage >= 3 else 0,
             base_specs=param_specs,
+            offload_optimizer=offload_opt,
+            offload_param=offload_par,
         )
+        # offload execution mode: the true host-offload path (host-kind
+        # out_shardings + compute_on) is TPU-only; the CPU test mesh hits an
+        # XLA SPMD-partitioner RET_CHECK on memory-kind annotations, so it
+        # stages state through device memory inside the step and parks it
+        # back to pinned_host eagerly between steps (same semantics).
+        self._offload_native = jax.default_backend() == "tpu"
         if not dont_change_device:
-            params = jax.device_put(params, self.plan.param_shardings)
+            init_shardings = (
+                self.plan.param_shardings
+                if self._offload_native
+                else self.plan.device_shardings(self.plan.param_shardings)
+            )
+            params = jax.device_put(params, init_shardings)
         self.params = params
 
         # optimizer (+ fp32 master, sharded per plan)
         self.optimizer = self._configure_optimizer(optimizer, config)
         state_shapes = jax.eval_shape(self.optimizer.init, self.params)
         self._state_shardings = self.plan.state_shardings(state_shapes)
-        self.opt_state = jax.jit(self.optimizer.init, out_shardings=self._state_shardings)(self.params)
+        self.opt_state = jax.jit(
+            self.optimizer.init,
+            out_shardings=self.plan.device_shardings(self._state_shardings),
+        )(self.params)
+        if self.plan.offload_optimizer:
+            self.opt_state = jax.device_put(self.opt_state, self._state_shardings)
+        self.params = self._park_params(self.params)
 
         # loss scaling
         self.scaler_cfg = ls.make_config(config.fp16) if self.fp16_enabled else ls.LossScalerConfig(
@@ -374,6 +402,110 @@ class DeepSpeedEngine:
 
         return jax.tree.map(spec, batch)
 
+    def _stage_params(self, params):
+        """offload_param tier (native/TPU): params rest in pinned_host between
+        steps; the compiled step stages them into HBM before any compute
+        (XLA overlaps the per-leaf H2D chain with the first layers' compute).
+        On the eager path the un-park happens outside jit instead."""
+        if not (self.plan.offload_param and self._offload_native):
+            return params
+        return jax.device_put(params, self.plan.device_shardings(self.plan.param_shardings))
+
+    def _unpark_for_step(self):
+        """Eager offload mode only: move host-parked state/params into device
+        memory before a compiled step (outside jit — the CPU backend rejects
+        memory-kind annotations inside SPMD programs)."""
+        if self._offload_native:
+            return
+        if self.plan.offload_optimizer:
+            self.opt_state = jax.device_put(
+                self.opt_state, self.plan.device_shardings(self._state_shardings)
+            )
+        self._unpark_params()
+
+    def _unpark_params(self):
+        if self._offload_native:
+            return
+        if self.plan.offload_param:
+            self.params = jax.device_put(
+                self.params, self.plan.device_shardings(self.plan.param_shardings)
+            )
+
+    def _opt_apply(self, safe_grads, opt_state, params, lr, overflow):
+        """Optimizer update + overflow skip-step, honoring the offload tier.
+
+        ZeRO-Offload (reference stage_1_and_2.py:1307 cpu-offload path +
+        cpu_adam): with ``offload_optimizer`` the fp32 master and moments
+        live in ``pinned_host`` memory; on TPU the update itself runs on the
+        host CPU (``compute_on("device_host")`` — the XLA-native CPU-Adam),
+        so only grads cross PCIe down and the half-precision params cross
+        back up; optimizer state never touches HBM. XLA schedules the
+        per-leaf D2H/compute/H2D chains concurrently, which is the
+        double-buffering the reference implements by hand. Muon's
+        Newton–Schulz matmuls belong on the MXU, so it stages state through
+        HBM instead. On non-TPU backends (CPU test meshes) the state is
+        staged through device memory inside the step and parked back to host
+        eagerly after it — same semantics, exercised by the CPU suite.
+        """
+        offload = self.plan.offload_optimizer
+        # Pallas-backed optimizers (fused_adam) and MXU-bound ones (muon)
+        # cannot lower inside a host-compute region; they stage through HBM.
+        host_compute = (
+            offload
+            and self._offload_native
+            and self.optimizer.name not in ("muon", "fused_adam")
+        )
+        if host_compute:
+            from jax.experimental.compute_on import compute_on
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            host_grads = jax.device_put(safe_grads, self.plan.master_shardings)
+            ov_host = jax.device_put(
+                overflow,
+                NamedSharding(self.topo.mesh, PartitionSpec(), memory_kind="pinned_host"),
+            )
+            with compute_on("device_host"):
+                new_params, new_opt_state = self.optimizer.step(
+                    host_grads, opt_state, params, lr
+                )
+                new_opt_state = _tree_select(ov_host, opt_state, new_opt_state)
+            new_params = jax.device_put(
+                new_params, self.plan.device_shardings(self.plan.param_shardings)
+            )
+            new_params = _tree_select(overflow, self._stage_params(params), new_params)
+            return new_params, new_opt_state
+        if offload and self._offload_native:  # muon: stage through HBM
+            opt_state = jax.device_put(
+                opt_state, self.plan.device_shardings(self._state_shardings)
+            )
+        new_params, new_opt_state = self.optimizer.step(safe_grads, opt_state, params, lr)
+        new_params = _tree_select(overflow, self._stage_params(params), new_params)
+        new_opt_state = _tree_select(overflow, opt_state, new_opt_state)
+        return new_params, new_opt_state
+
+    def _jit_param_shardings(self):
+        if self.plan.offload_param and not self._offload_native:
+            return self.plan.device_shardings(self.plan.param_shardings)
+        return self.plan.param_shardings
+
+    def _jit_state_shardings(self):
+        if self.plan.offload_optimizer and not self._offload_native:
+            return self.plan.device_shardings(self._state_shardings)
+        return self._state_shardings
+
+    def _park_state(self, opt_state):
+        """Eager-mode offload: move optimizer state back to pinned_host
+        between steps (no-op on the native path, where out_shardings keep it
+        there)."""
+        if self.plan.offload_optimizer and not self._offload_native:
+            return jax.device_put(opt_state, self._state_shardings)
+        return opt_state
+
+    def _park_params(self, params):
+        if self.plan.offload_param and not self._offload_native:
+            return jax.device_put(params, self.plan.param_shardings)
+        return params
+
     def _build_train_step(self):
         gas = self.config.gradient_accumulation_steps
         clip = self.config.gradient_clipping
@@ -392,6 +524,7 @@ class DeepSpeedEngine:
             return loss_scaled / scale, grads
 
         def train_step(params, opt_state, scaler_state, step, lr, batch):
+            params = self._stage_params(params)
             scale = scaler_state.scale if scaler_cfg.dynamic or scaler_cfg.init_scale != 1.0 else jnp.float32(1.0)
             base_rng = jax.random.fold_in(self._rng_key, step)
 
@@ -422,10 +555,9 @@ class DeepSpeedEngine:
                 safe_grads, grad_norm = clip_by_global_norm(safe_grads, clip)
             else:
                 grad_norm = global_grad_norm(safe_grads)
-            new_params, new_opt_state = self.optimizer.step(safe_grads, opt_state, params, lr)
-            # functional skip-step on overflow (reference step skipping, fp16)
-            new_params = _tree_select(overflow, params, new_params)
-            new_opt_state = _tree_select(overflow, opt_state, new_opt_state)
+            # offload-aware update + functional skip-step on overflow
+            # (reference step skipping, fp16)
+            new_params, new_opt_state = self._opt_apply(safe_grads, opt_state, params, lr, overflow)
             new_scaler = ls.update_state(scaler_cfg, scaler_state, overflow)
             mean_loss = jnp.mean(losses)
             return new_params, new_opt_state, new_scaler, mean_loss, grad_norm, overflow
@@ -434,8 +566,8 @@ class DeepSpeedEngine:
             train_step,
             donate_argnums=(0, 1, 2),
             out_shardings=(
-                self.plan.param_shardings,
-                self._state_shardings,
+                self._jit_param_shardings(),
+                self._jit_state_shardings(),
                 None,
                 None,
                 None,
@@ -448,6 +580,7 @@ class DeepSpeedEngine:
         mesh = self.topo.mesh
 
         def fwd_bwd(params, scaler_state, step, batch):
+            params = self._stage_params(params)
             scale = scaler_state.scale
             rng = jax.random.fold_in(self._rng_key, step)
 
@@ -467,6 +600,7 @@ class DeepSpeedEngine:
         gas = self.config.gradient_accumulation_steps
 
         def apply_step(params, opt_state, scaler_state, acc_grads, lr):
+            params = self._stage_params(params)
             scale = scaler_state.scale
             inv = 1.0 / (gas * scale)
             grads = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, acc_grads)
@@ -476,16 +610,14 @@ class DeepSpeedEngine:
                 safe_grads, grad_norm = clip_by_global_norm(safe_grads, clip)
             else:
                 grad_norm = global_grad_norm(safe_grads)
-            new_params, new_opt_state = self.optimizer.step(safe_grads, opt_state, params, lr)
-            new_params = _tree_select(overflow, params, new_params)
-            new_opt_state = _tree_select(overflow, opt_state, new_opt_state)
+            new_params, new_opt_state = self._opt_apply(safe_grads, opt_state, params, lr, overflow)
             new_scaler = ls.update_state(scaler_cfg, scaler_state, overflow)
             return new_params, new_opt_state, new_scaler, grad_norm, overflow
 
         return jax.jit(
             apply_step,
             donate_argnums=(0, 1, 2, 3),
-            out_shardings=(self.plan.param_shardings, self._state_shardings, None, None, None),
+            out_shardings=(self._jit_param_shardings(), self._jit_state_shardings(), None, None, None),
         )
 
     # ------------------------------------------------------------------
@@ -513,6 +645,7 @@ class DeepSpeedEngine:
         lr = self._lr_for_step()
         self.tput_timer.start()
         self.timers(STEP_GLOBAL_TIMER).start()
+        self._unpark_for_step()
         shardings = self._batch_shardings(stacked, leading_gas_dim=True)
         stacked = jax.device_put(stacked, shardings)
         (
@@ -531,6 +664,8 @@ class DeepSpeedEngine:
             stacked,
         )
         self.timers(STEP_GLOBAL_TIMER).stop()
+        self.params = self._park_params(self.params)
+        self.opt_state = self._park_state(self.opt_state)
         self._after_step(loss, grad_norm, overflow)
         self.tput_timer.stop(global_step=True)
         return loss
@@ -541,6 +676,7 @@ class DeepSpeedEngine:
         if self._fwd_bwd_jit is None:
             self._fwd_bwd_jit = self._build_fwd_bwd()
         self.timers(FORWARD_GLOBAL_TIMER).start()
+        self._unpark_params()
         batch = jax.device_put(batch, self._batch_shardings(batch))
         loss, grads = self._fwd_bwd_jit(
             self.params, self.scaler_state, jnp.int32(self.micro_steps), batch
@@ -582,6 +718,7 @@ class DeepSpeedEngine:
         if self._apply_jit is None:
             self._apply_jit = self._build_apply()
         lr = self._lr_for_step()
+        self._unpark_for_step()
         self.timers(STEP_GLOBAL_TIMER).start()
         (
             self.params,
@@ -590,6 +727,8 @@ class DeepSpeedEngine:
             grad_norm,
             overflow,
         ) = self._apply_jit(self.params, self.opt_state, self.scaler_state, self._acc_grads, jnp.float32(lr))
+        self.params = self._park_params(self.params)
+        self.opt_state = self._park_state(self.opt_state)
         self.timers(STEP_GLOBAL_TIMER).stop()
         self._acc_grads = None
         self._after_step(self._last_loss, grad_norm, overflow)
@@ -632,10 +771,12 @@ class DeepSpeedEngine:
         if self._eval_jit is None:
 
             def eval_fn(params, batch):
+                params = self._stage_params(params)
                 loss, aux = self._call_loss(params, batch, None if not self._loss_fn_takes_rng else self._rng_key)
                 return loss
 
             self._eval_jit = jax.jit(eval_fn)
+        self._unpark_params()
         batch = jax.device_put(batch, self._batch_shardings(batch))
         return self._eval_jit(self.params, batch)
 

@@ -109,6 +109,30 @@ class ZeroShardingPlan:
     grad_specs: Any
     master_specs: Any
     persistence_threshold: int = 0
+    # ZeRO-Offload tiers (reference offload_config.py): state/params live in
+    # host memory ("pinned_host" memory kind) instead of HBM
+    offload_optimizer: bool = False
+    offload_param: bool = False
+
+    @property
+    def state_memory_kind(self):
+        return "pinned_host" if self.offload_optimizer else None
+
+    @property
+    def param_memory_kind(self):
+        return "pinned_host" if self.offload_param else None
+
+    def device_shardings(self, shardings):
+        """The HBM-resident twin of a (possibly host-kind) sharding tree —
+        used to stage offloaded state onto the chip around the update. No
+        explicit memory kind: the default is device memory, and kind-less
+        shardings avoid placement annotations that the CPU backend's SPMD
+        partitioner rejects on scalars."""
+        return jax.tree.map(
+            lambda s: NamedSharding(s.mesh, s.spec),
+            shardings,
+            is_leaf=lambda x: isinstance(x, NamedSharding),
+        )
 
     def state_shardings(self, state_shape_tree):
         """Shardings for an optimizer-state pytree (from ``jax.eval_shape`` of
@@ -123,11 +147,20 @@ class ZeroShardingPlan:
         mesh = self.topology.mesh
         stage = self.stage
 
+        kind = self.state_memory_kind
+
         def leaf_sharding(leaf):
             shape = tuple(getattr(leaf, "shape", ()))
             if stage >= 1 and shape:
-                return NamedSharding(mesh, choose_zero_spec(shape, axis_size, None))
-            return NamedSharding(mesh, PartitionSpec())
+                spec = choose_zero_spec(shape, axis_size, None)
+            else:
+                spec = PartitionSpec()
+            # scalars (step counts) stay in device memory: XLA's SPMD
+            # partitioner rejects host-placement annotations on scalar
+            # side-effect custom-calls, and 4 bytes buys nothing offloaded
+            if kind is not None and shape:
+                return NamedSharding(mesh, spec, memory_kind=kind)
+            return NamedSharding(mesh, spec)
 
         return jax.tree.map(leaf_sharding, state_shape_tree)
 
@@ -139,6 +172,8 @@ def build_zero_plan(
     persistence_threshold: int = 0,
     base_specs: Any = None,
     zero_axes=(DATA_AXIS,),
+    offload_optimizer: bool = False,
+    offload_param: bool = False,
 ) -> ZeroShardingPlan:
     """Construct the stage's sharding plan over a params pytree.
 
@@ -183,18 +218,26 @@ def build_zero_plan(
     grad_specs = build((lambda p, b: sharded_spec(p, b, 0)) if stage >= 2 else base_or_replicated)
     master_specs = build((lambda p, b: sharded_spec(p, b, 0)) if stage >= 1 else base_or_replicated)
 
-    to_sharding = lambda spec: NamedSharding(mesh, spec)
+    def to_sharding(kind):
+        if kind is None:
+            return lambda spec: NamedSharding(mesh, spec)
+        return lambda spec: NamedSharding(mesh, spec, memory_kind=kind)
+
     is_spec = lambda x: isinstance(x, PartitionSpec)
+    param_kind = "pinned_host" if offload_param else None
+    master_kind = "pinned_host" if offload_optimizer else None
     return ZeroShardingPlan(
         stage=stage,
         topology=topology,
-        param_shardings=jax.tree.map(to_sharding, param_specs, is_leaf=is_spec),
-        grad_shardings=jax.tree.map(to_sharding, grad_specs, is_leaf=is_spec),
-        master_shardings=jax.tree.map(to_sharding, master_specs, is_leaf=is_spec),
+        param_shardings=jax.tree.map(to_sharding(param_kind), param_specs, is_leaf=is_spec),
+        grad_shardings=jax.tree.map(to_sharding(None), grad_specs, is_leaf=is_spec),
+        master_shardings=jax.tree.map(to_sharding(master_kind), master_specs, is_leaf=is_spec),
         param_specs=param_specs,
         grad_specs=grad_specs,
         master_specs=master_specs,
         persistence_threshold=persistence_threshold,
+        offload_optimizer=offload_optimizer,
+        offload_param=offload_param,
     )
 
 

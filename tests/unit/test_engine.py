@@ -260,3 +260,69 @@ def test_optimizer_zoo(opt_type, devices8):
     fixed = batch_of(dataset, 0, 8)
     losses = [float(engine.train_batch(batch=fixed)) for _ in range(8)]
     assert losses[-1] < losses[0], f"{opt_type} loss on a fixed batch should decrease: {losses}"
+
+
+class TestZeroOffload:
+    """ZeRO-Offload tier (VERDICT missing #1): optimizer state in pinned_host
+    memory, update computed on the host CPU; trajectory must match the
+    non-offloaded run exactly."""
+
+    def _offload_losses(self, stage, dataset, n_steps, offload_param=False):
+        params = make_mlp_params(jax.random.key(0))
+        zero = {"stage": stage, "param_persistence_threshold": 0,
+                "offload_optimizer": {"device": "cpu", "pin_memory": True}}
+        if offload_param:
+            zero["offload_param"] = {"device": "cpu"}
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=mlp_loss_fn,
+            model_parameters=params,
+            config={
+                "train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "AdamW", "params": {"lr": LR}},
+                "zero_optimization": zero,
+                "steps_per_print": 1000,
+            },
+        )
+        losses = []
+        pos = 0
+        for _ in range(n_steps):
+            batch = batch_of(dataset, pos, 8)
+            pos += 8
+            losses.append(float(engine.train_batch(batch=batch)))
+        return losses, engine
+
+    @pytest.mark.parametrize("stage", [1, 2, 3])
+    def test_offload_trajectory_matches_optax(self, stage, devices8):
+        dataset = random_dataset(n=512)
+        params = make_mlp_params(jax.random.key(0))
+        ref = _pure_optax_losses(params, dataset, n_steps=5, batch_size=8)
+        got, engine = self._offload_losses(stage, dataset, n_steps=5)
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+        # optimizer state actually lives in host memory
+        master_leaf = engine.opt_state.master["layer_0"]["w"]
+        assert master_leaf.sharding.memory_kind == "pinned_host"
+        # params stay in device memory
+        assert engine.params["layer_0"]["w"].sharding.memory_kind == "device"
+
+    def test_offload_param_tier(self, devices8):
+        """offload_param: params also live in pinned_host between steps."""
+        dataset = random_dataset(n=512)
+        params = make_mlp_params(jax.random.key(0))
+        ref = _pure_optax_losses(params, dataset, n_steps=3, batch_size=8)
+        got, engine = self._offload_losses(3, dataset, n_steps=3, offload_param=True)
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+        assert engine.params["layer_0"]["w"].sharding.memory_kind == "pinned_host"
+
+    def test_offload_checkpoint_roundtrip(self, tmp_path, devices8):
+        """Offloaded state survives save/load (orbax handles host arrays)."""
+        dataset = random_dataset(n=512)
+        _, engine = self._offload_losses(2, dataset, n_steps=2)
+        engine.save_checkpoint(str(tmp_path), tag="off")
+        before = np.asarray(
+            jax.device_get(engine.opt_state.master["layer_0"]["w"])
+        )
+        _, engine2 = self._offload_losses(2, dataset, n_steps=1)
+        engine2.load_checkpoint(str(tmp_path), tag="off")
+        after = np.asarray(jax.device_get(engine2.opt_state.master["layer_0"]["w"]))
+        np.testing.assert_allclose(before, after, rtol=0, atol=0)
+        assert engine2.opt_state.master["layer_0"]["w"].sharding.memory_kind == "pinned_host"
