@@ -1,0 +1,23 @@
+"""Optimized / compressed collectives for the gradient-exchange path.
+
+Analogue of the reference ``runtime/comm/`` package: the 1-bit
+error-feedback compressed allreduce backends (``compressed.py:13``,
+``nccl.py:16``, ``mpi.py``) and the qgZ fused quant+reduce collectives
+(``coalesced_collectives.py``). On TPU these are expressed as packed
+integer payloads moved by XLA collectives inside ``shard_map`` manual
+regions — see :mod:`deepspeed_tpu.runtime.comm.compressed`.
+"""
+
+from deepspeed_tpu.runtime.comm.compressed import (
+    CompressedBackend,
+    compressed_allreduce,
+    pack_signs,
+    unpack_signs,
+)
+
+__all__ = [
+    "CompressedBackend",
+    "compressed_allreduce",
+    "pack_signs",
+    "unpack_signs",
+]
