@@ -117,35 +117,136 @@ def quantized_reduce_scatter(
     flat = x.reshape(-1).astype(jnp.float32)
     flat, _ = _pad_to(flat, W * block_size)
     chunk = flat.shape[0] // W
-    chunks = flat.reshape(W, chunk)
+    rows = flat.reshape(W, chunk)
 
+    payload, scales = _quantize_rows(rows, bits, block_size)
+    # the int8 payload and fp32 block scales are what crosses ICI
+    payload_rx = jax.lax.all_to_all(payload, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    scales_rx = jax.lax.all_to_all(scales, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    deq = _dequantize_rows(payload_rx, scales_rx, bits, block_size)  # [W, chunk]
+    total = jnp.sum(deq, axis=0)
+    if mean:
+        total = total / W
+    return total.astype(x.dtype)
+
+
+def _quantize_rows(rows: jax.Array, bits: int, block_size: int):
+    """Per-row blockwise quantization helper: rows [R, m] (m % block == 0) →
+    (payload int8 [R, nb, bs or bs/2], scales fp32 [R, nb, 1])."""
     qmax = _QMAX[bits]
-    blocks = chunks.reshape(W, chunk // block_size, block_size)
+    R, m = rows.shape
+    blocks = rows.reshape(R, m // block_size, block_size)
     absmax = jnp.max(jnp.abs(blocks), axis=-1, keepdims=True)
     scales = absmax / qmax
     inv = jnp.where(scales > 0, 1.0 / jnp.maximum(scales, 1e-30), 0.0)
     q = jnp.clip(jnp.round(blocks * inv), -qmax, qmax)
     if bits == 4:
-        payload = _pack_int4(q.reshape(-1, block_size)).reshape(W, chunk // block_size, block_size // 2)
+        payload = _pack_int4(q.reshape(-1, block_size)).reshape(R, m // block_size, block_size // 2)
     else:
         payload = q.astype(jnp.int8)
+    return payload, scales
 
-    # the int8 payload and fp32 block scales are what crosses ICI
+
+def _dequantize_rows(payload: jax.Array, scales: jax.Array, bits: int, block_size: int):
+    R, nb = payload.shape[0], payload.shape[1]
+    if bits == 4:
+        vals = _unpack_int4(payload.reshape(-1, block_size // 2)).reshape(R, nb, block_size)
+    else:
+        vals = payload.astype(jnp.float32)
+    return (vals * scales).reshape(R, nb * block_size)
+
+
+def quantized_reduce_scatter_along(
+    x: jax.Array,
+    axis_name: str,
+    dim: int,
+    bits: int = 8,
+    block_size: int = 256,
+    mean: bool = True,
+) -> jax.Array:
+    """qgZ exchange producing a *dimension* shard: reduce-scatter ``x`` along
+    logical dim ``dim`` of the tensor (which must divide by the axis size),
+    int8/int4 payload on the wire. Call INSIDE shard_map over ``axis_name``
+    with the full local gradient; returns this rank's dim-``dim`` slice —
+    i.e. the ZeRO stage-2/3 gradient layout (``grad_specs`` data placement).
+    """
+    W = jax.lax.axis_size(axis_name)
+    D = x.shape[dim]
+    assert D % W == 0, f"dim {dim} of size {D} not divisible by axis {axis_name}={W}"
+    moved = jnp.moveaxis(x, dim, 0)
+    rest_shape = moved.shape[1:]
+    rows = moved.reshape(W, -1).astype(jnp.float32)  # [W, m] — row w goes to rank w
+    m = rows.shape[1]
+    pad = (-m) % block_size
+    if pad:
+        rows = jnp.pad(rows, ((0, 0), (0, pad)))
+
+    payload, scales = _quantize_rows(rows, bits, block_size)
     payload_rx = jax.lax.all_to_all(payload, axis_name, split_axis=0, concat_axis=0, tiled=True)
     scales_rx = jax.lax.all_to_all(scales, axis_name, split_axis=0, concat_axis=0, tiled=True)
-
-    payload_rx = payload_rx.reshape(W, chunk // block_size, -1)
-    if bits == 4:
-        vals = _unpack_int4(payload_rx.reshape(-1, block_size // 2)).reshape(
-            W, chunk // block_size, block_size
-        )
-    else:
-        vals = payload_rx.astype(jnp.float32)
-    deq = vals * scales_rx.reshape(W, chunk // block_size, 1)
-    total = jnp.sum(deq, axis=0).reshape(chunk)
+    deq = _dequantize_rows(payload_rx, scales_rx, bits, block_size)  # [W, m+pad]
+    total = jnp.sum(deq, axis=0)[:m]
     if mean:
         total = total / W
-    return total.astype(x.dtype)
+    out = total.reshape((D // W,) + rest_shape)
+    return jnp.moveaxis(out, 0, dim).astype(x.dtype)
+
+
+def quantized_allreduce(
+    x: jax.Array,
+    axis_name: str,
+    bits: int = 8,
+    block_size: int = 256,
+    mean: bool = True,
+) -> jax.Array:
+    """Quantized mean-allreduce for replicated-gradient layouts (ZeRO ≤ 1
+    under ``zero_quantized_gradients``): quantized reduce-scatter followed by
+    a *re-quantized* all-gather (the reference qgZ two-hop pipeline,
+    quant_reduce.cu — both hops move int payloads, never full-width floats).
+    Call INSIDE shard_map over ``axis_name``. Returns the full averaged
+    tensor in ``x``'s shape/dtype."""
+    W = jax.lax.axis_size(axis_name)
+    n = x.size
+    chunk = quantized_reduce_scatter(x, axis_name, bits=bits, block_size=block_size, mean=mean)
+    rows = chunk.reshape(1, -1).astype(jnp.float32)
+    pad = (-rows.shape[1]) % block_size
+    if pad:
+        rows = jnp.pad(rows, ((0, 0), (0, pad)))
+    payload, scales = _quantize_rows(rows, bits, block_size)
+    payload_all = jax.lax.all_gather(payload, axis_name, axis=0, tiled=True)  # [W, nb, bs]
+    scales_all = jax.lax.all_gather(scales, axis_name, axis=0, tiled=True)
+    deq = _dequantize_rows(payload_all, scales_all, bits, block_size)  # [W, chunk+pad]
+    flat = deq[:, : chunk.shape[0]].reshape(-1)[:n]
+    return flat.reshape(x.shape).astype(x.dtype)
+
+
+def quantized_all_gather_along(
+    x: jax.Array,
+    axis_name: str,
+    dim: int,
+    bits: int = 8,
+    block_size: int = 256,
+) -> jax.Array:
+    """qwZ: quantized parameter all-gather (reference zero_quantized_weights,
+    stage3.py:1610 + csrc/quantization swizzled gather). Each rank quantizes
+    its dim-``dim`` slice, int8 payload + fp32 block scales cross the wire,
+    receivers dequantize — halving gather bytes vs bf16 weights. Call INSIDE
+    shard_map over ``axis_name`` with the local slice; returns the full
+    tensor along ``dim`` in ``x``'s dtype."""
+    moved = jnp.moveaxis(x, dim, 0)
+    rest_shape = moved.shape[1:]
+    rows = moved.reshape(1, -1).astype(jnp.float32)
+    m = rows.shape[1]
+    pad = (-m) % block_size
+    if pad:
+        rows = jnp.pad(rows, ((0, 0), (0, pad)))
+    payload, scales = _quantize_rows(rows, bits, block_size)
+    payload_all = jax.lax.all_gather(payload, axis_name, axis=0, tiled=True)
+    scales_all = jax.lax.all_gather(scales, axis_name, axis=0, tiled=True)
+    deq = _dequantize_rows(payload_all, scales_all, bits, block_size)  # [W, m+pad]
+    W = deq.shape[0]
+    full = deq[:, :m].reshape((W * moved.shape[0],) + rest_shape)
+    return jnp.moveaxis(full, 0, dim).astype(x.dtype)
 
 
 # ---------------------------------------------------------------------------

@@ -1,23 +1,32 @@
-"""1-bit Adam family.
+"""1-bit Adam family with a real compressed gradient exchange.
 
-Analogue of the reference ``runtime/fp16/onebit/adam.py`` (``OnebitAdam`` :14)
-and the compressed-allreduce backends (``runtime/comm/compressed.py:13`` —
-error-feedback sign compression). Semantics preserved: a warmup phase of
-exact Adam (``freeze_step`` steps) freezes the variance term; afterwards the
-momentum is communicated as sign+scale with a local error-feedback buffer.
+Analogue of the reference ``runtime/fp16/onebit/adam.py`` (``OnebitAdam``
+:14) + the compressed-allreduce backends (``runtime/comm/compressed.py:13``,
+``runtime/comm/nccl.py:16``). Semantics preserved: a warmup phase of exact
+Adam (``freeze_step`` steps, variance frozen afterwards); in the compressed
+phase each data-parallel worker updates momentum with its *local* gradient
+and the momenta are averaged with the two-phase error-feedback sign
+compression — packed sign bits + per-chunk scales are what crosses ICI
+(:mod:`deepspeed_tpu.runtime.comm.compressed`).
 
-On TPU the "compressed allreduce" is expressed as: compress locally →
-all-reduce the 1-bit payload (XLA collective over ICI) → decompress. The
-compression math (sign ⊗ per-tensor scale + error feedback) is identical;
-the reference's hand-rolled NCCL gather/scatter choreography
-(runtime/comm/nccl.py:16) is replaced by one psum of the packed signs.
+Two forms:
+  * :func:`onebit_adam_transform` — single-device form (no collective; the
+    compression + error feedback still runs so trajectories are comparable).
+  * :func:`onebit_adam_collective_transform` — the multi-worker form. Its
+    ``update`` MUST run inside a ``shard_map`` manual region over the data
+    axis with *local* (unreduced) gradients; the engine's 1-bit train step
+    (``engine._build_onebit_train_step``) provides that. Error-feedback
+    buffers are per-worker state (leading ``[W]`` dim sharded over data).
 """
 
 from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
+
+from deepspeed_tpu.runtime.comm.compressed import compressed_allreduce, padded_size
 
 
 class OnebitAdamState(NamedTuple):
@@ -39,6 +48,8 @@ def compress_sign(x, error):
 
 
 def onebit_adam_transform(b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.0, freeze_step=100000):
+    """Single-device 1-bit Adam (compression without a wire)."""
+
     def init(params):
         zeros = lambda: jax.tree.map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params)
         return OnebitAdamState(mu=zeros(), nu=zeros(), error=zeros(), count=jnp.zeros((), jnp.int32))
@@ -76,3 +87,139 @@ def onebit_adam_transform(b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.0, freeze_s
         return jax.tree.map(lambda u, g: u.astype(g.dtype), updates, grads), new_state
 
     return optax.GradientTransformation(init, update)
+
+
+# ---------------------------------------------------------------------------
+# Collective (multi-worker) form
+# ---------------------------------------------------------------------------
+class OnebitCollectiveState(NamedTuple):
+    mu: Any  # momentum, replicated over data
+    nu: Any  # second moment (frozen after warmup), replicated
+    worker_error: jnp.ndarray  # [W, N_pad] fp32 — one fused per-worker buffer
+    server_error: jnp.ndarray  # [W, N_pad // W] fp32
+    count: jnp.ndarray
+
+
+def onebit_adam_collective_transform(
+    axis_name: str,
+    world: int,
+    b1=0.9,
+    b2=0.999,
+    eps=1e-8,
+    weight_decay=0.0,
+    freeze_step=100000,
+    var_freeze_step=None,
+):
+    """Multi-worker 1-bit Adam. ``update`` runs INSIDE shard_map over
+    ``axis_name`` with local grads. All momentum leaves are packed into ONE
+    fused comm buffer per step (like the reference NcclBackend's flat
+    buffer), so the compressed phase issues exactly one all_to_all and one
+    all_gather regardless of leaf count; the error buffers shard their
+    leading ``[W]`` dim over the data axis.
+
+    ``var_freeze_step`` (reference 0/1-Adam knob, onebit/zoadam.py): in this
+    implementation the variance-freeze point and the compression onset are a
+    single threshold — supplying ``var_freeze_step`` sets that threshold
+    (i.e. it delays BOTH the variance freeze and the start of compressed
+    communication). The reference 0/1-Adam's decoupled learning-rate/variance
+    schedules are not modeled.
+    """
+    freeze = var_freeze_step if var_freeze_step is not None else freeze_step
+
+    def fused_sizes(tree):
+        leaves = jax.tree_util.tree_leaves(tree)
+        sizes = [int(np.prod(p.shape)) if p.shape else 1 for p in leaves]
+        total = sum(sizes)
+        return sizes, total, padded_size(total, world)
+
+    def init(params):
+        zeros = lambda: jax.tree.map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params)
+        _, _, n_pad = fused_sizes(params)
+        return OnebitCollectiveState(
+            mu=zeros(),
+            nu=zeros(),
+            worker_error=jnp.zeros((world, n_pad), jnp.float32),
+            server_error=jnp.zeros((world, n_pad // world), jnp.float32),
+            count=jnp.zeros((), jnp.int32),
+        )
+
+    def update(grads, state, params=None, *, lr):
+        count = state.count + 1
+        flat_g, treedef = jax.tree_util.tree_flatten(grads)
+        flat_mu = treedef.flatten_up_to(state.mu)
+        flat_nu = treedef.flatten_up_to(state.nu)
+        flat_p = treedef.flatten_up_to(params) if params is not None else flat_g
+        sizes, total, n_pad = fused_sizes(grads)
+
+        def warmup_phase(args):
+            flat_g, flat_mu, flat_nu, we, se = args
+            out_mu, out_nu = [], []
+            for g, mu, nu in zip(flat_g, flat_mu, flat_nu):
+                g_avg = jax.lax.pmean(g.astype(jnp.float32), axis_name)
+                out_mu.append(b1 * mu + (1 - b1) * g_avg)
+                out_nu.append(b2 * nu + (1 - b2) * jnp.square(g_avg))
+            return out_mu, out_nu, we, se
+
+        def compressed_phase(args):
+            flat_g, flat_mu, flat_nu, we, se = args
+            mu_locals = [
+                (b1 * mu + (1 - b1) * g.astype(jnp.float32)).reshape(-1)
+                for g, mu in zip(flat_g, flat_mu)
+            ]
+            fused = jnp.concatenate(mu_locals) if len(mu_locals) > 1 else mu_locals[0]
+            fused = jnp.pad(fused, (0, n_pad - total))
+            avg, we_new, se_new = compressed_allreduce(fused, we[0], se[0], axis_name)
+            out_mu, off = [], 0
+            for mu, n in zip(flat_mu, sizes):
+                out_mu.append(avg[off : off + n].reshape(mu.shape))
+                off += n
+            return out_mu, list(flat_nu), we_new[None], se_new[None]
+
+        warmup = count <= freeze
+        new_mu, new_nu, new_we, new_se = jax.lax.cond(
+            warmup,
+            warmup_phase,
+            compressed_phase,
+            (flat_g, flat_mu, flat_nu, state.worker_error, state.server_error),
+        )
+
+        updates = []
+        for mu, nu, p, g in zip(new_mu, new_nu, flat_p, flat_g):
+            denom = jnp.sqrt(nu) + eps
+            u = -lr * (mu / denom + (weight_decay * p.astype(jnp.float32) if weight_decay else 0.0))
+            updates.append(u.astype(g.dtype))
+
+        new_state = OnebitCollectiveState(
+            mu=treedef.unflatten(new_mu),
+            nu=treedef.unflatten(new_nu),
+            worker_error=new_we,
+            server_error=new_se,
+            count=count,
+        )
+        return treedef.unflatten(updates), new_state
+
+    return optax.GradientTransformation(init, update)
+
+
+def onebit_state_partition_specs(state_shapes, data_axis: str):
+    """PartitionSpec tree for an OptState(master, OnebitCollectiveState):
+    everything replicated except the per-worker error buffers, which shard
+    their leading [W] dim over the data axis. Consumed by the engine in place
+    of the generic ZeRO state-sharding rule."""
+    from jax.sharding import PartitionSpec as P
+
+    def build(tree, spec):
+        return jax.tree.map(lambda _: spec, tree)
+
+    master_specs = build(state_shapes.master, P())
+    inner = state_shapes.inner
+    return type(state_shapes)(
+        master=master_specs,
+        inner=OnebitCollectiveState(
+            mu=build(inner.mu, P()),
+            nu=build(inner.nu, P()),
+            worker_error=P(data_axis),
+            server_error=P(data_axis),
+            count=P(),
+        ),
+    )

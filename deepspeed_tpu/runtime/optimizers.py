@@ -55,6 +55,12 @@ class DeepSpeedOptimizer:
         self.defaults = dict(defaults)
         self.keep_master = keep_master
         self._lr = defaults.get("lr", 1e-3)
+        # collective-optimizer contract (set by build_optimizer for 1-bit
+        # family): the engine must run the whole update inside shard_map over
+        # the data axis with LOCAL grads, and the optimizer owns its state
+        # partitioning (per-worker error buffers shard over data).
+        self.collective_grad_exchange = False
+        self.state_partition_specs: Optional[Callable] = None
 
     # imperative LR hook used by the reference-style schedulers
     def set_lr(self, lr):
@@ -157,7 +163,15 @@ def build_optimizer(
             if weight_decay:
                 tx = optax.chain(optax.add_decayed_weights(weight_decay), tx)
         canonical = "adamw" if is_adamw else "adam"
-    elif name in (LAMB_OPTIMIZER, FUSED_LAMB, ONEBIT_LAMB_OPTIMIZER):
+    elif name == ONEBIT_LAMB_OPTIMIZER:
+        # The reference OnebitLamb (fp16/onebit/lamb.py) fuses compressed
+        # momentum exchange with Lamb's per-layer trust-ratio bookkeeping;
+        # silently substituting plain Lamb would compress nothing. Refuse
+        # until the compressed Lamb exchange exists.
+        raise NotImplementedError(
+            "OnebitLamb is not implemented; use OnebitAdam (compressed) or Lamb (uncompressed)"
+        )
+    elif name in (LAMB_OPTIMIZER, FUSED_LAMB):
         tx = _InjectLR.wrap(optax.lamb, b1=betas[0], b2=betas[1], eps=eps, weight_decay=weight_decay)
         canonical = "lamb"
     elif name in (LION_OPTIMIZER, FUSED_LION):
@@ -176,15 +190,30 @@ def build_optimizer(
         tx = _muon(beta=params.pop("momentum", 0.95), weight_decay=weight_decay, adam_betas=betas, eps=eps)
         canonical = "muon"
     elif name in (ONEBIT_ADAM_OPTIMIZER, ZERO_ONE_ADAM_OPTIMIZER):
-        from deepspeed_tpu.runtime.fp16.onebit import onebit_adam_transform
-
-        tx = onebit_adam_transform(
-            b1=betas[0],
-            b2=betas[1],
-            eps=eps,
-            weight_decay=weight_decay,
-            freeze_step=params.pop("freeze_step", 100000),
+        from deepspeed_tpu.parallel.topology import DATA_AXIS
+        from deepspeed_tpu.runtime.fp16.onebit import (
+            onebit_adam_collective_transform,
+            onebit_adam_transform,
         )
+
+        freeze_step = params.pop("freeze_step", 100000)
+        var_freeze_step = params.pop("var_freeze_step", None)
+        dp = mesh.shape.get(DATA_AXIS, 1) if mesh is not None else 1
+        if dp > 1:
+            # multi-worker: real compressed exchange — the engine runs the
+            # whole update inside shard_map over the data axis with LOCAL
+            # grads (reference engines disable backward allreduce for 1-bit
+            # optimizers; the comm happens inside the optimizer)
+            tx = onebit_adam_collective_transform(
+                axis_name=DATA_AXIS, world=dp,
+                b1=betas[0], b2=betas[1], eps=eps, weight_decay=weight_decay,
+                freeze_step=freeze_step, var_freeze_step=var_freeze_step,
+            )
+        else:
+            tx = onebit_adam_transform(
+                b1=betas[0], b2=betas[1], eps=eps, weight_decay=weight_decay,
+                freeze_step=freeze_step,
+            )
         canonical = name
     else:
         raise ValueError(f"Unknown optimizer type {opt_config.type}")
@@ -192,6 +221,14 @@ def build_optimizer(
     logger.info(f"Using optimizer: {canonical} (lr={lr}, wd={weight_decay})")
     opt = DeepSpeedOptimizer(tx, canonical, {"lr": lr, "betas": betas, "eps": eps, "weight_decay": weight_decay})
     opt.set_lr(lr)
+    if name in (ONEBIT_ADAM_OPTIMIZER, ZERO_ONE_ADAM_OPTIMIZER) and mesh is not None:
+        from deepspeed_tpu.parallel.topology import DATA_AXIS as _DA
+
+        if mesh.shape.get(_DA, 1) > 1:
+            from deepspeed_tpu.runtime.fp16.onebit import onebit_state_partition_specs as _specs
+
+            opt.collective_grad_exchange = True
+            opt.state_partition_specs = lambda shapes: _specs(shapes, _DA)
     return opt
 
 
