@@ -48,11 +48,15 @@ def main():
     platform = jax.default_backend()
     on_tpu = platform == "tpu"
     if on_tpu:
+        # largest llama-style decoder that fits one v5e chip under ZeRO-3
+        # semantics with full fp32 Adam state on-chip (617M params; 16 GB HBM
+        # bounds it — measured b=4 fits, b=6 OOMs at these dims)
         cfg = TransformerConfig(
-            vocab_size=32000, hidden_size=1024, n_layers=16, n_heads=8,
-            ffn_hidden_size=2816, max_seq_len=2048, dtype="bfloat16",
+            vocab_size=32000, hidden_size=1536, n_layers=20, n_heads=12,
+            n_kv_heads=6, ffn_hidden_size=4096, max_seq_len=2048,
+            dtype="bfloat16",
         )
-        bsz, seq, steps, warmup = 8, 2048, 10, 4
+        bsz, seq, steps, warmup = 4, 2048, 10, 4
     else:  # smoke-test path for CPU dev boxes
         cfg = TransformerConfig(
             vocab_size=512, hidden_size=128, n_layers=2, n_heads=4,
@@ -68,7 +72,7 @@ def main():
             "train_batch_size": bsz,
             "bf16": {"enabled": on_tpu},
             "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
-            "zero_optimization": {"stage": 0},
+            "zero_optimization": {"stage": 3 if on_tpu else 0},
             "steps_per_print": 10**9,
         },
     )
@@ -88,7 +92,7 @@ def main():
     achieved = tok_s * flops_per_token(cfg, seq)
     mfu = achieved / peak_flops(platform)
     print(json.dumps({
-        "metric": f"llama-dense train MFU ({platform}, {tok_s:.0f} tok/s, loss={loss:.3f})",
+        "metric": f"llama-617M zero3 train MFU ({platform}, {tok_s:.0f} tok/s, loss={loss:.3f})",
         "value": round(mfu * 100, 2),
         "unit": "% MFU",
         "vs_baseline": round(mfu / 0.40, 3),

@@ -77,6 +77,11 @@ class TransformerConfig:
     moe_capacity_factor: float = 1.25
     moe_aux_loss_coef: float = 0.01
     vocab_parallel: bool = True  # shard embedding/lm_head vocab dim on `model`
+    # >1: compute the LM loss per sequence tile so [b, s, vocab] logits never
+    # materialize (ALST TiledFusedLogitsLoss, ulysses_sp.py:960) — frees
+    # ~b*s*vocab bytes of activations at the cost of recomputing the head
+    # matmul in backward (~1pp MFU at 32k vocab); enable when memory-bound
+    loss_tiles: int = 0
 
     @property
     def kv_heads(self) -> int:
@@ -327,14 +332,14 @@ def _layer(c: TransformerConfig, lp, x, positions, segment_ids):
     return x, aux_loss
 
 
-def forward(
+def forward_hidden(
     params: Dict[str, Any],
     tokens: jax.Array,
     config: TransformerConfig,
     positions: Optional[jax.Array] = None,
     segment_ids: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Full forward: tokens [b, s] int32 → (logits [b, s, vocab], aux_loss).
+    """Body forward: tokens [b, s] → (final-norm'd hidden [b, s, h], aux_loss).
 
     Layers run under ``lax.scan`` over the stacked layer pytree; with
     ``config.remat`` each layer is rematerialized (dots saveable) so
@@ -362,11 +367,26 @@ def forward(
 
     x, aux_losses = jax.lax.scan(scan_body, x, params["layers"])
     x = _norm(x, params["final_norm"], params.get("final_norm_b"), c.norm, c.norm_eps)
-    if c.tie_embeddings:
-        logits = x @ params["embed"].astype(x.dtype).T
-    else:
-        logits = x @ params["lm_head"]
-    return logits, jnp.sum(aux_losses)
+    return x, jnp.sum(aux_losses)
+
+
+def _lm_head_matrix(params, config: TransformerConfig, dtype):
+    if config.tie_embeddings:
+        return params["embed"].astype(dtype).T
+    return params["lm_head"]
+
+
+def forward(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    config: TransformerConfig,
+    positions: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Full forward: tokens [b, s] int32 → (logits [b, s, vocab], aux_loss)."""
+    x, aux = forward_hidden(params, tokens, config, positions, segment_ids)
+    logits = x @ _lm_head_matrix(params, config, x.dtype)
+    return logits, aux
 
 
 def decode_step(params, tokens, config, kv_caches, positions):
@@ -442,14 +462,20 @@ def embed_tokens(params, tokens, positions, config: TransformerConfig):
     return x
 
 
-def nll_loss(logits, labels, mask=None):
-    """Masked next-token NLL from full logits."""
+def _masked_nll(logits, labels, mask):
+    """Shared CE core: fp32 log-softmax NLL → (sum_loss, count)."""
     logits = logits.astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-    if mask is not None:
-        return -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
-    return -jnp.mean(ll)
+    return jnp.sum(-ll * mask), jnp.sum(mask)
+
+
+def nll_loss(logits, labels, mask=None):
+    """Masked next-token NLL from full logits."""
+    if mask is None:
+        mask = jnp.ones(labels.shape, jnp.float32)
+    total, count = _masked_nll(logits, labels, mask)
+    return total / jnp.maximum(count, 1.0)
 
 
 def lm_head_loss(params, x, labels, mask, config: TransformerConfig, aux=None):
@@ -475,8 +501,21 @@ def make_loss_fn(config: TransformerConfig):
 
     def loss_fn(params, batch):
         inputs, labels, mask, positions, segment_ids = split_lm_batch(batch)
-        logits, aux = forward(params, inputs, config, positions=positions, segment_ids=segment_ids)
-        loss = nll_loss(logits, labels, mask)
+        if config.loss_tiles > 1:
+            from deepspeed_tpu.parallel.sequence.tiled import tiled_logits_loss
+
+            x, aux = forward_hidden(params, inputs, config, positions=positions, segment_ids=segment_ids)
+            loss = tiled_logits_loss(
+                _masked_nll,
+                x,
+                _lm_head_matrix(params, config, x.dtype),
+                labels,
+                num_tiles=config.loss_tiles,
+                mask=mask,
+            )
+        else:
+            logits, aux = forward(params, inputs, config, positions=positions, segment_ids=segment_ids)
+            loss = nll_loss(logits, labels, mask)
         return loss + config.moe_aux_loss_coef * aux if config.n_experts > 0 else loss
 
     return loss_fn

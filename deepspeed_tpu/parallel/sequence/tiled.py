@@ -54,29 +54,43 @@ def tiled_logits_loss(
     lm_head: jax.Array,
     labels: jax.Array,
     num_tiles: int = 8,
+    mask: jax.Array = None,
 ):
     """Reference TiledFusedLogitsLoss (ulysses_sp.py:960): never materialize
     [b, s, vocab] logits — compute the loss per sequence tile and reduce.
 
-    loss_of_logits(logits, labels) -> (sum_loss, count)
-    Returns mean loss over all positions.
+    loss_of_logits(logits, labels, mask) -> (sum_loss, count)
+    Returns mean loss over unmasked positions.
     """
     b, s, h = hidden.shape
+    if mask is None:
+        mask = jnp.ones(labels.shape, jnp.float32)
+    import inspect
+
+    try:
+        if len(inspect.signature(loss_of_logits).parameters) == 2:
+            two_arg = loss_of_logits
+            loss_of_logits = lambda lg, lb, m: two_arg(lg, lb)  # noqa: E731
+    except (TypeError, ValueError):
+        pass
     if num_tiles <= 1 or s % num_tiles != 0:
         logits = hidden @ lm_head
-        total, count = loss_of_logits(logits, labels)
+        total, count = loss_of_logits(logits, labels, mask)
         return total / jnp.maximum(count, 1.0)
     tile = s // num_tiles
     hid_t = hidden.reshape(b, num_tiles, tile, h).transpose(1, 0, 2, 3)
     lab_t = labels.reshape(b, num_tiles, tile).transpose(1, 0, 2)
+    mask_t = mask.reshape(b, num_tiles, tile).transpose(1, 0, 2)
 
     @jax.checkpoint
     def body(carry, xs):
         total, count = carry
-        h_tile, l_tile = xs
+        h_tile, l_tile, m_tile = xs
         logits = h_tile @ lm_head
-        t, c = loss_of_logits(logits, l_tile)
+        t, c = loss_of_logits(logits, l_tile, m_tile)
         return (total + t, count + c), None
 
-    (total, count), _ = jax.lax.scan(body, (jnp.float32(0.0), jnp.float32(0.0)), (hid_t, lab_t))
+    (total, count), _ = jax.lax.scan(
+        body, (jnp.float32(0.0), jnp.float32(0.0)), (hid_t, lab_t, mask_t)
+    )
     return total / jnp.maximum(count, 1.0)

@@ -90,6 +90,12 @@ def _tree_select(pred, on_true, on_false):
 
 
 class DeepSpeedEngine:
+    # qgZ: gradient leaves below this many elements reduce in full precision —
+    # quantizing a [h]-sized norm/bias grad saves no bandwidth but injects
+    # noise and costs two collective launches (the reference likewise only
+    # quantizes the bucketed bulk)
+    QGZ_MIN_SIZE = 65536
+
     def __init__(
         self,
         loss_fn: Callable,
@@ -583,7 +589,7 @@ class DeepSpeedEngine:
 
         def reduce_leaf(g, spec):
             k = self._data_dim(spec)
-            if qgz:
+            if qgz and g.size >= self.QGZ_MIN_SIZE:
                 if k is None:
                     return quantized_allreduce(g, DATA_AXIS)
                 return quantized_reduce_scatter_along(g, DATA_AXIS, k)

@@ -144,6 +144,8 @@ def onebit_adam_collective_transform(
         )
 
     def update(grads, state, params=None, *, lr):
+        if params is None and weight_decay:
+            raise ValueError("onebit adam with weight_decay requires params in update()")
         count = state.count + 1
         flat_g, treedef = jax.tree_util.tree_flatten(grads)
         flat_mu = treedef.flatten_up_to(state.mu)
