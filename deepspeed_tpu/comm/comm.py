@@ -88,6 +88,17 @@ def init_distributed(
     Single-process (one controller, N local devices) needs no bootstrap.
     """
     global _initialized
+    if os.environ.get("DSTPU_POD") and not _initialized:
+        # Cloud TPU pod (dstpu --tpu via GcloudRunner): coordinator address
+        # and process id come from instance metadata — argless initialize is
+        # the only scheme that works when the launcher ran off-pod
+        if verbose:
+            logger.info("Initializing JAX distributed from TPU pod metadata")
+        jax.distributed.initialize()
+        if mesh_config:
+            set_topology(Topology(**mesh_config))
+        _initialized = True
+        return get_topology()
     coordinator = os.environ.get("DSTPU_COORDINATOR") or os.environ.get("MASTER_ADDR")
     nproc = int(os.environ.get("DSTPU_NUM_PROCESSES", os.environ.get("WORLD_SIZE", "1")))
     pid = int(os.environ.get("DSTPU_PROCESS_ID", os.environ.get("RANK", "0")))

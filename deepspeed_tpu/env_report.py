@@ -1,0 +1,74 @@
+"""Environment report (reference ``deepspeed/env_report.py`` + ``bin/ds_report``).
+
+Prints the software stack, visible accelerators, and per-op availability —
+the TPU analogue of the reference's op-compatibility table (its green/red
+``[OKAY]/[NO]`` rows per CUDA op builder).
+"""
+
+import importlib
+import sys
+
+GREEN_OK = "\033[92m[OKAY]\033[0m"
+RED_NO = "\033[91m[NO]\033[0m"
+
+
+def _try_version(mod: str) -> str:
+    try:
+        m = importlib.import_module(mod)
+        return getattr(m, "__version__", "unknown")
+    except Exception:
+        return None
+
+
+def op_report():
+    """Per-op availability, mirroring the reference's builder table."""
+    from deepspeed_tpu.ops.op_builder import ALL_OPS
+
+    rows = []
+    for name, builder in sorted(ALL_OPS.items()):
+        try:
+            compatible = builder().is_compatible()
+        except Exception:
+            compatible = False
+        rows.append((name, compatible))
+    return rows
+
+
+def main():
+    import deepspeed_tpu
+
+    print("-" * 60)
+    print("DeepSpeed-TPU environment report")
+    print("-" * 60)
+    print(f"python ................ {sys.version.split()[0]}")
+    print(f"deepspeed_tpu ......... {deepspeed_tpu.__version__}")
+    for mod in ("jax", "jaxlib", "flax", "optax", "orbax.checkpoint", "numpy"):
+        v = _try_version(mod)
+        print(f"{mod:<22}{'.' * max(1, 22 - len(mod))} {v if v else RED_NO}")
+    print("-" * 60)
+    try:
+        import jax
+
+        print(f"backend ............... {jax.default_backend()}")
+        for d in jax.devices():
+            kind = getattr(d, "device_kind", "?")
+            print(f"  device {d.id}: {kind}")
+        try:
+            stats = jax.devices()[0].memory_stats() or {}
+            lim = stats.get("bytes_limit")
+            if lim:
+                print(f"  hbm bytes_limit: {lim / 2**30:.2f} GiB")
+        except Exception:
+            pass
+    except Exception as e:
+        print(f"jax devices ........... {RED_NO} ({e})")
+    print("-" * 60)
+    print("op availability:")
+    for name, ok in op_report():
+        print(f"  {name:<28} {GREEN_OK if ok else RED_NO}")
+    print("-" * 60)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

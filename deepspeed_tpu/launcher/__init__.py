@@ -1,0 +1,26 @@
+"""Launcher package (reference deepspeed/launcher/): the dstpu CLI, per-node
+launch, and multi-node runner command construction."""
+
+from deepspeed_tpu.launcher.multinode_runner import (
+    GcloudRunner,
+    MultiNodeRunner,
+    PDSHRunner,
+    SlurmRunner,
+    SSHRunner,
+)
+from deepspeed_tpu.launcher.runner import (
+    main,
+    parse_hostfile,
+    parse_inclusion_exclusion,
+)
+
+__all__ = [
+    "GcloudRunner",
+    "MultiNodeRunner",
+    "PDSHRunner",
+    "SSHRunner",
+    "SlurmRunner",
+    "main",
+    "parse_hostfile",
+    "parse_inclusion_exclusion",
+]

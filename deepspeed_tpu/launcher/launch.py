@@ -1,0 +1,120 @@
+"""Per-node launcher: run the user script with the distributed env set.
+
+Analogue of the reference ``launcher/launch.py:145`` — but where the
+reference spawns one process per local GPU rank with RANK/LOCAL_RANK, a TPU
+host runs ONE process that owns all local chips (JAX's multi-controller
+model), so this launcher:
+
+  * derives DSTPU_PROCESS_ID (from DSTPU_HOSTS position or SLURM_PROCID when
+    the fan-out tool could not pass it per host),
+  * sets DSTPU_COORDINATOR / DSTPU_NUM_PROCESSES for
+    ``comm.init_distributed`` (comm/comm.py),
+  * execs the user script, forwarding SIGTERM/SIGINT to the child and
+    killing the process tree on exit (reference launch.py:131,333).
+"""
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="dstpu per-node launcher")
+    p.add_argument("--coordinator", default=None, help="coordinator (master) address")
+    p.add_argument("--port", type=int, default=29500)
+    p.add_argument("--process_id", type=int, default=None, help="override this host's process id")
+    p.add_argument("--module", action="store_true", help="run user_script as a python module (-m)")
+    p.add_argument("--no_python", action="store_true", help="exec user_script directly")
+    p.add_argument("user_script", type=str)
+    p.add_argument("user_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _local_addresses() -> set:
+    """Local hostname + every address it resolves to (for IP hostfiles)."""
+    me = socket.gethostname()
+    addrs = {me, "localhost", "127.0.0.1"}
+    try:
+        _, aliases, ips = socket.gethostbyname_ex(me)
+        addrs.update(aliases)
+        addrs.update(ips)
+    except OSError:
+        pass
+    return addrs
+
+
+def infer_process_id(env) -> int:
+    """Process id resolution order: explicit env, TPU_WORKER_ID (Cloud TPU
+    metadata), position of this host in DSTPU_HOSTS (pdsh path — matched by
+    hostname, hostname prefix, or resolved IP, so IP hostfiles work),
+    SLURM_PROCID, else 0."""
+    if env.get("DSTPU_PROCESS_ID"):
+        return int(env["DSTPU_PROCESS_ID"])
+    if env.get("TPU_WORKER_ID"):
+        return int(env["TPU_WORKER_ID"])
+    hosts = [h for h in env.get("DSTPU_HOSTS", "").split(",") if h]
+    if hosts:
+        me = socket.gethostname()
+        local = _local_addresses()
+        for i, h in enumerate(hosts):
+            if h in local or me.startswith(h + ".") or h.startswith(me + "."):
+                return i
+            try:
+                if socket.gethostbyname(h) in local:
+                    return i
+            except OSError:
+                pass
+        logger.warning(f"host {me} not found in DSTPU_HOSTS={hosts}; defaulting to 0")
+    if env.get("SLURM_PROCID"):
+        return int(env["SLURM_PROCID"])
+    return 0
+
+
+def build_child_cmd(args) -> list:
+    if args.no_python:
+        cmd = [args.user_script]
+    elif args.module:
+        cmd = [sys.executable, "-u", "-m", args.user_script]
+    else:
+        cmd = [sys.executable, "-u", args.user_script]
+    return cmd + list(args.user_args)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    env = dict(os.environ)
+    if env.get("DSTPU_POD"):
+        # Cloud TPU pod: jax.distributed discovers coordinator/process id
+        # from instance metadata — exporting fabricated values would break it
+        pid = int(env.get("TPU_WORKER_ID", "0"))
+    else:
+        if args.coordinator:
+            env["DSTPU_COORDINATOR"] = args.coordinator
+            env.setdefault("MASTER_PORT", str(args.port))
+        pid = args.process_id if args.process_id is not None else infer_process_id(env)
+        env["DSTPU_PROCESS_ID"] = str(pid)
+        env.setdefault("DSTPU_NUM_PROCESSES", "1")
+
+    cmd = build_child_cmd(args)
+    logger.info(f"launch: process {pid}/{env['DSTPU_NUM_PROCESSES']} exec: {' '.join(cmd)}")
+    child = subprocess.Popen(cmd, env=env)
+
+    def forward(signum, _frame):
+        try:
+            child.send_signal(signum)
+        except ProcessLookupError:
+            pass
+
+    signal.signal(signal.SIGTERM, forward)
+    signal.signal(signal.SIGINT, forward)
+    rc = child.wait()
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

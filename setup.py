@@ -1,0 +1,19 @@
+"""Packaging for deepspeed_tpu (reference setup.py, minus the CUDA op
+build machinery — TPU kernels are Pallas, compiled by XLA at trace time)."""
+
+from setuptools import find_packages, setup
+
+setup(
+    name="deepspeed_tpu",
+    version=open("deepspeed_tpu/version.py").read().split('"')[1],
+    description="TPU-native DeepSpeed-equivalent training/inference framework (JAX/XLA/Pallas)",
+    packages=find_packages(include=["deepspeed_tpu", "deepspeed_tpu.*"]),
+    python_requires=">=3.10",
+    install_requires=["jax", "optax", "orbax-checkpoint", "numpy", "einops"],
+    entry_points={
+        "console_scripts": [
+            "dstpu=deepspeed_tpu.launcher.runner:main",
+            "dstpu_report=deepspeed_tpu.env_report:main",
+        ]
+    },
+)

@@ -1,0 +1,228 @@
+"""Launcher tests (analogue of reference tests/unit/launcher/: hostfile
+parsing, resource filtering, multinode runner command construction, user-arg
+propagation, plus a live single-host launch)."""
+
+import os
+import subprocess
+import sys
+from types import SimpleNamespace
+
+import pytest
+
+from deepspeed_tpu.launcher.multinode_runner import (
+    GcloudRunner,
+    PDSHRunner,
+    SlurmRunner,
+    SSHRunner,
+)
+from deepspeed_tpu.launcher.runner import (
+    collect_env,
+    parse_args,
+    parse_hostfile,
+    parse_inclusion_exclusion,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# ---------------------------------------------------------------------------
+# hostfile
+# ---------------------------------------------------------------------------
+def test_parse_hostfile(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text(
+        """
+# comment
+worker-0 slots=4
+worker-1 slots=4   # trailing comment
+worker-2
+"""
+    )
+    assert parse_hostfile(str(hf)) == {"worker-0": 4, "worker-1": 4, "worker-2": 1}
+
+
+def test_parse_hostfile_missing_returns_empty():
+    assert parse_hostfile("/nonexistent/hostfile") == {}
+
+
+def test_parse_hostfile_duplicate_raises(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("a slots=1\na slots=2\n")
+    with pytest.raises(ValueError, match="duplicate"):
+        parse_hostfile(str(hf))
+
+
+def test_parse_hostfile_bad_slots(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("a slots=x\n")
+    with pytest.raises(ValueError, match="bad slots"):
+        parse_hostfile(str(hf))
+
+
+# ---------------------------------------------------------------------------
+# include / exclude
+# ---------------------------------------------------------------------------
+def test_include_filter():
+    res = {"a": 1, "b": 1, "c": 1}
+    assert parse_inclusion_exclusion(res, "a@c", "") == {"a": 1, "c": 1}
+
+
+def test_exclude_filter():
+    res = {"a": 1, "b": 1, "c": 1}
+    assert parse_inclusion_exclusion(res, "", "b") == {"a": 1, "c": 1}
+
+
+def test_include_exclude_mutually_exclusive():
+    with pytest.raises(ValueError):
+        parse_inclusion_exclusion({"a": 1}, "a", "a")
+
+
+def test_include_unknown_host():
+    with pytest.raises(ValueError, match="not in hostfile"):
+        parse_inclusion_exclusion({"a": 1}, "z", "")
+
+
+def test_slot_level_include_rejected():
+    with pytest.raises(ValueError, match="slot-level"):
+        parse_inclusion_exclusion({"a": 1}, "a:0,1", "")
+
+
+# ---------------------------------------------------------------------------
+# runner command construction
+# ---------------------------------------------------------------------------
+def _args(**kw):
+    base = dict(
+        master_addr="worker-0", master_port=29500, module=False, no_python=False,
+        user_script="train.py", user_args=["--config", "ds.json"],
+        tpu_name="", zone="", num_nodes=-1, remote_python="",
+    )
+    base.update(kw)
+    return SimpleNamespace(**base)
+
+
+def test_pdsh_cmd():
+    world = {"worker-0": 1, "worker-1": 1}
+    r = PDSHRunner(_args(), world)
+    r.add_export("PYTHONPATH", "/repo")
+    cmd = r.get_cmd({}, world)
+    assert cmd[0] == "pdsh"
+    assert "worker-0,worker-1" in cmd
+    inner = cmd[-1]
+    assert "export PYTHONPATH=/repo;" in inner
+    assert "export DSTPU_COORDINATOR=worker-0;" in inner
+    assert "export DSTPU_NUM_PROCESSES=2;" in inner
+    assert "export DSTPU_HOSTS=worker-0,worker-1;" in inner
+    assert "deepspeed_tpu.launcher.launch" in inner
+    assert inner.endswith("train.py --config ds.json")
+
+
+def test_ssh_cmds_have_per_host_process_id():
+    world = {"worker-0": 1, "worker-1": 1}
+    r = SSHRunner(_args(), world)
+    c0 = r.get_host_cmd("worker-0", 0)
+    c1 = r.get_host_cmd("worker-1", 1)
+    assert c0[0] == "ssh" and "worker-0" in c0
+    assert "export DSTPU_PROCESS_ID=0;" in c0[-1]
+    assert "export DSTPU_PROCESS_ID=1;" in c1[-1]
+
+
+def test_gcloud_cmd():
+    world = {"worker-0": 1, "worker-1": 1}
+    r = GcloudRunner(_args(tpu_name="my-pod", zone="us-central2-b"), world)
+    cmd = r.get_cmd({}, world)
+    assert cmd[:6] == ["gcloud", "compute", "tpus", "tpu-vm", "ssh", "my-pod"]
+    assert "--worker=all" in cmd
+    assert any(c.startswith("--zone=us-central2-b") for c in cmd)
+    inner = next(c for c in cmd if c.startswith("--command="))
+    assert "train.py" in inner
+    # pod workers derive identity from TPU metadata, NOT fabricated exports —
+    # and the local interpreter path must not leak into the pod
+    assert "export DSTPU_POD=1;" in inner
+    assert "DSTPU_COORDINATOR" not in inner
+    assert "DSTPU_PROCESS_ID" not in inner
+    assert sys.executable not in inner
+    assert "python3 -u -m deepspeed_tpu.launcher.launch" in inner
+
+
+def test_all_user_args_shell_quoted():
+    world = {"a": 1, "b": 1}
+    r = PDSHRunner(_args(user_args=["--glob", "*.json", "--cmd", "$HOME;rm"]), world)
+    inner = r.get_cmd({}, world)[-1]
+    assert "'*.json'" in inner
+    assert "'$HOME;rm'" in inner
+
+
+def test_slurm_cmd():
+    world = {"n0": 1, "n1": 1, "n2": 1}
+    r = SlurmRunner(_args(), world)
+    cmd = r.get_cmd({}, world)
+    assert cmd[0] == "srun"
+    assert "--nodes" in cmd and "3" in cmd
+
+
+def test_user_args_with_spaces_quoted():
+    world = {"a": 1, "b": 1}
+    r = PDSHRunner(_args(user_args=["--name", "two words"]), world)
+    inner = r.get_cmd({}, world)[-1]
+    assert "'two words'" in inner
+
+
+# ---------------------------------------------------------------------------
+# env propagation
+# ---------------------------------------------------------------------------
+def test_collect_env_allowlist_and_file(tmp_path, monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("SECRET_TOKEN", "nope")
+    monkeypatch.chdir(tmp_path)
+    (tmp_path / ".dstpu_env").write_text("MY_FLAG=1\n# comment\nOTHER=a=b\n")
+    args = parse_args(["--export", "EXTRA=2", "train.py"])
+    env = collect_env(args)
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert "SECRET_TOKEN" not in env
+    assert env["MY_FLAG"] == "1"
+    assert env["OTHER"] == "a=b"  # split on first '=' only
+    assert env["EXTRA"] == "2"
+
+
+# ---------------------------------------------------------------------------
+# live single-host launch + per-node launcher
+# ---------------------------------------------------------------------------
+def test_local_launch_sets_env(tmp_path):
+    script = tmp_path / "probe.py"
+    script.write_text(
+        "import os\n"
+        "print('NP=' + os.environ['DSTPU_NUM_PROCESSES'], 'PID=' + os.environ['DSTPU_PROCESS_ID'])\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.launcher.runner", "--hostfile", "/none", str(script)],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "PYTHONPATH": REPO},
+    )
+    assert out.returncode == 0, out.stderr
+    assert "NP=1 PID=0" in out.stdout
+
+
+def test_launch_infers_process_id_from_hosts(tmp_path):
+    import socket
+
+    script = tmp_path / "probe.py"
+    script.write_text("import os; print('PID=' + os.environ['DSTPU_PROCESS_ID'])\n")
+    me = socket.gethostname()
+    env = {**os.environ, "PYTHONPATH": REPO, "DSTPU_HOSTS": f"other-host,{me}",
+           "DSTPU_NUM_PROCESSES": "2"}
+    out = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.launcher.launch", str(script)],
+        capture_output=True, text=True, cwd=REPO, env=env,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "PID=1" in out.stdout
+
+
+def test_env_report_runs():
+    out = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.env_report"],
+        capture_output=True, text=True, cwd=REPO, env={**os.environ, "PYTHONPATH": REPO},
+    )
+    assert out.returncode == 0, out.stderr
+    assert "deepspeed_tpu" in out.stdout
+    assert "op availability" in out.stdout
