@@ -5,6 +5,8 @@ The reference reaches models through HF + injection policies
 as pure-functional JAX with declarative sharding.
 """
 
+from deepspeed_tpu.models.adapters import flax_loss_fn
+from deepspeed_tpu.models.hf import config_from_hf, load_hf_llama
 from deepspeed_tpu.models.transformer import (
     PRESETS,
     TransformerConfig,
@@ -21,6 +23,9 @@ from deepspeed_tpu.models.transformer import (
 
 __all__ = [
     "PRESETS",
+    "config_from_hf",
+    "flax_loss_fn",
+    "load_hf_llama",
     "TransformerConfig",
     "decode_step",
     "flops_per_token",

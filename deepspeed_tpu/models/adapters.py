@@ -1,0 +1,91 @@
+"""User-model adapters: bring arbitrary flax modules / loss functions to the
+engine's ``loss_fn(params, batch[, rng])`` contract.
+
+The reference wraps user ``nn.Module``s directly (``deepspeed.initialize``
+engine.py:202 takes the torch module); the TPU engine trains pure loss
+functions over param pytrees, so foreign model types adapt here.
+"""
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _default_loss(outputs: jax.Array, batch: dict) -> jax.Array:
+    """Heuristic loss when the user does not supply one:
+    * [b, s, vocab] outputs + integer 'labels' → next-token cross-entropy
+      (shifted like HF causal-LM heads; label -100 = HF ignore_index, and an
+      optional 'loss_mask' in the batch also masks positions)
+    * 'labels'/'y' same shape as outputs → MSE
+    """
+    labels = batch.get("labels", batch.get("y"))
+    if labels is None:
+        raise ValueError(
+            "default loss needs 'labels' (or 'y') in the batch; pass loss=... for custom objectives"
+        )
+    labels = jnp.asarray(labels)
+    if outputs.ndim == 3 and jnp.issubdtype(labels.dtype, jnp.integer):
+        logits = outputs[:, :-1].astype(jnp.float32)
+        targets = labels[:, 1:]
+        mask = (targets != -100).astype(jnp.float32)
+        if "loss_mask" in batch:
+            mask = mask * jnp.asarray(batch["loss_mask"])[:, 1:].astype(jnp.float32)
+        safe_targets = jnp.maximum(targets, 0)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, safe_targets[..., None], axis=-1)[..., 0]
+        return jnp.sum(-ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.mean(jnp.square(outputs.astype(jnp.float32) - labels.astype(jnp.float32)))
+
+
+def flax_loss_fn(
+    module: Any,
+    loss: Optional[Callable[[jax.Array, dict], jax.Array]] = None,
+    inputs_key: str = "inputs",
+    train: Optional[bool] = None,
+    mutable: bool = False,
+):
+    """Adapt a flax ``nn.Module`` to the engine contract.
+
+    ``params = module.init(rng, example_inputs)['params']`` is what you pass
+    to ``deepspeed_tpu.initialize(model_parameters=...)``; this wrapper is
+    the ``model=`` argument.
+
+    module:     a flax ``linen.Module`` instance
+    loss:       ``loss(outputs, batch) -> scalar`` (default: causal-LM CE for
+                [b, s, vocab] integer labels, MSE otherwise)
+    inputs_key: batch key holding the module's positional input (falls back
+                to 'input_ids' then 'x')
+    train:      value passed to ``module.apply(..., train=...)`` when the
+                module's __call__ accepts it (dropout etc.); None = omit
+    mutable:    pass-through for modules with batch-norm-style state — the
+                mutated collections are DISCARDED (the engine trains pure
+                params), so only enable for modules where that is acceptable
+    """
+    loss = loss or _default_loss
+
+    def _inputs(batch):
+        for k in (inputs_key, "input_ids", "x"):
+            if k in batch:
+                return batch[k]
+        raise KeyError(f"none of ({inputs_key!r}, 'input_ids', 'x') found in batch")
+
+    def loss_fn(params, batch, rng=None):
+        kwargs = {}
+        if train is not None:
+            kwargs["train"] = train
+        if rng is not None:
+            kwargs["rngs"] = {"dropout": rng}
+        variables = {"params": params}
+        if mutable:
+            out = module.apply(variables, _inputs(batch), mutable=["batch_stats"], **kwargs)
+            outputs = out[0]
+        else:
+            outputs = module.apply(variables, _inputs(batch), **kwargs)
+        if isinstance(outputs, tuple):
+            outputs = outputs[0]
+        if hasattr(outputs, "logits"):  # HF-flax output dataclasses
+            outputs = outputs.logits
+        return loss(outputs, batch)
+
+    return loss_fn

@@ -1,0 +1,174 @@
+"""HF checkpoint import: llama/mistral-family → the native model family.
+
+Analogue of the reference checkpoint-shard loading
+(``module_inject/load_checkpoint.py``, ``inference/engine.py:303`` meta-load
+path): a HF `LlamaForCausalLM` (or mistral — same layout) directory becomes a
+(:class:`TransformerConfig`, stacked-params pytree) pair that trains or
+serves through ``deepspeed_tpu.initialize`` / ``init_inference`` unchanged.
+
+Weight-layout notes (why each mapping is what it is):
+  * HF Linear stores ``[out, in]``; this model family uses JAX's ``[in,
+    out]`` → transpose every projection.
+  * Layers here are STACKED along a leading ``[n_layers, ...]`` dim (the
+    ``lax.scan`` layout), so per-layer tensors stack after transposing.
+  * RoPE: HF llama's ``rotate_half`` IS the half-split convention used by
+    ``transformer._rope`` — weights map 1:1, no permutation needed.
+  * ``torch`` is only used to read the checkpoint on host (CPU); arrays
+    convert to numpy before entering JAX.
+"""
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.models.transformer import TransformerConfig
+from deepspeed_tpu.utils.logging import logger
+
+
+def _to_np(t) -> np.ndarray:
+    if hasattr(t, "detach"):
+        t = t.detach()
+    if hasattr(t, "float") and str(getattr(t, "dtype", "")).startswith("torch.bfloat16"):
+        t = t.float()
+    return np.asarray(t.cpu() if hasattr(t, "cpu") else t)
+
+
+def config_from_hf(hf_cfg) -> TransformerConfig:
+    """HF LlamaConfig/MistralConfig (object or dict) → TransformerConfig."""
+    get = (lambda k, d=None: hf_cfg.get(k, d)) if isinstance(hf_cfg, dict) else (
+        lambda k, d=None: getattr(hf_cfg, k, d)
+    )
+    head_dim = get("head_dim", None)
+    derived = get("hidden_size") // get("num_attention_heads")
+    if head_dim is not None and int(head_dim) != derived:
+        # mistral-nemo-style decoupled head_dim: the native family derives
+        # head_dim = hidden/n_heads, so the qkv shapes would not line up —
+        # fail at load time with the real reason, not a reshape error later
+        raise ValueError(
+            f"unsupported checkpoint: head_dim={head_dim} != hidden/num_heads={derived} "
+            "(decoupled head_dim is not representable in TransformerConfig yet)"
+        )
+    return TransformerConfig(
+        vocab_size=get("vocab_size"),
+        hidden_size=get("hidden_size"),
+        n_layers=get("num_hidden_layers"),
+        n_heads=get("num_attention_heads"),
+        n_kv_heads=get("num_key_value_heads", None),
+        ffn_hidden_size=get("intermediate_size"),
+        max_seq_len=get("max_position_embeddings", 2048),
+        norm="rmsnorm",
+        activation="swiglu",
+        position="rope",
+        rope_theta=float(get("rope_theta", 10000.0)),
+        norm_eps=float(get("rms_norm_eps", 1e-5)),
+        tie_embeddings=bool(get("tie_word_embeddings", False)),
+    )
+
+
+def _load_state_dict(path: str) -> Dict[str, Any]:
+    """Read all weights of a HF checkpoint dir (safetensors preferred,
+    sharded or single-file; torch .bin fallback)."""
+    index = os.path.join(path, "model.safetensors.index.json")
+    single_st = os.path.join(path, "model.safetensors")
+    torch_bin = os.path.join(path, "pytorch_model.bin")
+    state: Dict[str, Any] = {}
+    if os.path.isfile(index) or os.path.isfile(single_st):
+        # framework="pt": the numpy backend cannot represent bf16 tensors;
+        # torch (cpu) reads them and _to_np upcasts
+        from safetensors import safe_open
+
+        files = (
+            sorted({os.path.join(path, s) for s in json.load(open(index))["weight_map"].values()})
+            if os.path.isfile(index)
+            else [single_st]
+        )
+        for shard in files:
+            with safe_open(shard, framework="pt") as f:
+                for k in f.keys():
+                    state[k] = _to_np(f.get_tensor(k))
+    elif os.path.isfile(torch_bin):
+        import torch
+
+        state = {k: _to_np(v) for k, v in torch.load(torch_bin, map_location="cpu", weights_only=True).items()}
+    else:
+        raise FileNotFoundError(f"no safetensors/bin checkpoint under {path}")
+    return state
+
+
+def load_hf_llama(
+    model_name_or_path: str,
+    dtype: str = "bfloat16",
+) -> Tuple[TransformerConfig, Dict[str, Any]]:
+    """Load a llama/mistral-family HF checkpoint directory into the native
+    family's stacked layout. Returns (config, params) — feed them to
+    ``make_loss_fn(config)`` + ``initialize(model_parameters=params)`` or the
+    inference engine."""
+    cfg_path = os.path.join(model_name_or_path, "config.json")
+    if not os.path.isfile(cfg_path):
+        raise FileNotFoundError(
+            f"{model_name_or_path} is not a checkpoint dir (no config.json); "
+            "download/snapshot the model first — there is no network access at load time"
+        )
+    hf_cfg = json.load(open(cfg_path))
+    cfg = dataclass_replace(config_from_hf(hf_cfg), dtype=dtype)
+    state = _load_state_dict(model_name_or_path)
+
+    P = "model.layers.{i}.{name}"
+
+    def take(name) -> np.ndarray:
+        return _np_cast(state.pop(name), dtype)
+
+    def take_linear(name) -> np.ndarray:
+        return take(name).T  # [out, in] → [in, out]
+
+    layers: Dict[str, list] = {
+        "attn_norm": [], "wq": [], "wk": [], "wv": [], "wo": [],
+        "mlp_norm": [], "w_gate": [], "w_up": [], "w_down": [],
+    }
+    for i in range(cfg.n_layers):
+        layers["attn_norm"].append(take(P.format(i=i, name="input_layernorm.weight")))
+        layers["wq"].append(take_linear(P.format(i=i, name="self_attn.q_proj.weight")))
+        layers["wk"].append(take_linear(P.format(i=i, name="self_attn.k_proj.weight")))
+        layers["wv"].append(take_linear(P.format(i=i, name="self_attn.v_proj.weight")))
+        layers["wo"].append(take_linear(P.format(i=i, name="self_attn.o_proj.weight")))
+        layers["mlp_norm"].append(take(P.format(i=i, name="post_attention_layernorm.weight")))
+        layers["w_gate"].append(take_linear(P.format(i=i, name="mlp.gate_proj.weight")))
+        layers["w_up"].append(take_linear(P.format(i=i, name="mlp.up_proj.weight")))
+        layers["w_down"].append(take_linear(P.format(i=i, name="mlp.down_proj.weight")))
+
+    params: Dict[str, Any] = {
+        "embed": _np_cast(state.pop("model.embed_tokens.weight"), dtype),
+        "final_norm": take("model.norm.weight"),
+        "layers": {k: np.stack(v) for k, v in layers.items()},
+    }
+    if not cfg.tie_embeddings:
+        if "lm_head.weight" in state:
+            params["lm_head"] = _np_cast(state.pop("lm_head.weight"), dtype).T
+        else:
+            logger.warning("no lm_head.weight in checkpoint; tying to embeddings")
+            cfg = dataclass_replace(cfg, tie_embeddings=True)
+    else:
+        state.pop("lm_head.weight", None)
+    leftover = [k for k in state if not k.endswith("rotary_emb.inv_freq")]
+    if leftover:
+        logger.warning(f"unmapped HF weights ignored: {leftover[:8]}{'...' if len(leftover) > 8 else ''}")
+    return cfg, params
+
+
+def _np_cast(a, dtype: str) -> np.ndarray:
+    """Host-only dtype cast (ml_dtypes carries bf16 in numpy — no device
+    round-trip for multi-GB checkpoints)."""
+    import ml_dtypes
+
+    a = _to_np(a)
+    if a.dtype == np.dtype("V2") or str(a.dtype) == "bfloat16":
+        a = a.view(ml_dtypes.bfloat16).astype(np.float32)
+    target = {"bfloat16": ml_dtypes.bfloat16, "float32": np.float32, "float16": np.float16}[dtype]
+    return a.astype(target)
+
+
+def dataclass_replace(cfg, **kw):
+    return dataclasses.replace(cfg, **kw)

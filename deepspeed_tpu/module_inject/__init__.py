@@ -1,0 +1,11 @@
+"""Model-transform layer (reference deepspeed/module_inject/): AutoTP spec
+inference for arbitrary param pytrees. On TPU there is no module surgery —
+classification produces PartitionSpecs and GSPMD does the slicing."""
+
+from deepspeed_tpu.module_inject.auto_tp import (
+    classify,
+    describe,
+    infer_partition_specs,
+)
+
+__all__ = ["classify", "describe", "infer_partition_specs"]

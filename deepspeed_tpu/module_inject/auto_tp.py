@@ -1,0 +1,167 @@
+"""AutoTP: policy-free tensor-parallel spec inference for arbitrary pytrees.
+
+TPU-native re-design of the reference AutoTP (``module_inject/auto_tp.py:193``
+— module-graph scan classifying Linears into column-parallel vs
+all-reduce/row-parallel, then ``ReplaceWithTensorSlicing`` :32). On TPU no
+module surgery happens: the result of classification is a *PartitionSpec
+pytree* handed to ``initialize(param_specs=...)``; GSPMD does the slicing and
+inserts the collectives the reference's ``LinearAllreduce`` layers issue by
+hand.
+
+Classification mirrors the reference's name heuristics:
+  * row-parallel (input-dim sharded, output psum'd): projections that close
+    a parallel block — o_proj/out_proj/wo/down_proj/w2/fc2/dense_4h_to_h...
+    (reference ``tp_parser`` collects these as the "allreduce linears")
+  * column-parallel (output-dim sharded): every other 2-D weight —
+    q/k/v/gate/up/fc1/w1/w3/query_key_value... (reference default)
+  * replicated: norms, biases of row-parallel layers, scalars, small leaves
+  * embeddings: vocab-dim sharded when divisible (reference
+    ``ReplaceWithTensorSlicing`` embedding path)
+
+Weights are assumed ``[in, out]`` (JAX convention). Leaves whose candidate
+dim does not divide the axis size stay replicated — same fallback as the
+reference's ``require_tp_fused_qkvw`` divisibility guards.
+"""
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel.topology import MODEL_AXIS, get_topology
+
+# reference auto_tp.py: the "allreduce linears" — output projections whose
+# INPUT dim carries the parallel slices (row parallel)
+ROW_PATTERNS = (
+    "o_proj", "out_proj", "wo", "down_proj", "w2", "fc2", "dense_4h_to_h",
+    "attention/dense", "self_attention/dense", "proj_out", "c_proj",
+)
+# column-parallel producers (output dim sharded)
+COL_PATTERNS = (
+    "q_proj", "k_proj", "v_proj", "wq", "wk", "wv", "query", "key", "value",
+    "query_key_value", "gate_proj", "up_proj", "w1", "w3", "fc1",
+    "dense_h_to_4h", "c_attn", "c_fc", "in_proj",
+)
+EMBED_PATTERNS = ("embed", "wte", "wpe", "word_embeddings", "lm_head", "embed_tokens")
+NORM_PATTERNS = ("norm", "ln_", "layernorm", "layer_norm", "rmsnorm")
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts).lower()
+
+
+def _matches(name: str, patterns: Sequence[str]) -> bool:
+    return any(p in name for p in patterns)
+
+
+def classify(name: str) -> str:
+    """'row' | 'col' | 'embed' | 'replicate' from a parameter path name."""
+    if name.endswith("/bias") or name.endswith("/b"):
+        # biases follow their kernel's sharding; resolved by the caller
+        name = name.rsplit("/", 1)[0] + "/kernel"
+    if _matches(name, NORM_PATTERNS):
+        return "replicate"
+    if _matches(name, ROW_PATTERNS):
+        return "row"
+    if _matches(name, COL_PATTERNS):
+        return "col"
+    if _matches(name, EMBED_PATTERNS):
+        return "embed"
+    return "default"
+
+
+def _spec_for(kind: str, shape: Tuple[int, ...], tp: int, axis: str, shard_default: bool) -> P:
+    nd = len(shape)
+    if nd < 1 or tp <= 1:
+        return P()
+
+    def ok(dim):
+        return shape[dim] % tp == 0
+
+    if nd == 1:
+        # bias vector: column-parallel bias shards with the output; handled
+        # by the caller pairing. Standalone vectors (norms) replicate.
+        return P()
+    lead = (None,) * (nd - 2)  # stacked-layer / expert leading dims untouched
+    if kind == "row" and ok(nd - 2):
+        return P(*lead, axis, None)
+    if kind == "col" and ok(nd - 1):
+        return P(*lead, None, axis)
+    if kind == "embed":
+        # [vocab, hidden] → vocab-dim sharding (reference embedding slicing)
+        if shape[0] % tp == 0:
+            return P(axis, *((None,) * (nd - 1)))
+        return P()
+    if kind == "default" and shard_default and ok(nd - 1):
+        # reference default: unmatched linears become column-parallel
+        return P(*lead, None, axis)
+    return P()
+
+
+def infer_partition_specs(
+    params: Any,
+    tp_size: Optional[int] = None,
+    axis: str = MODEL_AXIS,
+    shard_default: bool = True,
+    min_size: int = 1024,
+) -> Any:
+    """Infer a tensor-parallel PartitionSpec pytree for an arbitrary model.
+
+    params:        the model's parameter pytree (arrays or ShapeDtypeStructs)
+    tp_size:       model-axis size (default: current topology's)
+    shard_default: column-shard unmatched 2-D weights (the reference AutoTP
+                   default); False = only shard recognized names
+    min_size:      leaves with fewer elements stay replicated
+
+    Returns a pytree of PartitionSpec matching ``params``, for
+    ``deepspeed_tpu.initialize(param_specs=...)``.
+    """
+    if tp_size is None:
+        tp_size = get_topology().model_parallel_size
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    names = [_path_str(path) for path, _ in flat]
+    kinds = [classify(n) for n in names]
+
+    # pair biases with their kernel's classification (flax: ".../kernel" +
+    # ".../bias"; column-parallel bias shards on its only dim)
+    specs = []
+    for (path, leaf), name, kind in zip(flat, names, kinds):
+        shape = tuple(getattr(leaf, "shape", ()))
+        n = 1
+        for d in shape:
+            n *= d
+        if n < min_size:
+            specs.append(P())
+            continue
+        if name.endswith("/bias") and len(shape) == 1:
+            if kind == "col" or (kind == "default" and shard_default):
+                specs.append(P(axis) if shape[0] % tp_size == 0 else P())
+            else:
+                specs.append(P())  # row-parallel bias is added post-psum once
+            continue
+        specs.append(_spec_for(kind, shape, tp_size, axis, shard_default))
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def describe(params: Any, specs: Any) -> str:
+    """Human-readable classification table (ds_report-style debugging aid)."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    flat_s = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda x: isinstance(x, P)
+    )
+    lines = []
+    for (path, leaf), spec in zip(flat, flat_s):
+        shape = tuple(getattr(leaf, "shape", ()))
+        lines.append(f"{_path_str(path):<60} {str(shape):<20} {spec}")
+    return "\n".join(lines)

@@ -1,0 +1,219 @@
+"""User-model path tests (VERDICT #7): flax module adapter, AutoTP spec
+inference for arbitrary pytrees, and HF llama checkpoint import with logits +
+greedy-decode parity vs the HF torch implementation (analogue of reference
+tests/unit/model_parallelism AutoTP tests + inference checkpoint tests)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import deepspeed_tpu
+from deepspeed_tpu.module_inject import classify, infer_partition_specs
+
+LR = 1e-2
+
+
+# ---------------------------------------------------------------------------
+# flax adapter
+# ---------------------------------------------------------------------------
+def test_flax_module_trains_through_initialize(devices8):
+    import flax.linen as nn
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.Dense(32)(x)
+            x = nn.relu(x)
+            return nn.Dense(8)(x)
+
+    from deepspeed_tpu.models import flax_loss_fn
+
+    module = MLP()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 16)).astype(np.float32)
+    y = rng.normal(size=(64, 8)).astype(np.float32)
+    params = module.init(jax.random.key(0), x[:1])["params"]
+
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=flax_loss_fn(module),
+        model_parameters=params,
+        config={
+            "train_micro_batch_size_per_gpu": 8,
+            "optimizer": {"type": "AdamW", "params": {"lr": LR}},
+            "zero_optimization": {"stage": 2},
+            "mesh": {"data": 8},
+            "steps_per_print": 1000,
+        },
+    )
+    batch = {"x": x, "y": y}
+    losses = [float(engine.train_batch(batch=batch)) for _ in range(6)]
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+# ---------------------------------------------------------------------------
+# AutoTP spec inference
+# ---------------------------------------------------------------------------
+def test_classify_patterns():
+    assert classify("model/layers/0/self_attn/q_proj/weight") == "col"
+    assert classify("model/layers/0/self_attn/o_proj/weight") == "row"
+    assert classify("model/layers/0/mlp/down_proj/weight") == "row"
+    assert classify("model/layers/0/input_layernorm/weight") == "replicate"
+    assert classify("model/embed_tokens/weight") == "embed"
+    assert classify("transformer/h/3/attn/c_attn/kernel") == "col"
+    assert classify("transformer/h/3/attn/c_proj/kernel") == "row"
+
+
+def test_infer_specs_hf_style_pytree():
+    h, ffn, vocab = 64, 128, 256
+    params = {
+        "embed_tokens": {"weight": np.zeros((vocab, h), np.float32)},
+        "layers": {
+            "q_proj": {"kernel": np.zeros((h, h), np.float32), "bias": np.zeros((h,), np.float32)},
+            "o_proj": {"kernel": np.zeros((h, h), np.float32), "bias": np.zeros((h,), np.float32)},
+            "up_proj": {"kernel": np.zeros((h, ffn), np.float32)},
+            "down_proj": {"kernel": np.zeros((ffn, h), np.float32)},
+            "input_layernorm": {"weight": np.zeros((h,), np.float32)},
+        },
+    }
+    specs = infer_partition_specs(params, tp_size=2, min_size=1)
+    assert specs["layers"]["q_proj"]["kernel"] == P(None, "model")
+    assert specs["layers"]["q_proj"]["bias"] == P("model")
+    assert specs["layers"]["o_proj"]["kernel"] == P("model", None)
+    assert specs["layers"]["o_proj"]["bias"] == P()  # added once, post-psum
+    assert specs["layers"]["up_proj"]["kernel"] == P(None, "model")
+    assert specs["layers"]["down_proj"]["kernel"] == P("model", None)
+    assert specs["layers"]["input_layernorm"]["weight"] == P()
+    assert specs["embed_tokens"]["weight"] == P("model", None)
+
+
+def test_infer_specs_indivisible_replicates():
+    params = {"q_proj": {"kernel": np.zeros((64, 63), np.float32)}}
+    specs = infer_partition_specs(params, tp_size=2, min_size=1)
+    assert specs["q_proj"]["kernel"] == P()
+
+
+def test_flax_model_with_inferred_tp_trains(devices8):
+    """End-to-end: arbitrary flax model + inferred specs on a model=2 mesh."""
+    import flax.linen as nn
+
+    class Block(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            h = nn.Dense(64, name="fc1")(x)
+            h = nn.relu(h)
+            return nn.Dense(16, name="fc2")(h)
+
+    from deepspeed_tpu.models import flax_loss_fn
+
+    module = Block()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 16)).astype(np.float32)
+    y = rng.normal(size=(32, 16)).astype(np.float32)
+    params = module.init(jax.random.key(0), x[:1])["params"]
+    specs = infer_partition_specs(params, tp_size=2, min_size=1)
+    assert specs["fc1"]["kernel"] == P(None, "model")
+    assert specs["fc2"]["kernel"] == P("model", None)
+
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=flax_loss_fn(module),
+        model_parameters=params,
+        config={
+            "train_micro_batch_size_per_gpu": 8,
+            "optimizer": {"type": "AdamW", "params": {"lr": LR}},
+            "zero_optimization": {"stage": 0},
+            "mesh": {"data": 4, "model": 2},
+            "steps_per_print": 1000,
+        },
+        param_specs=specs,
+    )
+    batch = {"x": x, "y": y}
+    losses = [float(engine.train_batch(batch=batch)) for _ in range(6)]
+    assert losses[-1] < losses[0] * 0.7, losses
+    # fc1 kernel is actually model-sharded
+    leaf = engine.params["fc1"]["kernel"]
+    assert len(leaf.sharding.device_set) >= 2
+
+
+# ---------------------------------------------------------------------------
+# HF llama import
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def tiny_hf_llama(tmp_path_factory):
+    transformers = pytest.importorskip("transformers")
+    import torch
+
+    torch.manual_seed(0)
+    cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rms_norm_eps=1e-5, rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    model = transformers.LlamaForCausalLM(cfg).eval()
+    path = tmp_path_factory.mktemp("hf_llama")
+    model.save_pretrained(path)
+    return model, str(path)
+
+
+def test_hf_llama_logits_parity(tiny_hf_llama):
+    import torch
+
+    hf_model, path = tiny_hf_llama
+    from deepspeed_tpu.models import load_hf_llama
+    from deepspeed_tpu.models.transformer import forward
+
+    cfg, params = load_hf_llama(path, dtype="float32")
+    assert cfg.n_layers == 2 and cfg.n_heads == 4 and cfg.kv_heads == 2
+
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, 256, size=(2, 16)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    ours, _ = forward(params, jnp.asarray(tokens), cfg)
+    np.testing.assert_allclose(np.asarray(ours, np.float32), ref, atol=2e-3, rtol=2e-3)
+
+
+def test_hf_llama_greedy_decode_parity(tiny_hf_llama):
+    import torch
+
+    hf_model, path = tiny_hf_llama
+    from deepspeed_tpu.models import load_hf_llama
+    from deepspeed_tpu.models.transformer import forward
+
+    cfg, params = load_hf_llama(path, dtype="float32")
+    prompt = np.array([[5, 17, 42, 7]], dtype=np.int32)
+    with torch.no_grad():
+        hf_out = hf_model.generate(
+            torch.tensor(prompt, dtype=torch.long), max_new_tokens=8, do_sample=False
+        ).numpy()[0]
+
+    toks = prompt.copy()
+    for _ in range(8):
+        logits, _ = forward(params, jnp.asarray(toks), cfg)
+        nxt = int(jnp.argmax(logits[0, -1]))
+        toks = np.concatenate([toks, [[nxt]]], axis=1)
+    np.testing.assert_array_equal(toks[0], hf_out)
+
+
+def test_hf_llama_trains_through_initialize(tiny_hf_llama, devices8):
+    _, path = tiny_hf_llama
+    from deepspeed_tpu.models import load_hf_llama, make_loss_fn
+
+    cfg, params = load_hf_llama(path, dtype="float32")
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=make_loss_fn(cfg),
+        model_parameters=params,
+        config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 3, "param_persistence_threshold": 0},
+            "mesh": {"data": 8},
+            "steps_per_print": 1000,
+        },
+    )
+    toks = np.random.default_rng(0).integers(0, 256, size=(8, 33)).astype(np.int32)
+    losses = [float(engine.train_batch(batch={"input_ids": toks})) for _ in range(5)]
+    assert losses[-1] < losses[0], losses
