@@ -52,6 +52,7 @@ class InferenceEngineV2:
         self._k_cache = jnp.zeros(shape, dtype)
         self._v_cache = jnp.zeros(shape, dtype)
         self._row_jit = {}
+        self._batched_jit = None  # shape-polymorphic: jit specializes per bucket
         self.last_scheduled_tokens = 0
         self.last_capped = set()
         log_dist(
@@ -130,6 +131,66 @@ class InferenceEngineV2:
         return jax.jit(row_step, donate_argnums=(5, 6))
 
     # ------------------------------------------------------------------
+    def _build_batched_step(self):
+        """ONE compiled step over the whole packed ragged batch (the actual
+        SplitFuse execution: reference ragged_ops kernels run every scheduled
+        sequence in one launch; the round-1 per-sequence Python loop is kept
+        only as ``_step_per_row`` for comparison). All sequences' new tokens
+        are flattened to [T]; every matmul serves the fused batch; attention
+        is the paged block-table kernel (ops/attention/paged_pallas)."""
+        from deepspeed_tpu.ops.attention.paged_pallas import paged_attention
+
+        c = self._mc
+        kv = self.config.kv_cache
+        bs = kv.block_size
+        B = kv.max_blocks_per_seq
+        trash = kv.num_blocks
+        R = self.config.state_manager.max_ragged_sequence_count
+        dtype = T.DTYPES[c.dtype]
+
+        def step(params, tokens, seq_idx, positions, tables, last_idx, k_cache, v_cache):
+            """tokens/seq_idx/positions: [T] packed; tables: [R+1, B]
+            (row R all-trash for padding); last_idx: [R] flat index of each
+            row's last valid token. Returns (logits [R, vocab], caches)."""
+            t = tokens.shape[0]
+            x = params["embed"].astype(dtype)[tokens][None]  # [1, T, h]
+            if c.position == "learned":
+                x = x + params["pos_embed"][jnp.clip(positions, 0, c.max_seq_len - 1)][None]
+            tok_tables = tables[seq_idx]  # [T, B]
+            blk = jnp.take_along_axis(
+                tok_tables, jnp.clip(positions // bs, 0, B - 1)[:, None], axis=1
+            )[:, 0]
+            row = positions % bs
+            nh, nkv, d = c.n_heads, c.kv_heads, c.head_dim
+
+            def layer_step(x, inputs):
+                lp, kc_l, vc_l = inputs
+                a = T._norm(x, lp["attn_norm"], lp.get("attn_norm_b"), c.norm, c.norm_eps)
+                q = (a[0] @ lp["wq"]).reshape(t, nh, d)
+                k = (a[0] @ lp["wk"]).reshape(t, nkv, d)
+                v = (a[0] @ lp["wv"]).reshape(t, nkv, d)
+                if c.position == "rope":
+                    q = T._rope(q.transpose(1, 0, 2)[None], positions[None], c.rope_theta)[0].transpose(1, 0, 2)
+                    k = T._rope(k.transpose(1, 0, 2)[None], positions[None], c.rope_theta)[0].transpose(1, 0, 2)
+                kc_l = kc_l.at[blk, row].set(k)
+                vc_l = vc_l.at[blk, row].set(v)
+                out = paged_attention(q, kc_l, vc_l, tok_tables, positions, trash)
+                x = x + (out.reshape(t, nh * d) @ lp["wo"])[None]
+                m = T._norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"), c.norm, c.norm_eps)
+                mlp_out, _ = T._mlp_block(c, lp, m)
+                return x + mlp_out, (kc_l, vc_l)
+
+            x, (k_new, v_new) = jax.lax.scan(layer_step, x, (params["layers"], k_cache, v_cache))
+            x = T._norm(x, params["final_norm"], params.get("final_norm_b"), c.norm, c.norm_eps)
+            last = x[0, jnp.clip(last_idx, 0, t - 1)]  # [R, h]
+            if c.tie_embeddings:
+                logits = last @ params["embed"].astype(last.dtype).T
+            else:
+                logits = last @ params["lm_head"]
+            return logits.astype(jnp.float32), k_new, v_new
+
+        return jax.jit(step, donate_argnums=(6, 7))
+
     def put(self, batch_uids, batch_tokens) -> Dict[int, np.ndarray]:
         """Submit new sequences (reference put :107) and run ONE engine step.
         Returns {uid: logits} for sequences whose scheduled tokens completed a
@@ -139,6 +200,68 @@ class InferenceEngineV2:
         return self.step()
 
     def step(self) -> Dict[int, np.ndarray]:
+        """One engine step: the scheduler's packed batch advances in a single
+        device call (multi-sequence decode + prompt chunks fused)."""
+        batch = self.scheduler.next_batch()
+        self.last_scheduled_tokens = batch.total_tokens if batch is not None else 0
+        self.last_capped |= self.scheduler.drain_capped()
+        if batch is None:
+            return {}
+        kv = self.config.kv_cache
+        R = self.config.state_manager.max_ragged_sequence_count
+        B = kv.max_blocks_per_seq
+        trash = kv.num_blocks
+
+        total = batch.total_tokens
+        tb = _bucket(total)  # pads the token dim to a small set of compiled shapes
+        if self._batched_jit is None:
+            self._batched_jit = self._build_batched_step()
+
+        tokens = np.zeros(tb, np.int32)
+        seq_idx = np.full(tb, R, np.int32)  # padding → all-trash table row
+        positions = np.zeros(tb, np.int32)
+        tables = np.full((R + 1, B), trash, np.int32)
+        last_idx = np.zeros(R, np.int32)
+        off = 0
+        for i, (uid, toks, start) in enumerate(
+            zip(batch.uids, batch.tokens, batch.start_positions)
+        ):
+            n = len(toks)
+            tokens[off : off + n] = toks
+            seq_idx[off : off + n] = i
+            positions[off : off + n] = start + np.arange(n)
+            seq = self.state_manager.get_sequence(uid)
+            # only the ALLOCATED slots: unused table entries must stay trash
+            # so the kernel's blk != trash guard holds for live rows too
+            nblk = len(seq.block_table)
+            tables[i, :nblk] = seq.block_table
+            last_idx[i] = off + n - 1
+            off += n
+
+        logits, self._k_cache, self._v_cache = self._batched_jit(
+            self.params,
+            jnp.asarray(tokens),
+            jnp.asarray(seq_idx),
+            jnp.asarray(positions),
+            jnp.asarray(tables),
+            jnp.asarray(last_idx),
+            self._k_cache,
+            self._v_cache,
+        )
+        logits = np.asarray(logits)
+        results: Dict[int, np.ndarray] = {}
+        for i, (uid, toks, chunked) in enumerate(
+            zip(batch.uids, batch.tokens, batch.is_prompt_chunk)
+        ):
+            seq = self.state_manager.get_sequence(uid)
+            seq.seen_tokens += len(toks)
+            if not chunked:  # prompt complete (or decode token): logits usable
+                results[uid] = logits[i]
+        return results
+
+    def _step_per_row(self) -> Dict[int, np.ndarray]:
+        """Round-1 execution model (one compiled call per sequence) — kept as
+        the baseline the batched step is benchmarked against."""
         batch = self.scheduler.next_batch()
         self.last_scheduled_tokens = batch.total_tokens if batch is not None else 0
         self.last_capped |= self.scheduler.drain_capped()

@@ -1,0 +1,165 @@
+"""Paged (block-table) attention kernel for the ragged inference batch.
+
+TPU-native analogue of the reference blocked-flash ragged kernels
+(``inference/v2/kernels/ragged_ops/blocked_flash``, ``linear_blocked_kv_rotary``):
+every query token carries its own block table and context length, so one
+kernel call serves a fused batch of decode tokens and prompt chunks from
+different sequences (the Dynamic SplitFuse execution model).
+
+Layout:
+  q            [T, nh, d]     — packed new-token queries (T = token budget)
+  k/v cache    [NB, bs, nkv, d] — the paged pool, one layer's slice
+  block_tables [T, B]         — per TOKEN block table (row's table gathered
+                                by seq index before the call)
+  q_pos        [T]            — global position of each query in its sequence
+
+Kernel structure: grid (T, B); per program one query token against one of
+its context blocks. The block index comes from a scalar-prefetched table
+(``PrefetchScalarGridSpec``) so the DMA of the right cache block overlaps
+compute — the TPU form of the reference kernel's block-table gather. Online
+softmax accumulates in VMEM scratch across the B (sequential) grid dim.
+GQA handled by an unrolled per-kv-head loop (MXU dots on [group, d]@[d, bs]).
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def paged_attention_reference(q, k_cache, v_cache, block_tables, q_pos, trash_block):
+    """jnp reference: per-token context gather + masked softmax, mapped over
+    tokens so peak memory is one context window ([S, nkv, d]) rather than T
+    of them. Shapes as module docstring; returns [T, nh, d]."""
+    T, nh, d = q.shape
+    NB, bs, nkv, _ = k_cache.shape
+    B = block_tables.shape[1]
+    S = B * bs
+    group = nh // nkv
+    kpos = jnp.arange(S, dtype=jnp.int32)
+
+    def one_token(args):
+        qt, bt, pos = args  # [nh, d], [B], scalar
+        k_ctx = k_cache[bt].reshape(S, nkv, d).astype(jnp.float32)
+        v_ctx = v_cache[bt].reshape(S, nkv, d).astype(jnp.float32)
+        blk_valid = jnp.repeat(bt != trash_block, bs)
+        mask = (kpos <= pos) & blk_valid  # [S]
+        qg = qt.reshape(nkv, group, d).astype(jnp.float32)
+        scores = jnp.einsum("ngd,snd->ngs", qg, k_ctx) * (d**-0.5)
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+        w = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("ngs,snd->ngd", w, v_ctx).reshape(nh, d)
+
+    out = jax.lax.map(one_token, (q, block_tables, q_pos), batch_size=min(T, 32))
+    return out.astype(q.dtype)
+
+
+def _paged_kernel(
+    bt_ref, qpos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, bs, nh, nkv, d, trash
+):
+    t = pl.program_id(0)
+    j = pl.program_id(1)
+    B = pl.num_programs(1)
+    group = nh // nkv
+    scale = d**-0.5
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    blk = bt_ref[t, j]
+    qpos = qpos_ref[t]
+    base = j * bs
+    kpos = base + jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1)  # [1, bs]
+    valid = (kpos <= qpos) & (blk != trash)  # [1, bs]
+
+    q = q_ref[0].astype(jnp.float32) * scale  # [nh, d]
+    k = k_ref[0].astype(jnp.float32)  # [bs, nkv, d]
+    v = v_ref[0].astype(jnp.float32)
+
+    m_prev = m_scr[...]  # [nh, 128] (col 0 meaningful)
+    l_prev = l_scr[...]
+    for n in range(nkv):
+        qn = q[n * group : (n + 1) * group]  # [group, d]
+        kn = k[:, n, :]  # [bs, d]
+        vn = v[:, n, :]
+        s = jax.lax.dot_general(
+            qn, kn, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [group, bs]
+        s = jnp.where(valid, s, NEG_INF)
+        m_p = m_prev[n * group : (n + 1) * group, :1]  # [group, 1]
+        m_new = jnp.maximum(m_p, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_p - m_new)
+        p = jnp.exp(s - m_new)  # [group, bs]
+        l_p = l_prev[n * group : (n + 1) * group, :1]
+        l_new = l_p * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc_scr[n * group : (n + 1) * group, :]  # [group, d]
+        acc_scr[n * group : (n + 1) * group, :] = acc * alpha + jax.lax.dot_general(
+            p, vn, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_scr[n * group : (n + 1) * group, :1] = m_new
+        l_scr[n * group : (n + 1) * group, :1] = l_new
+
+    @pl.when(j == B - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        o_ref[0] = (acc_scr[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def paged_attention(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    block_tables: jax.Array,
+    q_pos: jax.Array,
+    trash_block: int,
+    impl: Optional[str] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Dispatching entry point (kernel on TPU, reference otherwise)."""
+    T, nh, d = q.shape
+    NB, bs, nkv, _ = k_cache.shape
+    use_kernel = impl == "kernel" or (
+        impl is None and jax.default_backend() == "tpu" and d in (64, 128, 256)
+    )
+    if not use_kernel and not interpret:
+        return paged_attention_reference(q, k_cache, v_cache, block_tables, q_pos, trash_block)
+
+    B = block_tables.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(T, B),
+        in_specs=[
+            pl.BlockSpec((1, nh, d), lambda t, j, bt, qp: (t, 0, 0)),
+            pl.BlockSpec((1, bs, nkv, d), lambda t, j, bt, qp: (bt[t, j], 0, 0, 0)),
+            pl.BlockSpec((1, bs, nkv, d), lambda t, j, bt, qp: (bt[t, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, nh, d), lambda t, j, bt, qp: (t, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((nh, 128), jnp.float32),
+            pltpu.VMEM((nh, 128), jnp.float32),
+            pltpu.VMEM((nh, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _paged_kernel, bs=bs, nh=nh, nkv=nkv, d=d, trash=trash_block
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((T, nh, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            # tokens are independent (scratch re-inits at j==0) → megacore
+            # can split the T dim; only the block dim accumulates
+            dimension_semantics=("parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), q_pos.astype(jnp.int32), q, k_cache, v_cache)
