@@ -54,6 +54,9 @@ def paged_attention_reference(q, k_cache, v_cache, block_tables, q_pos, trash_bl
         scores = jnp.einsum("ngd,snd->ngs", qg, k_ctx) * (d**-0.5)
         scores = jnp.where(mask[None, None], scores, NEG_INF)
         w = jax.nn.softmax(scores, axis=-1)
+        # fully-masked token (all-trash padding): return 0 like the kernel
+        # does, not the uniform-softmax mean of trash V
+        w = jnp.where(jnp.any(mask), w, 0.0)
         return jnp.einsum("ngs,snd->ngd", w, v_ctx).reshape(nh, d)
 
     out = jax.lax.map(one_token, (q, block_tables, q_pos), batch_size=min(T, 32))
@@ -111,7 +114,11 @@ def _paged_kernel(
     @pl.when(j == B - 1)
     def _finish():
         l = l_scr[:, :1]
-        o_ref[0] = (acc_scr[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        # fully-masked token (all-trash padding): m never left NEG_INF and
+        # every p degenerated to exp(0) — emit 0, matching the reference
+        any_valid = m_scr[:, :1] > NEG_INF * 0.5
+        out = jnp.where(any_valid, acc_scr[...] / jnp.maximum(l, 1e-30), 0.0)
+        o_ref[0] = out.astype(o_ref.dtype)
 
 
 def paged_attention(

@@ -637,6 +637,12 @@ class DeepSpeedEngine:
 
     def _build_train_step(self):
         if getattr(self.optimizer, "collective_grad_exchange", False):
+            if getattr(self.loss_fn, "custom_value_and_grad", None) is not None:
+                raise NotImplementedError(
+                    "1-bit optimizers are incompatible with custom-gradient loss "
+                    "functions (1F1B pipeline): the compressed exchange needs local "
+                    "grads from autodiff, which they bypass"
+                )
             return self._build_onebit_train_step()
         gas = self.config.gradient_accumulation_steps
         clip = self.config.gradient_clipping
@@ -645,7 +651,26 @@ class DeepSpeedEngine:
         mesh = self.topo.mesh
         accum_dtype = self.grad_accum_dtype
 
-        if self._quantized_exchange_enabled():
+        custom_vg = getattr(self.loss_fn, "custom_value_and_grad", None)
+        if custom_vg is not None and self.fp16_enabled:
+            raise NotImplementedError(
+                "fp16 dynamic loss scaling is incompatible with custom-gradient loss "
+                "functions (1F1B pipeline): scaling wraps autodiff, which they bypass — use bf16"
+            )
+        if custom_vg is not None and self._quantized_exchange_enabled():
+            raise NotImplementedError(
+                "zero_quantized_gradients/weights are incompatible with custom-gradient "
+                "loss functions (1F1B pipeline): the quantized exchange wraps autodiff, "
+                "which they bypass"
+            )
+        if custom_vg is not None:
+            # loss fn drives its own backward (1F1B pipeline executor)
+            def micro_grads(params, mb, rng, scale):
+                loss, grads = custom_vg(params, mb)
+                grads = constrain_tree(grads, grad_specs, mesh)
+                return loss.astype(jnp.float32), grads
+
+        elif self._quantized_exchange_enabled():
             micro_grads = self._make_quantized_micro_grads(grad_specs, mesh)
         else:
 

@@ -201,6 +201,263 @@ def make_pipelined_loss_fn(config, micro_batches: int, topo: Topology = None):
     return loss_fn
 
 
+def _tree_add_where(pred, acc, delta):
+    return jax.tree.map(lambda a, d: a + jnp.where(pred, d, jnp.zeros_like(d)), acc, delta)
+
+
+class Pipelined1F1BLoss:
+    """Pipelined causal-LM loss with a TRUE 1F1B executing schedule.
+
+    The GPipe-shaped rotation (``make_pipelined_loss_fn`` + autodiff) keeps
+    every microbatch's stage activations alive until the scan's backward —
+    O(n_micro) liveness per stage (VERDICT weak #7). This executor reproduces
+    the reference ``TrainSchedule`` memory property (runtime/pipe/engine.py:60,
+    schedule.py:189): forward and backward INTERLEAVE inside one scan, so a
+    stage holds at most ``2*(S-1-stage_id)+1`` in-flight microbatch inputs —
+    bounded by the stage count, independent of n_micro.
+
+    Mechanics (all SPMD over the ``pipe`` axis, one compiled program):
+      * tick t, stage s: forward of microbatch ``f = t - s`` and backward of
+        microbatch ``b = t - (2S-2) + s`` (on the last stage b == f: the
+        "1F then 1B" of the same microbatch, reference steady state).
+      * backward is hand-driven ``jax.vjp`` per stage per tick; only the
+        stage INPUT is saved (circular buffer of depth 2S), the stage body
+        recomputes under its remat policy inside the tick.
+      * the LM head + loss run inside the region on the last stage the tick
+        a microbatch's forward completes (lax.cond — other stages skip the
+        compute), producing the output cotangent that starts its backward
+        the same tick. The embedding's gather-vjp likewise runs on stage 0
+        at each backward tick.
+      * activation sends ride ``ppermute`` (i→i+1); cotangent sends ride the
+        reverse permutation — the SendGrad/RecvGrad instructions, fused into
+        the same tick.
+
+    Loss is the mean of per-microbatch means (the reference's
+    ``_aggregate_total_loss`` semantics); with non-uniform loss masks this
+    differs from the dense path's global-mask normalization.
+
+    Restrictions: tie_embeddings unsupported (head cotangent would need to
+    reach the embedding table across stages); fp16 loss-scaling unsupported
+    (the engine applies scaling around autodiff, not custom grads).
+    """
+
+    def __init__(self, config, micro_batches: int, topo: Topology = None):
+        self.config = config
+        self.micro_batches = micro_batches
+        self.topo = topo or get_topology()
+        if config.tie_embeddings:
+            raise NotImplementedError("1F1B pipeline does not support tied embeddings")
+        self._fwd_loss = make_pipelined_loss_fn(config, micro_batches, self.topo)
+
+    def __call__(self, params, batch):
+        return self._fwd_loss(params, batch)
+
+    def custom_value_and_grad(self, params, batch):
+        """(loss, grads) with 1F1B liveness. Engine hook: when a loss_fn
+        exposes ``custom_value_and_grad``, the train step uses it instead of
+        ``jax.value_and_grad``."""
+        from deepspeed_tpu.models import transformer as T
+
+        c = self.config
+        topo = self.topo
+        S = topo.pipe_parallel_size
+        n_micro = self.micro_batches
+        if S <= 1:
+            return jax.value_and_grad(self._fwd_loss)(params, batch)
+
+        inputs, labels, mask, positions, segment_ids = T.split_lm_batch(batch)
+        b, s = inputs.shape
+        assert b % n_micro == 0, f"batch {b} not divisible by micro_batches {n_micro}"
+        mb = b // n_micro
+        if positions is None:
+            positions = jnp.arange(s, dtype=jnp.int32)
+        if mask is None:
+            mask = jnp.ones(labels.shape, jnp.float32)
+        has_seg = segment_ids is not None
+
+        tokens_m = inputs.reshape(n_micro, mb, s)
+        labels_m = labels.reshape(n_micro, mb, s)
+        mask_m = mask.reshape(n_micro, mb, s)
+        seg_m = segment_ids.reshape(n_micro, mb, s) if has_seg else jnp.zeros((n_micro, 1, 1), jnp.int32)
+
+        stage_params = _stack_stages(params["layers"], S)
+        head_keys = [k for k in ("final_norm", "final_norm_b", "lm_head") if k in params]
+        embed_keys = [k for k in ("embed", "pos_embed") if k in params]
+        head_params = {k: params[k] for k in head_keys}
+        embed_params = {k: params[k] for k in embed_keys}
+
+        D = 2 * S  # circular save-buffer depth: covers max in-flight 2(S-1)+1
+        total = n_micro + 2 * S - 2
+        perm_f = [(i, (i + 1) % S) for i in range(S)]
+        perm_b = [((i + 1) % S, i) for i in range(S)]
+
+        def run_stage(sp, state, seg):
+            layer = functools.partial(T._layer, c)
+            if c.remat:
+                layer = jax.checkpoint(
+                    layer, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                )
+
+            def body(carry, lp):
+                h, a = carry
+                h, a_l = layer(lp, h, positions, seg if has_seg else None)
+                return (h, a + a_l), None
+
+            out, _ = jax.lax.scan(body, state, sp)
+            return out
+
+        def head_loss(hp, y, aux, i):
+            full = dict(hp)
+            return T.lm_head_loss(full, y, labels_m[i], mask_m[i], c, aux=aux)
+
+        def per_stage(stage_params, tokens_m, labels_m, mask_m, seg_m, head_params, embed_params):
+            sp = jax.tree.map(lambda l: l[0], stage_params)  # this stage's [L/S, ...]
+            sid = jax.lax.axis_index(PIPE_AXIS)
+            is_first = sid == 0
+            is_last = sid == S - 1
+
+            x_tmpl = jnp.zeros((mb, s, c.hidden_size), T.DTYPES[c.dtype])
+            state_tmpl = (x_tmpl, jnp.float32(0.0))
+            zeros_hg = jax.tree.map(jnp.zeros_like, head_params)
+
+            def embed_mb(i):
+                return T.embed_tokens(embed_params, tokens_m[i], positions, c)
+
+            carry0 = (
+                state_tmpl,  # fwd_in
+                state_tmpl,  # bwd_in (cotangents share the state structure)
+                jax.tree.map(lambda l: jnp.zeros((D,) + l.shape, l.dtype), state_tmpl),  # xsave
+                jax.tree.map(jnp.zeros_like, sp),  # layer grads
+                jax.tree.map(jnp.zeros_like, embed_params),  # embed grads
+                zeros_hg,  # head grads
+                jnp.float32(0.0),  # loss
+            )
+
+            def tick(carry, t):
+                fwd_in, bwd_in, xsave, lg, eg, hg, loss_acc = carry
+                f = t - sid
+                f_valid = (f >= 0) & (f < n_micro)
+                bi = t - (2 * S - 2) + sid
+                b_valid = (bi >= 0) & (bi < n_micro)
+                fidx = jnp.clip(f, 0, n_micro - 1)
+                bidx = jnp.clip(bi, 0, n_micro - 1)
+                seg_f = seg_m[fidx] if has_seg else None
+                seg_b = seg_m[bidx] if has_seg else None
+
+                # ---- forward of microbatch f
+                x_first = jax.lax.cond(
+                    is_first, lambda: embed_mb(fidx), lambda: jnp.zeros_like(x_tmpl)
+                )
+                x_in = (
+                    jnp.where(is_first, x_first, fwd_in[0]),
+                    jnp.where(is_first, 0.0, fwd_in[1]),
+                )
+                y_state = run_stage(sp, x_in, seg_f)
+
+                # save the stage input for this microbatch's backward
+                slot = fidx % D
+                xsave = jax.tree.map(
+                    lambda buf, v: jax.lax.dynamic_update_index_in_dim(
+                        buf,
+                        jnp.where(f_valid, v, jax.lax.dynamic_index_in_dim(buf, slot, keepdims=False)),
+                        slot,
+                        0,
+                    ),
+                    xsave,
+                    x_in,
+                )
+
+                # ---- head + loss on the last stage (same tick starts backward)
+                def do_head():
+                    lo, vjp = jax.vjp(
+                        lambda hp, yy, aa: head_loss(hp, yy, aa, fidx), head_params, *y_state
+                    )
+                    dhp, dy, daux = vjp(jnp.float32(1.0))
+                    return lo, dhp, dy, daux
+
+                def no_head():
+                    return jnp.float32(0.0), zeros_hg, jnp.zeros_like(x_tmpl), jnp.float32(0.0)
+
+                head_on = is_last & f_valid
+                # gate on validity too: fill/drain ticks skip the full-vocab
+                # head matmul + vjp instead of computing-then-zeroing it
+                loss_f, dhp, dy_head, daux_head = jax.lax.cond(head_on, do_head, no_head)
+                loss_acc = loss_acc + jnp.where(head_on, loss_f / n_micro, 0.0)
+                hg = _tree_add_where(head_on, hg, jax.tree.map(lambda g: g / n_micro, dhp))
+
+                # ---- backward of microbatch b
+                x_in_b = jax.tree.map(
+                    lambda buf: jax.lax.dynamic_index_in_dim(buf, bidx % D, keepdims=False), xsave
+                )
+                dy_b = (
+                    jnp.where(is_last, dy_head / n_micro, bwd_in[0]),
+                    jnp.where(is_last, daux_head / n_micro, bwd_in[1]),
+                )
+                _, vjp_stage = jax.vjp(lambda p, st: run_stage(p, st, seg_b), sp, x_in_b)
+                dp, dstate = vjp_stage(dy_b)
+                lg = _tree_add_where(b_valid, lg, dp)
+
+                def do_embed_grad():
+                    _, evjp = jax.vjp(lambda ep: T.embed_tokens(ep, tokens_m[bidx], positions, c), embed_params)
+                    (dep,) = evjp(dstate[0])
+                    return dep
+
+                def no_embed_grad():
+                    return jax.tree.map(jnp.zeros_like, embed_params)
+
+                embed_on = b_valid & is_first
+                dep = jax.lax.cond(embed_on, do_embed_grad, no_embed_grad)
+                eg = _tree_add_where(embed_on, eg, dep)
+
+                # ---- neighbor exchange: activations forward, cotangents back
+                fwd_out = jax.tree.map(lambda l: jax.lax.ppermute(l, PIPE_AXIS, perm_f), y_state)
+                bwd_out = jax.tree.map(lambda l: jax.lax.ppermute(l, PIPE_AXIS, perm_b), dstate)
+                return (fwd_out, bwd_out, xsave, lg, eg, hg, loss_acc), None
+
+            (fwd_in, bwd_in, xsave, lg, eg, hg, loss_acc), _ = jax.lax.scan(
+                tick, carry0, jnp.arange(total)
+            )
+            # contributions live on single stages → psum replicates them
+            loss_out = jax.lax.psum(loss_acc, PIPE_AXIS)
+            eg = jax.tree.map(lambda l: jax.lax.psum(l, PIPE_AXIS), eg)
+            hg = jax.tree.map(lambda l: jax.lax.psum(l, PIPE_AXIS), hg)
+            lg = jax.tree.map(lambda l: l[None], lg)  # re-grow the pipe dim
+            return loss_out, lg, eg, hg
+
+        in_specs = (
+            jax.tree.map(lambda _: P(PIPE_AXIS), stage_params),
+            P(), P(), P(), P(),
+            jax.tree.map(lambda _: P(), head_params),
+            jax.tree.map(lambda _: P(), embed_params),
+        )
+        out_specs = (
+            P(),
+            jax.tree.map(lambda _: P(PIPE_AXIS), stage_params),
+            jax.tree.map(lambda _: P(), embed_params),
+            jax.tree.map(lambda _: P(), head_params),
+        )
+        fn = jax.shard_map(
+            per_stage,
+            mesh=topo.mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            axis_names={PIPE_AXIS},
+            check_vma=False,
+        )
+        loss, lg, eg, hg = fn(stage_params, tokens_m, labels_m, mask_m, seg_m, head_params, embed_params)
+
+        L = jax.tree_util.tree_leaves(params["layers"])[0].shape[0]
+        grads = dict(eg)
+        grads.update(hg)
+        grads["layers"] = jax.tree.map(lambda l: l.reshape((L,) + l.shape[2:]), lg)
+        return loss, grads
+
+
+def make_1f1b_loss_fn(config, micro_batches: int, topo: Topology = None) -> Pipelined1F1BLoss:
+    """The 1F1B pipelined loss (see :class:`Pipelined1F1BLoss`)."""
+    return Pipelined1F1BLoss(config, micro_batches, topo)
+
+
 def pipeline_partition_specs(config, topo: Topology = None) -> Any:
     """Param PartitionSpecs for the pipelined transformer: layer-stack leading
     dim sharded over ``pipe``, composed with the TP specs."""
