@@ -11,6 +11,7 @@ from typing import Any, Callable, Optional, Union
 
 from deepspeed_tpu.version import __version__
 from deepspeed_tpu import comm
+from deepspeed_tpu.runtime import zero
 from deepspeed_tpu.accelerator import get_accelerator
 from deepspeed_tpu.comm.comm import init_distributed
 from deepspeed_tpu.parallel.topology import Topology, get_topology, set_topology
@@ -40,8 +41,10 @@ def initialize(
 
     TPU adaptation: ``model`` is a pure loss function
     ``loss_fn(params, batch[, rng]) -> loss | (loss, aux)`` and
-    ``model_parameters`` is the params pytree. A flax ``nn.Module`` can be
-    adapted via ``deepspeed_tpu.models.flax_loss_fn``. ``mesh_param`` (the
+    ``model_parameters`` is the params pytree — or a ``zero.Init``/callable
+    for deferred construction (params materialize under jit with the ZeRO
+    plan's shardings; the full pytree never exists on one host). A flax
+    ``nn.Module`` can be adapted via ``deepspeed_tpu.models.flax_loss_fn``. ``mesh_param`` (the
     reference's DeviceMesh knob, __init__.py:163-171) or the config's
     ``mesh`` section sizes the parallelism grid.
     """

@@ -140,11 +140,21 @@ class DeepSpeedEngine:
                 "offload device 'nvme' maps to the host-memory tier on TPU "
                 "(no NVMe swap yet)", ranks=[0],
             )
-        params = _snapshot_cast(params, self.compute_dtype)
+        # zero.Init deferred construction (reference partition_parameters.py:878):
+        # a callable/zero.Init marker materializes UNDER jit with the plan's
+        # out_shardings — each device computes only its shard and the full
+        # pytree never exists on a single host
+        from deepspeed_tpu.runtime.zero import as_deferred_init
+
+        deferred_init = as_deferred_init(params)
+        plan_shapes = jax.eval_shape(deferred_init) if deferred_init is not None else params
+        if deferred_init is None:
+            params = _snapshot_cast(params, self.compute_dtype)
+            plan_shapes = params
         self.plan: ZeroShardingPlan = build_zero_plan(
             stage=self.zero_stage,
             topology=self.topo,
-            params=params,
+            params=plan_shapes,
             persistence_threshold=zcfg.param_persistence_threshold if self.zero_stage >= 3 else 0,
             base_specs=param_specs,
             offload_optimizer=offload_opt,
@@ -156,12 +166,17 @@ class DeepSpeedEngine:
         # stages state through device memory inside the step and parks it
         # back to pinned_host eagerly between steps (same semantics).
         self._offload_native = jax.default_backend() == "tpu"
-        if not dont_change_device:
-            init_shardings = (
-                self.plan.param_shardings
-                if self._offload_native
-                else self.plan.device_shardings(self.plan.param_shardings)
-            )
+        init_shardings = (
+            self.plan.param_shardings
+            if self._offload_native
+            else self.plan.device_shardings(self.plan.param_shardings)
+        )
+        if deferred_init is not None:
+            dtype = self.compute_dtype
+            params = jax.jit(
+                lambda: _tree_cast(deferred_init(), dtype), out_shardings=init_shardings
+            )()
+        elif not dont_change_device:
             params = jax.device_put(params, init_shardings)
         self.params = params
 
@@ -843,6 +858,12 @@ class DeepSpeedEngine:
         return jax.jit(train_step, donate_argnums=(0, 1, 2))
 
     def _build_fwd_bwd(self):
+        if getattr(self.loss_fn, "custom_value_and_grad", None) is not None:
+            raise NotImplementedError(
+                "custom-gradient loss functions (1F1B pipeline) require the fused "
+                "train_batch() path: the imperative forward/backward API would autodiff "
+                "through the GPipe-shaped forward, losing the 1F1B memory bound"
+            )
         grad_specs = self.plan.grad_specs
         mesh = self.topo.mesh
         quantized = (

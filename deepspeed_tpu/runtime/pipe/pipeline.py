@@ -169,27 +169,32 @@ def make_pipelined_loss_fn(config, micro_batches: int, topo: Topology = None):
         mb = b // micro_batches
         x_micro = x.reshape((micro_batches, mb) + x.shape[1:])
         aux_micro = jnp.zeros((micro_batches,), jnp.float32)
-        seg_micro = (
-            segment_ids.reshape((micro_batches, mb) + segment_ids.shape[1:])
-            if segment_ids is not None
-            else None
-        )
+        # per-microbatch metadata (packed batches) travels with the rotating
+        # state; shared [s] positions ride as a plain broadcast arg
+        meta = {}
+        if segment_ids is not None:
+            meta["seg"] = segment_ids.reshape((micro_batches, mb) + segment_ids.shape[1:])
+        if positions.ndim == 2:
+            meta["pos"] = positions.reshape((micro_batches, mb) + positions.shape[1:])
+            positions_arg = jnp.arange(s, dtype=jnp.int32)  # unused placeholder
+        else:
+            positions_arg = positions
         stage_params = _stack_stages(params["layers"], S)
 
-        if seg_micro is None:
+        if not meta:
             y_micro, aux_out = pipeline_apply(
                 lambda p, st, pos: stage_fn(p, st, pos, None),
-                stage_params, (x_micro, aux_micro), positions, topo=topo,
+                stage_params, (x_micro, aux_micro), positions_arg, topo=topo,
             )
         else:
-            # segment ids travel with their microbatch as rotating state
-            def stage_seg(p, st, pos):
-                (x, aux), seg = st[0], st[1]
-                y, a = stage_fn(p, (x, aux), pos, seg)
-                return (y, a), seg
+
+            def stage_meta(p, st, pos):
+                (x, aux), md = st
+                y, a = stage_fn(p, (x, aux), md.get("pos", pos), md.get("seg"))
+                return (y, a), md
 
             (y_micro, aux_out), _ = pipeline_apply(
-                stage_seg, stage_params, ((x_micro, aux_micro), seg_micro), positions, topo=topo,
+                stage_meta, stage_params, ((x_micro, aux_micro), meta), positions_arg, topo=topo,
             )
 
         y = y_micro.reshape((b,) + y_micro.shape[2:])
@@ -291,7 +296,15 @@ class Pipelined1F1BLoss:
         perm_f = [(i, (i + 1) % S) for i in range(S)]
         perm_b = [((i + 1) % S, i) for i in range(S)]
 
-        def run_stage(sp, state, seg):
+        # per-example positions ([b, s], packed batches) split per microbatch
+        # exactly like segment_ids; shared [s] positions broadcast as-is
+        per_ex_pos = positions.ndim == 2
+        pos_m = positions.reshape(n_micro, mb, s) if per_ex_pos else positions
+
+        def mb_positions(i):
+            return pos_m[i] if per_ex_pos else pos_m
+
+        def run_stage(sp, state, seg, pos):
             layer = functools.partial(T._layer, c)
             if c.remat:
                 layer = jax.checkpoint(
@@ -300,17 +313,19 @@ class Pipelined1F1BLoss:
 
             def body(carry, lp):
                 h, a = carry
-                h, a_l = layer(lp, h, positions, seg if has_seg else None)
+                h, a_l = layer(lp, h, pos, seg if has_seg else None)
                 return (h, a + a_l), None
 
             out, _ = jax.lax.scan(body, state, sp)
             return out
 
         def head_loss(hp, y, aux, i):
+            # closes over labels_m/mask_m (replicated over pipe): only head
+            # PARAMS need to be vjp inputs
             full = dict(hp)
             return T.lm_head_loss(full, y, labels_m[i], mask_m[i], c, aux=aux)
 
-        def per_stage(stage_params, tokens_m, labels_m, mask_m, seg_m, head_params, embed_params):
+        def per_stage(stage_params, tokens_m, seg_m, head_params, embed_params):
             sp = jax.tree.map(lambda l: l[0], stage_params)  # this stage's [L/S, ...]
             sid = jax.lax.axis_index(PIPE_AXIS)
             is_first = sid == 0
@@ -321,7 +336,7 @@ class Pipelined1F1BLoss:
             zeros_hg = jax.tree.map(jnp.zeros_like, head_params)
 
             def embed_mb(i):
-                return T.embed_tokens(embed_params, tokens_m[i], positions, c)
+                return T.embed_tokens(embed_params, tokens_m[i], mb_positions(i), c)
 
             carry0 = (
                 state_tmpl,  # fwd_in
@@ -343,6 +358,8 @@ class Pipelined1F1BLoss:
                 bidx = jnp.clip(bi, 0, n_micro - 1)
                 seg_f = seg_m[fidx] if has_seg else None
                 seg_b = seg_m[bidx] if has_seg else None
+                pos_f = mb_positions(fidx)
+                pos_b = mb_positions(bidx)
 
                 # ---- forward of microbatch f
                 x_first = jax.lax.cond(
@@ -352,7 +369,7 @@ class Pipelined1F1BLoss:
                     jnp.where(is_first, x_first, fwd_in[0]),
                     jnp.where(is_first, 0.0, fwd_in[1]),
                 )
-                y_state = run_stage(sp, x_in, seg_f)
+                y_state = run_stage(sp, x_in, seg_f, pos_f)
 
                 # save the stage input for this microbatch's backward
                 slot = fidx % D
@@ -393,12 +410,12 @@ class Pipelined1F1BLoss:
                     jnp.where(is_last, dy_head / n_micro, bwd_in[0]),
                     jnp.where(is_last, daux_head / n_micro, bwd_in[1]),
                 )
-                _, vjp_stage = jax.vjp(lambda p, st: run_stage(p, st, seg_b), sp, x_in_b)
+                _, vjp_stage = jax.vjp(lambda p, st: run_stage(p, st, seg_b, pos_b), sp, x_in_b)
                 dp, dstate = vjp_stage(dy_b)
                 lg = _tree_add_where(b_valid, lg, dp)
 
                 def do_embed_grad():
-                    _, evjp = jax.vjp(lambda ep: T.embed_tokens(ep, tokens_m[bidx], positions, c), embed_params)
+                    _, evjp = jax.vjp(lambda ep: T.embed_tokens(ep, tokens_m[bidx], pos_b, c), embed_params)
                     (dep,) = evjp(dstate[0])
                     return dep
 
@@ -426,7 +443,7 @@ class Pipelined1F1BLoss:
 
         in_specs = (
             jax.tree.map(lambda _: P(PIPE_AXIS), stage_params),
-            P(), P(), P(), P(),
+            P(), P(),
             jax.tree.map(lambda _: P(), head_params),
             jax.tree.map(lambda _: P(), embed_params),
         )
@@ -444,7 +461,7 @@ class Pipelined1F1BLoss:
             axis_names={PIPE_AXIS},
             check_vma=False,
         )
-        loss, lg, eg, hg = fn(stage_params, tokens_m, labels_m, mask_m, seg_m, head_params, embed_params)
+        loss, lg, eg, hg = fn(stage_params, tokens_m, seg_m, head_params, embed_params)
 
         L = jax.tree_util.tree_leaves(params["layers"])[0].shape[0]
         grads = dict(eg)

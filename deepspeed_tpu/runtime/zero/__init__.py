@@ -1,0 +1,22 @@
+"""ZeRO package: sharding-plan stages (partition.py), config (config.py),
+and deferred sharded construction (init_context.py — the zero.Init analogue,
+reference runtime/zero/partition_parameters.py:878)."""
+
+from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+from deepspeed_tpu.runtime.zero.init_context import Init, as_deferred_init
+from deepspeed_tpu.runtime.zero.partition import (
+    ZeroShardingPlan,
+    build_zero_plan,
+    choose_zero_spec,
+    constrain_tree,
+)
+
+__all__ = [
+    "DeepSpeedZeroConfig",
+    "Init",
+    "ZeroShardingPlan",
+    "as_deferred_init",
+    "build_zero_plan",
+    "choose_zero_spec",
+    "constrain_tree",
+]

@@ -1,0 +1,63 @@
+"""zero.Init — deferred, directly-sharded parameter construction.
+
+Analogue of the reference ``zero.Init`` context
+(``runtime/zero/partition_parameters.py:878``): there, ``nn.Module.__init__``
+is patched so every parameter is partitioned the moment it is constructed,
+letting models larger than a single host's memory be built. The functional
+JAX form: the user hands ``initialize()`` an *init function* instead of a
+materialized pytree; the engine evaluates its shapes abstractly
+(``jax.eval_shape``), builds the ZeRO sharding plan from those shapes, and
+materializes by running the init function under ``jax.jit`` with the plan's
+``out_shardings`` — every device computes/receives only its own shard, and
+the full parameter pytree never exists on any single host or device.
+
+Usage::
+
+    def build_params():
+        return init_params(cfg, jax.random.key(0))
+
+    engine, *_ = deepspeed_tpu.initialize(
+        model=make_loss_fn(cfg),
+        model_parameters=zero.Init(build_params),  # or just build_params
+        config={...,"zero_optimization": {"stage": 3}},
+    )
+
+A bare zero-argument callable works too; ``zero.Init`` adds reference-API
+parity plus optional dtype/rng plumbing.
+"""
+
+from typing import Any, Callable, Optional
+
+import jax
+
+
+class Init:
+    """Marker wrapping a parameter init function for deferred construction.
+
+    fn:    zero-argument callable returning the params pytree (close over
+           your config/rng), or one taking ``rng`` when ``rng`` is given.
+    rng:   optional PRNG key passed to ``fn``.
+    """
+
+    def __init__(self, fn: Callable[..., Any], rng: Optional[jax.Array] = None):
+        if not callable(fn):
+            raise TypeError(f"zero.Init needs a callable init function, got {type(fn)}")
+        self.fn = fn
+        self.rng = rng
+
+    def make_init_fn(self) -> Callable[[], Any]:
+        if self.rng is not None:
+            rng = self.rng
+            return lambda: self.fn(rng)
+        return self.fn
+
+
+def as_deferred_init(model_parameters) -> Optional[Callable[[], Any]]:
+    """Recognize a deferred-init request: a ``zero.Init`` marker or a bare
+    callable (pytrees of arrays are not callable). Returns the zero-arg init
+    fn, or None for eager (materialized) parameters."""
+    if isinstance(model_parameters, Init):
+        return model_parameters.make_init_fn()
+    if callable(model_parameters) and not hasattr(model_parameters, "shape"):
+        return model_parameters
+    return None

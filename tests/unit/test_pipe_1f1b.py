@@ -129,6 +129,37 @@ def test_1f1b_engine_end_to_end(pp_setup):
     assert losses[-1] < losses[0], losses
 
 
+def test_1f1b_packed_batch_per_example_positions(pp_setup):
+    """Packed sequences: [b, s] positions + segment_ids must split per
+    microbatch like tokens do, and match the GPipe autodiff gradients."""
+    topo, cfg, params, batch = pp_setup
+    rng = np.random.default_rng(1)
+    b, s1 = batch["input_ids"].shape
+    s = s1 - 1
+    half = s // 2
+    positions = np.concatenate(
+        [np.arange(half), np.arange(s - half)]
+    )[None].repeat(b, 0).astype(np.int32)
+    segment_ids = np.concatenate(
+        [np.zeros(half), np.ones(s - half)]
+    )[None].repeat(b, 0).astype(np.int32)
+    packed = dict(batch, positions=positions, segment_ids=segment_ids)
+
+    gpipe = make_pipelined_loss_fn(cfg, micro_batches=4, topo=topo)
+    loss_ref, grads_ref = jax.jit(jax.value_and_grad(gpipe))(params, packed)
+    f1b = make_1f1b_loss_fn(cfg, micro_batches=4, topo=topo)
+    loss_new, grads_new = jax.jit(f1b.custom_value_and_grad)(params, packed)
+    np.testing.assert_allclose(float(loss_new), float(loss_ref), rtol=1e-5)
+    key = lambda kv: str(kv[0])
+    for (ka, a), (kb, b_) in zip(
+        sorted(jax.tree_util.tree_leaves_with_path(grads_ref), key=key),
+        sorted(jax.tree_util.tree_leaves_with_path(grads_new), key=key),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(b_), np.asarray(a), atol=3e-4, rtol=3e-3, err_msg=str(ka)
+        )
+
+
 def test_1f1b_refuses_fp16(pp_setup):
     topo, cfg, params, batch = pp_setup
     f1b = make_1f1b_loss_fn(cfg, micro_batches=4, topo=topo)

@@ -1,0 +1,88 @@
+"""zero.Init deferred sharded construction (VERDICT #10; reference
+runtime/zero/partition_parameters.py:878): params materialize under jit with
+the plan's out_shardings — born sharded, never full on one host/device."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu import zero
+
+from tests.unit.simple_model import batch_of, make_mlp_params, mlp_loss_fn, random_dataset
+
+LR = 1e-2
+
+
+def _engine(params_arg, stage=3):
+    return deepspeed_tpu.initialize(
+        model=mlp_loss_fn,
+        model_parameters=params_arg,
+        config={
+            "train_micro_batch_size_per_gpu": 8,
+            "optimizer": {"type": "AdamW", "params": {"lr": LR}},
+            "zero_optimization": {"stage": stage, "param_persistence_threshold": 0},
+            "mesh": {"data": 8},
+            "steps_per_print": 1000,
+        },
+    )[0]
+
+
+def test_deferred_init_params_born_sharded(devices8):
+    engine = _engine(zero.Init(lambda: make_mlp_params(jax.random.key(0))))
+    for leaf in jax.tree_util.tree_leaves(engine.params):
+        if leaf.ndim >= 2 and leaf.shape[0] % 8 == 0 or (leaf.ndim >= 2 and leaf.shape[1] % 8 == 0):
+            assert len(leaf.sharding.device_set) == 8, leaf.shape
+            shard = leaf.addressable_shards[0].data
+            assert shard.size == leaf.size // 8, (shard.shape, leaf.shape)
+
+
+def test_deferred_init_trajectory_matches_eager(devices8):
+    dataset = random_dataset(n=64 * 6)
+
+    def run(params_arg):
+        engine = _engine(params_arg)
+        losses, pos = [], 0
+        for _ in range(6):
+            b = batch_of(dataset, pos, 64)
+            pos += 64
+            losses.append(float(engine.train_batch(batch=b)))
+        return losses
+
+    eager = run(make_mlp_params(jax.random.key(0)))
+    deferred = run(zero.Init(lambda: make_mlp_params(jax.random.key(0))))
+    np.testing.assert_allclose(deferred, eager, rtol=1e-6)
+
+
+def test_bare_callable_is_deferred(devices8):
+    engine = _engine(lambda: make_mlp_params(jax.random.key(1)))
+    leaf = jax.tree_util.tree_leaves(engine.params)[0]
+    assert len(leaf.sharding.device_set) == 8
+    dataset = random_dataset(n=64)
+    loss = float(engine.train_batch(batch=batch_of(dataset, 0, 64)))
+    assert np.isfinite(loss)
+
+
+def test_init_with_rng_argument(devices8):
+    engine = _engine(zero.Init(make_mlp_params, rng=jax.random.key(0)))
+    dataset = random_dataset(n=64)
+    loss = float(engine.train_batch(batch=batch_of(dataset, 0, 64)))
+    assert np.isfinite(loss)
+
+
+def test_deferred_init_dtype_cast(devices8):
+    engine = deepspeed_tpu.initialize(
+        model=mlp_loss_fn,
+        model_parameters=zero.Init(lambda: make_mlp_params(jax.random.key(0))),
+        config={
+            "train_micro_batch_size_per_gpu": 8,
+            "bf16": {"enabled": True},
+            "optimizer": {"type": "AdamW", "params": {"lr": LR}},
+            "zero_optimization": {"stage": 3},
+            "mesh": {"data": 8},
+            "steps_per_print": 1000,
+        },
+    )[0]
+    for leaf in jax.tree_util.tree_leaves(engine.params):
+        assert leaf.dtype == jnp.bfloat16
