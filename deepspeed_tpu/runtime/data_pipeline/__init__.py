@@ -1,0 +1,31 @@
+"""Data-efficiency pipeline (reference runtime/data_pipeline/, 3.2k LoC):
+curriculum learning, metric-indexed curriculum sampling, variable batch size
++ LR scaling, and random layer token drop."""
+
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import CurriculumScheduler
+from deepspeed_tpu.runtime.data_pipeline.data_sampler import (
+    CurriculumDataSampler,
+    DataAnalyzer,
+)
+from deepspeed_tpu.runtime.data_pipeline.random_ltd import (
+    RandomLTDScheduler,
+    random_ltd_apply,
+)
+from deepspeed_tpu.runtime.data_pipeline.variable_batch import (
+    VariableBatchSizeLR,
+    batch_by_seqlens,
+    dataloader_for_variable_batch_size,
+    scale_lr,
+)
+
+__all__ = [
+    "CurriculumDataSampler",
+    "CurriculumScheduler",
+    "DataAnalyzer",
+    "RandomLTDScheduler",
+    "VariableBatchSizeLR",
+    "batch_by_seqlens",
+    "dataloader_for_variable_batch_size",
+    "random_ltd_apply",
+    "scale_lr",
+]

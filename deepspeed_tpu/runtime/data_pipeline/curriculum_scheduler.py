@@ -1,0 +1,111 @@
+"""Curriculum learning scheduler.
+
+Analogue of the reference ``runtime/data_pipeline/curriculum_scheduler.py``
+(``CurriculumScheduler``): maps the global step to a difficulty value under
+``fixed_linear`` / ``fixed_root`` / ``fixed_discrete`` / ``custom``
+schedules. Schedule-config keys match the reference JSON exactly.
+
+TPU note: when the difficulty drives the sequence length, every distinct
+value is a distinct compiled shape — ``difficulty_step`` (reference's Tensor
+Core alignment knob) doubles as the recompile bucketer here, so keep it
+coarse (e.g. 64) on TPU.
+"""
+
+import math
+from typing import Callable, Optional
+
+FIXED_LINEAR = "fixed_linear"
+FIXED_ROOT = "fixed_root"
+FIXED_DISCRETE = "fixed_discrete"
+CUSTOM = "custom"
+
+
+class CurriculumScheduler:
+    def __init__(self, config: dict):
+        for key in ("min_difficulty", "max_difficulty", "schedule_type"):
+            assert key in config, f"Curriculum learning requires the config '{key}'"
+        self.state = {
+            "min_difficulty": config["min_difficulty"],
+            "max_difficulty": config["max_difficulty"],
+            "current_difficulty": config["min_difficulty"],
+            "schedule_type": config["schedule_type"],
+        }
+        self.custom_get_difficulty: Optional[Callable[[int], int]] = None
+        sched = config.get("schedule_config", {})
+        stype = config["schedule_type"]
+        if stype == FIXED_DISCRETE:
+            assert "difficulty" in sched and "max_step" in sched
+            assert len(sched["max_step"]) > 0
+            assert len(sched["difficulty"]) == len(sched["max_step"]) + 1
+        elif stype in (FIXED_LINEAR, FIXED_ROOT):
+            assert "total_curriculum_step" in sched
+            assert "difficulty_step" in sched
+            if stype == FIXED_ROOT:
+                assert "root_degree" in sched
+        elif stype == CUSTOM:
+            pass
+        else:
+            raise ValueError(f"Unknown curriculum schedule_type {stype!r}")
+        self.state["schedule_config"] = sched
+
+    # -- reference API ----------------------------------------------------
+    def get_current_difficulty(self) -> int:
+        return self.state["current_difficulty"]
+
+    def set_current_difficulty(self, difficulty: int):
+        self.state["current_difficulty"] = difficulty
+
+    def set_custom_get_difficulty(self, schedule_function: Callable[[int], int]):
+        self.custom_get_difficulty = schedule_function
+
+    def get_state(self):
+        return self.state
+
+    def set_state(self, state):
+        self.state = state
+
+    def __fixed_discrete_get_difficulty(self, global_steps: int) -> int:
+        s = self.state["schedule_config"]
+        for max_step, diff in zip(s["max_step"], s["difficulty"]):
+            if global_steps <= max_step:
+                return diff
+        return s["difficulty"][-1]
+
+    def __fixed_root_get_difficulty(self, global_steps: int, root_degree=None) -> int:
+        s = self.state["schedule_config"]
+        if root_degree is None:
+            root_degree = s["root_degree"]
+        next_difficulty = (float(global_steps) / s["total_curriculum_step"]) ** (1.0 / root_degree)
+        next_difficulty = math.floor(
+            next_difficulty * (self.state["max_difficulty"] - self.state["min_difficulty"])
+            + self.state["min_difficulty"]
+        )
+        next_difficulty -= next_difficulty % s["difficulty_step"]
+        return min(next_difficulty, self.state["max_difficulty"])
+
+    def get_difficulty(self, global_steps: int) -> int:
+        stype = self.state["schedule_type"]
+        if stype == FIXED_DISCRETE:
+            return self.__fixed_discrete_get_difficulty(global_steps)
+        if stype == FIXED_LINEAR:
+            return self.__fixed_root_get_difficulty(global_steps, root_degree=1)
+        if stype == FIXED_ROOT:
+            return self.__fixed_root_get_difficulty(global_steps)
+        assert self.custom_get_difficulty is not None, (
+            "custom schedule requires set_custom_get_difficulty()"
+        )
+        return self.custom_get_difficulty(global_steps)
+
+    def update_difficulty(self, global_steps: int) -> int:
+        if self.state["current_difficulty"] < self.state["max_difficulty"]:
+            self.state["current_difficulty"] = max(
+                self.get_difficulty(global_steps), self.state["min_difficulty"]
+            )
+        return self.state["current_difficulty"]
+
+    # checkpointable
+    def state_dict(self):
+        return dict(self.state)
+
+    def load_state_dict(self, sd):
+        self.state.update(sd)

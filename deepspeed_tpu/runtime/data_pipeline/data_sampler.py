@@ -1,0 +1,104 @@
+"""Curriculum data sampling over metric-indexed datasets.
+
+Analogue of the reference ``data_sampling/data_analyzer.py`` +
+``data_sampler.py`` (``DeepSpeedDataSampler``): a per-sample difficulty
+metric (e.g. sequence length, loss, perplexity percentile) indexes the
+dataset; each global batch is drawn only from samples whose metric is within
+the curriculum's current difficulty, deterministically and resumably.
+
+TPU adaptation: the index arithmetic is pure numpy on host (it feeds the
+input pipeline, not the compiled step); no mmap indexed-dataset machinery —
+metric arrays are plain numpy (the analyzer below builds them).
+"""
+
+from typing import Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+
+class DataAnalyzer:
+    """Minimal analogue of the reference ``DataAnalyzer``: map a dataset to
+    per-sample metric arrays (run once, offline)."""
+
+    def __init__(self, dataset, metric_fns: Dict[str, Callable[[dict], float]]):
+        self.dataset = dataset
+        self.metric_fns = metric_fns
+
+    def run(self) -> Dict[str, np.ndarray]:
+        n = len(self.dataset)
+        out = {name: np.zeros(n, np.float64) for name in self.metric_fns}
+        for i in range(n):
+            sample = self.dataset[i]
+            for name, fn in self.metric_fns.items():
+                out[name][i] = fn(sample)
+        return out
+
+
+class CurriculumDataSampler:
+    """Difficulty-gated sampler (reference DeepSpeedDataSampler, data_sampler.py:36).
+
+    metric_values: [n] per-sample difficulty (higher = harder)
+    difficulty_type: 'value' — admit samples with metric <= difficulty;
+                     'percentile' — admit the easiest ``difficulty`` percent.
+    Iterate with ``set_difficulty`` between epochs/steps; emits global-batch
+    index arrays. Deterministic under seed, resumable via state_dict.
+    """
+
+    def __init__(
+        self,
+        metric_values: np.ndarray,
+        batch_size: int,
+        difficulty_type: str = "value",
+        seed: int = 1234,
+        drop_last: bool = True,
+    ):
+        assert difficulty_type in ("value", "percentile")
+        self.metric = np.asarray(metric_values)
+        self.order = np.argsort(self.metric, kind="stable")  # easy → hard
+        self.batch_size = batch_size
+        self.difficulty_type = difficulty_type
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+        self.consumed = 0  # batches consumed in current epoch (for resume)
+        self._difficulty: Optional[float] = None
+
+    def set_difficulty(self, difficulty: float):
+        self._difficulty = difficulty
+
+    def _admissible(self) -> np.ndarray:
+        assert self._difficulty is not None, "call set_difficulty() first"
+        if self.difficulty_type == "value":
+            k = int(np.searchsorted(self.metric[self.order], self._difficulty, side="right"))
+        else:
+            k = int(round(len(self.order) * min(self._difficulty, 100.0) / 100.0))
+        k = max(k, min(self.batch_size, len(self.order)))  # never starve a batch
+        return self.order[:k]
+
+    def __iter__(self):
+        pool = self._admissible()
+        rng = np.random.default_rng(self.seed + self.epoch)
+        perm = pool[rng.permutation(len(pool))]
+        n_batches = len(perm) // self.batch_size if self.drop_last else -(-len(perm) // self.batch_size)
+        for b in range(self.consumed, n_batches):
+            # mark consumed BEFORE yielding: a checkpoint taken while the
+            # caller holds batch b must resume at b+1 (generator resumption
+            # order would otherwise lag one batch)
+            self.consumed = b + 1
+            yield perm[b * self.batch_size : (b + 1) * self.batch_size]
+        self.epoch += 1
+        self.consumed = 0
+
+    def state_dict(self):
+        return {
+            "epoch": self.epoch,
+            "consumed": self.consumed,
+            "seed": self.seed,
+            "difficulty": self._difficulty,
+        }
+
+    def load_state_dict(self, sd):
+        self.epoch = sd["epoch"]
+        self.consumed = sd["consumed"]
+        self.seed = sd["seed"]
+        self._difficulty = sd["difficulty"]

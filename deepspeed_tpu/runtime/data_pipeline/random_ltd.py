@@ -1,0 +1,76 @@
+"""Random layer token drop (random-LTD).
+
+Analogue of the reference ``data_routing/`` package: ``RandomLayerTokenDrop``
+(basic_layer.py:14) wraps middle transformer layers so each processes only a
+random subset of tokens, and a scheduler (scheduler.py) grows the kept-token
+count from ``start`` to the full sequence over training (the reference's
+``seq_per_layer`` schedule). The dropped tokens BYPASS the layer (identity)
+and are re-scattered, preserving positions — that is what distinguishes LTD
+from attention masking.
+
+TPU adaptation: the kept count is a static Python int per compiled step
+(bucketed by the scheduler's step size, like curriculum seqlen); the
+gather/scatter is a jnp take/scatter on the sequence dim, batched over the
+batch dim with one shared permutation per step (cheap, and keeps the gather
+a contiguous dynamic-slice after sort).
+"""
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+class RandomLTDScheduler:
+    """Kept-token schedule (reference data_routing/scheduler.py): linear ramp
+    from ``start`` to ``end`` over ``schedule_steps``, quantized by
+    ``step_size`` (the recompile bucketer on TPU)."""
+
+    def __init__(self, start: int, end: int, schedule_steps: int, step_size: int = 16):
+        assert start <= end and schedule_steps > 0 and step_size > 0
+        self.start = start
+        self.end = end
+        self.schedule_steps = schedule_steps
+        self.step_size = step_size
+        self.current = start
+
+    def update_seq(self, global_step: int) -> int:
+        frac = min(global_step / self.schedule_steps, 1.0)
+        if frac >= 1.0:
+            # exact end at schedule completion even when end % step_size != 0
+            # — otherwise tokens would stay dropped for the rest of training
+            self.current = self.end
+            return self.current
+        n = int(self.start + frac * (self.end - self.start))
+        n -= n % self.step_size
+        self.current = max(min(n, self.end), min(self.start, self.end))
+        return self.current
+
+    def get_current_seq(self) -> int:
+        return self.current
+
+    def state_dict(self):
+        return {"current": self.current}
+
+    def load_state_dict(self, sd):
+        self.current = sd["current"]
+
+
+def random_ltd_apply(
+    layer_fn: Callable[[jax.Array], jax.Array],
+    x: jax.Array,
+    keep: int,
+    rng: jax.Array,
+) -> jax.Array:
+    """Apply ``layer_fn`` to a random ``keep``-token subset of ``x``
+    ([b, s, h]); dropped tokens pass through unchanged (reference
+    basic_layer.py:66 gather → layer → scatter). ``keep`` must be a static
+    int (from the scheduler). The same sorted random subset is used across
+    the batch this step, matching the reference's per-step sampling."""
+    b, s, h = x.shape
+    if keep >= s:
+        return layer_fn(x)
+    idx = jnp.sort(jax.random.choice(rng, s, shape=(keep,), replace=False))
+    sub = jnp.take(x, idx, axis=1)
+    out = layer_fn(sub)
+    return x.at[:, idx, :].set(out)

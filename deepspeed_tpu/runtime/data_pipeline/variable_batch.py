@@ -1,0 +1,175 @@
+"""Dynamic batch size + LR scaling (reference
+``data_sampling/variable_batch_size_and_lr.py``): pack samples of varying
+sequence length into batches bounded by a token budget, and scale the
+learning rate with the realized batch size.
+
+TPU adaptation: each packed batch pads its sequence dim to a power-of-two
+bucket so the compiled-shape set stays small (the reference pads to the
+longest sample per batch, which on TPU would retrace per batch).
+"""
+
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+
+def batch_by_seqlens(
+    seqlens: Sequence[int],
+    max_tokens_per_batch: int,
+    max_seqlen: Optional[int] = None,
+    min_batch_size: int = 1,
+    max_batch_size: Optional[int] = None,
+    shuffle: bool = False,
+    seed: int = 0,
+    order_by_seqlen: bool = True,
+) -> List[List[int]]:
+    """Pack sample indices into batches with ≤ max_tokens_per_batch tokens
+    (reference batch_by_seqlens, variable_batch_size_and_lr.py:23). Sorting
+    by length first (default) minimizes padding waste."""
+    idx = np.arange(len(seqlens))
+    lens = np.asarray(seqlens)
+    if max_seqlen is not None:
+        keep = lens <= max_seqlen
+        idx, lens = idx[keep], lens[keep]
+    if len(lens) and int(lens.max()) > max_tokens_per_batch:
+        raise ValueError(
+            f"sample of length {int(lens.max())} exceeds max_tokens_per_batch="
+            f"{max_tokens_per_batch}; set max_seqlen to filter long samples"
+        )
+    if order_by_seqlen:
+        order = np.argsort(lens, kind="stable")
+        idx, lens = idx[order], lens[order]
+    batches, cur, cur_max, dropped = [], [], 0, 0
+    for i, L in zip(idx, lens):
+        new_max = max(cur_max, int(L))
+        if cur and (
+            new_max * (len(cur) + 1) > max_tokens_per_batch
+            or (max_batch_size and len(cur) >= max_batch_size)
+        ):
+            if len(cur) >= min_batch_size:
+                batches.append(cur)
+            else:
+                dropped += len(cur)
+            cur, cur_max = [], 0
+            new_max = int(L)
+        cur.append(int(i))
+        cur_max = new_max
+    if len(cur) >= min_batch_size:
+        batches.append(cur)
+    else:
+        dropped += len(cur)
+    if dropped:
+        from deepspeed_tpu.utils.logging import logger
+
+        logger.warning(
+            f"batch_by_seqlens: dropped {dropped} samples in sub-min_batch_size batches"
+        )
+    if shuffle:
+        rng = np.random.default_rng(seed)
+        rng.shuffle(batches)
+    return batches
+
+
+def scale_lr(base_batch_size: int, batch_size: int, base_lr: float = 1.0, method: str = "linear") -> float:
+    """Reference scale_lr (:149): linear or sqrt LR scaling with batch size."""
+    if method == "linear":
+        return base_lr * batch_size / base_batch_size
+    if method == "sqrt":
+        return base_lr * (batch_size / base_batch_size) ** 0.5
+    raise ValueError(f"unknown lr scaling method {method!r}")
+
+
+def pad_to_bucket(n: int, buckets: Sequence[int]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return n
+
+
+class VariableBatchSizeLR:
+    """LR scheduler wrapper scaling by each batch's realized size (reference
+    VariableBatchSizeLR, :226). Drives an inner scheduler (or a fixed base
+    LR) and multiplies by ``scale_lr`` of the current batch."""
+
+    def __init__(
+        self,
+        optimizer,
+        base_batch_size: int,
+        batch_sizes: Sequence[int],
+        base_scheduler=None,
+        method: str = "linear",
+    ):
+        self.optimizer = optimizer
+        self.base_batch_size = base_batch_size
+        self.batch_sizes = list(batch_sizes)
+        self.base_scheduler = base_scheduler
+        self.method = method
+        self.step_count = 0
+        self._last_lr = [optimizer.get_lr()]
+
+    def get_last_lr(self):
+        return self._last_lr
+
+    def step(self, epoch=None):
+        if self.base_scheduler is not None:
+            base = self.base_scheduler.step()
+            base = float(base[0] if isinstance(base, (list, tuple)) else base)
+        else:
+            base = float(self.optimizer.defaults.get("lr", self.optimizer.get_lr()))
+        bsz = self.batch_sizes[self.step_count % len(self.batch_sizes)]
+        lr = scale_lr(self.base_batch_size, bsz, base, self.method)
+        self.optimizer.set_lr(lr)
+        self._last_lr = [lr]
+        self.step_count += 1
+        return [lr]
+
+    def state_dict(self):
+        return {
+            "step_count": self.step_count,
+            "base": self.base_scheduler.state_dict() if self.base_scheduler else None,
+        }
+
+    def load_state_dict(self, sd):
+        self.step_count = sd["step_count"]
+        if self.base_scheduler and sd.get("base"):
+            self.base_scheduler.load_state_dict(sd["base"])
+
+
+def dataloader_for_variable_batch_size(
+    dataset,
+    batches: List[List[int]],
+    collate_fn: Optional[Callable] = None,
+    seq_buckets: Sequence[int] = (128, 256, 512, 1024, 2048, 4096),
+    pad_value: int = 0,
+    seqlen_of: Optional[Callable] = None,
+):
+    """Yield packed batches padded to bucketed sequence lengths (reference
+    dataloader_for_variable_batch_size, :165 — re-thought for static shapes:
+    the pad target is the bucket, not the batch max). Samples are dicts of
+    1-D arrays or raw 1-D arrays; a custom ``collate_fn(samples, bucket)``
+    overrides the default padding."""
+
+    def pad_rows(arrs, bucket):
+        out = np.full((len(arrs), bucket), pad_value, np.asarray(arrs[0]).dtype)
+        for r, xa in enumerate(arrs):
+            xa = np.asarray(xa)
+            out[r, : min(len(xa), bucket)] = xa[:bucket]
+        return out
+
+    def default_collate(samples, bucket):
+        if isinstance(samples[0], dict):
+            return {k: pad_rows([s[k] for s in samples], bucket) for k in samples[0]}
+        return pad_rows(samples, bucket)
+
+    collate = collate_fn or default_collate
+    for batch_ids in batches:
+        samples = [dataset[i] for i in batch_ids]
+        if seqlen_of is not None:
+            longest = max(seqlen_of(s) for s in samples)
+        else:
+            first = samples[0]
+            longest = max(
+                len(next(iter(s.values())) if isinstance(s, dict) else s) for s in samples
+            )
+        bucket = pad_to_bucket(longest, seq_buckets)
+        yield collate(samples, bucket)

@@ -243,6 +243,25 @@ class DeepSpeedEngine:
         # monitor
         self.monitor = self._configure_monitor(config)
 
+        # curriculum learning (reference engine.py:2112 legacy hooks +
+        # data_efficiency.data_sampling.curriculum_learning)
+        self.curriculum_scheduler = None
+        self._curriculum_metric = "seqlen"
+        self._curriculum_post = None
+        ccfg = dict(config.curriculum_learning or {})
+        if not ccfg.get("enabled") and config.data_efficiency:
+            ccfg = (
+                (config.data_efficiency.get("data_sampling", {}) or {}).get(
+                    "curriculum_learning", {}
+                )
+                or {}
+            )
+        if ccfg.get("enabled"):
+            from deepspeed_tpu.runtime.data_pipeline import CurriculumScheduler
+
+            self._curriculum_metric = ccfg.get("curriculum_type", "seqlen")
+            self.curriculum_scheduler = CurriculumScheduler(ccfg)
+
         # comms logger
         get_comms_logger().configure(config.comms_logger)
 
@@ -937,11 +956,45 @@ class DeepSpeedEngine:
             )
         return batch
 
+    def set_custom_curriculum_truncation(self, fn):
+        """Override how a batch adapts to the curriculum difficulty:
+        ``fn(stacked_batch, difficulty) -> stacked_batch`` (the analogue of
+        the reference's data post-process hook)."""
+        self._curriculum_post = fn
+
+    def _apply_curriculum(self, stacked):
+        if self.curriculum_scheduler is None:
+            return stacked
+        difficulty = self.curriculum_scheduler.update_difficulty(self.global_steps + 1)
+        if self._curriculum_post is not None:
+            return self._curriculum_post(stacked, difficulty)
+        if self._curriculum_metric == "seqlen":
+            # token-stream convention: leaves carry s+1 tokens for s targets,
+            # so difficulty d trains on sequences of length d. Each distinct
+            # difficulty is a compiled shape — use coarse difficulty_step.
+            # Only SEQUENCE leaves truncate (by batch key name): slicing the
+            # last axis of arbitrary leaves would cut hidden dims / per-sample
+            # vectors. Custom batches use set_custom_curriculum_truncation.
+            seq_keys = {
+                "input_ids", "labels", "tokens", "loss_mask", "attention_mask",
+                "segment_ids", "positions",
+            }
+
+            def trunc(path, x):
+                name = str(path[-1].key) if path and hasattr(path[-1], "key") else ""
+                if name in seq_keys and getattr(x, "ndim", 0) >= 2 and x.shape[-1] > difficulty + 1:
+                    return x[..., : difficulty + 1]
+                return x
+
+            return jax.tree_util.tree_map_with_path(trunc, stacked)
+        return stacked
+
     def train_batch(self, data_iter=None, batch=None):
         """Fused full step: gas micro-batches → grads → update. The hot path
         (reference PipelineEngine.train_batch :337 is the analogous fused API)."""
         assert (data_iter is None) != (batch is None), "pass exactly one of data_iter/batch"
         stacked = self._stack_batch(data_iter if data_iter is not None else batch)
+        stacked = self._apply_curriculum(stacked)
         if self._train_step_jit is None:
             self._train_step_jit = self._build_train_step()
         lr = self._lr_for_step()

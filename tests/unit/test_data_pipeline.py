@@ -1,0 +1,250 @@
+"""Data-efficiency pipeline tests (analogue of reference
+tests/unit/runtime/test_data_efficiency.py): curriculum schedules, curriculum
+data sampling, variable batch + LR, random-LTD, and engine wiring."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.data_pipeline import (
+    CurriculumDataSampler,
+    CurriculumScheduler,
+    DataAnalyzer,
+    RandomLTDScheduler,
+    VariableBatchSizeLR,
+    batch_by_seqlens,
+    dataloader_for_variable_batch_size,
+    random_ltd_apply,
+    scale_lr,
+)
+
+
+# ---------------------------------------------------------------------------
+# curriculum scheduler (schedule math mirrors reference curriculum_scheduler.py)
+# ---------------------------------------------------------------------------
+class TestCurriculumScheduler:
+    def test_fixed_linear(self):
+        s = CurriculumScheduler(
+            {
+                "enabled": True,
+                "min_difficulty": 8,
+                "max_difficulty": 64,
+                "schedule_type": "fixed_linear",
+                "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 8},
+            }
+        )
+        assert s.update_difficulty(0) == 8
+        assert s.update_difficulty(50) == 8 + ((50 / 100) * 56) // 8 * 8
+        assert s.update_difficulty(100) == 64
+        assert s.update_difficulty(500) == 64  # saturates
+
+    def test_fixed_root(self):
+        s = CurriculumScheduler(
+            {
+                "min_difficulty": 8,
+                "max_difficulty": 64,
+                "schedule_type": "fixed_root",
+                "schedule_config": {
+                    "total_curriculum_step": 100,
+                    "difficulty_step": 8,
+                    "root_degree": 2,
+                },
+            }
+        )
+        # sqrt schedule reaches difficulty faster than linear early on
+        lin = CurriculumScheduler(
+            {
+                "min_difficulty": 8,
+                "max_difficulty": 64,
+                "schedule_type": "fixed_linear",
+                "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 8},
+            }
+        )
+        assert s.update_difficulty(25) >= lin.update_difficulty(25)
+        assert s.update_difficulty(100) == 64
+
+    def test_fixed_discrete(self):
+        s = CurriculumScheduler(
+            {
+                "min_difficulty": 1,
+                "max_difficulty": 3,
+                "schedule_type": "fixed_discrete",
+                "schedule_config": {"difficulty": [1, 2, 3], "max_step": [5, 10]},
+            }
+        )
+        assert s.update_difficulty(3) == 1
+        assert s.update_difficulty(7) == 2
+        assert s.update_difficulty(11) == 3
+
+    def test_custom(self):
+        s = CurriculumScheduler(
+            {"min_difficulty": 1, "max_difficulty": 10, "schedule_type": "custom"}
+        )
+        s.set_custom_get_difficulty(lambda step: min(1 + step // 2, 10))
+        assert s.update_difficulty(6) == 4
+
+    def test_state_roundtrip(self):
+        cfg = {
+            "min_difficulty": 8,
+            "max_difficulty": 64,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 8},
+        }
+        a = CurriculumScheduler(cfg)
+        a.update_difficulty(50)
+        b = CurriculumScheduler(cfg)
+        b.load_state_dict(a.state_dict())
+        assert b.get_current_difficulty() == a.get_current_difficulty()
+
+
+# ---------------------------------------------------------------------------
+# curriculum data sampler
+# ---------------------------------------------------------------------------
+class TestCurriculumSampler:
+    def test_difficulty_gating_and_determinism(self):
+        metric = np.arange(100, dtype=np.float64)  # sample i has difficulty i
+        s1 = CurriculumDataSampler(metric, batch_size=8, difficulty_type="value", seed=7)
+        s1.set_difficulty(31)
+        batches = list(iter(s1))
+        seen = np.concatenate(batches)
+        assert seen.max() <= 31  # only admissible samples
+        assert len(batches) == 32 // 8
+        s2 = CurriculumDataSampler(metric, batch_size=8, difficulty_type="value", seed=7)
+        s2.set_difficulty(31)
+        np.testing.assert_array_equal(np.concatenate(list(iter(s2))), seen)
+
+    def test_percentile_mode(self):
+        metric = np.arange(100, dtype=np.float64)
+        s = CurriculumDataSampler(metric, batch_size=10, difficulty_type="percentile", seed=0)
+        s.set_difficulty(20)  # easiest 20%
+        seen = np.concatenate(list(iter(s)))
+        assert seen.max() <= 19
+
+    def test_resume_mid_epoch(self):
+        metric = np.arange(64, dtype=np.float64)
+        s = CurriculumDataSampler(metric, batch_size=8, seed=3)
+        s.set_difficulty(1000)
+        it = iter(s)
+        first = [next(it), next(it)]
+        sd = s.state_dict()
+        s2 = CurriculumDataSampler(metric, batch_size=8, seed=3)
+        s2.load_state_dict(sd)
+        rest_resumed = list(iter(s2))
+        rest_original = list(it)
+        for a, b in zip(rest_resumed, rest_original):
+            np.testing.assert_array_equal(a, b)
+
+    def test_analyzer(self):
+        ds = [{"x": np.arange(i + 1)} for i in range(10)]
+        metrics = DataAnalyzer(ds, {"seqlen": lambda s: len(s["x"])}).run()
+        np.testing.assert_array_equal(metrics["seqlen"], np.arange(1, 11))
+
+
+# ---------------------------------------------------------------------------
+# variable batch + LR
+# ---------------------------------------------------------------------------
+class TestVariableBatch:
+    def test_packing_respects_budget(self):
+        lens = [10, 20, 30, 100, 5, 50, 60, 8]
+        batches = batch_by_seqlens(lens, max_tokens_per_batch=120)
+        all_ids = sorted(i for b in batches for i in b)
+        assert all_ids == list(range(8))
+        for b in batches:
+            longest = max(lens[i] for i in b)
+            assert longest * len(b) <= 120
+
+    def test_max_seqlen_filter(self):
+        batches = batch_by_seqlens([10, 500, 20], max_tokens_per_batch=100, max_seqlen=100)
+        ids = {i for b in batches for i in b}
+        assert 1 not in ids
+
+    def test_scale_lr(self):
+        assert scale_lr(32, 64, 1e-3, "linear") == pytest.approx(2e-3)
+        assert scale_lr(32, 64, 1e-3, "sqrt") == pytest.approx(1e-3 * 2**0.5)
+
+    def test_variable_lr_scheduler(self):
+        from deepspeed_tpu.runtime.optimizers import DeepSpeedOptimizer
+        import optax
+
+        opt = DeepSpeedOptimizer(optax.sgd(1.0), "sgd", {"lr": 1e-2})
+        opt.set_lr(1e-2)
+        sched = VariableBatchSizeLR(opt, base_batch_size=32, batch_sizes=[32, 64, 16])
+        assert sched.step() == [pytest.approx(1e-2)]
+        assert sched.step() == [pytest.approx(2e-2)]
+        assert sched.step() == [pytest.approx(5e-3)]
+
+    def test_bucketed_dataloader(self):
+        ds = [{"input_ids": np.arange(n, dtype=np.int32)} for n in (100, 130, 200, 260)]
+        batches = batch_by_seqlens([100, 130, 200, 260], max_tokens_per_batch=600)
+        out = list(dataloader_for_variable_batch_size(ds, batches, seq_buckets=(128, 256, 512)))
+        for b in out:
+            assert b["input_ids"].shape[1] in (128, 256, 512)
+
+
+# ---------------------------------------------------------------------------
+# random-LTD
+# ---------------------------------------------------------------------------
+class TestRandomLTD:
+    def test_scheduler_ramp(self):
+        s = RandomLTDScheduler(start=64, end=256, schedule_steps=100, step_size=16)
+        assert s.update_seq(0) == 64
+        mid = s.update_seq(50)
+        assert 64 <= mid <= 256 and mid % 16 == 0
+        assert s.update_seq(100) == 256
+        assert s.update_seq(1000) == 256
+
+    def test_dropped_tokens_bypass_layer(self):
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 16, 4)), jnp.float32)
+        layer = lambda h: h * 100.0
+        out = random_ltd_apply(layer, x, keep=4, rng=jax.random.key(0))
+        changed = np.abs(np.asarray(out - x)).sum(axis=(0, 2)) > 1e-6
+        assert changed.sum() == 4  # exactly `keep` positions transformed
+        untouched = ~changed
+        np.testing.assert_allclose(
+            np.asarray(out)[:, untouched], np.asarray(x)[:, untouched]
+        )
+
+    def test_full_keep_equals_plain_layer(self):
+        x = jnp.ones((1, 8, 4))
+        layer = lambda h: h + 1
+        out = random_ltd_apply(layer, x, keep=8, rng=jax.random.key(0))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x) + 1)
+
+
+# ---------------------------------------------------------------------------
+# engine wiring
+# ---------------------------------------------------------------------------
+def test_engine_curriculum_seqlen_truncation(devices8):
+    from deepspeed_tpu.models import TransformerConfig, init_params, make_loss_fn
+
+    cfg = TransformerConfig(
+        vocab_size=64, hidden_size=32, n_layers=2, n_heads=2, max_seq_len=32,
+        dtype="float32",
+    )
+    params = init_params(cfg, jax.random.key(0))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=make_loss_fn(cfg),
+        model_parameters=params,
+        config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 0},
+            "mesh": {"data": 8},
+            "curriculum_learning": {
+                "enabled": True,
+                "curriculum_type": "seqlen",
+                "min_difficulty": 8,
+                "max_difficulty": 32,
+                "schedule_type": "fixed_linear",
+                "schedule_config": {"total_curriculum_step": 4, "difficulty_step": 8},
+            },
+            "steps_per_print": 1000,
+        },
+    )
+    toks = np.random.default_rng(0).integers(0, 64, size=(8, 33)).astype(np.int32)
+    losses = [float(engine.train_batch(batch={"input_ids": toks})) for _ in range(5)]
+    assert np.isfinite(losses).all()
+    assert engine.curriculum_scheduler.get_current_difficulty() == 32
