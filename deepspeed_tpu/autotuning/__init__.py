@@ -1,0 +1,19 @@
+"""Autotuning (reference deepspeed/autotuning/)."""
+
+from deepspeed_tpu.autotuning.autotuner import (
+    Autotuner,
+    AutotunerConfig,
+    ModelInfo,
+    TuningRecord,
+    activation_memory_per_chip,
+    zero_memory_per_chip,
+)
+
+__all__ = [
+    "Autotuner",
+    "AutotunerConfig",
+    "ModelInfo",
+    "TuningRecord",
+    "activation_memory_per_chip",
+    "zero_memory_per_chip",
+]

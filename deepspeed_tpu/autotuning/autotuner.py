@@ -1,0 +1,206 @@
+"""Autotuner: search ZeRO stage × micro-batch × remat for best throughput.
+
+Analogue of the reference ``autotuning/autotuner.py:42`` (``Autotuner``) +
+``tuner/{index_based_tuner,model_based_tuner}.py``: a memory model prunes
+infeasible (stage, micro-batch) points, then experiments run through a
+user-supplied runner (the reference launches each through the CLI launcher;
+here the runner is a callable so the search also works in-process — on TPU a
+failed compile reports OOM deterministically, which the runner maps to
+``None``). The fast path mirrors the reference's stage-ordered search with
+early termination.
+"""
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from deepspeed_tpu.utils.logging import logger
+
+AUTOTUNING_METRICS = ("throughput", "latency", "flops")
+
+
+# ---------------------------------------------------------------------------
+# ZeRO memory model (reference autotuner's get_instantiation_memory_required_*
+# heuristics, expressed per chip)
+# ---------------------------------------------------------------------------
+def zero_memory_per_chip(
+    n_params: int,
+    stage: int,
+    dp_world: int,
+    param_bytes: int = 2,
+    grad_bytes: int = 4,
+    optim_bytes: int = 12,  # fp32 master + two moments
+) -> int:
+    """Model-state bytes per chip for a ZeRO stage (the standard 2+4+12
+    accounting; sharded terms divide by the data-parallel world)."""
+    dp = max(dp_world, 1)
+    params = n_params * param_bytes / (dp if stage >= 3 else 1)
+    grads = n_params * grad_bytes / (dp if stage >= 2 else 1)
+    optim = n_params * optim_bytes / (dp if stage >= 1 else 1)
+    return int(params + grads + optim)
+
+
+def activation_memory_per_chip(
+    micro_batch: int,
+    seq_len: int,
+    hidden: int,
+    n_layers: int,
+    bytes_per_el: int = 2,
+    remat: bool = True,
+    saved_factor: float = 12.0,
+) -> int:
+    """Rough activation bytes: ``saved_factor`` elements of width ``hidden``
+    per token per layer survive the remat policy (measured ~12 for the
+    dots-saveable policy; ~34 with remat off)."""
+    factor = saved_factor if remat else 34.0
+    return int(micro_batch * seq_len * hidden * n_layers * factor * bytes_per_el)
+
+
+@dataclass
+class ModelInfo:
+    """Reference model_info (profile step, engine.py:2198): what the memory
+    model needs to prune the space."""
+
+    num_params: int
+    hidden_size: int
+    num_layers: int
+    seq_len: int
+
+
+@dataclass
+class TuningRecord:
+    config: Dict[str, Any]
+    metric_val: Optional[float]  # None = failed / OOM
+
+
+@dataclass
+class AutotunerConfig:
+    """The ``autotuning`` config section (reference autotuning/config.py)."""
+
+    enabled: bool = False
+    metric: str = "throughput"
+    fast: bool = True
+    max_experiments: int = 50
+    tuner_type: str = "gridsearch"  # gridsearch | random
+    micro_batch_sizes: Sequence[int] = (1, 2, 4, 8, 16, 32)
+    stages: Sequence[int] = (0, 1, 2, 3)
+    remat: Sequence[bool] = (True,)
+    seed: int = 0
+
+
+class Autotuner:
+    def __init__(
+        self,
+        model_info: ModelInfo,
+        hbm_bytes_per_chip: int,
+        dp_world: int,
+        runner: Callable[[Dict[str, Any]], Optional[float]],
+        config: Optional[AutotunerConfig] = None,
+    ):
+        """runner(exp_config) -> metric value (higher better) or None on
+        failure/OOM — the reference's scheduler+launcher round trip."""
+        self.mi = model_info
+        self.hbm = hbm_bytes_per_chip
+        self.dp = dp_world
+        self.runner = runner
+        self.cfg = config or AutotunerConfig()
+        if self.cfg.metric not in AUTOTUNING_METRICS:
+            raise ValueError(f"unknown autotuning metric {self.cfg.metric!r}")
+        # latency minimizes; throughput/flops maximize (reference
+        # autotuning_metric semantics)
+        self._sign = -1.0 if self.cfg.metric == "latency" else 1.0
+        self.records: List[TuningRecord] = []
+
+    # -- feasibility ------------------------------------------------------
+    def memory_feasible(self, stage: int, micro: int, remat: bool) -> bool:
+        need = zero_memory_per_chip(self.mi.num_params, stage, self.dp) + activation_memory_per_chip(
+            micro, self.mi.seq_len, self.mi.hidden_size, self.mi.num_layers, remat=remat
+        )
+        return need < self.hbm * 0.92  # leave runway for workspace/fragmentation
+
+    def max_feasible_micro(self, stage: int, remat: bool) -> Optional[int]:
+        feas = [m for m in self.cfg.micro_batch_sizes if self.memory_feasible(stage, m, remat)]
+        return max(feas) if feas else None
+
+    # -- space enumeration -------------------------------------------------
+    def _space(self) -> List[Dict[str, Any]]:
+        exps = []
+        for stage, remat in itertools.product(self.cfg.stages, self.cfg.remat):
+            for micro in self.cfg.micro_batch_sizes:
+                if self.memory_feasible(stage, micro, remat):
+                    exps.append(
+                        {"zero_stage": stage, "micro_batch": micro, "remat": remat}
+                    )
+        return exps
+
+    def _run(self, exp: Dict[str, Any]) -> Optional[float]:
+        try:
+            val = self.runner(exp)
+        except Exception as e:  # an OOM/compile failure is a data point
+            logger.warning(f"autotuning experiment {exp} failed: {e}")
+            val = None
+        self.records.append(TuningRecord(config=dict(exp), metric_val=val))
+        return val
+
+    # -- search ------------------------------------------------------------
+    def tune(self) -> Tuple[Optional[Dict[str, Any]], Optional[float]]:
+        """Returns (best_config, best_metric). Fast mode: per stage, probe
+        the largest feasible micro-batch then its neighbors, keep the stage
+        while it improves (reference tune() stage walk :404); otherwise
+        grid/random over the full feasible space."""
+        if self.cfg.fast:
+            return self._tune_fast()
+        space = self._space()
+        if self.cfg.tuner_type == "random":
+            import random
+
+            rng = random.Random(self.cfg.seed)
+            rng.shuffle(space)
+        best, best_val = None, None
+        for exp in space[: self.cfg.max_experiments]:
+            val = self._run(exp)
+            if val is not None and (best_val is None or self._sign * val > self._sign * best_val):
+                best, best_val = exp, val
+        return best, best_val
+
+    def _tune_fast(self):
+        best, best_val = None, None
+        for stage in self.cfg.stages:
+            for remat in self.cfg.remat:
+                top = self.max_feasible_micro(stage, remat)
+                if top is None:
+                    continue
+                feas = [m for m in self.cfg.micro_batch_sizes if m <= top]
+                stage_best_val = None
+                # largest first, then step down while it improves
+                for micro in sorted(feas, reverse=True):
+                    if len(self.records) >= self.cfg.max_experiments:
+                        break
+                    val = self._run({"zero_stage": stage, "micro_batch": micro, "remat": remat})
+                    if val is None:
+                        continue
+                    if stage_best_val is not None and self._sign * val <= self._sign * stage_best_val:
+                        break  # descending micro stopped helping
+                    stage_best_val = val
+                    if best_val is None or self._sign * val > self._sign * best_val:
+                        best = {"zero_stage": stage, "micro_batch": micro, "remat": remat}
+                        best_val = val
+            # reference fast mode: once a lower stage beats a higher-capacity
+            # one, higher stages only add comm — stop after first regression
+            if best is not None and stage > best["zero_stage"]:
+                break
+        return best, best_val
+
+    def best_experiment(self):
+        done = [r for r in self.records if r.metric_val is not None]
+        if not done:
+            return None
+        return max(done, key=lambda r: self._sign * r.metric_val)
+
+    def summary(self) -> str:
+        lines = [f"{'stage':>5} {'micro':>6} {'remat':>6} {'metric':>12}"]
+        for r in self.records:
+            c = r.config
+            val = f"{r.metric_val:.2f}" if r.metric_val is not None else "FAIL"
+            lines.append(f"{c['zero_stage']:>5} {c['micro_batch']:>6} {str(c['remat']):>6} {val:>12}")
+        return "\n".join(lines)

@@ -1,0 +1,25 @@
+"""Elastic training (reference deepspeed/elasticity/)."""
+
+from deepspeed_tpu.elasticity.elasticity import (
+    ElasticityConfig,
+    ElasticityConfigError,
+    ElasticityError,
+    compute_elastic_config,
+    elastic_resume_plan,
+    get_best_candidates,
+    get_candidate_batch_sizes,
+    get_valid_gpus,
+    micro_batch_for_world,
+)
+
+__all__ = [
+    "ElasticityConfig",
+    "ElasticityConfigError",
+    "ElasticityError",
+    "compute_elastic_config",
+    "elastic_resume_plan",
+    "get_best_candidates",
+    "get_candidate_batch_sizes",
+    "get_valid_gpus",
+    "micro_batch_for_world",
+]

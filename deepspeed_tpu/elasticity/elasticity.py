@@ -1,0 +1,247 @@
+"""Elastic training: batch-size/chip-count co-design + resume planning.
+
+Analogue of the reference ``elasticity/elasticity.py`` (``compute_elastic_config``
+:233, candidate enumeration :27-126) and the elastic agent's role
+(``elastic_agent.py:32``): pick a global batch size with MANY compatible
+accelerator counts so the job can scale up/down without changing convergence
+behavior (batch = micro × gas × dp_world must stay fixed), and on a
+membership change emit the new (micro, gas) decomposition — recovery itself
+is universal-checkpoint resume (checkpoint/engine.py), which reshards state
+to the new topology.
+
+Math mirrors the reference v0.1/v0.2 algorithms; "GPUs" become chips.
+"""
+
+from dataclasses import dataclass, field
+from math import lcm
+from typing import List, Optional, Tuple
+
+
+class ElasticityError(Exception):
+    pass
+
+
+class ElasticityConfigError(ElasticityError):
+    pass
+
+
+@dataclass
+class ElasticityConfig:
+    """The ``elasticity`` config section (reference elasticity/config.py)."""
+
+    enabled: bool = False
+    max_train_batch_size: int = 2000
+    micro_batch_sizes: List[int] = field(default_factory=lambda: [2, 4, 6])
+    min_gpus: int = 1
+    max_gpus: int = 10000
+    min_time: int = 0
+    version: float = 0.1
+    prefer_larger_batch: bool = True
+    model_parallel_size: int = 1
+    num_gpus_per_node: int = 1
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ElasticityConfig":
+        known = {f for f in cls.__dataclass_fields__}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+def get_candidate_batch_sizes(base_list: List[int], max_acceptable: int) -> List[int]:
+    """Largest multiple of each base ≤ max (reference :27)."""
+    candidates = set()
+    for base in base_list:
+        if base <= max_acceptable:
+            candidates.add(base * (max_acceptable // base))
+    return sorted(candidates)
+
+
+def get_valid_gpus(batch_size: int, micro_batches: List[int], min_gpus: int, max_gpus: int) -> List[int]:
+    """Chip counts g where some micro-batch evenly decomposes batch_size
+    (reference :45): batch % (micro * g) == 0."""
+    valid = []
+    for g in range(min_gpus, max_gpus + 1):
+        if any(batch_size % (mb * g) == 0 for mb in micro_batches):
+            valid.append(g)
+    return valid
+
+
+def get_best_candidates(
+    candidate_batch_sizes: List[int],
+    micro_batches: List[int],
+    min_gpus: int,
+    max_gpus: int,
+    prefer_larger: bool,
+) -> Tuple[int, List[int]]:
+    """Candidate with the most compatible chip counts; ties → batch-size
+    preference (reference :63)."""
+    max_valid = -1
+    best_batch, best_gpus = 0, []
+    for batch in candidate_batch_sizes:
+        valid = get_valid_gpus(batch, micro_batches, min_gpus, max_gpus)
+        better_tie = prefer_larger and batch > best_batch
+        if len(valid) > max_valid or (len(valid) == max_valid and better_tie):
+            max_valid = len(valid)
+            best_batch, best_gpus = batch, valid
+    return best_batch, best_gpus
+
+
+def _get_compatible_gpus_v01(
+    micro_batches: List[int],
+    max_acceptable_batch_size: int,
+    min_gpus: Optional[int] = None,
+    max_gpus: Optional[int] = None,
+    prefer_larger: bool = True,
+) -> Tuple[int, List[int]]:
+    """Reference v0.1 (:83): candidate bases are each micro batch and their
+    LCM, scaled to the largest multiple under the cap."""
+    min_gpus = min_gpus or 1
+    max_gpus = max_gpus or max_acceptable_batch_size // min(micro_batches)
+    if not all(mb <= max_acceptable_batch_size for mb in micro_batches):
+        raise ValueError(
+            "All micro batches must be <= max_acceptable_batch_size "
+            f"({max_acceptable_batch_size})"
+        )
+    base_list = list(micro_batches) + [lcm(*micro_batches)]
+    candidates = get_candidate_batch_sizes(base_list, max_acceptable_batch_size)
+    return get_best_candidates(candidates, micro_batches, min_gpus, max_gpus, prefer_larger)
+
+
+def _get_compatible_gpus_v02(
+    micro_batches: List[int],
+    max_acceptable_batch_size: int,
+    current_num_gpus: int,
+    min_gpus: Optional[int] = None,
+    max_gpus: Optional[int] = None,
+    prefer_larger: bool = True,
+    num_gpus_per_node: int = 1,
+    model_parallel_size: int = 1,
+) -> Tuple[int, List[int], Optional[int]]:
+    """Reference v0.2 (:126): the batch search runs at NODE granularity —
+    candidates come from v0.1 over batch/dp_size_per_node with node counts,
+    then scale back. Returns (batch, valid DP WORLD sizes, micro) — callers
+    convert chips → dp world via model_parallel_size. If the current dp
+    world is not elastic-compatible, falls back to the largest batch that
+    decomposes on exactly that world (reference :172-186)."""
+    if num_gpus_per_node % model_parallel_size:
+        raise ElasticityError(
+            f"num_gpus_per_node {num_gpus_per_node} must be divisible by "
+            f"model_parallel_size {model_parallel_size}"
+        )
+    dp_size_per_node = num_gpus_per_node // model_parallel_size
+    current_dp = current_num_gpus // model_parallel_size
+
+    def get_microbatch(batch, dp_world):
+        cands = [mb for mb in micro_batches if (batch // dp_world) % mb == 0]
+        if not cands:
+            return None
+        return max(cands) if prefer_larger else min(cands)
+
+    batch, valid_nodes = _get_compatible_gpus_v01(
+        micro_batches,
+        max_acceptable_batch_size // dp_size_per_node,
+        max(int((min_gpus or 1) / num_gpus_per_node), 1),
+        max(int((max_gpus or num_gpus_per_node) / num_gpus_per_node), 1),
+        prefer_larger=prefer_larger,
+    )
+    batch = int(batch) * dp_size_per_node
+    valid_dp = [n * dp_size_per_node for n in valid_nodes]
+    if current_dp in valid_dp:
+        return batch, valid_dp, get_microbatch(batch, current_dp)
+
+    # current world not elastic-compatible: largest batch decomposing on it
+    best_batch, best_micro = 0, None
+    for mb in micro_batches:
+        unit = mb * current_dp
+        if unit <= max_acceptable_batch_size:
+            cand = (max_acceptable_batch_size // unit) * unit
+            if cand > best_batch or (cand == best_batch and prefer_larger):
+                best_batch, best_micro = cand, mb
+    if best_batch == 0:
+        raise ElasticityError(
+            f"no batch <= {max_acceptable_batch_size} decomposes on dp world {current_dp}"
+        )
+    return best_batch, [current_dp], best_micro
+
+
+def compute_elastic_config(
+    ds_config: dict,
+    target_deepspeed_version: str = "",
+    world_size: int = 0,
+    return_microbatch: bool = False,
+):
+    """Reference compute_elastic_config (:233). Returns
+    (final_batch_size, valid_gpus[, micro_batch]). Deterministic per config."""
+    if "elasticity" not in ds_config:
+        raise ElasticityConfigError("'elasticity' is missing from the config")
+    ecfg = ElasticityConfig.from_dict(ds_config["elasticity"])
+    if not ds_config["elasticity"].get("enabled", False):
+        # reference semantics: missing/false 'enabled' refuses (the caller is
+        # running an elastic job; a silently-inactive config would mislead)
+        raise ElasticityConfigError("Elasticity is disabled")
+
+    if ecfg.version >= 0.2:
+        batch, valid, micro02 = _get_compatible_gpus_v02(
+            ecfg.micro_batch_sizes,
+            ecfg.max_train_batch_size,
+            current_num_gpus=world_size or ecfg.num_gpus_per_node * ecfg.model_parallel_size,
+            min_gpus=ecfg.min_gpus,
+            max_gpus=ecfg.max_gpus,
+            prefer_larger=ecfg.prefer_larger_batch,
+            num_gpus_per_node=ecfg.num_gpus_per_node,
+            model_parallel_size=ecfg.model_parallel_size,
+        )
+        dp_world = (world_size // ecfg.model_parallel_size) if world_size > 0 else 0
+    else:
+        batch, valid = _get_compatible_gpus_v01(
+            ecfg.micro_batch_sizes,
+            ecfg.max_train_batch_size,
+            min_gpus=ecfg.min_gpus,
+            max_gpus=ecfg.max_gpus,
+            prefer_larger=ecfg.prefer_larger_batch,
+        )
+        micro02 = None
+        dp_world = world_size
+
+    if dp_world > 0 and dp_world not in valid:
+        raise ElasticityError(
+            f"dp world {dp_world} is not compatible with batch {batch} "
+            f"(valid dp worlds: {valid[:16]}{'...' if len(valid) > 16 else ''})"
+        )
+    if not return_microbatch:
+        return batch, valid
+    assert dp_world > 0, "return_microbatch requires world_size"
+    micro = micro02 if micro02 is not None else micro_batch_for_world(
+        batch, ecfg.micro_batch_sizes, dp_world, ecfg.prefer_larger_batch
+    )
+    return batch, valid, micro
+
+
+def micro_batch_for_world(
+    batch: int, micro_batches: List[int], world_size: int, prefer_larger: bool = True
+) -> int:
+    """The micro-batch that decomposes ``batch`` on ``world_size`` chips."""
+    compatible = [mb for mb in micro_batches if batch % (mb * world_size) == 0]
+    if not compatible:
+        raise ElasticityError(
+            f"no configured micro batch decomposes batch {batch} over {world_size} chips"
+        )
+    return max(compatible) if prefer_larger else min(compatible)
+
+
+def elastic_resume_plan(ds_config: dict, new_world_size: int) -> dict:
+    """Membership change → the new training decomposition (the elastic
+    agent's restart math, reference elastic_agent.py:32 + engine guard
+    :680-690). ``new_world_size`` is total chips; the batch decomposes over
+    the DATA-parallel world (chips / model_parallel_size). Apply the patch to
+    the config and resume from the universal checkpoint."""
+    batch, valid, micro = compute_elastic_config(
+        ds_config, world_size=new_world_size, return_microbatch=True
+    )
+    mp = ds_config["elasticity"].get("model_parallel_size", 1)
+    dp = new_world_size // mp
+    gas = batch // (micro * dp)
+    return {
+        "train_batch_size": batch,
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": gas,
+    }

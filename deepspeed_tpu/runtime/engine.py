@@ -1032,6 +1032,7 @@ class DeepSpeedEngine:
             self._fwd_bwd_jit = self._build_fwd_bwd()
         self.timers(FORWARD_GLOBAL_TIMER).start()
         self._unpark_params()
+        batch = self._apply_curriculum(batch)  # name-keyed: works un-stacked too
         batch = jax.device_put(batch, self._batch_shardings(batch))
         loss, grads = self._fwd_bwd_jit(
             self.params, self.scaler_state, jnp.int32(self.micro_steps), batch

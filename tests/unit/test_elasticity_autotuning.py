@@ -1,0 +1,206 @@
+"""Elasticity + autotuning tests (analogue of reference tests/unit/elasticity
++ tests/unit/autotuning)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.autotuning import (
+    Autotuner,
+    AutotunerConfig,
+    ModelInfo,
+    activation_memory_per_chip,
+    zero_memory_per_chip,
+)
+from deepspeed_tpu.elasticity import (
+    ElasticityConfigError,
+    ElasticityError,
+    compute_elastic_config,
+    elastic_resume_plan,
+    get_valid_gpus,
+    micro_batch_for_world,
+)
+
+BASE_CONFIG = {
+    "elasticity": {
+        "enabled": True,
+        "max_train_batch_size": 10000,
+        "micro_batch_sizes": [8, 12, 16, 17],
+        "min_gpus": 32,
+        "max_gpus": 1500,
+        "min_time": 20,
+        "version": 0.1,
+    }
+}
+
+
+class TestElasticity:
+    def test_candidate_selection(self):
+        """The reference's own doc example: these knobs give a highly
+        composite batch size with many valid worlds."""
+        batch, valid = compute_elastic_config(BASE_CONFIG)
+        assert batch <= 10000
+        # every valid count decomposes the batch through some micro batch
+        for g in valid[:20]:
+            assert any(batch % (mb * g) == 0 for mb in [8, 12, 16, 17])
+        assert len(valid) > 20  # elasticity means MANY valid counts
+
+    def test_world_size_validation(self):
+        batch, valid = compute_elastic_config(BASE_CONFIG)
+        ok = valid[len(valid) // 2]
+        compute_elastic_config(BASE_CONFIG, world_size=ok)  # no raise
+        bad = max(valid) + 1
+        if bad not in valid:
+            with pytest.raises(ElasticityError):
+                compute_elastic_config(BASE_CONFIG, world_size=bad)
+
+    def test_return_microbatch(self):
+        batch, valid, micro = compute_elastic_config(
+            BASE_CONFIG, world_size=valid_world(BASE_CONFIG), return_microbatch=True
+        )
+        w = valid_world(BASE_CONFIG)
+        assert batch % (micro * w) == 0
+
+    def test_missing_section_raises(self):
+        with pytest.raises(ElasticityConfigError):
+            compute_elastic_config({})
+
+    def test_disabled_raises(self):
+        cfg = {"elasticity": dict(BASE_CONFIG["elasticity"], enabled=False)}
+        with pytest.raises(ElasticityConfigError):
+            compute_elastic_config(cfg)
+
+    def test_get_valid_gpus(self):
+        assert get_valid_gpus(96, [8, 12], 1, 12) == [1, 2, 3, 4, 6, 8, 12]
+
+    def test_micro_batch_for_world_prefers_larger(self):
+        assert micro_batch_for_world(96, [2, 4, 8], 4) == 8
+        with pytest.raises(ElasticityError):
+            micro_batch_for_world(97, [2, 4, 8], 4)
+
+    def test_resume_plan_preserves_global_batch(self):
+        w = valid_world(BASE_CONFIG)
+        plan = elastic_resume_plan(BASE_CONFIG, w)
+        assert (
+            plan["train_micro_batch_size_per_gpu"]
+            * plan["gradient_accumulation_steps"]
+            * w
+            == plan["train_batch_size"]
+        )
+        # scale down to another valid count: same global batch size
+        batch, valid = compute_elastic_config(BASE_CONFIG)
+        other = [g for g in valid if g != w][0]
+        plan2 = elastic_resume_plan(BASE_CONFIG, other)
+        assert plan2["train_batch_size"] == plan["train_batch_size"]
+
+
+def valid_world(cfg):
+    _, valid = compute_elastic_config(cfg)
+    return valid[len(valid) // 2]
+
+
+class TestElasticityV02:
+    CFG = {
+        "elasticity": {
+            "enabled": True,
+            "max_train_batch_size": 512,
+            "micro_batch_sizes": [2, 4],
+            "min_gpus": 1,
+            "max_gpus": 64,
+            "version": 0.2,
+            "num_gpus_per_node": 4,
+            "model_parallel_size": 2,
+        }
+    }
+
+    def test_every_advertised_world_decomposes(self):
+        batch, valid = compute_elastic_config(self.CFG, world_size=4)
+        for dp in valid:
+            assert any(batch % (mb * dp) == 0 for mb in [2, 4]), (batch, dp)
+
+    def test_mp_aware_resume_plan(self):
+        # 8 chips, mp=2 → dp world 4: realized samples/step must equal batch
+        plan = elastic_resume_plan(self.CFG, 8)
+        dp = 8 // 2
+        assert (
+            plan["train_micro_batch_size_per_gpu"]
+            * plan["gradient_accumulation_steps"]
+            * dp
+            == plan["train_batch_size"]
+        )
+
+
+def test_autotuner_latency_minimizes():
+    from deepspeed_tpu.autotuning import Autotuner, AutotunerConfig, ModelInfo
+
+    def runner(exp):  # latency: smaller micro = smaller latency
+        return float(exp["micro_batch"])
+
+    tuner = Autotuner(
+        ModelInfo(50_000_000, 512, 8, 1024), 16 * 2**30, dp_world=8, runner=runner,
+        config=AutotunerConfig(fast=False, metric="latency", max_experiments=100),
+    )
+    best, val = tuner.tune()
+    assert best["micro_batch"] == 1  # lowest latency wins, not highest value
+
+
+class TestAutotuner:
+    MI = ModelInfo(num_params=700_000_000, hidden_size=1536, num_layers=20, seq_len=2048)
+    HBM = 16 * 2**30
+
+    def test_memory_model_monotonic(self):
+        # higher stages shard more state
+        mems = [zero_memory_per_chip(10**9, s, dp_world=8) for s in range(4)]
+        assert mems == sorted(mems, reverse=True)
+        # remat reduces activation memory
+        assert activation_memory_per_chip(8, 2048, 1024, 16, remat=True) < \
+            activation_memory_per_chip(8, 2048, 1024, 16, remat=False)
+
+    def test_feasibility_pruning(self):
+        tuner = Autotuner(self.MI, self.HBM, dp_world=1, runner=lambda e: 1.0)
+        # stage 0 with 700M params needs 12.6GB of state: huge micros infeasible
+        assert not tuner.memory_feasible(0, 32, remat=True)
+        assert tuner.memory_feasible(3, 4, remat=True) == tuner.memory_feasible(0, 4, remat=True)
+
+    def test_grid_search_finds_synthetic_optimum(self):
+        # synthetic cost: throughput peaks at stage 1, micro 8
+        def runner(exp):
+            return 100 - 10 * abs(exp["zero_stage"] - 1) - abs(exp["micro_batch"] - 8)
+
+        tuner = Autotuner(
+            ModelInfo(50_000_000, 512, 8, 1024), self.HBM, dp_world=8, runner=runner,
+            config=AutotunerConfig(fast=False, tuner_type="gridsearch", max_experiments=100),
+        )
+        best, val = tuner.tune()
+        assert best["zero_stage"] == 1 and best["micro_batch"] == 8
+
+    def test_fast_mode_early_stops(self):
+        calls = []
+
+        def runner(exp):
+            calls.append(exp)
+            return float(exp["micro_batch"])  # bigger micro always better
+
+        tuner = Autotuner(
+            ModelInfo(50_000_000, 512, 8, 1024), self.HBM, dp_world=8, runner=runner,
+            config=AutotunerConfig(fast=True),
+        )
+        best, val = tuner.tune()
+        assert best is not None
+        # fast mode: largest feasible micro first, then stop on regression —
+        # far fewer experiments than the full grid
+        assert len(calls) < 24
+
+    def test_failed_experiments_are_records_not_crashes(self):
+        def runner(exp):
+            if exp["micro_batch"] > 2:
+                raise MemoryError("RESOURCE_EXHAUSTED")
+            return 1.0
+
+        tuner = Autotuner(
+            ModelInfo(50_000_000, 512, 8, 1024), self.HBM, dp_world=8, runner=runner,
+            config=AutotunerConfig(fast=False, max_experiments=10),
+        )
+        best, val = tuner.tune()
+        assert best is not None and best["micro_batch"] <= 2
+        assert any(r.metric_val is None for r in tuner.records)
+        assert "FAIL" in tuner.summary()
