@@ -1,0 +1,11 @@
+"""Profiling (reference deepspeed/profiling/): jaxpr/XLA-cost-model flops
+profiler."""
+
+from deepspeed_tpu.profiling.flops_profiler import (
+    FlopsProfiler,
+    analyze_fn,
+    jaxpr_flops_by_primitive,
+    num_to_string,
+)
+
+__all__ = ["FlopsProfiler", "analyze_fn", "jaxpr_flops_by_primitive", "num_to_string"]

@@ -756,6 +756,7 @@ class DeepSpeedEngine:
             mean_loss = jnp.mean(losses)
             return new_params, new_opt_state, new_scaler, mean_loss, grad_norm, overflow
 
+        self._train_step_raw = train_step  # unjitted: profiler jaxpr walk
         return jax.jit(
             train_step,
             donate_argnums=(0, 1, 2),
@@ -874,6 +875,7 @@ class DeepSpeedEngine:
             )
             return fn(params, opt_state, scaler_state, step, lr, batch)
 
+        self._train_step_raw = train_step
         return jax.jit(train_step, donate_argnums=(0, 1, 2))
 
     def _build_fwd_bwd(self):
@@ -1003,6 +1005,9 @@ class DeepSpeedEngine:
         self._unpark_for_step()
         shardings = self._batch_shardings(stacked, leading_gas_dim=True)
         stacked = jax.device_put(stacked, shardings)
+        fp = self.config.flops_profiler
+        profiling = fp.enabled and self.global_steps + 1 == fp.profile_step
+        t_prof = time.perf_counter() if profiling else 0.0
         (
             self.params,
             self.opt_state,
@@ -1018,12 +1023,68 @@ class DeepSpeedEngine:
             jnp.float32(lr),
             stacked,
         )
+        if profiling:
+            jax.block_until_ready(loss)
+            self._run_flops_profile(stacked, time.perf_counter() - t_prof)
         self.timers(STEP_GLOBAL_TIMER).stop()
         self.params = self._park_params(self.params)
         self.opt_state = self._park_state(self.opt_state)
         self._after_step(loss, grad_norm, overflow)
         self.tput_timer.stop(global_step=True)
         return loss
+
+    def _run_flops_profile(self, stacked, duration):
+        """flops_profiler.profile_step hook (reference engine.py:2690): cost
+        analysis of the train step + the measured wall time. Runs once; the
+        extra lower/compile pass is the price of the XLA cost model (logged)."""
+        from deepspeed_tpu.profiling.flops_profiler import (
+            FlopsProfiler,
+            jaxpr_flops_by_primitive,
+        )
+
+        fp = self.config.flops_profiler
+        if fp.profile_step <= 1:
+            logger.warning(
+                "flops_profiler.profile_step=1 measures the FIRST step, whose wall "
+                "time includes tracing + XLA compilation — the reported achieved "
+                "FLOPS/s will be far below hardware rate; set profile_step >= 2"
+            )
+        args = (
+            self.params, self.opt_state, self.scaler_state,
+            jnp.int32(self.global_steps), jnp.float32(self._current_lr()), stacked,
+        )
+        try:
+            log_dist("flops profile: lowering step for cost analysis (one-time)", ranks=[0])
+            cost = self._train_step_jit.lower(*args).compile().cost_analysis() or {}
+        except Exception as e:  # profiling must never break training
+            logger.warning(f"flops profile failed: {e}")
+            return
+        by_prim = {}
+        if fp.detailed and getattr(self, "_train_step_raw", None) is not None:
+            try:
+                jaxpr = jax.make_jaxpr(self._train_step_raw)(*args)
+                by_prim = jaxpr_flops_by_primitive(jaxpr.jaxpr)
+            except Exception as e:
+                logger.warning(f"per-primitive breakdown failed: {e}")
+        prof = FlopsProfiler(ds_engine=self)
+        prof._analysis = {
+            "flops": float(cost.get("flops", 0.0)),
+            "bytes_accessed": float(cost.get("bytes accessed", 0.0)),
+            "by_primitive": by_prim,
+        }
+        prof._duration = duration
+        prof.set_total_params(self.params)
+        prof.print_model_profile(
+            profile_step=self.global_steps + 1,
+            module_depth=fp.module_depth,
+            top_modules=fp.top_modules,
+            detailed=fp.detailed,
+            output_file=fp.output_file,
+        )
+        if self.config.memory_breakdown:
+            from deepspeed_tpu.utils.memory import see_memory_usage
+
+            see_memory_usage("after profiled step", force=True)
 
     def forward(self, batch):
         """Compute loss for one micro-batch; grads are computed in the same

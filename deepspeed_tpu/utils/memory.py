@@ -1,0 +1,67 @@
+"""Memory observability (reference ``runtime/utils.py:776 see_memory_usage``
++ ``memory_breakdown`` config): device HBM stats from the JAX client, host
+RSS from the OS — logged rank-0, forceable."""
+
+import resource
+from typing import Dict
+
+import jax
+
+from deepspeed_tpu.utils.logging import log_dist
+
+_GB = 2**30
+_MB = 2**20
+
+
+def memory_status(device=None) -> Dict[str, float]:
+    """Device + host memory snapshot in bytes. Keys mirror the reference's
+    MA/CA (allocated/reserved) naming where a TPU equivalent exists."""
+    dev = device or jax.devices()[0]
+    stats = {}
+    try:
+        s = dev.memory_stats() or {}
+        stats["bytes_in_use"] = s.get("bytes_in_use", 0)
+        stats["peak_bytes_in_use"] = s.get("peak_bytes_in_use", 0)
+        stats["bytes_limit"] = s.get("bytes_limit", 0)
+        stats["largest_free_block_bytes"] = s.get("largest_free_block_bytes", 0)
+    except Exception:
+        pass
+    stats["host_rss_bytes"] = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    return stats
+
+
+def see_memory_usage(message: str, force: bool = False, ranks=(0,)):
+    """Reference see_memory_usage: one formatted line of device/host memory.
+    Cheap (no device sync beyond the stats query); gate call sites with
+    ``force`` or the ``memory_breakdown`` config like the reference does."""
+    if not force:
+        return
+    s = memory_status()
+    parts = [message]
+    if s.get("bytes_limit"):
+        parts.append(
+            f"HBM {s['bytes_in_use'] / _GB:.2f}GB used "
+            f"(peak {s['peak_bytes_in_use'] / _GB:.2f}GB / limit {s['bytes_limit'] / _GB:.2f}GB)"
+        )
+    parts.append(f"host RSS {s['host_rss_bytes'] / _GB:.2f}GB")
+    log_dist(" | ".join(parts), ranks=list(ranks))
+    return s
+
+
+def params_memory_breakdown(tree) -> Dict[str, int]:
+    """Bytes per top-level pytree key (what the reference's per-module
+    breakdown gives for model state)."""
+    import numpy as np
+
+    out = {}
+    if isinstance(tree, dict):
+        items = tree.items()
+    else:
+        items = [("params", tree)]
+    for k, sub in items:
+        out[str(k)] = sum(
+            int(np.prod(p.shape)) * p.dtype.itemsize
+            for p in jax.tree_util.tree_leaves(sub)
+            if hasattr(p, "shape")
+        )
+    return out

@@ -1,0 +1,99 @@
+"""Flops profiler + memory observability tests (analogue of reference
+tests/unit/profiling/flops_profiler)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.profiling import FlopsProfiler, analyze_fn, jaxpr_flops_by_primitive
+from deepspeed_tpu.utils.memory import memory_status, params_memory_breakdown, see_memory_usage
+
+from tests.unit.simple_model import batch_of, make_mlp_params, mlp_loss_fn, random_dataset
+
+
+def test_analyze_fn_counts_matmul_flops():
+    def f(x, w):
+        return jnp.tanh(x @ w).sum()
+
+    a = analyze_fn(f, jnp.ones((64, 128)), jnp.ones((128, 256)))
+    assert a["flops"] > 0
+    assert a["by_primitive"]["dot_general"] == pytest.approx(2 * 64 * 128 * 256)
+
+
+def test_scan_multiplies_by_trip_count():
+    w = jnp.ones((64, 64))
+
+    def g(x):
+        def body(c, _):
+            return c @ w, None
+
+        out, _ = jax.lax.scan(body, x, None, length=5)
+        return out.sum()
+
+    a = analyze_fn(g, jnp.ones((8, 64)))
+    assert a["by_primitive"]["dot_general"] == pytest.approx(5 * 2 * 8 * 64 * 64)
+
+
+def test_profiler_reference_api(tmp_path):
+    prof = FlopsProfiler()
+    prof.start_profile()
+
+    def step(x, w):
+        return (x @ w).sum()
+
+    x, w = jnp.ones((32, 64)), jnp.ones((64, 64))
+    _ = step(x, w)
+    prof.stop_profile(step, x, w)
+    prof.set_total_params({"w": np.ones((64, 64))})
+    assert prof.get_total_flops() > 0
+    assert prof.get_total_macs() == prof.get_total_flops() / 2
+    assert prof.get_total_params() == 64 * 64
+    assert "FLOPS" in prof.get_total_flops(as_string=True)
+    out = tmp_path / "profile.txt"
+    prof.print_model_profile(output_file=str(out))
+    text = out.read_text()
+    assert "Flops Profiler" in text and "dot_general" in text
+    prof.end_profile()
+    assert prof.get_total_flops() == 0
+
+
+def test_engine_profile_step_runs(devices8, tmp_path):
+    dataset = random_dataset(n=64 * 3)
+    params = make_mlp_params(jax.random.key(0))
+    out_file = tmp_path / "flops.txt"
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=mlp_loss_fn,
+        model_parameters=params,
+        config={
+            "train_micro_batch_size_per_gpu": 8,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 2},
+            "mesh": {"data": 8},
+            "flops_profiler": {
+                "enabled": True,
+                "profile_step": 2,
+                "output_file": str(out_file),
+            },
+            "memory_breakdown": True,
+            "steps_per_print": 1000,
+        },
+    )
+    for i in range(3):
+        engine.train_batch(batch=batch_of(dataset, i * 64, 64))
+    text = out_file.read_text()
+    assert "Flops Profiler (step 2)" in text
+    assert "achieved:" in text
+
+
+def test_memory_status_and_breakdown():
+    s = memory_status()
+    assert s["host_rss_bytes"] > 0
+    params = {"layer_0": {"w": np.zeros((16, 16), np.float32)}, "head": np.zeros((4,), np.float32)}
+    bd = params_memory_breakdown(params)
+    assert bd["layer_0"] == 16 * 16 * 4
+    assert bd["head"] == 16
+    assert see_memory_usage("msg", force=False) is None  # gated off
+    assert see_memory_usage("msg", force=True) is not None
