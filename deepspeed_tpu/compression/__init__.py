@@ -1,0 +1,31 @@
+"""Compression suite (reference deepspeed/compression/, 2.4k LoC)."""
+
+from deepspeed_tpu.compression.compress import init_compression, redundancy_clean
+from deepspeed_tpu.compression.scheduler import CompressionScheduler, TechniqueSchedule
+from deepspeed_tpu.compression.transforms import (
+    fake_quantize,
+    head_mask,
+    prune_weights,
+    quantize_activation,
+    quantize_weights,
+    reduce_layers,
+    row_mask,
+    sparse_mask,
+    sparsity,
+)
+
+__all__ = [
+    "CompressionScheduler",
+    "TechniqueSchedule",
+    "fake_quantize",
+    "head_mask",
+    "init_compression",
+    "prune_weights",
+    "quantize_activation",
+    "quantize_weights",
+    "redundancy_clean",
+    "reduce_layers",
+    "row_mask",
+    "sparse_mask",
+    "sparsity",
+]

@@ -1,0 +1,144 @@
+"""Compression transforms: functional analogues of the reference's
+``compression/basic_layer.py`` mixins (``LinearLayer_Compress`` — weight/
+activation quantization, sparse/row/head pruning).
+
+The torch reference mutates module forwards; here each technique is a pure
+leaf transform applied to matched parameters (QAT fake-quant during
+training, masks for pruning), selected by path patterns like the reference's
+``different_groups`` ``modules`` lists.
+"""
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def match_leaves(params: Any, patterns: Sequence[str]) -> List[Tuple[tuple, Any]]:
+    """(path, leaf) pairs matching any pattern by FULL path segments
+    ('layer_1' does not match 'layer_10'; '*' matches everything — the
+    reference's catch-all group)."""
+    from deepspeed_tpu.utils.pytree import path_str, segments_match
+
+    out = []
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    for path, leaf in flat:
+        name = path_str(path)
+        if any(segments_match(name, p) for p in patterns):
+            out.append((path, leaf))
+    return out
+
+
+def _apply_to_matched(params, patterns, leaf_fn):
+    matched_paths = {tuple(p) for p, _ in match_leaves(params, patterns)}
+
+    def visit(path, leaf):
+        return leaf_fn(leaf) if tuple(path) in matched_paths else leaf
+
+    return jax.tree_util.tree_map_with_path(visit, params)
+
+
+# ---------------------------------------------------------------------------
+# quantization (QAT fake-quant)
+# ---------------------------------------------------------------------------
+def fake_quantize(x: jax.Array, bits: int, symmetric: bool = True) -> jax.Array:
+    """Quantize-dequantize at ``bits`` (reference LinearLayer_Compress weight
+    quantization forward): straight-through in backward (the round is wrapped
+    in a stop-gradient identity)."""
+    levels = 2.0 ** (bits - 1) - 1 if symmetric else 2.0**bits - 1
+    xf = x.astype(jnp.float32)
+    if symmetric:
+        scale = jnp.max(jnp.abs(xf)) / levels
+        scale = jnp.maximum(scale, 1e-12)
+        q = jnp.round(xf / scale)
+        deq = jnp.clip(q, -levels, levels) * scale
+    else:
+        lo, hi = jnp.min(xf), jnp.max(xf)
+        scale = jnp.maximum((hi - lo) / levels, 1e-12)
+        q = jnp.round((xf - lo) / scale)
+        deq = jnp.clip(q, 0, levels) * scale + lo
+    # straight-through estimator: forward sees deq, backward sees identity
+    return (xf + jax.lax.stop_gradient(deq - xf)).astype(x.dtype)
+
+
+def quantize_weights(params, patterns: Sequence[str], bits: int, symmetric: bool = True):
+    return _apply_to_matched(params, patterns, lambda w: fake_quantize(w, bits, symmetric))
+
+
+def quantize_activation(x: jax.Array, bits: int, range_calibration: str = "dynamic") -> jax.Array:
+    """Reference QuantAct (basic_layer.py:17): activation fake-quant."""
+    return fake_quantize(x, bits, symmetric=True)
+
+
+# ---------------------------------------------------------------------------
+# pruning masks
+# ---------------------------------------------------------------------------
+def sparse_mask(w: jax.Array, dense_ratio: float, method: str = "l1") -> jax.Array:
+    """Unstructured magnitude mask keeping the top ``dense_ratio`` fraction
+    (reference sparse_pruning l1/topk)."""
+    k = max(int(w.size * dense_ratio), 1)
+    flat = jnp.abs(w.astype(jnp.float32)).reshape(-1)
+    thresh = jnp.sort(flat)[-k]
+    return (jnp.abs(w.astype(jnp.float32)) >= thresh).astype(w.dtype)
+
+
+def row_mask(w: jax.Array, dense_ratio: float) -> jax.Array:
+    """Structured row mask by L2 norm ([in, out]: prune OUTPUT rows — the
+    reference prunes nn.Linear rows, i.e. output features)."""
+    norms = jnp.linalg.norm(w.astype(jnp.float32), axis=0)
+    k = max(int(norms.size * dense_ratio), 1)
+    thresh = jnp.sort(norms)[-k]
+    return jnp.broadcast_to((norms >= thresh).astype(w.dtype), w.shape)
+
+
+def head_mask(w: jax.Array, num_heads: int, dense_ratio: float) -> jax.Array:
+    """Attention-head mask: [in, H*d] weights pruned per head by L2 norm
+    (reference head_pruning on the attention output projection)."""
+    in_dim, out_dim = w.shape[-2], w.shape[-1]
+    assert out_dim % num_heads == 0, f"out dim {out_dim} not divisible by heads {num_heads}"
+    d = out_dim // num_heads
+    per_head = jnp.linalg.norm(
+        w.astype(jnp.float32).reshape(-1, num_heads, d), axis=(0, 2)
+    )
+    k = max(int(num_heads * dense_ratio), 1)
+    thresh = jnp.sort(per_head)[-k]
+    keep = (per_head >= thresh).astype(w.dtype)  # [H]
+    return jnp.broadcast_to(jnp.repeat(keep, d), w.shape)
+
+
+def prune_weights(params, patterns, dense_ratio, method: str = "sparse", num_heads: int = 0):
+    def leaf_fn(w):
+        if getattr(w, "ndim", 0) < 2:
+            return w
+        if method == "sparse":
+            return w * sparse_mask(w, dense_ratio)
+        if method == "row":
+            return w * row_mask(w, dense_ratio)
+        if method == "head":
+            return w * head_mask(w, num_heads, dense_ratio)
+        raise ValueError(f"unknown pruning method {method!r}")
+
+    return _apply_to_matched(params, patterns, leaf_fn)
+
+
+def sparsity(params, patterns=("*",)) -> float:
+    """Realized zero fraction over matched leaves."""
+    total, zeros = 0, 0
+    for _, leaf in match_leaves(params, patterns):
+        if getattr(leaf, "ndim", 0) >= 2:
+            total += leaf.size
+            zeros += int(jnp.sum(leaf == 0))
+    return zeros / max(total, 1)
+
+
+# ---------------------------------------------------------------------------
+# layer reduction (depth distillation prep)
+# ---------------------------------------------------------------------------
+def reduce_layers(params: Dict[str, Any], keep_layers: Sequence[int], layers_key: str = "layers"):
+    """Reference layer_reduction: keep only the listed layer indices of the
+    stacked [L, ...] layer pytree (student initialization from teacher
+    depths)."""
+    idx = jnp.asarray(list(keep_layers), jnp.int32)
+    out = dict(params)
+    out[layers_key] = jax.tree.map(lambda l: l[idx], params[layers_key])
+    return out

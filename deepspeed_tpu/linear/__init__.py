@@ -1,0 +1,21 @@
+"""Optimized linear / LoRA (reference deepspeed/linear/)."""
+
+from deepspeed_tpu.linear.optimized_linear import (
+    LoRAConfig,
+    QuantizationConfig,
+    init_optimized_linear,
+    lora_trainable_mask,
+    merge_lora,
+    optimized_linear,
+    optimized_linear_partition_specs,
+)
+
+__all__ = [
+    "LoRAConfig",
+    "QuantizationConfig",
+    "init_optimized_linear",
+    "lora_trainable_mask",
+    "merge_lora",
+    "optimized_linear",
+    "optimized_linear_partition_specs",
+]

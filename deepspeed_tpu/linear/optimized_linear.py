@@ -1,0 +1,140 @@
+"""OptimizedLinear: sharded frozen base + LoRA adapters (+ quantized base).
+
+Analogue of the reference ``linear/optimized_linear.py`` (``OptimizedLinear``
+dispatching to ``LoRAOptimizedLinear``) + ``linear/quantization.py``
+(``QuantizedParameter``): the full-rank base weight is frozen (optionally
+stored int8 with block scales), sharded over the model axis, and only the
+low-rank A/B adapters train.
+
+Functional form:
+  params = init_optimized_linear(key, in_f, out_f, lora, quant)
+  y      = optimized_linear(params, x, lora, quant)
+  specs  = optimized_linear_partition_specs(lora)      # for initialize()
+  mask   = lora_trainable_mask(params)                 # freeze the base
+"""
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.ops.quantizer.block_quant import (
+    QuantizedTensor,
+    dequantize_blockwise,
+    quantize_blockwise,
+)
+from deepspeed_tpu.parallel.topology import MODEL_AXIS
+
+
+@dataclass
+class LoRAConfig:
+    """Reference linear/config.py LoRAConfig."""
+
+    lora_r: int = 64
+    lora_alpha: float = 16.0
+    base_weight_sharding: int = 1  # shard the frozen base over `model`
+
+
+@dataclass
+class QuantizationConfig:
+    """Reference linear/config.py QuantizationConfig."""
+
+    q_bits: int = 8
+    group_size: int = 512
+    quantized_weights: bool = True
+
+
+def init_optimized_linear(
+    key: jax.Array,
+    in_features: int,
+    out_features: int,
+    lora: LoRAConfig = LoRAConfig(),
+    quant: Optional[QuantizationConfig] = None,
+    dtype=jnp.float32,
+    base_weight: Optional[jax.Array] = None,
+) -> Dict[str, Any]:
+    """Build the param dict: frozen base [in, out] (int8 payload + scales
+    when ``quant``), trainable lora_a [in, r] (kaiming-ish) and lora_b
+    [r, out] (zeros — adapters start as identity)."""
+    k1, k2 = jax.random.split(key)
+    if base_weight is None:
+        base_weight = jax.random.normal(k1, (in_features, out_features), jnp.float32) * (
+            in_features**-0.5
+        )
+    base_weight = base_weight.astype(dtype)
+    if quant is not None and quant.quantized_weights:
+        qt = quantize_blockwise(base_weight, bits=quant.q_bits, block_size=quant.group_size)
+        base = {"values": qt.values, "scales": qt.scales}
+    else:
+        base = {"weight": base_weight}
+    return {
+        "base": base,
+        "lora_a": (jax.random.normal(k2, (in_features, lora.lora_r)) * (in_features**-0.5)).astype(dtype),
+        "lora_b": jnp.zeros((lora.lora_r, out_features), dtype),
+    }
+
+
+def _base_weight(params, quant: Optional[QuantizationConfig], shape, dtype):
+    base = params["base"]
+    if "weight" in base:
+        return base["weight"]
+    qt = QuantizedTensor(
+        values=base["values"], scales=base["scales"], shape=shape,
+        bits=quant.q_bits if quant else 8,
+        block_size=quant.group_size if quant else 512,
+    )
+    return dequantize_blockwise(qt, dtype)
+
+
+def optimized_linear(
+    params: Dict[str, Any],
+    x: jax.Array,
+    lora: LoRAConfig = LoRAConfig(),
+    quant: Optional[QuantizationConfig] = None,
+) -> jax.Array:
+    """y = x @ W_base + (alpha / r) * (x @ A) @ B  (base under
+    stop_gradient — frozen like the reference's requires_grad=False)."""
+    in_f = params["lora_a"].shape[0]
+    out_f = params["lora_b"].shape[1]
+    w = _base_weight(params, quant, (in_f, out_f), x.dtype)
+    w = jax.lax.stop_gradient(w)
+    y = x @ w.astype(x.dtype)
+    scaling = lora.lora_alpha / lora.lora_r
+    return y + scaling * (x @ params["lora_a"]) @ params["lora_b"]
+
+
+def merge_lora(
+    params: Dict[str, Any],
+    lora: LoRAConfig = LoRAConfig(),
+    quant: Optional[QuantizationConfig] = None,
+) -> jax.Array:
+    """Fold adapters into a dense weight (the hybrid-engine fuse / export
+    path): W = W_base + (alpha/r) A@B."""
+    in_f = params["lora_a"].shape[0]
+    out_f = params["lora_b"].shape[1]
+    w = _base_weight(params, quant, (in_f, out_f), params["lora_a"].dtype)
+    return w + (lora.lora_alpha / lora.lora_r) * (params["lora_a"] @ params["lora_b"])
+
+
+def optimized_linear_partition_specs(
+    lora: LoRAConfig = LoRAConfig(), quant: Optional[QuantizationConfig] = None
+) -> Dict[str, Any]:
+    """PartitionSpecs: base sharded over `model` when base_weight_sharding>1
+    (the reference's sharded frozen base); adapters replicated (tiny)."""
+    shard = lora.base_weight_sharding > 1
+    if quant is not None and quant.quantized_weights:
+        base = {"values": P(MODEL_AXIS, None) if shard else P(), "scales": P()}
+    else:
+        base = {"weight": P(None, MODEL_AXIS) if shard else P(None, None)}
+    return {"base": base, "lora_a": P(None, None), "lora_b": P(None, None)}
+
+
+def lora_trainable_mask(params: Dict[str, Any]) -> Dict[str, Any]:
+    """True for trainable leaves (adapters), False for the frozen base —
+    feed to optax.masked / multi_transform to skip base updates."""
+    return jax.tree.map(lambda _: False, {"base": params["base"]}) | {
+        "lora_a": True,
+        "lora_b": True,
+    }

@@ -46,18 +46,7 @@ EMBED_PATTERNS = ("embed", "wte", "wpe", "word_embeddings", "lm_head", "embed_to
 NORM_PATTERNS = ("norm", "ln_", "layernorm", "layer_norm", "rmsnorm")
 
 
-def _path_str(path) -> str:
-    parts = []
-    for p in path:
-        if hasattr(p, "key"):
-            parts.append(str(p.key))
-        elif hasattr(p, "idx"):
-            parts.append(str(p.idx))
-        elif hasattr(p, "name"):
-            parts.append(str(p.name))
-        else:
-            parts.append(str(p))
-    return "/".join(parts).lower()
+from deepspeed_tpu.utils.pytree import path_str as _path_str  # shared renderer
 
 
 def _matches(name: str, patterns: Sequence[str]) -> bool:

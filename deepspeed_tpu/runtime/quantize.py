@@ -1,0 +1,74 @@
+"""MoQ — Mixture-of-Quantization (reference ``runtime/quantize.py``):
+training-time quantization whose precision ramps down on a schedule,
+optionally modulated per layer by Hessian eigenvalues (high-curvature layers
+quantize later)."""
+
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+
+from deepspeed_tpu.compression.transforms import fake_quantize
+from deepspeed_tpu.runtime.eigenvalue import quantize_period_scale
+
+
+class Quantizer:
+    """Reference Quantizer: start_bits → target_bits halving every
+    ``quantize_period`` steps; ``eigenvalues`` (per layer index) stretch each
+    layer's period by its normalized curvature."""
+
+    def __init__(
+        self,
+        q_start_bits: int = 16,
+        q_target_bits: int = 8,
+        q_period: int = 100,
+        q_offset: int = 0,
+        use_quantizer_kernel: bool = False,
+        eigenvalues: Optional[Dict[int, float]] = None,
+    ):
+        self.q_start_bits = q_start_bits
+        self.q_target_bits = q_target_bits
+        self.q_period = q_period
+        self.q_offset = q_offset
+        self.eigenvalues = eigenvalues
+        self._scales = quantize_period_scale(eigenvalues) if eigenvalues else None
+
+    def bits_for(self, step: int, layer: Optional[int] = None) -> int:
+        if step < self.q_offset:
+            return self.q_start_bits
+        period = self.q_period
+        if self._scales is not None and layer is not None:
+            period = int(self.q_period * (1.0 + self._scales.get(layer, 0.0)))
+        halvings = (step - self.q_offset) // max(period, 1)
+        bits = self.q_start_bits
+        for _ in range(halvings):
+            if bits <= self.q_target_bits:
+                break
+            bits = max(bits // 2, self.q_target_bits)
+        return bits
+
+    def quantize(self, params: Any, step: int, layers_key: str = "layers") -> Any:
+        """Fake-quantize params at the step's precision; stacked layer leaves
+        get per-layer bits when eigenvalues were provided."""
+        out = dict(params) if isinstance(params, dict) else params
+        if isinstance(params, dict) and layers_key in params and self._scales is not None:
+            L = jax.tree_util.tree_leaves(params[layers_key])[0].shape[0]
+            import jax.numpy as jnp
+
+            def per_layer(leaf):
+                rows = [
+                    fake_quantize(leaf[i], self.bits_for(step, i)) for i in range(L)
+                ]
+                return jnp.stack(rows)
+
+            out[layers_key] = jax.tree.map(per_layer, params[layers_key])
+            rest = {k: v for k, v in params.items() if k != layers_key}
+            bits = self.bits_for(step)
+            for k, v in rest.items():
+                out[k] = jax.tree.map(
+                    lambda w: fake_quantize(w, bits) if getattr(w, "ndim", 0) >= 2 else w, v
+                )
+            return out
+        bits = self.bits_for(step)
+        return jax.tree.map(
+            lambda w: fake_quantize(w, bits) if getattr(w, "ndim", 0) >= 2 else w, params
+        )

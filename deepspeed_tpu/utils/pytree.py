@@ -1,0 +1,32 @@
+"""Shared pytree-path helpers (used by AutoTP classification and the
+compression matchers)."""
+
+from typing import Sequence
+
+
+def path_str(path) -> str:
+    """'/'-joined, lowercased render of a tree_flatten_with_path key path."""
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts).lower()
+
+
+def segments_match(name: str, pattern: str) -> bool:
+    """Pattern matches when its '/'- or '.'-separated segments appear as a
+    CONTIGUOUS run of full segments in ``name`` — 'layer_1' matches
+    'layers/layer_1/w' but not 'layers/layer_10/w' (bare substring matching
+    silently over-matches numbered modules)."""
+    if pattern == "*":
+        return True
+    nsegs = name.lower().split("/")
+    psegs = pattern.lower().replace(".", "/").split("/")
+    n, m = len(nsegs), len(psegs)
+    return any(nsegs[i : i + m] == psegs for i in range(n - m + 1))

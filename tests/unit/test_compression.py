@@ -1,0 +1,237 @@
+"""Compression suite + OptimizedLinear/LoRA + MoQ/eigenvalue tests (analogue
+of reference tests/unit/compression + tests/unit/linear)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.compression import (
+    CompressionScheduler,
+    fake_quantize,
+    head_mask,
+    init_compression,
+    redundancy_clean,
+    reduce_layers,
+    row_mask,
+    sparse_mask,
+    sparsity,
+)
+from deepspeed_tpu.linear import (
+    LoRAConfig,
+    QuantizationConfig,
+    init_optimized_linear,
+    lora_trainable_mask,
+    merge_lora,
+    optimized_linear,
+)
+from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+from deepspeed_tpu.runtime.quantize import Quantizer
+
+
+# ---------------------------------------------------------------------------
+# transforms
+# ---------------------------------------------------------------------------
+class TestTransforms:
+    def test_fake_quantize_reduces_precision_monotonically(self):
+        w = jnp.asarray(np.random.default_rng(0).normal(size=(64, 64)), jnp.float32)
+        errs = []
+        for bits in (8, 4, 2):
+            err = float(jnp.mean(jnp.abs(fake_quantize(w, bits) - w)))
+            errs.append(err)
+        assert errs[0] < errs[1] < errs[2]
+
+    def test_fake_quantize_straight_through_gradient(self):
+        w = jnp.asarray(np.random.default_rng(0).normal(size=(16, 16)), jnp.float32)
+        g = jax.grad(lambda w: jnp.sum(fake_quantize(w, 4) * 2.0))(w)
+        np.testing.assert_allclose(np.asarray(g), 2.0)  # identity backward
+
+    def test_sparse_mask_ratio(self):
+        w = jnp.asarray(np.random.default_rng(0).normal(size=(32, 32)), jnp.float32)
+        m = sparse_mask(w, dense_ratio=0.25)
+        assert abs(float(m.mean()) - 0.25) < 0.02
+
+    def test_row_mask_structured(self):
+        w = jnp.concatenate([jnp.ones((8, 4)), jnp.full((8, 4), 1e-3)], axis=1)
+        m = row_mask(w, dense_ratio=0.5)
+        np.testing.assert_allclose(np.asarray(m[:, :4]), 1.0)
+        np.testing.assert_allclose(np.asarray(m[:, 4:]), 0.0)
+
+    def test_head_mask(self):
+        # 4 heads of d=4; heads 0/1 strong
+        w = jnp.concatenate(
+            [jnp.ones((8, 8)), jnp.full((8, 8), 1e-3)], axis=1
+        )  # [8, 16] = 4 heads x 4
+        m = head_mask(w, num_heads=4, dense_ratio=0.5)
+        np.testing.assert_allclose(np.asarray(m[:, :8]), 1.0)
+        np.testing.assert_allclose(np.asarray(m[:, 8:]), 0.0)
+
+    def test_reduce_layers(self):
+        params = {"layers": {"w": jnp.arange(8)[:, None] * jnp.ones((8, 3))}}
+        out = reduce_layers(params, [0, 3, 7])
+        np.testing.assert_allclose(np.asarray(out["layers"]["w"][:, 0]), [0, 3, 7])
+
+
+# ---------------------------------------------------------------------------
+# scheduler + entry points
+# ---------------------------------------------------------------------------
+CONFIG = {
+    "compression_training": {
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 10, "quantize_period": 5},
+            "different_groups": {
+                "wq1": {"params": {"start_bits": 8, "target_bits": 4}, "modules": ["layer_0"]}
+            },
+        },
+        "sparse_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 20},
+            "different_groups": {
+                "sp1": {"params": {"dense_ratio": 0.5}, "modules": ["layer_1"]}
+            },
+        },
+    }
+}
+
+
+def test_pattern_matching_is_segment_precise():
+    from deepspeed_tpu.compression.transforms import match_leaves
+
+    params = {f"layer_{i}": {"w": jnp.zeros((4, 4))} for i in (1, 10, 11)}
+    hits = {p[0].key for p, _ in match_leaves(params, ["layer_1"])}
+    assert hits == {"layer_1"}  # layer_10/11 NOT matched
+
+
+def test_head_pruning_without_heads_refuses():
+    cfg = {
+        "compression_training": {
+            "head_pruning": {
+                "shared_parameters": {"enabled": True, "schedule_offset": 0},
+                "different_groups": {"g": {"params": {"dense_ratio": 0.5}}},
+            }
+        }
+    }
+    with pytest.raises(ValueError, match="num_heads"):
+        init_compression({"w": jnp.zeros((4, 4))}, cfg)
+
+
+class TestCompressionPipeline:
+    def _params(self):
+        rng = np.random.default_rng(0)
+        return {
+            "layer_0": {"w": jnp.asarray(rng.normal(size=(32, 32)), jnp.float32)},
+            "layer_1": {"w": jnp.asarray(rng.normal(size=(32, 32)), jnp.float32)},
+        }
+
+    def test_schedule_gating(self):
+        params, sched, compress = init_compression(self._params(), CONFIG)
+        p5 = compress(params, step=5)  # nothing active yet
+        np.testing.assert_array_equal(np.asarray(p5["layer_0"]["w"]), np.asarray(params["layer_0"]["w"]))
+        p15 = compress(params, step=15)  # quantization active, pruning not
+        assert not np.allclose(np.asarray(p15["layer_0"]["w"]), np.asarray(params["layer_0"]["w"]))
+        np.testing.assert_array_equal(np.asarray(p15["layer_1"]["w"]), np.asarray(params["layer_1"]["w"]))
+        p25 = compress(params, step=25)  # both active; pruning zeros half
+        assert sparsity(p25, ["layer_1"]) == pytest.approx(0.5, abs=0.02)
+
+    def test_bits_ramp(self):
+        _, sched, _ = init_compression(self._params(), CONFIG)
+        wq = sched.techniques["weight_quantization"]
+        assert wq.bits_at(10) == 8
+        assert wq.bits_at(16) == 4  # one halving at +5
+        assert wq.bits_at(100) == 4  # floor at target
+
+    def test_redundancy_clean(self):
+        cleaned = redundancy_clean(self._params(), CONFIG)
+        assert sparsity(cleaned, ["layer_1"]) == pytest.approx(0.5, abs=0.02)
+
+    def test_compress_under_jit(self):
+        params, _, compress = init_compression(self._params(), CONFIG)
+        loss = jax.jit(lambda p: jnp.sum(compress(p, 25)["layer_1"]["w"] ** 2))(params)
+        assert np.isfinite(float(loss))
+
+
+# ---------------------------------------------------------------------------
+# OptimizedLinear / LoRA
+# ---------------------------------------------------------------------------
+class TestOptimizedLinear:
+    def test_adapters_start_as_identity(self):
+        p = init_optimized_linear(jax.random.key(0), 32, 16)
+        x = jnp.ones((4, 32))
+        base_out = x @ p["base"]["weight"]
+        np.testing.assert_allclose(
+            np.asarray(optimized_linear(p, x)), np.asarray(base_out), atol=1e-6
+        )
+
+    def test_quantized_base_close(self):
+        q = QuantizationConfig(q_bits=8, group_size=128)
+        key = jax.random.key(0)
+        w = jax.random.normal(key, (64, 32)) * 0.1
+        p = init_optimized_linear(key, 64, 32, quant=q, base_weight=w)
+        assert "values" in p["base"] and p["base"]["values"].dtype == jnp.int8
+        x = jax.random.normal(jax.random.key(1), (4, 64))
+        np.testing.assert_allclose(
+            np.asarray(optimized_linear(p, x, quant=q)),
+            np.asarray(x @ w),
+            atol=0.05,
+        )
+
+    def test_base_frozen_lora_trains(self):
+        lora = LoRAConfig(lora_r=4, lora_alpha=8)
+        p = init_optimized_linear(jax.random.key(0), 16, 8, lora=lora)
+
+        def loss(p, x):
+            return jnp.sum(optimized_linear(p, x, lora) ** 2)
+
+        g = jax.grad(loss)(p, jnp.ones((2, 16)))
+        np.testing.assert_allclose(np.asarray(g["base"]["weight"]), 0.0)  # frozen
+        # at init lora_b is zero so lora_a's grad vanishes; b gets gradient
+        assert float(jnp.abs(g["lora_b"]).sum()) > 0
+        mask = lora_trainable_mask(p)
+        assert mask["lora_a"] is True and mask["base"]["weight"] is False
+
+    def test_merge_lora(self):
+        lora = LoRAConfig(lora_r=4, lora_alpha=8)
+        p = init_optimized_linear(jax.random.key(0), 16, 8, lora=lora)
+        p["lora_b"] = jnp.ones_like(p["lora_b"]) * 0.1
+        x = jax.random.normal(jax.random.key(1), (4, 16))
+        np.testing.assert_allclose(
+            np.asarray(x @ merge_lora(p, lora)),
+            np.asarray(optimized_linear(p, x, lora)),
+            atol=1e-5,
+        )
+
+
+# ---------------------------------------------------------------------------
+# eigenvalue + MoQ
+# ---------------------------------------------------------------------------
+class TestEigenvalueMoQ:
+    def test_power_iteration_on_quadratic(self):
+        # loss = 0.5 x^T A x with known top eigenvalue
+        evals = jnp.asarray([5.0, 2.0, 1.0])
+        A = jnp.diag(evals)
+        loss = lambda x: 0.5 * x @ A @ x
+        eig = Eigenvalue(max_iter=50, tol=1e-4).compute_eigenvalue(loss, jnp.ones(3))
+        assert eig == pytest.approx(5.0, rel=0.05)
+
+    def test_moq_bits_schedule(self):
+        q = Quantizer(q_start_bits=16, q_target_bits=4, q_period=10, q_offset=0)
+        assert q.bits_for(0) == 16
+        assert q.bits_for(10) == 8
+        assert q.bits_for(20) == 4
+        assert q.bits_for(1000) == 4
+
+    def test_moq_eigenvalue_stretches_period(self):
+        q = Quantizer(
+            q_start_bits=16, q_target_bits=4, q_period=10,
+            eigenvalues={0: 10.0, 1: 1.0},
+        )
+        # layer 0 (max curvature): period 20; layer 1: period 11 — at step 22
+        # layer 0 has halved once, layer 1 twice
+        assert q.bits_for(22, layer=0) == 8
+        assert q.bits_for(22, layer=1) == 4
+
+    def test_moq_quantize_params(self):
+        q = Quantizer(q_start_bits=8, q_target_bits=4, q_period=1)
+        params = {"w": jnp.asarray(np.random.default_rng(0).normal(size=(16, 16)), jnp.float32)}
+        out = q.quantize(params, step=100)
+        assert not np.allclose(np.asarray(out["w"]), np.asarray(params["w"]))
