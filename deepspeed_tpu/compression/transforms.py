@@ -29,11 +29,30 @@ def match_leaves(params: Any, patterns: Sequence[str]) -> List[Tuple[tuple, Any]
     return out
 
 
-def _apply_to_matched(params, patterns, leaf_fn):
+# norms/biases/embedding gathers are not matmul weights: the reference scopes
+# techniques to Linear modules; the catch-all '*' group must not QAT-distort
+# normalization scales (they are also what stacked [L, h] leaves mostly are)
+NON_WEIGHT_PATTERNS = ("norm", "bias", "ln_", "layernorm", "embed", "pos_embed")
+
+
+def _is_weight_leaf(name: str, leaf) -> bool:
+    if getattr(leaf, "ndim", 0) < 2:
+        return False
+    last = name.rsplit("/", 1)[-1]
+    return not any(p in last for p in NON_WEIGHT_PATTERNS)
+
+
+def _apply_to_matched(params, patterns, leaf_fn, weights_only: bool = True):
+    from deepspeed_tpu.utils.pytree import path_str
+
     matched_paths = {tuple(p) for p, _ in match_leaves(params, patterns)}
 
     def visit(path, leaf):
-        return leaf_fn(leaf) if tuple(path) in matched_paths else leaf
+        if tuple(path) not in matched_paths:
+            return leaf
+        if weights_only and not _is_weight_leaf(path_str(path), leaf):
+            return leaf
+        return leaf_fn(leaf)
 
     return jax.tree_util.tree_map_with_path(visit, params)
 
@@ -44,16 +63,20 @@ def _apply_to_matched(params, patterns, leaf_fn):
 def fake_quantize(x: jax.Array, bits: int, symmetric: bool = True) -> jax.Array:
     """Quantize-dequantize at ``bits`` (reference LinearLayer_Compress weight
     quantization forward): straight-through in backward (the round is wrapped
-    in a stop-gradient identity)."""
+    in a stop-gradient identity). Leading dims beyond the last two (stacked
+    layers / experts) get their OWN scales — one global absmax across a
+    [L, in, out] stack would let one hot layer crush the others' precision."""
     levels = 2.0 ** (bits - 1) - 1 if symmetric else 2.0**bits - 1
     xf = x.astype(jnp.float32)
+    reduce_axes = tuple(range(max(xf.ndim - 2, 0), xf.ndim))  # last two dims
     if symmetric:
-        scale = jnp.max(jnp.abs(xf)) / levels
+        scale = jnp.max(jnp.abs(xf), axis=reduce_axes, keepdims=True) / levels
         scale = jnp.maximum(scale, 1e-12)
         q = jnp.round(xf / scale)
         deq = jnp.clip(q, -levels, levels) * scale
     else:
-        lo, hi = jnp.min(xf), jnp.max(xf)
+        lo = jnp.min(xf, axis=reduce_axes, keepdims=True)
+        hi = jnp.max(xf, axis=reduce_axes, keepdims=True)
         scale = jnp.maximum((hi - lo) / levels, 1e-12)
         q = jnp.round((xf - lo) / scale)
         deq = jnp.clip(q, 0, levels) * scale + lo
@@ -75,35 +98,42 @@ def quantize_activation(x: jax.Array, bits: int, range_calibration: str = "dynam
 # ---------------------------------------------------------------------------
 def sparse_mask(w: jax.Array, dense_ratio: float, method: str = "l1") -> jax.Array:
     """Unstructured magnitude mask keeping the top ``dense_ratio`` fraction
-    (reference sparse_pruning l1/topk)."""
-    k = max(int(w.size * dense_ratio), 1)
-    flat = jnp.abs(w.astype(jnp.float32)).reshape(-1)
-    thresh = jnp.sort(flat)[-k]
-    return (jnp.abs(w.astype(jnp.float32)) >= thresh).astype(w.dtype)
+    per matrix (reference sparse_pruning l1/topk); stacked leading dims each
+    threshold independently."""
+    lead = w.shape[:-2] if w.ndim > 2 else ()
+    a = jnp.abs(w.astype(jnp.float32)).reshape(lead + (-1,))
+    k = max(int(a.shape[-1] * dense_ratio), 1)
+    thresh = jnp.sort(a, axis=-1)[..., -k][..., None]
+    return (a >= thresh).reshape(w.shape).astype(w.dtype)
 
 
 def row_mask(w: jax.Array, dense_ratio: float) -> jax.Array:
-    """Structured row mask by L2 norm ([in, out]: prune OUTPUT rows — the
-    reference prunes nn.Linear rows, i.e. output features)."""
-    norms = jnp.linalg.norm(w.astype(jnp.float32), axis=0)
-    k = max(int(norms.size * dense_ratio), 1)
-    thresh = jnp.sort(norms)[-k]
-    return jnp.broadcast_to((norms >= thresh).astype(w.dtype), w.shape)
+    """Structured row mask by L2 norm over the last two dims ([.., in, out]:
+    prune OUTPUT features — reference nn.Linear rows). Leading dims (stacked
+    layers) each get their own mask."""
+    norms = jnp.linalg.norm(w.astype(jnp.float32), axis=-2)  # [.., out]
+    k = max(int(norms.shape[-1] * dense_ratio), 1)
+    thresh = jnp.sort(norms, axis=-1)[..., -k][..., None]
+    keep = (norms >= thresh).astype(w.dtype)  # [.., out]
+    return jnp.broadcast_to(keep[..., None, :], w.shape)
 
 
 def head_mask(w: jax.Array, num_heads: int, dense_ratio: float) -> jax.Array:
-    """Attention-head mask: [in, H*d] weights pruned per head by L2 norm
-    (reference head_pruning on the attention output projection)."""
+    """Attention-head mask: [.., in, H*d] weights pruned per head by L2 norm
+    (reference head_pruning on the attention output projection); stacked
+    leading dims get independent per-layer masks."""
     in_dim, out_dim = w.shape[-2], w.shape[-1]
     assert out_dim % num_heads == 0, f"out dim {out_dim} not divisible by heads {num_heads}"
     d = out_dim // num_heads
+    lead = w.shape[:-2]
     per_head = jnp.linalg.norm(
-        w.astype(jnp.float32).reshape(-1, num_heads, d), axis=(0, 2)
-    )
+        w.astype(jnp.float32).reshape(lead + (in_dim, num_heads, d)), axis=(-3, -1)
+    )  # [.., H]
     k = max(int(num_heads * dense_ratio), 1)
-    thresh = jnp.sort(per_head)[-k]
-    keep = (per_head >= thresh).astype(w.dtype)  # [H]
-    return jnp.broadcast_to(jnp.repeat(keep, d), w.shape)
+    thresh = jnp.sort(per_head, axis=-1)[..., -k][..., None]
+    keep = (per_head >= thresh).astype(w.dtype)  # [.., H]
+    keep = jnp.repeat(keep, d, axis=-1)  # [.., H*d]
+    return jnp.broadcast_to(keep[..., None, :], w.shape)
 
 
 def prune_weights(params, patterns, dense_ratio, method: str = "sparse", num_heads: int = 0):

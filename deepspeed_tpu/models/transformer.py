@@ -77,11 +77,22 @@ class TransformerConfig:
     moe_capacity_factor: float = 1.25
     moe_aux_loss_coef: float = 0.01
     vocab_parallel: bool = True  # shard embedding/lm_head vocab dim on `model`
+    # sequence-parallel attention: "ulysses" (all-to-all head scatter) or
+    # "ring" (ppermute blockwise — O(s/N) per-device memory, unbounded SP
+    # degree; no segment_ids support)
+    seq_impl: str = "ulysses"
     # >1: compute the LM loss per sequence tile so [b, s, vocab] logits never
     # materialize (ALST TiledFusedLogitsLoss, ulysses_sp.py:960) — frees
     # ~b*s*vocab bytes of activations at the cost of recomputing the head
     # matmul in backward (~1pp MFU at 32k vocab); enable when memory-bound
     loss_tiles: int = 0
+
+    def __post_init__(self):
+        if self.seq_impl not in ("ulysses", "ring"):
+            raise ValueError(
+                f"seq_impl={self.seq_impl!r}: expected 'ulysses' or 'ring' "
+                "(a typo would silently fall back to the wrong parallelism)"
+            )
 
     @property
     def kv_heads(self) -> int:
@@ -298,9 +309,14 @@ def _attention_block(c: TransformerConfig, lp, x, positions, segment_ids, kv_cac
     else:
         topo = get_topology()
         if topo.sequence_parallel_size > 1:
-            from deepspeed_tpu.parallel.sequence import ulysses_attention
+            if c.seq_impl == "ring":
+                from deepspeed_tpu.parallel.sequence import ring_attention
 
-            out = ulysses_attention(q, k, v, causal=True, segment_ids=segment_ids)
+                out = ring_attention(q, k, v, causal=True, segment_ids=segment_ids)
+            else:
+                from deepspeed_tpu.parallel.sequence import ulysses_attention
+
+                out = ulysses_attention(q, k, v, causal=True, segment_ids=segment_ids)
         else:
             out = attention_op(q, k, v, causal=True, segment_ids=segment_ids)
     out = out.transpose(0, 2, 1, 3).reshape(b, s, nh * d)

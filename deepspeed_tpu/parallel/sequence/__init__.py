@@ -21,6 +21,8 @@ from deepspeed_tpu.parallel.sequence.ulysses import (
     ulysses_attention,
     shard_batch_along_sequence,
 )
+from deepspeed_tpu.parallel.sequence.fpdt import fpdt_attention
+from deepspeed_tpu.parallel.sequence.ring import ring_attention, ring_attention_local
 from deepspeed_tpu.parallel.sequence.tiled import (
     tiled_compute,
     tiled_mlp,
@@ -29,6 +31,9 @@ from deepspeed_tpu.parallel.sequence.tiled import (
 
 __all__ = [
     "UlyssesAttention",
+    "fpdt_attention",
+    "ring_attention",
+    "ring_attention_local",
     "ulysses_attention",
     "shard_batch_along_sequence",
     "tiled_compute",

@@ -1,0 +1,149 @@
+"""Ring attention: blockwise sequence parallelism over ICI neighbor exchange.
+
+The long-context alternative to Ulysses (SURVEY §5: "ring/blockwise attention
+as a Pallas kernel alternative"): Ulysses gathers the FULL sequence onto each
+device for its head shard — per-device memory stays O(s) and the head count
+caps the parallelism. Ring attention keeps q/k/v sequence-sharded the whole
+time: each device computes online-softmax attention of its q shard against
+one k/v shard at a time while k/v shards rotate around the ring
+(``ppermute``), so per-device memory is O(s/N) and seq-parallel degree is
+unbounded by heads. Compute-communication overlap comes from XLA scheduling
+the next shard's ppermute against the current block's attention.
+
+Causal masking by block index: ring step t on device i holds the k/v shard
+originating at ``src = (i - t) mod N``; the whole block is visible when
+src < i, masked out when src > i, and diagonal (src == i) applies the local
+causal mask. Backward is reverse-mode AD through the scan + ppermute (the
+gradient ring runs in the transposed direction automatically).
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel.topology import SEQUENCE_AXIS, get_topology
+
+NEG_INF = -1e30
+
+
+def _local_attention_stats(q, k, v, bias, scale=None):
+    """One block's contribution: returns (out_unnormalized, m, l) for online
+    merging. q: [b, h, sq, d]; k/v: [b, hk, sk, d]; bias: [sq, sk]."""
+    b, h, sq, d = q.shape
+    hk = k.shape[1]
+    group = h // hk
+    k = jnp.repeat(k, group, axis=1) if group > 1 else k
+    v = jnp.repeat(v, group, axis=1) if group > 1 else v
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * (scale if scale is not None else d**-0.5)
+    scores = scores + bias[None, None]
+    m = jnp.max(scores, axis=-1)  # [b, h, sq]
+    p = jnp.exp(scores - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return out, m, l
+
+
+def block_causal_bias(sq, src, i, diag_bias, zero_bias, full_mask):
+    """Three-way causal block bias: fully visible (src < i), diagonal causal
+    (src == i), fully masked (src > i). Shared by the ring loop and FPDT's
+    chunk loop so the masks cannot drift."""
+    return jnp.where(
+        (src == i)[None, None],
+        diag_bias,
+        jnp.where((src < i)[None, None], zero_bias, full_mask),
+    )
+
+
+def make_block_biases(sq):
+    local_pos = jnp.arange(sq)
+    diag = jnp.where(local_pos[:, None] >= local_pos[None, :], 0.0, NEG_INF).astype(jnp.float32)
+    return diag, jnp.zeros((sq, sq), jnp.float32), jnp.full((sq, sq), NEG_INF, jnp.float32)
+
+
+def ring_attention_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = SEQUENCE_AXIS,
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """The ring loop — call INSIDE shard_map over ``axis_name`` with
+    sequence-sharded [b, h, s/N, d] blocks. Returns the local output block."""
+    N = jax.lax.axis_size(axis_name)
+    i = jax.lax.axis_index(axis_name)
+    b, h, sq, d = q.shape
+    perm = [(r, (r + 1) % N) for r in range(N)]  # kv blocks flow forward
+
+    q32 = q.astype(jnp.float32)
+    diag_bias, zero_bias, full_mask = make_block_biases(sq)
+
+    def step(carry, t):
+        k_cur, v_cur, acc, m_run, l_run = carry
+        src = (i - t) % N  # origin shard of the current k/v block
+        if causal:
+            bias = block_causal_bias(sq, src, i, diag_bias, zero_bias, full_mask)
+        else:
+            bias = zero_bias
+        out_b, m_b, l_b = _local_attention_stats(q32, k_cur, v_cur, bias, scale)
+        # online merge (flash-style)
+        m_new = jnp.maximum(m_run, m_b)
+        alpha = jnp.exp(m_run - m_new)
+        beta = jnp.exp(m_b - m_new)
+        acc = acc * alpha[..., None] + out_b * beta[..., None]
+        l_run = l_run * alpha + l_b * beta
+        m_run = m_new
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, acc, m_run, l_run), None
+
+    acc0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    m0 = jnp.full((b, h, sq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    (k_f, v_f, acc, m_run, l_run), _ = jax.lax.scan(
+        step, (k, v, acc0, m0, l0), jnp.arange(N)
+    )
+    out = acc / jnp.maximum(l_run[..., None], 1e-30)
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Drop-in for ``ulysses_attention``: inputs logically [b, h, s, d] with
+    s sharded over ``sequence``; output in the same layout. Falls back to the
+    plain attention op when the sequence axis is trivial."""
+    from deepspeed_tpu.ops.attention import attention as attention_op
+
+    topo = get_topology()
+    sp = topo.sequence_parallel_size
+    if sp <= 1:
+        return attention_op(q, k, v, causal=causal, segment_ids=segment_ids, scale=scale)
+    if segment_ids is not None:
+        # packed sequences span shard boundaries; the block mask would need
+        # per-position segment exchange — use Ulysses for packed batches
+        raise NotImplementedError("ring attention does not support segment_ids; use Ulysses")
+    assert q.shape[2] % sp == 0, f"seq {q.shape[2]} not divisible by sequence axis {sp}"
+
+    # manual over `sequence` only: specs may not reference auto axes — the
+    # batch dim stays under GSPMD (data/expert sharding preserved around the
+    # manual region)
+    spec = P(None, None, SEQUENCE_AXIS, None)
+    fn = jax.shard_map(
+        lambda q_, k_, v_: ring_attention_local(q_, k_, v_, SEQUENCE_AXIS, causal, scale),
+        mesh=topo.mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        axis_names={SEQUENCE_AXIS},
+        check_vma=False,
+    )
+    return fn(q, k, v)

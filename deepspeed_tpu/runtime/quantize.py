@@ -47,28 +47,42 @@ class Quantizer:
         return bits
 
     def quantize(self, params: Any, step: int, layers_key: str = "layers") -> Any:
-        """Fake-quantize params at the step's precision; stacked layer leaves
-        get per-layer bits when eigenvalues were provided."""
-        out = dict(params) if isinstance(params, dict) else params
+        """Fake-quantize the matmul WEIGHTS at the step's precision (norm
+        scales/biases/embeddings are excluded by name, like the compression
+        transforms); stacked layer leaves get per-layer bits when eigenvalues
+        were provided."""
+        from deepspeed_tpu.compression.transforms import _is_weight_leaf
+        from deepspeed_tpu.utils.pytree import path_str
+
+        def visit_with(bits_of):
+            def visit(path, w):
+                if not _is_weight_leaf(path_str(path), w):
+                    return w
+                return bits_of(path, w)
+
+            return visit
+
         if isinstance(params, dict) and layers_key in params and self._scales is not None:
-            L = jax.tree_util.tree_leaves(params[layers_key])[0].shape[0]
             import jax.numpy as jnp
 
-            def per_layer(leaf):
-                rows = [
-                    fake_quantize(leaf[i], self.bits_for(step, i)) for i in range(L)
-                ]
+            L = jax.tree_util.tree_leaves(params[layers_key])[0].shape[0]
+            out = dict(params)
+
+            def per_layer(path, leaf):
+                rows = [fake_quantize(leaf[i], self.bits_for(step, i)) for i in range(L)]
                 return jnp.stack(rows)
 
-            out[layers_key] = jax.tree.map(per_layer, params[layers_key])
-            rest = {k: v for k, v in params.items() if k != layers_key}
+            out[layers_key] = jax.tree_util.tree_map_with_path(
+                visit_with(per_layer), params[layers_key]
+            )
             bits = self.bits_for(step)
-            for k, v in rest.items():
-                out[k] = jax.tree.map(
-                    lambda w: fake_quantize(w, bits) if getattr(w, "ndim", 0) >= 2 else w, v
-                )
+            for k, v in params.items():
+                if k != layers_key:
+                    out[k] = jax.tree_util.tree_map_with_path(
+                        visit_with(lambda p, w: fake_quantize(w, bits)), v
+                    )
             return out
         bits = self.bits_for(step)
-        return jax.tree.map(
-            lambda w: fake_quantize(w, bits) if getattr(w, "ndim", 0) >= 2 else w, params
+        return jax.tree_util.tree_map_with_path(
+            visit_with(lambda p, w: fake_quantize(w, bits)), params
         )
