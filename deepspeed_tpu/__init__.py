@@ -114,6 +114,21 @@ def initialize(
         collate_fn=collate_fn,
         param_specs=param_specs,
     )
+
+    # hybrid engine (reference __init__.py:190): train↔generate on one copy
+    hy = raw.get("hybrid_engine", {}) or {}
+    if hy.get("enabled"):
+        model_config = getattr(model, "model_config", None)
+        if model_config is None:
+            raise ValueError(
+                "hybrid_engine requires a model with a known architecture: use "
+                "make_loss_fn(config) (which carries .model_config) or build "
+                "DeepSpeedHybridEngine directly with your TransformerConfig"
+            )
+        from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngine
+
+        engine = DeepSpeedHybridEngine(engine, model_config, hy)
+        return engine, engine.engine.optimizer, engine.engine.training_dataloader, engine.engine.lr_scheduler
     return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
 
 

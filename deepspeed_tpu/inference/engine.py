@@ -49,7 +49,14 @@ class InferenceEngine:
     (config, params).
     """
 
-    def __init__(self, model, config: DeepSpeedInferenceConfig, params: Any = None, topology: Optional[Topology] = None):
+    def __init__(
+        self,
+        model,
+        config: DeepSpeedInferenceConfig,
+        params: Any = None,
+        topology: Optional[Topology] = None,
+        cast_params: bool = True,
+    ):
         if isinstance(model, tuple):
             self.model_config, params = model
         else:
@@ -60,10 +67,11 @@ class InferenceEngine:
         self.topo = topology or (get_topology() if tp <= 1 else Topology(model=tp, data=0))
         set_topology(self.topo)
 
-        dtype = T.DTYPES.get(config.dtype, jnp.bfloat16)
-        params = jax.tree.map(
-            lambda p: p.astype(dtype) if jnp.issubdtype(p.dtype, jnp.floating) else p, params
-        )
+        if cast_params:  # hybrid engine shares the training arrays: no copy
+            dtype = T.DTYPES.get(config.dtype, jnp.bfloat16)
+            params = jax.tree.map(
+                lambda p: p.astype(dtype) if jnp.issubdtype(p.dtype, jnp.floating) else p, params
+            )
         # TP placement (the AutoTP/injection analogue)
         if self.topo.model_parallel_size > 1:
             specs = T.param_partition_specs(self.model_config)

@@ -534,6 +534,9 @@ def make_loss_fn(config: TransformerConfig):
             loss = nll_loss(logits, labels, mask)
         return loss + config.moe_aux_loss_coef * aux if config.n_experts > 0 else loss
 
+    # the hybrid engine (train↔generate) recovers the architecture from the
+    # loss fn — deepspeed.initialize only ever sees this callable
+    loss_fn.model_config = config
     return loss_fn
 
 

@@ -1,0 +1,175 @@
+"""Hybrid engine: train ↔ generate on ONE copy of the weights (RLHF).
+
+Analogue of the reference ``DeepSpeedHybridEngine`` (runtime/hybrid_engine.py
+:30, selected by ``deepspeed.initialize`` when the ``hybrid_engine`` config
+section enables it): DeepSpeed-Chat's actor trains under ZeRO-3 and
+generates rollouts with inference kernels, without duplicating parameters —
+the reference choreographs ZeRO gather/release and module swapping around
+``generate()``.
+
+TPU-native form: the training params ARE the inference params — one sharded
+pytree. ``generate()`` rebinds the inference engine to the live training
+arrays (zero copy; decode runs at the training precision, and GSPMD inserts
+whatever gathers decode needs over the ZeRO/TP shardings). The reference's
+gather/release hook choreography and CUDA-graph capture have no hand-written
+counterpart — XLA owns both.
+
+LoRA: when the params contain OptimizedLinear nodes, ``generate()`` fuses
+the adapters into the dense base for the rollout and unfuses after
+(reference fuse_lora_weight :117 / unfuse_lora_weight :125). Fusion is
+structure-preserving — the base absorbs A@B and the adapters zero — so
+compiled train/eval functions stay valid.
+"""
+
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.linear.optimized_linear import LoRAConfig, merge_lora
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+# wrapper-own attributes; everything else get/sets through to the inner
+# engine (a write landing on the wrapper would silently desynchronize
+# training state from generation state)
+_OWN_ATTRS = frozenset(
+    {
+        "engine", "model_config", "_hybrid_cfg", "_lora_alpha", "_infer",
+        "_fused_backup", "_generate_latency", "_generate_calls",
+    }
+)
+
+
+def _is_lora_node(node) -> bool:
+    return isinstance(node, dict) and {"base", "lora_a", "lora_b"} <= set(node.keys())
+
+
+class DeepSpeedHybridEngine:
+    """Wraps a training :class:`DeepSpeedEngine`; everything not defined here
+    (train_batch/backward/step/checkpointing/...) passes through — reads AND
+    writes."""
+
+    def __init__(self, engine, model_config, hybrid_config: Optional[Dict[str, Any]] = None):
+        object.__setattr__(self, "engine", engine)
+        object.__setattr__(self, "model_config", model_config)
+        hc = dict(hybrid_config or {})
+        object.__setattr__(self, "_hybrid_cfg", hc)
+        # per-node LoRA rank is derived from lora_a's shape at fuse time;
+        # only alpha must come from config (it is not recoverable from shapes)
+        object.__setattr__(self, "_lora_alpha", hc.get("lora", {}).get("lora_alpha"))
+        object.__setattr__(self, "_infer", None)  # built lazily: no init-time copy
+        object.__setattr__(self, "_fused_backup", None)
+        object.__setattr__(self, "_generate_latency", 0.0)
+        object.__setattr__(self, "_generate_calls", 0)
+        log_dist("DeepSpeedHybridEngine: train/generate share one weight copy", ranks=[0])
+
+    # -- training passthrough ------------------------------------------------
+    def __getattr__(self, name):
+        return getattr(self.engine, name)
+
+    def __setattr__(self, name, value):
+        if name in _OWN_ATTRS:
+            object.__setattr__(self, name, value)
+        else:
+            setattr(self.engine, name, value)
+
+    def _inference_engine(self):
+        if self._infer is None:
+            from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+            from deepspeed_tpu.inference.engine import InferenceEngine
+
+            inf_cfg = DeepSpeedInferenceConfig.from_dict(
+                {
+                    # decode at the TRAINING precision: the shared arrays are
+                    # the compute-dtype params (fp32 master is optimizer state)
+                    "dtype": self.engine.config.precision_dtype,
+                    "max_out_tokens": self._hybrid_cfg.get("max_out_tokens", 512),
+                }
+            )
+            infer = InferenceEngine(
+                self.model_config, inf_cfg, params=self.engine.params,
+                topology=self.engine.topo, cast_params=False,
+            )
+            object.__setattr__(self, "_infer", infer)
+        return self._infer
+
+    # -- generation ----------------------------------------------------------
+    def generate(self, input_ids, **kwargs):
+        """Rollout generation on the CURRENT training weights (reference
+        generate path with gather choreography — here a rebind). LoRA
+        adapters fuse for the rollout and unfuse after."""
+        t0 = time.perf_counter()
+        fused_here = self.fuse_lora_weight()
+        try:
+            infer = self._inference_engine()
+            infer.params = self.engine.params  # live weights, zero copy
+            out = infer.generate(input_ids, **kwargs)
+        finally:
+            if fused_here:
+                self.unfuse_lora_weight()
+        object.__setattr__(self, "_generate_latency", self._generate_latency + time.perf_counter() - t0)
+        object.__setattr__(self, "_generate_calls", self._generate_calls + 1)
+        return out
+
+    def eval(self):
+        self.engine.eval()
+        return self
+
+    def train(self, mode: bool = True):
+        self.engine.train(mode)
+        return self
+
+    # -- LoRA fuse/unfuse (reference :117/:125) -------------------------------
+    def fuse_lora_weight(self) -> bool:
+        """Fold OptimizedLinear adapters into their dense base —
+        structure-preserving (adapters zero out, tree shape unchanged, jits
+        stay valid). Returns True if anything fused. No-op without LoRA
+        nodes; refuses quantized bases (folding would need requantization)."""
+        if self._fused_backup is not None:
+            return False  # already fused
+        params = self.engine.params
+        found = []
+
+        def fuse(node):
+            if not _is_lora_node(node):
+                return node
+            if "weight" not in node["base"]:
+                raise NotImplementedError(
+                    "fuse_lora_weight with an int8-quantized base would require "
+                    "requantization; dequantize the base first"
+                )
+            r = node["lora_a"].shape[1]
+            alpha = self._lora_alpha if self._lora_alpha is not None else float(r)
+            if self._lora_alpha is None:
+                logger.warning(
+                    "hybrid_engine.lora.lora_alpha not configured: fusing with "
+                    f"alpha=r={r} (scale 1.0) — set it if your adapters used another alpha"
+                )
+            cfg = LoRAConfig(lora_r=r, lora_alpha=alpha)
+            found.append(True)
+            return {
+                "base": {"weight": merge_lora(node, cfg)},
+                "lora_a": jnp.zeros_like(node["lora_a"]),
+                "lora_b": jnp.zeros_like(node["lora_b"]),
+            }
+
+        fused = jax.tree_util.tree_map(fuse, params, is_leaf=_is_lora_node)
+        if found:
+            object.__setattr__(self, "_fused_backup", params)
+            self.engine.params = fused
+            return True
+        return False
+
+    def unfuse_lora_weight(self):
+        """Restore the unfused adapters after generation."""
+        if self._fused_backup is not None:
+            self.engine.params = self._fused_backup
+            object.__setattr__(self, "_fused_backup", None)
+
+    # -- stats (reference latency accounting) ---------------------------------
+    def generate_latency(self) -> float:
+        return self._generate_latency
+
+    def generate_call_count(self) -> int:
+        return self._generate_calls
