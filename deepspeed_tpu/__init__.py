@@ -96,6 +96,30 @@ def initialize(
         pp = raw.get("pipeline", {}).get("stages", 1)
         if pp > 1 and "pipe" not in mc:
             mc["pipe"] = pp
+        # MiCS/hpZ shard-group axis: factorize data parallelism into
+        # (data=groups, zero=shard-group) so ZeRO can partition within a group
+        zc = raw.get("zero_optimization", {}) or {}
+        mics = int(zc.get("mics_shard_size", -1) or -1)
+        hpz = int(zc.get("zero_hpz_partition_size", 1) or 1)
+        if mics > 0 and hpz > 1 and mics != hpz:
+            raise ValueError(
+                f"mics_shard_size={mics} and zero_hpz_partition_size={hpz} conflict: "
+                "they would need different shard-group sizes — configure one"
+            )
+        shard = mics if mics > 0 else hpz
+        if shard > 1:
+            if "zero" in mc and mc["zero"] != shard:
+                raise ValueError(
+                    f"mesh zero={mc['zero']} does not match the configured "
+                    f"shard-group size {shard}"
+                )
+            mc["zero"] = shard
+            if mc.get("data"):
+                if mc["data"] % shard:
+                    raise ValueError(
+                        f"mesh data={mc['data']} not divisible by shard-group size {shard}"
+                    )
+                mc["data"] = mc["data"] // shard
         init_distributed(distributed_port=distributed_port, mesh_config=mc or None)
         topo = get_topology()
 

@@ -72,8 +72,9 @@ class InferenceEngine:
             params = jax.tree.map(
                 lambda p: p.astype(dtype) if jnp.issubdtype(p.dtype, jnp.floating) else p, params
             )
-        # TP placement (the AutoTP/injection analogue)
-        if self.topo.model_parallel_size > 1:
+        # TP placement (the AutoTP/injection analogue) — skipped for shared
+        # (hybrid-engine) params, which already carry the training shardings
+        if cast_params and self.topo.model_parallel_size > 1:
             specs = T.param_partition_specs(self.model_config)
             shardings = jax.tree.map(
                 lambda s: jax.sharding.NamedSharding(self.topo.mesh, s),

@@ -26,18 +26,24 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-# Canonical axis names, outermost first.
+# Canonical axis names, outermost first. The ``zero`` axis factorizes data
+# parallelism for MiCS/hpZ hierarchical partitioning (reference
+# runtime/zero/mics.py, groups.py:702 _create_zero_param_parallel_group):
+# it sits INSIDE ``data`` so shard groups are ICI-contiguous — ZeRO can
+# partition over only ``zero`` (shard group) while gradients still average
+# over the full data x zero x expert batch.
 PIPE_AXIS = "pipe"
 DATA_AXIS = "data"
+ZERO_AXIS = "zero"
 EXPERT_AXIS = "expert"
 SEQUENCE_AXIS = "sequence"
 MODEL_AXIS = "model"
-MESH_AXES = (PIPE_AXIS, DATA_AXIS, EXPERT_AXIS, SEQUENCE_AXIS, MODEL_AXIS)
+MESH_AXES = (PIPE_AXIS, DATA_AXIS, ZERO_AXIS, EXPERT_AXIS, SEQUENCE_AXIS, MODEL_AXIS)
 
 # Axis set that jointly shards the batch dimension (DP world).
-BATCH_AXES = (DATA_AXIS, EXPERT_AXIS)
-# Axis that ZeRO partitions parameters/optimizer state over.
-ZERO_AXES = (DATA_AXIS,)
+BATCH_AXES = (DATA_AXIS, ZERO_AXIS, EXPERT_AXIS)
+# Axes that ZeRO partitions parameters/optimizer state over (full dp).
+ZERO_AXES = (DATA_AXIS, ZERO_AXIS)
 
 
 class Topology:
@@ -50,26 +56,28 @@ class Topology:
         pipe: int = 1,
         sequence: int = 1,
         expert: int = 1,
+        zero: int = 1,
         devices: Optional[Sequence] = None,
     ):
         if devices is None:
             devices = jax.devices()
         n = len(devices)
-        fixed = model * pipe * sequence * expert
+        fixed = model * pipe * sequence * expert * zero
         if n % fixed != 0:
             raise ValueError(
-                f"device count {n} not divisible by model*pipe*sequence*expert={fixed}"
+                f"device count {n} not divisible by model*pipe*sequence*expert*zero={fixed}"
             )
         if data in (0, None):
             data = n // fixed
         if data * fixed != n:
             raise ValueError(
-                f"mesh sizes pipe={pipe} data={data} expert={expert} sequence={sequence} "
-                f"model={model} do not multiply to device count {n}"
+                f"mesh sizes pipe={pipe} data={data} zero={zero} expert={expert} "
+                f"sequence={sequence} model={model} do not multiply to device count {n}"
             )
         self.sizes = {
             PIPE_AXIS: pipe,
             DATA_AXIS: data,
+            ZERO_AXIS: zero,
             EXPERT_AXIS: expert,
             SEQUENCE_AXIS: sequence,
             MODEL_AXIS: model,
@@ -88,12 +96,18 @@ class Topology:
 
     @property
     def dp_world_size(self) -> int:
-        """Data-parallel world (batch shards): data × expert axes."""
-        return self.sizes[DATA_AXIS] * self.sizes[EXPERT_AXIS]
+        """Data-parallel world (batch shards): data × zero × expert axes."""
+        return self.sizes[DATA_AXIS] * self.sizes[ZERO_AXIS] * self.sizes[EXPERT_AXIS]
 
     @property
     def data_parallel_size(self) -> int:
-        return self.sizes[DATA_AXIS]
+        """Non-expert data parallelism (data × its zero factorization)."""
+        return self.sizes[DATA_AXIS] * self.sizes[ZERO_AXIS]
+
+    @property
+    def zero_shard_size(self) -> int:
+        """MiCS/hpZ shard-group size (1 = flat ZeRO over the full dp world)."""
+        return self.sizes[ZERO_AXIS]
 
     @property
     def model_parallel_size(self) -> int:

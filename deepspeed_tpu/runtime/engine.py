@@ -151,12 +151,40 @@ class DeepSpeedEngine:
         if deferred_init is None:
             params = _snapshot_cast(params, self.compute_dtype)
             plan_shapes = params
+        # MiCS / hpZ (reference runtime/zero/mics.py, zero++ hpZ): when the
+        # topology carries a `zero` shard-group axis, MiCS shards params AND
+        # optimizer state only within the group (replicated across groups —
+        # intra-group gathers, reference hierarchical partitioning); hpZ keeps
+        # optimizer state sharded over the full dp world but gathers params
+        # intra-group (the secondary partition)
+        from deepspeed_tpu.parallel.topology import ZERO_AXES, ZERO_AXIS
+
+        zero_axes, param_zero_axes = ZERO_AXES, None
+        wants_shard_group = (zcfg.mics_shard_size or -1) > 0 or (zcfg.zero_hpz_partition_size or 1) > 1
+        if wants_shard_group and self.topo.zero_shard_size <= 1:
+            raise ValueError(
+                "mics_shard_size/zero_hpz_partition_size configured but the topology has "
+                "no `zero` shard-group axis — build it with Topology(zero=N) (initialize() "
+                "does this automatically unless an mpu/topology was passed in)"
+            )
+        if self.topo.zero_shard_size > 1:
+            mics = zcfg.mics_shard_size and zcfg.mics_shard_size > 0
+            param_zero_axes = (ZERO_AXIS,)
+            if mics:
+                zero_axes = (ZERO_AXIS,)
+            log_dist(
+                f"{'MiCS' if mics else 'hpZ'}: shard group size "
+                f"{self.topo.zero_shard_size} over {self.topo.dp_world_size} dp",
+                ranks=[0],
+            )
         self.plan: ZeroShardingPlan = build_zero_plan(
             stage=self.zero_stage,
             topology=self.topo,
             params=plan_shapes,
             persistence_threshold=zcfg.param_persistence_threshold if self.zero_stage >= 3 else 0,
             base_specs=param_specs,
+            zero_axes=zero_axes,
+            param_zero_axes=param_zero_axes,
             offload_optimizer=offload_opt,
             offload_param=offload_par,
         )
@@ -606,7 +634,9 @@ class DeepSpeedEngine:
         if not self._pure_dp():
             raise NotImplementedError(
                 "zero_quantized_gradients/weights currently require a pure "
-                "data-parallel topology (no tensor/pipe/sequence/expert axes)"
+                "data-parallel topology — no tensor/pipe/sequence/expert axes, and "
+                "no MiCS/hpZ `zero` shard group (the explicit quantized exchange is "
+                "manual over the data axis only)"
             )
         zcfg = self.config.zero_optimization
         qgz, qwz = zcfg.zero_quantized_gradients, zcfg.zero_quantized_weights

@@ -140,11 +140,13 @@ class DeepSpeedHybridEngine:
                     "requantization; dequantize the base first"
                 )
             r = node["lora_a"].shape[1]
-            alpha = self._lora_alpha if self._lora_alpha is not None else float(r)
+            default_alpha = LoRAConfig().lora_alpha  # the library's init default
+            alpha = self._lora_alpha if self._lora_alpha is not None else default_alpha
             if self._lora_alpha is None:
                 logger.warning(
-                    "hybrid_engine.lora.lora_alpha not configured: fusing with "
-                    f"alpha=r={r} (scale 1.0) — set it if your adapters used another alpha"
+                    "hybrid_engine.lora.lora_alpha not configured: fusing with the "
+                    f"library default alpha={default_alpha} (rank {r} from the node) — "
+                    "set it if your adapters used another alpha"
                 )
             cfg = LoRAConfig(lora_r=r, lora_alpha=alpha)
             found.append(True)

@@ -35,7 +35,7 @@ import jax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
-from deepspeed_tpu.parallel.topology import DATA_AXIS, Topology
+from deepspeed_tpu.parallel.topology import DATA_AXIS, ZERO_AXES, Topology
 
 
 def _spec_axes(spec: Optional[PartitionSpec]):
@@ -53,13 +53,24 @@ def _spec_axes(spec: Optional[PartitionSpec]):
     return used
 
 
-def choose_zero_spec(shape, axis_size: int, base_spec: Optional[PartitionSpec] = None) -> PartitionSpec:
-    """Add the ``data`` axis to a leaf's PartitionSpec on the best free dim."""
+def choose_zero_spec(
+    shape,
+    axis_size: int,
+    base_spec: Optional[PartitionSpec] = None,
+    axes=(DATA_AXIS,),
+) -> PartitionSpec:
+    """Add the ZeRO axes (``data``/``zero`` or a MiCS subset) to a leaf's
+    PartitionSpec on the best free dim. ``axis_size`` is the product of the
+    participating axis sizes; trivial (size-1) axes are dropped from the
+    placement so specs stay readable."""
+    axes = tuple(a for a in axes)
     if axis_size <= 1:
         return base_spec if base_spec is not None else PartitionSpec()
+    placement = axes[0] if len(axes) == 1 else tuple(axes)
     base = tuple(base_spec) if base_spec is not None else ()
     base = base + (None,) * (len(shape) - len(base))
-    if DATA_AXIS in _spec_axes(base_spec):
+    used = _spec_axes(base_spec)
+    if any(a in used for a in axes):
         return PartitionSpec(*base)
     # candidate dims: unsharded by base spec and divisible by axis_size
     best_dim, best_size = None, 0
@@ -72,17 +83,17 @@ def choose_zero_spec(shape, axis_size: int, base_spec: Optional[PartitionSpec] =
         if d % axis_size == 0 and d > best_size:
             best_dim, best_size = i, d
     if best_dim is None:
-        # try nesting data inside an already-sharded dim: ('model','data')
+        # try nesting the zero axes inside an already-sharded dim
         for i, d in enumerate(shape):
             if i < len(base) and base[i] is not None:
                 prev = base[i] if isinstance(base[i], tuple) else (base[i],)
-                if DATA_AXIS not in prev and d % (axis_size * _axes_product(prev)) == 0:
+                if not any(a in prev for a in axes) and d % (axis_size * _axes_product(prev)) == 0:
                     new = list(base)
-                    new[i] = tuple(prev) + (DATA_AXIS,)
+                    new[i] = tuple(prev) + axes
                     return PartitionSpec(*new)
         return PartitionSpec(*base)  # replicated over data (e.g. odd-shaped scalars)
     new = list(base)
-    new[best_dim] = DATA_AXIS
+    new[best_dim] = placement
     return PartitionSpec(*new)
 
 
@@ -113,6 +124,10 @@ class ZeroShardingPlan:
     # host memory ("pinned_host" memory kind) instead of HBM
     offload_optimizer: bool = False
     offload_param: bool = False
+    # MiCS/hpZ: which mesh axes params vs optimizer state shard over
+    # (ZERO_AXES = full dp; ("zero",) = within the shard group only)
+    param_zero_axes: tuple = ZERO_AXES
+    state_zero_axes: tuple = ZERO_AXES
 
     @property
     def state_memory_kind(self):
@@ -141,8 +156,9 @@ class ZeroShardingPlan:
         scalars (step counts) are replicated. This is how the reference's
         per-partition optimizer state (stage_1_and_2.py ``single_partition_of_
         fp32_groups``) falls out of the sharding rule for free."""
+        axes = tuple(a for a in self.state_zero_axes if self.topology.axis_size(a) > 1)
         axis_size = 1
-        for a in (DATA_AXIS,):
+        for a in axes:
             axis_size *= self.topology.axis_size(a)
         mesh = self.topology.mesh
         stage = self.stage
@@ -152,7 +168,7 @@ class ZeroShardingPlan:
         def leaf_sharding(leaf):
             shape = tuple(getattr(leaf, "shape", ()))
             if stage >= 1 and shape:
-                spec = choose_zero_spec(shape, axis_size, None)
+                spec = choose_zero_spec(shape, axis_size, None, axes=axes or (DATA_AXIS,))
             else:
                 spec = PartitionSpec()
             # scalars (step counts) stay in device memory: XLA's SPMD
@@ -171,7 +187,8 @@ def build_zero_plan(
     params: Any,
     persistence_threshold: int = 0,
     base_specs: Any = None,
-    zero_axes=(DATA_AXIS,),
+    zero_axes=ZERO_AXES,
+    param_zero_axes=None,
     offload_optimizer: bool = False,
     offload_param: bool = False,
 ) -> ZeroShardingPlan:
@@ -179,11 +196,27 @@ def build_zero_plan(
 
     ``base_specs`` optionally carries tensor/expert-parallel PartitionSpecs
     per leaf (the AutoTP analogue); ZeRO composes with them by choosing a
-    free dimension.
+    free dimension. ``zero_axes`` shard optimizer state + gradients;
+    ``param_zero_axes`` (default = same) shard the parameters — MiCS/hpZ
+    restrict it to the ``zero`` shard-group axis so param gathers stay
+    intra-group while grads still reduce over the whole dp world.
     """
-    axis_size = 1
-    for a in zero_axes:
-        axis_size *= topology.axis_size(a)
+    if param_zero_axes is None:
+        param_zero_axes = zero_axes
+
+    def live(axes):
+        return tuple(a for a in axes if topology.axis_size(a) > 1)
+
+    def size_of(axes):
+        out = 1
+        for a in axes:
+            out *= topology.axis_size(a)
+        return out
+
+    state_axes = live(zero_axes)
+    param_axes = live(param_zero_axes)
+    state_size = size_of(state_axes)
+    param_size = size_of(param_axes)
     mesh = topology.mesh
 
     flat_params, treedef = jax.tree_util.tree_flatten(params)
@@ -196,27 +229,33 @@ def build_zero_plan(
     def leaf_shape(p):
         return tuple(p.shape) if hasattr(p, "shape") else ()
 
-    def sharded_spec(p, base, threshold):
-        shape = leaf_shape(p)
-        n = int(np.prod(shape)) if shape else 1
-        if n < threshold or not shape:
-            return PartitionSpec(*base) if base is not None else PartitionSpec()
-        return choose_zero_spec(shape, axis_size, base)
+    def sharded_spec(axes, axis_size):
+        def fn(p, base, threshold=0):
+            shape = leaf_shape(p)
+            n = int(np.prod(shape)) if shape else 1
+            if n < threshold or not shape:
+                return PartitionSpec(*base) if base is not None else PartitionSpec()
+            return choose_zero_spec(shape, axis_size, base, axes=axes or (DATA_AXIS,))
 
-    def base_or_replicated(p, base):
+        return fn
+
+    def base_or_replicated(p, base, threshold=0):
         return PartitionSpec(*base) if base is not None else PartitionSpec()
 
-    def build(spec_fn):
-        return jax.tree_util.tree_unflatten(treedef, [spec_fn(p, b) for p, b in zip(flat_params, flat_base)])
+    def build(spec_fn, threshold=0):
+        return jax.tree_util.tree_unflatten(
+            treedef, [spec_fn(p, b, threshold) for p, b in zip(flat_params, flat_base)]
+        )
 
     # persistence threshold applies to *params* only (reference
     # param_persistence_threshold); optimizer state and gradients always
     # partition at their stage.
     param_specs = build(
-        (lambda p, b: sharded_spec(p, b, persistence_threshold)) if stage >= 3 else base_or_replicated
+        sharded_spec(param_axes, param_size) if stage >= 3 else base_or_replicated,
+        persistence_threshold,
     )
-    grad_specs = build((lambda p, b: sharded_spec(p, b, 0)) if stage >= 2 else base_or_replicated)
-    master_specs = build((lambda p, b: sharded_spec(p, b, 0)) if stage >= 1 else base_or_replicated)
+    grad_specs = build(sharded_spec(state_axes, state_size) if stage >= 2 else base_or_replicated)
+    master_specs = build(sharded_spec(state_axes, state_size) if stage >= 1 else base_or_replicated)
 
     def to_sharding(kind):
         if kind is None:
@@ -238,6 +277,8 @@ def build_zero_plan(
         persistence_threshold=persistence_threshold,
         offload_optimizer=offload_optimizer,
         offload_param=offload_param,
+        param_zero_axes=tuple(param_zero_axes),
+        state_zero_axes=tuple(zero_axes),
     )
 
 

@@ -58,6 +58,13 @@ def get_world_size():
 
 # ---- group handles: axis names stand in for torch process groups ----
 def get_data_parallel_group():
+    """The non-expert data-parallel axes. When MiCS/hpZ factorized data into
+    (data, zero), the dp group spans BOTH — a collective over this handle
+    must cover the same world get_data_parallel_world_size() reports."""
+    from deepspeed_tpu.parallel.topology import ZERO_AXIS
+
+    if get_topology().zero_shard_size > 1:
+        return (DATA_AXIS, ZERO_AXIS)
     return DATA_AXIS
 
 
@@ -85,16 +92,26 @@ def get_expert_data_parallel_group(group_name=None):
 
 
 def get_zero_param_intra_parallel_group():
-    """hpZ secondary-partition group (reference groups.py:702); collapses to
-    the data axis until hierarchical partitioning is configured."""
-    return DATA_AXIS
+    """hpZ/MiCS shard-group axis (reference groups.py:702
+    _create_zero_param_parallel_group): the ``zero`` mesh axis when the
+    topology was built with a shard group, else the plain data axis."""
+    from deepspeed_tpu.parallel.topology import ZERO_AXIS
+
+    return ZERO_AXIS if get_topology().zero_shard_size > 1 else DATA_AXIS
+
+
+def get_zero_param_intra_parallel_group_world_size():
+    return get_topology().zero_shard_size
 
 
 # ---- in-trace ranks (valid inside shard_map) ----
 def get_data_parallel_rank():
     from jax import lax
 
-    return lax.axis_index(DATA_AXIS)
+    group = get_data_parallel_group()
+    if isinstance(group, tuple):
+        return lax.axis_index(group)  # combined (data, zero) rank
+    return lax.axis_index(group)
 
 
 def get_model_parallel_rank():

@@ -1,0 +1,116 @@
+"""MiCS / hpZ hierarchical partitioning tests (analogue of reference
+tests/unit/runtime/zero test_zeropp.py + mics tests): the `zero` shard-group
+axis restricts param (and for MiCS, optimizer-state) sharding to a sub-group
+of the dp world while gradients still reduce over all of it."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import deepspeed_tpu
+from deepspeed_tpu.parallel.topology import reset_topology
+
+from tests.unit.simple_model import batch_of, make_mlp_params, mlp_loss_fn, random_dataset
+
+LR = 1e-2
+
+
+def _spec_axes(spec):
+    axes = set()
+    for e in tuple(spec):
+        if e is None:
+            continue
+        axes.update(e if isinstance(e, tuple) else (e,))
+    return axes
+
+
+def _engine(zero_cfg, mesh=None):
+    params = make_mlp_params(jax.random.key(0))
+    cfg = {
+        "train_micro_batch_size_per_gpu": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": LR}},
+        "zero_optimization": {"stage": 3, "param_persistence_threshold": 0, **zero_cfg},
+        "steps_per_print": 1000,
+    }
+    if mesh:
+        cfg["mesh"] = mesh
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=mlp_loss_fn, model_parameters=params, config=cfg
+    )
+    return engine
+
+
+def _losses(engine, n=6):
+    dataset = random_dataset(n=64 * n)
+    out, pos = [], 0
+    for _ in range(n):
+        out.append(float(engine.train_batch(batch=batch_of(dataset, pos, 64))))
+        pos += 64
+    return out
+
+
+class TestMiCS:
+    def test_param_and_state_shard_within_group(self, devices8):
+        reset_topology()
+        engine = _engine({"mics_shard_size": 4})
+        assert engine.topo.zero_shard_size == 4
+        assert engine.topo.dp_world_size == 8  # 2 groups x 4
+        # params shard over `zero` ONLY (replicated across the 2 groups)
+        for path, spec in jax.tree_util.tree_flatten_with_path(engine.plan.param_specs)[0]:
+            axes = _spec_axes(spec)
+            assert "data" not in axes, (path, spec)
+        big = engine.plan.param_specs["layer_0"]["w"]
+        assert "zero" in _spec_axes(big)
+        # optimizer state too (MiCS replicates optimizer across groups)
+        master = engine.plan.master_specs["layer_0"]["w"]
+        assert "zero" in _spec_axes(master) and "data" not in _spec_axes(master)
+        reset_topology()
+
+    def test_trajectory_matches_flat_zero3(self, devices8):
+        reset_topology()
+        flat = _losses(_engine({}))
+        reset_topology()
+        mics = _losses(_engine({"mics_shard_size": 4}))
+        np.testing.assert_allclose(mics, flat, rtol=1e-5)
+        reset_topology()
+
+
+class TestHpZ:
+    def test_params_intra_group_state_full_dp(self, devices8):
+        reset_topology()
+        engine = _engine({"zero_hpz_partition_size": 4})
+        # params: secondary (intra-group) partition -> gathers stay in-group
+        big = engine.plan.param_specs["layer_0"]["w"]
+        assert _spec_axes(big) == {"zero"}
+        # optimizer state: full dp sharding (data x zero)
+        master = engine.plan.master_specs["layer_0"]["w"]
+        assert _spec_axes(master) == {"data", "zero"}
+        reset_topology()
+
+    def test_trajectory_matches_flat_zero3(self, devices8):
+        reset_topology()
+        flat = _losses(_engine({}))
+        reset_topology()
+        hpz = _losses(_engine({"zero_hpz_partition_size": 2}))
+        np.testing.assert_allclose(hpz, flat, rtol=1e-5)
+        reset_topology()
+
+
+def test_groups_expose_shard_group(devices8):
+    from deepspeed_tpu.utils import groups
+
+    reset_topology()
+    engine = _engine({"mics_shard_size": 2})
+    assert groups.get_zero_param_intra_parallel_group() == "zero"
+    assert groups.get_zero_param_intra_parallel_group_world_size() == 2
+    reset_topology()
+
+
+def test_explicit_mesh_data_divides(devices8):
+    reset_topology()
+    engine = _engine({"zero_hpz_partition_size": 4}, mesh={"data": 8})
+    assert engine.topo.axis_size("data") == 2 and engine.topo.zero_shard_size == 4
+    reset_topology()
