@@ -1,0 +1,17 @@
+"""Pluggable checkpoint engines (reference runtime/checkpoint_engine/)."""
+
+from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import (
+    AsyncCheckpointEngine,
+    CheckpointEngine,
+    DecoupledCheckpointEngine,
+    TorchCheckpointEngine,
+    create_checkpoint_engine,
+)
+
+__all__ = [
+    "AsyncCheckpointEngine",
+    "CheckpointEngine",
+    "DecoupledCheckpointEngine",
+    "TorchCheckpointEngine",
+    "create_checkpoint_engine",
+]

@@ -1252,12 +1252,61 @@ class DeepSpeedEngine:
             "lr_scheduler": self.lr_scheduler.state_dict() if hasattr(self.lr_scheduler, "state_dict") else None,
         }
 
-    def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True, exclude_frozen_parameters=False):
-        from deepspeed_tpu.checkpoint.engine import save_checkpoint as _save
+    def _checkpoint_writer(self):
+        if getattr(self, "_ckpt_writer", None) is None:
+            from deepspeed_tpu.runtime.checkpoint_engine import create_checkpoint_engine
 
+            self._ckpt_writer = create_checkpoint_engine(self.config.checkpoint.writer)
+            self._ckpt_pending = None
+        return self._ckpt_writer
+
+    def checkpoint_commit(self):
+        """Join outstanding async checkpoint writes and publish their tag
+        (the reference two-phase commit, engine.py:3655). No-op for the
+        synchronous orbax path. A failed commit DROPS the pending tag —
+        'latest' must never name a checkpoint that did not land."""
+        if getattr(self, "_ckpt_pending", None) is None:
+            return
+        save_dir, tag, save_latest = self._ckpt_pending
+        self._ckpt_pending = None  # even on failure: never re-publish a failed tag
+        self._ckpt_writer.commit(tag)  # raises if any write failed
+        if jax.process_count() > 1:
+            # every process's writes must be durable before the marker exists
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(f"ckpt_commit_{tag}")
+        if save_latest and jax.process_index() == 0:
+            with open(os.path.join(save_dir, "latest"), "w") as f:
+                f.write(tag)
+
+    def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True, exclude_frozen_parameters=False):
         tag = tag or f"global_step{self.global_steps}"
         state = self._client_state()
         state.update(client_state or {})
+        writer = self.config.checkpoint.writer
+        if writer:
+            # pluggable engine path (reference checkpoint_engine/): async
+            # writers return after the device→host snapshot; the PREVIOUS
+            # save publishes here (decoupled two-phase commit) and a final
+            # checkpoint_commit() publishes the last one
+            eng = self._checkpoint_writer()
+            self.checkpoint_commit()
+            eng.create(tag)
+            eng.save(
+                {
+                    "params": self.params,
+                    "opt_state": self.opt_state,
+                    "scaler_state": self.scaler_state,
+                    "__meta__": state,
+                },
+                os.path.join(save_dir, tag, "state"),
+            )
+            self._ckpt_pending = (save_dir, tag, save_latest)
+            if writer in ("sync", "torch"):
+                self.checkpoint_commit()
+            return True
+        from deepspeed_tpu.checkpoint.engine import save_checkpoint as _save
+
         _save(
             save_dir,
             tag,
@@ -1269,6 +1318,18 @@ class DeepSpeedEngine:
         )
         return True
 
+    def _restore_tree(self, template, loaded):
+        """Order-based restore: the writer serialized leaves in tree-flatten
+        order, so zip them back into the template's structure/shardings."""
+        t_leaves, treedef = jax.tree_util.tree_flatten(template)
+        l_leaves = jax.tree_util.tree_leaves(loaded)
+        assert len(t_leaves) == len(l_leaves), (len(t_leaves), len(l_leaves))
+        out = []
+        for t, l in zip(t_leaves, l_leaves):
+            assert tuple(t.shape) == tuple(l.shape), (t.shape, l.shape)
+            out.append(jax.device_put(jnp.asarray(l, dtype=t.dtype), t.sharding))
+        return jax.tree_util.tree_unflatten(treedef, out)
+
     def load_checkpoint(
         self,
         load_dir,
@@ -1279,6 +1340,24 @@ class DeepSpeedEngine:
         load_module_only=False,
         custom_load_fn=None,
     ):
+        writer = self.config.checkpoint.writer
+        if writer:
+            self.checkpoint_commit()  # a just-written tag must be readable
+            if tag is None:
+                latest = os.path.join(load_dir, "latest")
+                if not os.path.isfile(latest):
+                    return None, {}
+                tag = open(latest).read().strip()
+            eng = self._checkpoint_writer()
+            data = eng.load(os.path.join(load_dir, tag, "state"))
+            self.params = self._restore_tree(self.params, data["params"])
+            if load_optimizer_states and not load_module_only and "opt_state" in data:
+                self.opt_state = self._restore_tree(self.opt_state, data["opt_state"])
+            if "scaler_state" in data:
+                self.scaler_state = self._restore_tree(self.scaler_state, data["scaler_state"])
+            client_state = data.get("__meta__", {})
+            self._restore_client_state(client_state, load_module_only, load_lr_scheduler_states)
+            return os.path.join(load_dir, tag), client_state
         from deepspeed_tpu.checkpoint.engine import load_checkpoint as _load
 
         out = _load(
@@ -1296,15 +1375,22 @@ class DeepSpeedEngine:
         if out.get("scaler_state") is not None:
             self.scaler_state = out["scaler_state"]
         client_state = out.get("client_state", {})
-        if not load_module_only:
-            self.micro_steps = client_state.get("micro_steps", 0)
-            self.global_steps = client_state.get("global_steps", 0)
-            self.global_samples = client_state.get("global_samples", 0)
-            self.skipped_steps = client_state.get("skipped_steps", 0)
-            sched_sd = client_state.get("lr_scheduler")
-            if load_lr_scheduler_states and sched_sd and hasattr(self.lr_scheduler, "load_state_dict"):
-                self.lr_scheduler.load_state_dict(sched_sd)
+        self._restore_client_state(client_state, load_module_only, load_lr_scheduler_states)
         return out.get("load_path", load_dir), client_state
+
+    def _restore_client_state(self, client_state, load_module_only, load_lr_scheduler_states):
+        """Counter + LR-schedule restore shared by the orbax and writer-engine
+        load paths (one exit path: a counter added to _client_state restores
+        everywhere)."""
+        if load_module_only:
+            return
+        self.micro_steps = client_state.get("micro_steps", 0)
+        self.global_steps = client_state.get("global_steps", 0)
+        self.global_samples = client_state.get("global_samples", 0)
+        self.skipped_steps = client_state.get("skipped_steps", 0)
+        sched_sd = client_state.get("lr_scheduler")
+        if load_lr_scheduler_states and sched_sd and hasattr(self.lr_scheduler, "load_state_dict"):
+            self.lr_scheduler.load_state_dict(sched_sd)
 
     def save_16bit_model(self, save_dir, save_filename="pytorch_model.bin", exclude_frozen_parameters=False):
         """Consolidated half-precision export (reference save_16bit_model

@@ -1,0 +1,162 @@
+"""Pluggable checkpoint engine tests (analogue of reference
+tests/unit/checkpoint decoupled/fast engine tests)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.checkpoint_engine import (
+    AsyncCheckpointEngine,
+    DecoupledCheckpointEngine,
+    TorchCheckpointEngine,
+    create_checkpoint_engine,
+)
+
+from tests.unit.simple_model import batch_of, make_mlp_params, mlp_loss_fn, random_dataset
+
+LR = 1e-2
+
+
+def _state():
+    return {
+        "params": {"w": np.arange(12.0).reshape(3, 4), "b": np.zeros(4)},
+        "opt_state": {"mu": np.ones((3, 4))},
+        "__meta__": {"step": 7},
+    }
+
+
+class TestEngines:
+    def test_factory(self):
+        assert isinstance(create_checkpoint_engine(None), TorchCheckpointEngine)
+        assert isinstance(create_checkpoint_engine("fast"), AsyncCheckpointEngine)
+        assert isinstance(create_checkpoint_engine("decoupled"), DecoupledCheckpointEngine)
+        with pytest.raises(ValueError):
+            create_checkpoint_engine("nebula9000")
+
+    def test_sync_roundtrip(self, tmp_path):
+        eng = TorchCheckpointEngine()
+        path = str(tmp_path / "ck" / "state")
+        eng.save(_state(), path)
+        assert eng.commit("t")
+        out = eng.load(path)
+        np.testing.assert_array_equal(out["params"][1], np.arange(12.0).reshape(3, 4))
+        assert out["__meta__"]["step"] == 7
+
+    def test_async_commit_joins_writes(self, tmp_path):
+        eng = AsyncCheckpointEngine()
+        path = str(tmp_path / "ck" / "state")
+        eng.save(_state(), path)
+        assert eng.commit("t")
+        assert eng.in_flight == 0
+        out = eng.load(path)
+        assert out["__meta__"]["step"] == 7
+
+    def test_async_write_error_surfaces_at_commit(self, tmp_path, monkeypatch):
+        eng = AsyncCheckpointEngine()
+        import deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine as ce
+
+        def boom(state, path):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(ce, "_write_npz", boom)
+        eng.save(_state(), str(tmp_path / "x" / "state"))
+        with pytest.raises(RuntimeError, match="disk full"):
+            eng.commit("t")
+
+    def test_decoupled_rank_suffix(self, tmp_path):
+        eng = DecoupledCheckpointEngine()
+        path = str(tmp_path / "ck" / "state")
+        eng.save(_state(), path)
+        eng.commit("t")
+        assert os.path.isfile(path + ".rank0.npz")
+        out = eng.load(path)
+        assert out["__meta__"]["step"] == 7
+
+
+class TestEngineIntegration:
+    def _run(self, writer, tmp_path, devices8):
+        dataset = random_dataset(n=64 * 8)
+        params = make_mlp_params(jax.random.key(0))
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=mlp_loss_fn,
+            model_parameters=params,
+            config={
+                "train_micro_batch_size_per_gpu": 8,
+                "optimizer": {"type": "AdamW", "params": {"lr": LR}},
+                "zero_optimization": {"stage": 2},
+                "mesh": {"data": 8},
+                "checkpoint": {"writer": writer},
+                "steps_per_print": 1000,
+            },
+        )
+        pos = 0
+        for _ in range(3):
+            engine.train_batch(batch=batch_of(dataset, pos, 64))
+            pos += 64
+        engine.save_checkpoint(str(tmp_path))
+        engine.checkpoint_commit()
+        loss_before = float(engine.train_batch(batch=batch_of(dataset, pos, 64)))
+
+        # fresh engine resumes and must continue identically
+        engine2, _, _, _ = deepspeed_tpu.initialize(
+            model=mlp_loss_fn,
+            model_parameters=make_mlp_params(jax.random.key(1)),
+            config={
+                "train_micro_batch_size_per_gpu": 8,
+                "optimizer": {"type": "AdamW", "params": {"lr": LR}},
+                "zero_optimization": {"stage": 2},
+                "mesh": {"data": 8},
+                "checkpoint": {"writer": writer},
+                "steps_per_print": 1000,
+            },
+        )
+        load_path, client = engine2.load_checkpoint(str(tmp_path))
+        assert load_path is not None
+        assert engine2.global_steps == 3
+        loss_resumed = float(engine2.train_batch(batch=batch_of(dataset, pos, 64)))
+        assert loss_resumed == pytest.approx(loss_before, rel=1e-6)
+
+    @pytest.mark.parametrize("writer", ["sync", "async", "decoupled"])
+    def test_save_load_resume(self, writer, tmp_path, devices8):
+        self._run(writer, tmp_path, devices8)
+
+    def test_async_save_does_not_block_training(self, tmp_path, devices8, monkeypatch):
+        """The save call must return before serialization finishes: slow down
+        the writer and assert save_checkpoint is fast while commit waits."""
+        import deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine as ce
+
+        orig = ce._write_npz
+
+        def slow(state, path):
+            time.sleep(0.5)
+            orig(state, path)
+
+        monkeypatch.setattr(ce, "_write_npz", slow)
+        dataset = random_dataset(n=64)
+        params = make_mlp_params(jax.random.key(0))
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=mlp_loss_fn,
+            model_parameters=params,
+            config={
+                "train_micro_batch_size_per_gpu": 8,
+                "optimizer": {"type": "AdamW", "params": {"lr": LR}},
+                "mesh": {"data": 8},
+                "checkpoint": {"writer": "async"},
+                "steps_per_print": 1000,
+            },
+        )
+        engine.train_batch(batch=batch_of(dataset, 0, 64))
+        t0 = time.perf_counter()
+        engine.save_checkpoint(str(tmp_path))
+        save_time = time.perf_counter() - t0
+        assert save_time < 0.4, f"async save blocked for {save_time:.2f}s"
+        t0 = time.perf_counter()
+        engine.checkpoint_commit()
+        assert time.perf_counter() - t0 > 0.3  # commit is where the wait lives
+        assert os.path.isfile(os.path.join(tmp_path, "latest"))
