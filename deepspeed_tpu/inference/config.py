@@ -19,11 +19,21 @@ class TPConfig(DSConfigModel):
 
 
 @dataclass
+class QuantConfig(DSConfigModel):
+    """Weight-only quantized inference (reference inference/quantization/)."""
+
+    enabled: bool = False
+    bits: int = 8  # 8 | 4 (packed)
+    group_size: int = 128
+
+
+@dataclass
 class DeepSpeedInferenceConfig(DSConfigModel):
     """v1 engine config (reference inference/config.py)."""
 
     dtype: str = "bfloat16"
     tensor_parallel: Optional[TPConfig] = submodel(TPConfig)
+    quant: QuantConfig = submodel(QuantConfig)
     max_out_tokens: int = 1024
     min_out_tokens: int = 1
     max_tokens: int = 4096  # prompt + generation budget
@@ -69,5 +79,6 @@ class RaggedInferenceEngineConfig(DSConfigModel):
 
     dtype: str = "bfloat16"
     tp_size: int = 1
+    quant: QuantConfig = submodel(QuantConfig)
     kv_cache: Optional[KVCacheConfig] = submodel(KVCacheConfig)
     state_manager: Optional[StateManagerConfig] = submodel(StateManagerConfig)

@@ -72,6 +72,17 @@ class InferenceEngine:
             params = jax.tree.map(
                 lambda p: p.astype(dtype) if jnp.issubdtype(p.dtype, jnp.floating) else p, params
             )
+        if getattr(config, "quant", None) and config.quant.enabled:
+            # weight-only quantized inference (reference inference/quantization/)
+            if tp > 1 or self.topo.model_parallel_size > 1:
+                # the placement specs below describe the WIDE tree; quantized
+                # leaves change the pytree structure
+                raise NotImplementedError("quantized inference with tensor parallelism is unsupported")
+            from deepspeed_tpu.inference.quantization import quantize_inference_params
+
+            params = quantize_inference_params(
+                params, bits=config.quant.bits, group_size=config.quant.group_size
+            )
         # TP placement (the AutoTP/injection analogue) — skipped for shared
         # (hybrid-engine) params, which already carry the training shardings
         if cast_params and self.topo.model_parallel_size > 1:

@@ -1,0 +1,25 @@
+"""Weight-only quantized inference (reference ``inference/quantization/``:
+``module_quantize.py`` + the GroupQuantizer in replace_module.py:44).
+
+Matmul weights store as int8 (or packed int4) payloads with per-block fp32
+scales — HBM holds the narrow form; the model's layer scan dequantizes ONE
+layer slice at a time inside jit, so the transient wide copy is a single
+layer, not the model."""
+
+from deepspeed_tpu.inference.quantization.quantize import (
+    QuantizedWeight,
+    dequantize_leaf,
+    is_quantized_leaf,
+    maybe_dequantize,
+    model_memory_bytes,
+    quantize_inference_params,
+)
+
+__all__ = [
+    "QuantizedWeight",
+    "dequantize_leaf",
+    "is_quantized_leaf",
+    "maybe_dequantize",
+    "model_memory_bytes",
+    "quantize_inference_params",
+]

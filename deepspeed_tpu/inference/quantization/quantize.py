@@ -1,0 +1,124 @@
+"""Weight-only quantization transforms for inference params.
+
+A quantized weight is a :class:`QuantizedWeight` pytree node holding the
+int8 (or packed-int4) payload and per-block fp32 scales along the LAST dim
+(the contraction dim feeds the MXU as bf16 after dequant); bits/group ride
+as static metadata so jit caches per quantization config. Norm scales,
+biases and embeddings stay wide (same exclusion rule as compression:
+quantizing them saves ~nothing and costs accuracy; embeddings are gathers,
+not matmuls).
+"""
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.compression.transforms import NON_WEIGHT_PATTERNS
+from deepspeed_tpu.utils.pytree import path_str
+
+_QMAX = {8: 127.0, 4: 7.0}
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedWeight:
+    """int payload + block scales; bits/group are static aux data."""
+
+    def __init__(self, q, s, bits: int, group: int):
+        self.q = q
+        self.s = s
+        self.bits = bits
+        self.group = group
+
+    def tree_flatten(self):
+        return (self.q, self.s), (self.bits, self.group)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], *aux)
+
+    @property
+    def shape(self):
+        d = self.q.shape[-1] * (2 if self.bits == 4 else 1)
+        return self.q.shape[:-1] + (d,)
+
+    @property
+    def nbytes(self):
+        return int(self.q.nbytes + self.s.nbytes)
+
+    def __repr__(self):
+        return f"QuantizedWeight(shape={self.shape}, bits={self.bits}, group={self.group})"
+
+
+def is_quantized_leaf(node) -> bool:
+    return isinstance(node, QuantizedWeight)
+
+
+def _quantize_leaf(w: jax.Array, bits: int, group: int) -> QuantizedWeight:
+    d = w.shape[-1]
+    qmax = _QMAX[bits]
+    blocks = w.astype(jnp.float32).reshape(w.shape[:-1] + (d // group, group))
+    absmax = jnp.max(jnp.abs(blocks), axis=-1, keepdims=True)
+    scales = jnp.maximum(absmax / qmax, 1e-12)
+    q = jnp.clip(jnp.round(blocks / scales), -qmax, qmax).reshape(w.shape)
+    if bits == 4:
+        lo, hi = q[..., ::2], q[..., 1::2]
+        payload = ((lo + 7).astype(jnp.uint8) | ((hi + 7).astype(jnp.uint8) << 4)).astype(jnp.int8)
+    else:
+        payload = q.astype(jnp.int8)
+    return QuantizedWeight(payload, scales[..., 0].astype(jnp.float32), bits, group)
+
+
+def dequantize_leaf(node: QuantizedWeight, dtype=jnp.bfloat16) -> jax.Array:
+    if node.bits == 4:
+        u = node.q.astype(jnp.uint8)
+        lo = (u & 0xF).astype(jnp.float32) - 7.0
+        hi = (u >> 4).astype(jnp.float32) - 7.0
+        vals = jnp.stack([lo, hi], axis=-1).reshape(node.shape)
+    else:
+        vals = node.q.astype(jnp.float32)
+    d = vals.shape[-1]
+    blocks = vals.reshape(vals.shape[:-1] + (d // node.group, node.group))
+    wide = blocks * node.s[..., None]
+    return wide.reshape(vals.shape).astype(dtype)
+
+
+def maybe_dequantize(node, dtype=jnp.bfloat16):
+    """Identity for wide leaves; dequant for QuantizedWeight — the model's
+    layer scan calls this per layer slice, so the transient wide copy is one
+    layer's weights, never the whole model."""
+    return dequantize_leaf(node, dtype) if isinstance(node, QuantizedWeight) else node
+
+
+def quantize_inference_params(
+    params: Any,
+    bits: int = 8,
+    group_size: int = 128,
+    exclude: Sequence[str] = NON_WEIGHT_PATTERNS,
+) -> Any:
+    """Matmul-weight leaves → :class:`QuantizedWeight`; everything else
+    unchanged. Consumed transparently by the model family."""
+    assert bits in (8, 4), f"bits must be 4 or 8, got {bits}"
+
+    def visit(path, leaf):
+        name = path_str(path)
+        last = name.rsplit("/", 1)[-1]
+        if getattr(leaf, "ndim", 0) < 2 or any(p in last for p in exclude):
+            return leaf
+        if leaf.shape[-1] % group_size or (bits == 4 and leaf.shape[-1] % 2):
+            return leaf  # indivisible last dim: keep wide
+        return _quantize_leaf(leaf, bits, group_size)
+
+    return jax.tree_util.tree_map_with_path(visit, params)
+
+
+def model_memory_bytes(params: Any) -> int:
+    """Bytes held by the (possibly quantized) param tree."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(params):
+        if hasattr(leaf, "nbytes"):
+            total += int(leaf.nbytes)
+        elif hasattr(leaf, "size"):
+            total += int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+    return total

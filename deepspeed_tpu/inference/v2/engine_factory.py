@@ -1,0 +1,81 @@
+"""Engine factory + per-architecture model implementations registry.
+
+Analogue of the reference ``inference/v2/engine_factory.py``
+(``build_hf_engine``) and ``inference/v2/model_implementations/`` (llama/
+mistral/mixtral/opt/... classes): a HF checkpoint directory's declared
+architecture dispatches to a loader that produces the native family's
+(config, params); the factory then wraps them in the v1 generate engine or
+the v2 ragged/continuous-batching engine.
+"""
+
+import json
+import os
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from deepspeed_tpu.utils.logging import log_dist
+
+# architecture name (HF config.json "architectures"[0]) → loader(path, dtype)
+# → (TransformerConfig, params)
+POLICY_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_model_implementation(*arch_names: str):
+    """Decorator mirroring the reference's per-arch implementation classes."""
+
+    def wrap(fn):
+        for name in arch_names:
+            POLICY_REGISTRY[name] = fn
+        return fn
+
+    return wrap
+
+
+def _register_builtins():
+    from deepspeed_tpu.models.hf import load_hf_llama
+
+    for arch in ("LlamaForCausalLM", "MistralForCausalLM"):
+        POLICY_REGISTRY.setdefault(arch, load_hf_llama)
+
+
+def load_model_implementation(path: str, dtype: str = "bfloat16"):
+    """Resolve + run the loader for a HF checkpoint dir."""
+    _register_builtins()
+    cfg_path = os.path.join(path, "config.json")
+    if not os.path.isfile(cfg_path):
+        raise FileNotFoundError(f"{path} has no config.json")
+    arch_list = json.load(open(cfg_path)).get("architectures") or []
+    arch = arch_list[0] if arch_list else None
+    loader = POLICY_REGISTRY.get(arch)
+    if loader is None:
+        raise ValueError(
+            f"no model implementation for architecture {arch!r}; registered: "
+            f"{sorted(POLICY_REGISTRY)} (add one with register_model_implementation)"
+        )
+    log_dist(f"engine_factory: {arch} via {loader.__name__}", ranks=[0])
+    return loader(path, dtype=dtype)
+
+
+def build_hf_engine(path: str, engine_config=None):
+    """HF checkpoint dir → :class:`InferenceEngineV2` (reference
+    build_hf_engine)."""
+    from deepspeed_tpu.inference.config import RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+
+    cfg = engine_config or RaggedInferenceEngineConfig()
+    if isinstance(cfg, dict):
+        cfg = RaggedInferenceEngineConfig.from_dict(cfg)
+    model_config, params = load_model_implementation(path, dtype=cfg.dtype)
+    return InferenceEngineV2(model_config, params, cfg)
+
+
+def build_engine_v1(path: str, engine_config=None):
+    """HF checkpoint dir → v1 generate engine (the init_inference path for
+    checkpoint strings, reference engine.py:303 checkpoint loading)."""
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.inference.engine import InferenceEngine
+
+    cfg = engine_config or DeepSpeedInferenceConfig()
+    if isinstance(cfg, dict):
+        cfg = DeepSpeedInferenceConfig.from_dict(cfg)
+    model_config, params = load_model_implementation(path, dtype=cfg.dtype)
+    return InferenceEngine(model_config, cfg, params=params)

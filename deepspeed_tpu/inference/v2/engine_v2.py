@@ -39,9 +39,16 @@ class InferenceEngineV2:
         self.config = config or RaggedInferenceEngineConfig()
         self._mc = model_config
         dtype = T.DTYPES.get(self.config.dtype, jnp.bfloat16)
-        self.params = jax.tree.map(
+        params = jax.tree.map(
             lambda p: p.astype(dtype) if jnp.issubdtype(p.dtype, jnp.floating) else p, params
         )
+        if getattr(self.config, "quant", None) and self.config.quant.enabled:
+            from deepspeed_tpu.inference.quantization import quantize_inference_params
+
+            params = quantize_inference_params(
+                params, bits=self.config.quant.bits, group_size=self.config.quant.group_size
+            )
+        self.params = params
         kv = self.config.kv_cache
         self.state_manager = DSStateManager(self.config.state_manager, kv)
         self.scheduler = RaggedScheduler(self.config.state_manager, self.state_manager)
@@ -89,6 +96,7 @@ class InferenceEngineV2:
 
             def layer_step(x, inputs):
                 lp, kc_l, vc_l = inputs  # kc_l: [num_blocks, bs, nkv, d]
+                lp = T._dequant_tree(lp, T.DTYPES[c.dtype])
                 a = T._norm(x, lp["attn_norm"], lp.get("attn_norm_b"), c.norm, c.norm_eps)
                 b_, t_, h = a.shape
                 nh, nkv, d = c.n_heads, c.kv_heads, c.head_dim
@@ -125,7 +133,7 @@ class InferenceEngineV2:
             if c.tie_embeddings:
                 logits = last @ params["embed"].astype(last.dtype).T
             else:
-                logits = last @ params["lm_head"]
+                logits = last @ T._dequant_tree(params["lm_head"], last.dtype)
             return logits[0].astype(jnp.float32), k_new, v_new
 
         return jax.jit(row_step, donate_argnums=(5, 6))
@@ -165,6 +173,7 @@ class InferenceEngineV2:
 
             def layer_step(x, inputs):
                 lp, kc_l, vc_l = inputs
+                lp = T._dequant_tree(lp, dtype)
                 a = T._norm(x, lp["attn_norm"], lp.get("attn_norm_b"), c.norm, c.norm_eps)
                 q = (a[0] @ lp["wq"]).reshape(t, nh, d)
                 k = (a[0] @ lp["wk"]).reshape(t, nkv, d)
@@ -186,7 +195,7 @@ class InferenceEngineV2:
             if c.tie_embeddings:
                 logits = last @ params["embed"].astype(last.dtype).T
             else:
-                logits = last @ params["lm_head"]
+                logits = last @ T._dequant_tree(params["lm_head"], last.dtype)
             return logits.astype(jnp.float32), k_new, v_new
 
         return jax.jit(step, donate_argnums=(6, 7))

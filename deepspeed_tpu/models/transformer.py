@@ -336,7 +336,29 @@ def _mlp_block(c: TransformerConfig, lp, x):
     return act @ lp["w_down"], jnp.float32(0.0)
 
 
+def _dequant_tree(lp, dtype):
+    """Transparent weight-only quantized inference: QuantizedWeight leaves
+    (inference/quantization) widen HERE — inside the layer scan body — so
+    the transient bf16 copy is one layer, never the model."""
+    try:
+        from deepspeed_tpu.inference.quantization.quantize import (
+            is_quantized_leaf,
+            maybe_dequantize,
+        )
+    except ImportError:  # quantization package optional at import time
+        return lp
+    if not any(
+        is_quantized_leaf(l)
+        for l in jax.tree_util.tree_leaves(lp, is_leaf=is_quantized_leaf)
+    ):
+        return lp
+    return jax.tree.map(
+        lambda n: maybe_dequantize(n, dtype), lp, is_leaf=is_quantized_leaf
+    )
+
+
 def _layer(c: TransformerConfig, lp, x, positions, segment_ids):
+    lp = _dequant_tree(lp, DTYPES[c.dtype])
     a = _norm(x, lp["attn_norm"], lp.get("attn_norm_b"), c.norm, c.norm_eps)
     attn_out, _ = _attention_block(c, lp, a, positions, segment_ids)
     x = x + attn_out
@@ -389,7 +411,7 @@ def forward_hidden(
 def _lm_head_matrix(params, config: TransformerConfig, dtype):
     if config.tie_embeddings:
         return params["embed"].astype(dtype).T
-    return params["lm_head"]
+    return _dequant_tree(params["lm_head"], dtype)
 
 
 def forward(
@@ -421,6 +443,7 @@ def decode_step(params, tokens, config, kv_caches, positions):
 
     def scan_body(x, inputs):
         lp, cache = inputs
+        lp = _dequant_tree(lp, DTYPES[c.dtype])
         a = _norm(x, lp["attn_norm"], lp.get("attn_norm_b"), c.norm, c.norm_eps)
         attn_out, new_cache = _attention_block(c, lp, a, positions, None, kv_cache=cache)
         x = x + attn_out
@@ -433,7 +456,7 @@ def decode_step(params, tokens, config, kv_caches, positions):
     if c.tie_embeddings:
         logits = x @ params["embed"].astype(x.dtype).T
     else:
-        logits = x @ params["lm_head"]
+        logits = x @ _dequant_tree(params["lm_head"], x.dtype)
     return logits, new_caches
 
 
@@ -502,7 +525,7 @@ def lm_head_loss(params, x, labels, mask, config: TransformerConfig, aux=None):
     if c.tie_embeddings:
         logits = x @ params["embed"].astype(x.dtype).T
     else:
-        logits = x @ params["lm_head"]
+        logits = x @ _dequant_tree(params["lm_head"], x.dtype)
     loss = nll_loss(logits, labels, mask)
     if c.n_experts > 0 and aux is not None:
         loss = loss + c.moe_aux_loss_coef * aux

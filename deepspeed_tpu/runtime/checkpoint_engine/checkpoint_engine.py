@@ -86,17 +86,18 @@ def _write_npz(state_dict: Dict[str, Any], path: str):
     (``section::000042``): restore zips them back into the live template's
     treedef, which is robust for NamedTuple states whose field order is not
     alphabetical (a name-keyed round trip through plain dicts would re-sort)."""
+    base = path[: -len(".npz")] if path.endswith(".npz") else path
     flat = {}
     for k, v in state_dict.items():
         if k == "__meta__":
             continue
         for i, leaf in enumerate(jax.tree_util.tree_leaves(v)):
             flat[f"{k}::{i:06d}"] = np.asarray(leaf)
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    np.savez(path, **flat)
+    os.makedirs(os.path.dirname(base), exist_ok=True)
+    np.savez(base + ".npz", **flat)
     meta = state_dict.get("__meta__")
     if meta is not None:
-        with open(path + ".meta.json", "w") as f:
+        with open(base + ".meta.json", "w") as f:  # read side strips .npz too
             json.dump(meta, f, default=_json_safe)
 
 
@@ -188,9 +189,13 @@ class DecoupledCheckpointEngine(AsyncCheckpointEngine):
     def load(self, path, map_location=None):
         rank = jax.process_index()
         ranked = f"{path}.rank{rank}"
-        if os.path.isfile(ranked + ".npz"):
-            return _read_npz(ranked)
-        return _read_npz(f"{path}.rank0")
+        if not os.path.isfile(ranked + ".npz"):
+            raise FileNotFoundError(
+                f"{ranked}.npz missing: decoupled checkpoints resume with the SAME "
+                "process count/mapping they were saved with — reshape through the "
+                "universal (orbax) checkpoint path instead"
+            )
+        return _read_npz(ranked)
 
 
 ENGINES = {
