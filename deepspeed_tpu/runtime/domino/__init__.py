@@ -1,0 +1,5 @@
+"""Domino: tensor-parallel communication hiding (reference runtime/domino/)."""
+
+from deepspeed_tpu.runtime.domino.transformer import domino_layer, domino_transformer_layer
+
+__all__ = ["domino_layer", "domino_transformer_layer"]

@@ -1,0 +1,53 @@
+"""Domino: hide tensor-parallel collectives behind intra-layer microbatching.
+
+Analogue of the reference ``DominoTransformerLayer``
+(runtime/domino/transformer.py:250, ``ShardedAttention`` :108): the batch
+splits into chunks WITHIN a layer so chunk k's row-parallel all-reduce
+overlaps chunk k+1's compute — the reference manages async NCCL handles by
+hand (``DominoUtil`` :34).
+
+TPU-native form: the chunks are independent programs over the same weights;
+issuing them as separate computations inside one jit lets XLA's
+latency-hiding scheduler interleave chunk k's psum with chunk k+1's matmuls
+— no handle bookkeeping. The wrapper composes with ANY layer fn (the
+reference hardcodes its own attention/MLP pair)."""
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def domino_layer(layer_fn: Callable, x: jax.Array, n_chunks: int = 2, batch_axis: int = 0):
+    """Run ``layer_fn`` per batch chunk; XLA overlaps one chunk's TP
+    collectives with the next chunk's compute. Exact: chunks see the same
+    weights, outputs concatenate back. Falls through when the batch does not
+    divide."""
+    b = x.shape[batch_axis]
+    if n_chunks <= 1 or b % n_chunks:
+        return layer_fn(x)
+    chunks = jnp.split(x, n_chunks, axis=batch_axis)
+    # a Python loop (not scan): the chunk programs must be peers in the HLO
+    # schedule for the latency-hiding scheduler to interleave them — a scan
+    # would serialize them behind a loop carry
+    outs = [layer_fn(c) for c in chunks]
+    return jnp.concatenate(outs, axis=batch_axis)
+
+
+def domino_transformer_layer(config, lp, x, positions, segment_ids, n_chunks: int = 2):
+    """The model-family layer under Domino chunking (reference
+    DominoTransformerLayer): aux losses average over chunks."""
+    from deepspeed_tpu.models import transformer as T
+
+    b = x.shape[0]
+    if n_chunks <= 1 or b % n_chunks:
+        return T._layer(config, lp, x, positions, segment_ids)
+    outs, auxes = [], []
+    for i, xc in enumerate(jnp.split(x, n_chunks, axis=0)):
+        seg_c = None
+        if segment_ids is not None:
+            seg_c = jnp.split(segment_ids, n_chunks, axis=0)[i]
+        y, aux = T._layer(config, lp, xc, positions, seg_c)
+        outs.append(y)
+        auxes.append(aux)
+    return jnp.concatenate(outs, axis=0), sum(auxes) / n_chunks

@@ -1,0 +1,47 @@
+"""Progressive Layer Drop (reference ``runtime/progressive_layer_drop.py``,
+engine hookup :1975): theta(t) = (1 - theta) * exp(-gamma * t) + theta decays
+the keep probability ceiling from 1.0 toward theta; layers drop with depth-
+scaled probability (PLD paper: p_l = 1 - l/L * (1 - theta_t))."""
+
+import math
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+        log_dist(f"Enabled progressive layer dropping (theta = {self.theta})", ranks=[0])
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> float:
+        self.current_theta = (1.0 - self.theta) * math.exp(-self.gamma * global_step) + self.theta
+        return self.current_theta
+
+
+def layer_keep_probs(n_layers: int, theta_t: float) -> jnp.ndarray:
+    """Depth-scaled keep probabilities: shallow layers keep more (PLD paper
+    eq. 6: p_l = 1 - (l / L) * (1 - theta_t))."""
+    depth = jnp.arange(1, n_layers + 1, dtype=jnp.float32)
+    return 1.0 - (depth / n_layers) * (1.0 - theta_t)
+
+
+def apply_layer_drop(layer_fn: Callable, x, keep_prob, rng) -> jnp.ndarray:
+    """Stochastic identity-skip of one layer with inverse-prob output scaling
+    (so the expected forward matches the full model; the reference wraps the
+    torch module forward the same way)."""
+    keep = jax.random.bernoulli(rng, keep_prob)
+    y = layer_fn(x)
+    scaled = x + (y - x) / jnp.maximum(keep_prob, 1e-3)
+    return jnp.where(keep, scaled, x)

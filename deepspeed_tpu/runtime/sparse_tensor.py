@@ -1,0 +1,70 @@
+"""Sparse tensors + sparse gradient allreduce.
+
+Analogue of the reference ``runtime/sparse_tensor.py`` (``SparseTensor``) and
+the engine's sparse-grad allreduce (``engine.py:2962-3031``
+``sparse_allreduce_bucket``): embedding gradients touch only the rows whose
+tokens appeared in the batch, so the exchange moves (indices, values)
+instead of the dense [vocab, h] gradient.
+
+TPU form: the collective is one ``all_gather`` of each rank's (indices,
+values) pair inside shard_map (the reference gathers both via two
+all_gathers too); densification is a scatter-add. Static shapes: callers
+bound ``max_rows`` (the per-rank row budget) and pad with a sentinel row.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.parallel.topology import DATA_AXIS
+
+SENTINEL = -1
+
+
+class SparseTensor(NamedTuple):
+    """Row-sparse view of a [rows, cols] dense tensor (reference
+    SparseTensor: indices + values + dense size)."""
+
+    indices: jax.Array  # [k] int32 row ids; SENTINEL = padding
+    values: jax.Array  # [k, cols]
+    dense_rows: int
+
+    @property
+    def sparse_size(self) -> int:
+        return int(self.indices.shape[0]) * int(self.values.shape[1])
+
+
+def dense_to_sparse(grad: jax.Array, max_rows: int) -> SparseTensor:
+    """Top-``max_rows`` nonzero rows by L1 mass (the embedding-grad case:
+    rows for tokens absent from the batch are exactly zero)."""
+    rows = grad.shape[0]
+    mass = jnp.sum(jnp.abs(grad.astype(jnp.float32)), axis=-1)
+    k = min(max_rows, rows)
+    _, idx = jax.lax.top_k(mass, k)
+    vals = grad[idx]
+    live = mass[idx] > 0
+    idx = jnp.where(live, idx, SENTINEL).astype(jnp.int32)
+    return SparseTensor(indices=idx, values=vals, dense_rows=rows)
+
+
+def sparse_to_dense(st: SparseTensor) -> jax.Array:
+    """Scatter-add back to dense (sentinel rows drop into a trash row)."""
+    rows = st.dense_rows
+    safe = jnp.where(st.indices == SENTINEL, rows, st.indices)
+    dense = jnp.zeros((rows + 1, st.values.shape[1]), st.values.dtype)
+    dense = dense.at[safe].add(st.values)
+    return dense[:rows]
+
+
+def sparse_allreduce(st: SparseTensor, axis_name: str = DATA_AXIS, mean: bool = True) -> SparseTensor:
+    """Call INSIDE shard_map over ``axis_name``: gather every rank's
+    (indices, values); duplicates are fine — densification adds them. Bytes
+    on the wire: W * k * cols instead of rows * cols (a win whenever the
+    union of touched rows is small, reference sparse_allreduce :2984)."""
+    W = jax.lax.axis_size(axis_name)
+    idx = jax.lax.all_gather(st.indices, axis_name, axis=0, tiled=True)  # [W*k]
+    vals = jax.lax.all_gather(st.values, axis_name, axis=0, tiled=True)  # [W*k, cols]
+    if mean:
+        vals = vals / W
+    return SparseTensor(indices=idx, values=vals, dense_rows=st.dense_rows)

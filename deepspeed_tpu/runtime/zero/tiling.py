@@ -1,0 +1,66 @@
+"""TiledLinear: tile a huge linear's dims so only active tiles materialize.
+
+Analogue of the reference ``runtime/zero/tiling.py:32 TiledLinear``: the
+weight splits into an (in_splits × out_splits) grid processed sequentially —
+with ZeRO-3/offload, inactive tiles stay partitioned/offloaded, bounding
+peak memory by one tile. Functional form: the tiles ARE the params (a
+[in_splits, out_splits, tile_in, tile_out] stack the ZeRO plan shards like
+any leaf), and the matmul scans the grid accumulating partial products.
+"""
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def init_tiled_linear(
+    key: jax.Array,
+    in_features: int,
+    out_features: int,
+    in_splits: int = 1,
+    out_splits: int = 1,
+    bias: bool = True,
+    dtype=jnp.float32,
+    weight: Optional[jax.Array] = None,
+) -> Dict[str, Any]:
+    assert in_features % in_splits == 0 and out_features % out_splits == 0
+    ti, to = in_features // in_splits, out_features // out_splits
+    if weight is None:
+        weight = jax.random.normal(key, (in_features, out_features), jnp.float32) * (
+            in_features**-0.5
+        )
+    tiles = (
+        weight.reshape(in_splits, ti, out_splits, to).transpose(0, 2, 1, 3).astype(dtype)
+    )  # [in_splits, out_splits, ti, to]
+    out = {"tiles": tiles}
+    if bias:
+        out["bias"] = jnp.zeros((out_features,), dtype)
+    return out
+
+
+def tiled_linear(params: Dict[str, Any], x: jax.Array) -> jax.Array:
+    """y = x @ W + b over the tile grid: scan over in_splits accumulating
+    into [.., out] so at most one [ti, out_splits*to] row of tiles is live."""
+    tiles = params["tiles"]  # [I, O, ti, to]
+    I, O, ti, to = tiles.shape
+    xt = x.reshape(x.shape[:-1] + (I, ti))
+
+    def body(acc, io):
+        x_i, row = io  # x_i: [.., ti]; row: [O, ti, to]
+        part = jnp.einsum("...i,oid->...od", x_i, row)
+        return acc + part.reshape(part.shape[:-2] + (O * to,)), None
+
+    x_scan = jnp.moveaxis(xt, -2, 0)  # [I, .., ti]
+    acc0 = jnp.zeros(x.shape[:-1] + (O * to,), x.dtype)
+    acc, _ = jax.lax.scan(body, acc0, (x_scan, tiles))
+    if "bias" in params:
+        acc = acc + params["bias"]
+    return acc
+
+
+def tiled_linear_weight(params: Dict[str, Any]) -> jax.Array:
+    """Reassemble the dense [in, out] weight (export/debug)."""
+    tiles = params["tiles"]
+    I, O, ti, to = tiles.shape
+    return tiles.transpose(0, 2, 1, 3).reshape(I * ti, O * to)

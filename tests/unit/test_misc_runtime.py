@@ -1,0 +1,154 @@
+"""Domino, TiledLinear, sparse tensors, progressive layer drop (analogue of
+reference tests for runtime/domino, zero/tiling, sparse grads, PLD)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deepspeed_tpu.runtime.domino import domino_layer, domino_transformer_layer
+from deepspeed_tpu.runtime.progressive_layer_drop import (
+    ProgressiveLayerDrop,
+    apply_layer_drop,
+    layer_keep_probs,
+)
+from deepspeed_tpu.runtime.sparse_tensor import (
+    SENTINEL,
+    SparseTensor,
+    dense_to_sparse,
+    sparse_allreduce,
+    sparse_to_dense,
+)
+from deepspeed_tpu.runtime.zero.tiling import (
+    init_tiled_linear,
+    tiled_linear,
+    tiled_linear_weight,
+)
+
+
+class TestDomino:
+    def test_chunked_layer_is_exact(self):
+        w = jax.random.normal(jax.random.key(0), (16, 16))
+        layer = lambda x: jnp.tanh(x @ w)
+        x = jax.random.normal(jax.random.key(1), (8, 16))
+        np.testing.assert_allclose(
+            np.asarray(domino_layer(layer, x, n_chunks=2)),
+            np.asarray(layer(x)),
+            rtol=1e-6,
+        )
+
+    def test_indivisible_batch_falls_through(self):
+        layer = lambda x: x + 1
+        x = jnp.ones((7, 4))
+        np.testing.assert_allclose(np.asarray(domino_layer(layer, x, 2)), np.asarray(x + 1))
+
+    def test_transformer_layer_chunked_matches(self, devices8):
+        from deepspeed_tpu.models import TransformerConfig, init_params
+        from deepspeed_tpu.models import transformer as T
+        from deepspeed_tpu.parallel.topology import Topology, reset_topology, set_topology
+
+        reset_topology()
+        set_topology(Topology(data=4, model=2))
+        cfg = TransformerConfig(
+            vocab_size=64, hidden_size=32, n_layers=1, n_heads=2, max_seq_len=32,
+            dtype="float32",
+        )
+        params = init_params(cfg, jax.random.key(0))
+        lp = jax.tree.map(lambda l: l[0], params["layers"])
+        x = jax.random.normal(jax.random.key(1), (4, 16, 32))
+        pos = jnp.arange(16)
+        y_plain, aux_plain = T._layer(cfg, lp, x, pos, None)
+        y_dom, aux_dom = domino_transformer_layer(cfg, lp, x, pos, None, n_chunks=2)
+        np.testing.assert_allclose(np.asarray(y_dom), np.asarray(y_plain), atol=1e-5)
+        reset_topology()
+
+
+class TestTiledLinear:
+    def test_matches_dense(self):
+        key = jax.random.key(0)
+        p = init_tiled_linear(key, 32, 24, in_splits=4, out_splits=3)
+        x = jax.random.normal(jax.random.key(1), (5, 32))
+        dense = x @ tiled_linear_weight(p) + p["bias"]
+        np.testing.assert_allclose(np.asarray(tiled_linear(p, x)), np.asarray(dense), atol=1e-5)
+
+    def test_from_existing_weight(self):
+        w = jax.random.normal(jax.random.key(2), (16, 8))
+        p = init_tiled_linear(jax.random.key(0), 16, 8, in_splits=2, out_splits=2, bias=False, weight=w)
+        np.testing.assert_allclose(np.asarray(tiled_linear_weight(p)), np.asarray(w), atol=1e-6)
+        x = jnp.ones((3, 16))
+        np.testing.assert_allclose(np.asarray(tiled_linear(p, x)), np.asarray(x @ w), atol=1e-5)
+
+    def test_gradients_flow(self):
+        p = init_tiled_linear(jax.random.key(0), 8, 8, in_splits=2, out_splits=2)
+        g = jax.grad(lambda p, x: jnp.sum(tiled_linear(p, x) ** 2))(p, jnp.ones((2, 8)))
+        assert float(jnp.abs(g["tiles"]).sum()) > 0
+
+
+class TestSparseTensor:
+    def test_roundtrip(self):
+        dense = jnp.zeros((16, 4)).at[3].set(1.0).at[11].set(2.0)
+        st = dense_to_sparse(dense, max_rows=4)
+        assert (np.asarray(st.indices) != SENTINEL).sum() == 2
+        np.testing.assert_allclose(np.asarray(sparse_to_dense(st)), np.asarray(dense))
+
+    def test_wire_size_smaller(self):
+        dense = jnp.zeros((1024, 64)).at[5].set(1.0)
+        st = dense_to_sparse(dense, max_rows=8)
+        assert st.sparse_size < dense.size // 100
+
+    def test_sparse_allreduce_matches_dense_mean(self, devices8):
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("data",))
+        rows, cols, k = 32, 4, 6
+        rng = np.random.default_rng(0)
+        dense = np.zeros((8, rows, cols), np.float32)
+        for r in range(8):  # each rank touches a few rows
+            for i in rng.integers(0, rows, size=3):
+                dense[r, i] = rng.normal(size=cols)
+        dense_j = jnp.asarray(dense)
+
+        def run(d):
+            st = dense_to_sparse(d[0], max_rows=k)
+            out = sparse_allreduce(st, "data")
+            return sparse_to_dense(out)[None]
+
+        fn = jax.jit(
+            jax.shard_map(
+                run, mesh=mesh, in_specs=(P("data"),), out_specs=P("data"),
+                axis_names={"data"}, check_vma=False,
+            )
+        )
+        out = np.asarray(fn(dense_j))
+        expected = dense.mean(axis=0)
+        for r in range(8):
+            np.testing.assert_allclose(out[r], expected, atol=1e-6)
+
+
+class TestPLD:
+    def test_theta_schedule_matches_reference_math(self):
+        pld = ProgressiveLayerDrop(theta=0.5, gamma=0.001)
+        assert pld.get_theta() == 1.0
+        import math
+
+        for t in (0, 100, 5000):
+            assert pld.update_state(t) == pytest.approx(0.5 * math.exp(-0.001 * t) + 0.5)
+        assert pld.get_state()["progressive_layer_drop"] is True
+
+    def test_depth_scaled_keep_probs(self):
+        p = np.asarray(layer_keep_probs(4, theta_t=0.6))
+        assert p[0] > p[-1]  # shallow layers keep more
+        np.testing.assert_allclose(p[-1], 0.6)
+
+    def test_apply_layer_drop(self):
+        layer = lambda x: x * 2.0
+        x = jnp.ones((4, 8))
+        # keep_prob 1 → always the (unscaled) layer output
+        y = apply_layer_drop(layer, x, 1.0, jax.random.key(0))
+        np.testing.assert_allclose(np.asarray(y), 2.0)
+        # expectation over many keys ≈ full-model output (inverse scaling)
+        outs = [
+            np.asarray(apply_layer_drop(layer, x, 0.5, jax.random.key(i)))
+            for i in range(200)
+        ]
+        np.testing.assert_allclose(np.mean(outs), 2.0, rtol=0.15)
