@@ -50,5 +50,9 @@ class FusedRMSNormBuilder(PallasOpBuilder):
         return rms_norm
 
 
+# Native (C++ host) ops register themselves on import of their modules.
+from deepspeed_tpu.ops import aio as _aio  # noqa: F401  (registers async_io)
+from deepspeed_tpu.ops.adam import cpu_adam as _cpu_adam  # noqa: F401  (registers cpu_adam)
+
 # Compatibility table (reference deepspeed.ops.__compatible_ops__)
 __compatible_ops__ = {name: True for name in ALL_OPS}

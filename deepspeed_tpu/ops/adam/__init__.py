@@ -8,5 +8,17 @@ all leaves inside one jitted step, letting XLA batch the kernel launches.
 """
 
 from deepspeed_tpu.ops.adam.fused_adam import FusedAdam, fused_adam_step, fused_adam_transform
+from deepspeed_tpu.ops.adam.cpu_adam import (
+    DeepSpeedCPUAdam,
+    cpu_adagrad_step,
+    cpu_lion_step,
+)
 
-__all__ = ["FusedAdam", "fused_adam_step", "fused_adam_transform"]
+__all__ = [
+    "FusedAdam",
+    "fused_adam_step",
+    "fused_adam_transform",
+    "DeepSpeedCPUAdam",
+    "cpu_adagrad_step",
+    "cpu_lion_step",
+]

@@ -1,0 +1,179 @@
+"""Host-side (CPU) optimizer kernels for the offload tiers.
+
+Reference: ``csrc/adam/cpu_adam.cpp`` (`adam_update` binding cpu_adam.cpp:10-13,
+AVX loops cpu_adam_impl.cpp), ``csrc/adagrad/``, ``csrc/lion/`` — wrapped by
+``ops/adam/DeepSpeedCPUAdam``. The native engine here is
+``csrc/adam/cpu_adam.cpp`` (this repo): autovectorized OpenMP loops over flat
+fp32 arrays, JIT-built by ``NativeOpBuilder``; a numpy fallback keeps parity
+when no toolchain exists.
+
+Used by the ZeRO-Offload/SuperOffload path: grads stream D2H, the step runs
+here against host-resident master weights + moments, updated params stream
+H2D — the device never holds optimizer state.
+"""
+
+import ctypes
+import itertools
+
+import numpy as np
+
+from deepspeed_tpu.ops.op_builder import NativeOpBuilder, register_op
+
+
+@register_op
+class CPUAdamBuilder(NativeOpBuilder):
+    NAME = "cpu_adam"
+    SOURCES = ("adam/cpu_adam.cpp",)
+
+    def _bind(self, lib):
+        f32, i32, i64 = ctypes.c_float, ctypes.c_int, ctypes.c_int64
+        fp = ctypes.POINTER(ctypes.c_float)
+        u16p = ctypes.POINTER(ctypes.c_uint16)
+        lib.dstpu_create_adam.restype = i32
+        lib.dstpu_create_adam.argtypes = [i32, f32, f32, f32, f32, f32, i32]
+        lib.dstpu_destroy_adam.restype = i32
+        lib.dstpu_destroy_adam.argtypes = [i32]
+        lib.dstpu_adam_update.restype = i32
+        lib.dstpu_adam_update.argtypes = [i32, i64, f32, fp, fp, fp, fp, i64]
+        lib.dstpu_adagrad_update.restype = i32
+        lib.dstpu_adagrad_update.argtypes = [f32, f32, f32, fp, fp, fp, i64]
+        lib.dstpu_lion_update.restype = i32
+        lib.dstpu_lion_update.argtypes = [f32, f32, f32, f32, fp, fp, fp, i64]
+        lib.dstpu_bf16_to_fp32.restype = i32
+        lib.dstpu_bf16_to_fp32.argtypes = [u16p, fp, i64]
+        lib.dstpu_fp32_to_bf16.restype = i32
+        lib.dstpu_fp32_to_bf16.argtypes = [fp, u16p, i64]
+
+
+_IDS = itertools.count(1)
+
+
+def _native_lib():
+    return CPUAdamBuilder.lib()
+
+
+def _fp(a):
+    assert a.dtype == np.float32 and a.flags["C_CONTIGUOUS"]
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+class DeepSpeedCPUAdam:
+    """Host Adam/AdamW over flat numpy fp32 state (reference
+    ops/adam/cpu_adam.py:DeepSpeedCPUAdam).
+
+    ``step(params, grads, exp_avg, exp_avg_sq, lr=...)`` mutates the numpy
+    arrays in place and returns the step count. All arrays must be fp32,
+    C-contiguous, same length.
+    """
+
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
+                 adamw_mode=True):
+        self.lr = lr
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adamw_mode = adamw_mode
+        self.steps = 0
+        self._id = next(_IDS)
+        self._lib = _native_lib()
+        if self._lib is not None:
+            self._lib.dstpu_create_adam(
+                self._id, lr, self.beta1, self.beta2, eps, weight_decay,
+                int(adamw_mode))
+
+    @property
+    def is_native(self):
+        return self._lib is not None
+
+    def step(self, params, grads, exp_avg, exp_avg_sq, lr=None, step=None):
+        lr = self.lr if lr is None else float(lr)
+        self.steps = int(step) if step is not None else self.steps + 1
+        n = params.size
+        assert grads.size == n and exp_avg.size == n and exp_avg_sq.size == n
+        if self._lib is not None:
+            rc = self._lib.dstpu_adam_update(
+                self._id, self.steps, lr, _fp(params), _fp(grads), _fp(exp_avg),
+                _fp(exp_avg_sq), n)
+            assert rc == 0, f"cpu adam_update failed rc={rc}"
+            return self.steps
+        # numpy fallback — bit-for-bit same math as the C++ loop
+        b1, b2, eps, wd = self.beta1, self.beta2, self.eps, self.weight_decay
+        t = self.steps
+        g = grads
+        if not self.adamw_mode and wd != 0.0:
+            g = grads + wd * params
+        np.multiply(exp_avg, b1, out=exp_avg)
+        exp_avg += (1.0 - b1) * g
+        np.multiply(exp_avg_sq, b2, out=exp_avg_sq)
+        exp_avg_sq += (1.0 - b2) * np.square(g)
+        bc1 = 1.0 - b1 ** t
+        bc2 = 1.0 - b2 ** t
+        denom = np.sqrt(exp_avg_sq) / np.sqrt(bc2) + eps
+        if self.adamw_mode and wd != 0.0:
+            params *= 1.0 - lr * wd
+        params -= (lr / bc1) * (exp_avg / denom)
+        return self.steps
+
+    def __del__(self):
+        try:
+            if self._lib is not None:
+                self._lib.dstpu_destroy_adam(self._id)
+        except Exception:
+            pass
+
+
+def cpu_adagrad_step(params, grads, exp_avg_sq, lr, eps=1e-8, weight_decay=0.0):
+    """Host Adagrad step (reference csrc/adagrad/cpu_adagrad.cpp)."""
+    lib = _native_lib()
+    if lib is not None:
+        rc = lib.dstpu_adagrad_update(lr, eps, weight_decay, _fp(params),
+                                      _fp(grads), _fp(exp_avg_sq), params.size)
+        assert rc == 0
+        return
+    g = grads + weight_decay * params if weight_decay else grads
+    exp_avg_sq += np.square(g)
+    params -= lr * g / (np.sqrt(exp_avg_sq) + eps)
+
+
+def cpu_lion_step(params, grads, exp_avg, lr, betas=(0.9, 0.99), weight_decay=0.0):
+    """Host Lion step (reference csrc/lion/cpu_lion.cpp)."""
+    lib = _native_lib()
+    if lib is not None:
+        rc = lib.dstpu_lion_update(lr, betas[0], betas[1], weight_decay,
+                                   _fp(params), _fp(grads), _fp(exp_avg), params.size)
+        assert rc == 0
+        return
+    c = betas[0] * exp_avg + (1.0 - betas[0]) * grads
+    params -= lr * (np.sign(c) + weight_decay * params)
+    exp_avg *= betas[1]
+    exp_avg += (1.0 - betas[1]) * grads
+
+
+def bf16_to_fp32(src_u16, dst_f32=None):
+    """Widen a bf16-as-uint16 view into fp32 (native round trip helper)."""
+    lib = _native_lib()
+    if dst_f32 is None:
+        dst_f32 = np.empty(src_u16.size, dtype=np.float32)
+    if lib is not None and src_u16.flags["C_CONTIGUOUS"]:
+        lib.dstpu_bf16_to_fp32(
+            src_u16.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)), _fp(dst_f32),
+            src_u16.size)
+    else:
+        dst_f32[:] = (src_u16.astype(np.uint32) << 16).view(np.float32)
+    return dst_f32
+
+
+def fp32_to_bf16(src_f32, dst_u16=None):
+    """Round fp32 to bf16-as-uint16 with round-to-nearest-even."""
+    lib = _native_lib()
+    if dst_u16 is None:
+        dst_u16 = np.empty(src_f32.size, dtype=np.uint16)
+    if lib is not None and src_f32.flags["C_CONTIGUOUS"]:
+        lib.dstpu_fp32_to_bf16(
+            _fp(src_f32), dst_u16.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+            src_f32.size)
+    else:
+        bits = src_f32.view(np.uint32)
+        rounding = np.uint32(0x7FFF) + ((bits >> 16) & 1)
+        dst_u16[:] = ((bits + rounding) >> 16).astype(np.uint16)
+    return dst_u16

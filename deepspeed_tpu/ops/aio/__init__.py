@@ -1,0 +1,232 @@
+"""Async host file I/O — the DeepNVMe/AIO analogue.
+
+Reference surface: ``deepspeed/ops/aio`` + ``csrc/aio/py_lib`` (aio_handle with
+sync_pread/sync_pwrite/async_pread/async_pwrite/wait, pinned-tensor manager
+deepspeed_pin_tensor.cpp). Here the native engine is ``csrc/aio/dstpu_aio.cpp``
+(worker-thread pool slicing each transfer, page-aligned buffers), JIT-built by
+``NativeOpBuilder`` and bound via ctypes; a ThreadPoolExecutor fallback keeps
+the API available when no C++ toolchain exists.
+
+Buffers are numpy arrays (any contiguous dtype); the NVMe swap tier moves
+bytes between these host buffers and jax arrays at the HBM boundary.
+"""
+
+import ctypes
+import os
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from deepspeed_tpu.ops.op_builder import NativeOpBuilder, register_op
+
+
+@register_op
+class AsyncIOBuilder(NativeOpBuilder):
+    NAME = "async_io"
+    SOURCES = ("aio/dstpu_aio.cpp",)
+
+    def _bind(self, lib):
+        p, i32, i64, cp = ctypes.c_void_p, ctypes.c_int, ctypes.c_int64, ctypes.c_char_p
+        lib.dstpu_aio_handle_new.restype = p
+        lib.dstpu_aio_handle_new.argtypes = [i64, i32, i32, i32, i32]
+        lib.dstpu_aio_handle_free.argtypes = [p]
+        for fn in ("dstpu_aio_async_pread", "dstpu_aio_async_pwrite",
+                   "dstpu_aio_sync_pread", "dstpu_aio_sync_pwrite"):
+            f = getattr(lib, fn)
+            f.restype = i64
+            f.argtypes = [p, ctypes.c_void_p, i64, cp, i64]
+        lib.dstpu_aio_wait.restype = i64
+        lib.dstpu_aio_wait.argtypes = [p]
+        lib.dstpu_aio_pending.restype = i64
+        lib.dstpu_aio_pending.argtypes = [p]
+        lib.dstpu_aio_block_size.restype = i64
+        lib.dstpu_aio_block_size.argtypes = [p]
+        lib.dstpu_aio_alloc_pinned.restype = ctypes.c_void_p
+        lib.dstpu_aio_alloc_pinned.argtypes = [i64]
+        lib.dstpu_aio_free_pinned.argtypes = [ctypes.c_void_p]
+        lib.dstpu_aio_file_size.restype = i64
+        lib.dstpu_aio_file_size.argtypes = [cp]
+
+
+def _native_lib():
+    # legacy kill-switch kept alongside the canonical DSTPU_DISABLE_NATIVE_ASYNC_IO
+    if os.environ.get("DSTPU_DISABLE_NATIVE_AIO") == "1":
+        return None
+    return AsyncIOBuilder.lib()
+
+
+def _check(arr):
+    assert isinstance(arr, np.ndarray) and arr.flags["C_CONTIGUOUS"], (
+        "aio buffers must be C-contiguous numpy arrays"
+    )
+    return arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes
+
+
+class AioHandle:
+    """Reference ``aio_handle`` parity object (csrc/aio/py_lib/py_ds_aio.cpp).
+
+    One handle owns ``intra_op_parallelism`` worker threads; each pread/pwrite
+    is sliced across them in ``block_size`` chunks. ``wait()`` blocks until all
+    in-flight ops retire and returns how many it retired.
+    """
+
+    def __init__(self, block_size=1 << 20, queue_depth=8, single_submit=False,
+                 overlap_events=True, intra_op_parallelism=4):
+        self._lib = _native_lib()
+        self._block_size = block_size
+        self._queue_depth = queue_depth
+        self._parallelism = intra_op_parallelism
+        self._pinned = {}  # id(array) -> base pointer
+        if self._lib is not None:
+            self._h = self._lib.dstpu_aio_handle_new(
+                block_size, queue_depth, int(single_submit), int(overlap_events),
+                intra_op_parallelism)
+            self._pool = None
+            self._futures = []
+        else:
+            self._h = None
+            self._pool = ThreadPoolExecutor(max_workers=intra_op_parallelism)
+            self._futures = []
+
+    # -- properties (reference get_block_size/get_queue_depth/...) --
+    def get_block_size(self):
+        return self._block_size
+
+    def get_queue_depth(self):
+        return self._queue_depth
+
+    def get_intra_op_parallelism(self):
+        return self._parallelism
+
+    # -- sync ops --
+    def sync_pread(self, buffer, filename, file_offset=0):
+        if self._h is not None:
+            ptr, n = _check(buffer)
+            rc = self._lib.dstpu_aio_sync_pread(self._h, ptr, n, filename.encode(), file_offset)
+            if rc < 0:
+                raise OSError(-rc, os.strerror(-rc), filename)
+            return n
+        return self._py_pread(buffer, filename, file_offset)
+
+    def sync_pwrite(self, buffer, filename, file_offset=0):
+        if self._h is not None:
+            ptr, n = _check(buffer)
+            rc = self._lib.dstpu_aio_sync_pwrite(self._h, ptr, n, filename.encode(), file_offset)
+            if rc < 0:
+                raise OSError(-rc, os.strerror(-rc), filename)
+            return n
+        return self._py_pwrite(buffer, filename, file_offset)
+
+    # -- async ops --
+    def async_pread(self, buffer, filename, file_offset=0):
+        if self._h is not None:
+            ptr, n = _check(buffer)
+            rc = self._lib.dstpu_aio_async_pread(self._h, ptr, n, filename.encode(), file_offset)
+            if rc < 0:
+                raise OSError(-rc, os.strerror(-rc), filename)
+            return rc
+        self._futures.append(self._pool.submit(self._py_pread, buffer, filename, file_offset))
+        return len(self._futures)
+
+    def async_pwrite(self, buffer, filename, file_offset=0):
+        if self._h is not None:
+            ptr, n = _check(buffer)
+            rc = self._lib.dstpu_aio_async_pwrite(self._h, ptr, n, filename.encode(), file_offset)
+            if rc < 0:
+                raise OSError(-rc, os.strerror(-rc), filename)
+            return rc
+        self._futures.append(self._pool.submit(self._py_pwrite, buffer, filename, file_offset))
+        return len(self._futures)
+
+    def wait(self):
+        if self._h is not None:
+            rc = self._lib.dstpu_aio_wait(self._h)
+            if rc < 0:
+                raise OSError(-rc, os.strerror(-rc))
+            return rc
+        # drain ALL futures exactly once, even when one raises
+        futures, self._futures = self._futures, []
+        done = 0
+        first_err = None
+        for f in futures:
+            try:
+                f.result()
+                done += 1
+            except Exception as e:
+                if first_err is None:
+                    first_err = e
+        if first_err is not None:
+            raise first_err
+        return done
+
+    def pending(self):
+        if self._h is not None:
+            return self._lib.dstpu_aio_pending(self._h)
+        return sum(0 if f.done() else 1 for f in self._futures)
+
+    # -- pinned buffers (reference new_cpu_locked_tensor) --
+    def new_cpu_locked_tensor(self, num_elem, dtype=np.float32):
+        dtype = np.dtype(dtype)
+        nbytes = int(num_elem) * dtype.itemsize
+        if self._h is not None:
+            base = self._lib.dstpu_aio_alloc_pinned(nbytes)
+            if not base:
+                raise MemoryError("pinned alloc failed")
+            buf = (ctypes.c_char * nbytes).from_address(base)
+            arr = np.frombuffer(buf, dtype=dtype, count=num_elem)
+            arr.flags.writeable = True
+            # keyed by data address so views/reshapes of the buffer free too
+            self._pinned[int(arr.ctypes.data)] = base
+            return arr
+        return np.empty(num_elem, dtype=dtype)
+
+    def free_cpu_locked_tensor(self, arr):
+        base = self._pinned.pop(int(arr.ctypes.data), None)
+        if base is not None:
+            self._lib.dstpu_aio_free_pinned(base)
+
+    # -- fallback impls --
+    @staticmethod
+    def _py_pread(buffer, filename, offset):
+        with open(filename, "rb") as f:
+            f.seek(offset)
+            data = f.read(buffer.nbytes)
+        flat = buffer.reshape(-1).view(np.uint8)
+        flat[: len(data)] = np.frombuffer(data, dtype=np.uint8)
+        if len(data) < buffer.nbytes:
+            flat[len(data):] = 0
+        return buffer.nbytes
+
+    @staticmethod
+    def _py_pwrite(buffer, filename, offset):
+        # O_CREAT without O_TRUNC: concurrent writers to distinct offsets of a
+        # new file must not clobber each other (matches the native engine).
+        fd = os.open(filename, os.O_WRONLY | os.O_CREAT, 0o644)
+        try:
+            os.pwrite(fd, buffer.tobytes(), offset)
+        finally:
+            os.close(fd)
+        return buffer.nbytes
+
+    def __del__(self):
+        try:
+            if self._h is not None and self._lib is not None:
+                self._lib.dstpu_aio_handle_free(self._h)
+                self._h = None
+                for base in self._pinned.values():
+                    self._lib.dstpu_aio_free_pinned(base)
+                self._pinned.clear()
+            if self._pool is not None:
+                self._pool.shutdown(wait=False)
+        except Exception:
+            pass
+
+
+def aio_handle(*args, **kwargs):
+    """Factory matching the reference module-level constructor name."""
+    return AioHandle(*args, **kwargs)
+
+
+def is_native():
+    """True when the C++ engine (not the thread-pool fallback) is active."""
+    return _native_lib() is not None

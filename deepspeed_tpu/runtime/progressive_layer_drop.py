@@ -40,8 +40,16 @@ def layer_keep_probs(n_layers: int, theta_t: float) -> jnp.ndarray:
 def apply_layer_drop(layer_fn: Callable, x, keep_prob, rng) -> jnp.ndarray:
     """Stochastic identity-skip of one layer with inverse-prob output scaling
     (so the expected forward matches the full model; the reference wraps the
-    torch module forward the same way)."""
+    torch module forward the same way).
+
+    Uses ``lax.cond`` so a dropped layer's FLOPs are actually skipped — PLD's
+    point is the training speedup, not just the regularization. Under vmap
+    cond degrades to select (both branches); drive it with a per-batch (not
+    per-example) coin so the speedup survives jit."""
     keep = jax.random.bernoulli(rng, keep_prob)
-    y = layer_fn(x)
-    scaled = x + (y - x) / jnp.maximum(keep_prob, 1e-3)
-    return jnp.where(keep, scaled, x)
+
+    def kept(x):
+        y = layer_fn(x)
+        return x + (y - x) / jnp.maximum(keep_prob, 1e-3)
+
+    return jax.lax.cond(keep, kept, lambda x: x, x)

@@ -37,7 +37,14 @@ class SparseTensor(NamedTuple):
 
 def dense_to_sparse(grad: jax.Array, max_rows: int) -> SparseTensor:
     """Top-``max_rows`` nonzero rows by L1 mass (the embedding-grad case:
-    rows for tokens absent from the batch are exactly zero)."""
+    rows for tokens absent from the batch are exactly zero).
+
+    ``max_rows`` is a hard budget: if MORE than ``max_rows`` rows are nonzero
+    (unique-token count exceeds the budget) the excess rows would be silently
+    dropped and the allreduce would no longer equal the dense one. Callers
+    must size ``max_rows`` >= max unique tokens per batch (the engine sizes it
+    from micro_batch * seq_len); use ``sparse_overflowed`` as a jit-safe debug
+    check when in doubt."""
     rows = grad.shape[0]
     mass = jnp.sum(jnp.abs(grad.astype(jnp.float32)), axis=-1)
     k = min(max_rows, rows)
@@ -46,6 +53,13 @@ def dense_to_sparse(grad: jax.Array, max_rows: int) -> SparseTensor:
     live = mass[idx] > 0
     idx = jnp.where(live, idx, SENTINEL).astype(jnp.int32)
     return SparseTensor(indices=idx, values=vals, dense_rows=rows)
+
+
+def sparse_overflowed(grad: jax.Array, max_rows: int) -> jax.Array:
+    """Jit-safe scalar bool: True when ``dense_to_sparse(grad, max_rows)``
+    would drop live rows (more than max_rows rows have nonzero mass)."""
+    mass = jnp.sum(jnp.abs(grad.astype(jnp.float32)), axis=-1)
+    return jnp.sum((mass > 0).astype(jnp.int32)) > max_rows
 
 
 def sparse_to_dense(st: SparseTensor) -> jax.Array:

@@ -52,7 +52,9 @@ def tiled_linear(params: Dict[str, Any], x: jax.Array) -> jax.Array:
         return acc + part.reshape(part.shape[:-2] + (O * to,)), None
 
     x_scan = jnp.moveaxis(xt, -2, 0)  # [I, .., ti]
-    acc0 = jnp.zeros(x.shape[:-1] + (O * to,), x.dtype)
+    # carry dtype must match the einsum result (bf16 activations over fp32
+    # master tiles promote to fp32)
+    acc0 = jnp.zeros(x.shape[:-1] + (O * to,), jnp.result_type(x.dtype, tiles.dtype))
     acc, _ = jax.lax.scan(body, acc0, (x_scan, tiles))
     if "bias" in params:
         acc = acc + params["bias"]
