@@ -50,6 +50,56 @@ class FusedRMSNormBuilder(PallasOpBuilder):
         return rms_norm
 
 
+@register_op
+class SparseAttnBuilder(PallasOpBuilder):
+    NAME = "sparse_attn"
+
+    def _build(self):
+        from deepspeed_tpu.ops import sparse_attention
+
+        return sparse_attention
+
+
+@register_op
+class EvoformerAttnBuilder(PallasOpBuilder):
+    NAME = "evoformer_attn"
+
+    def _build(self):
+        from deepspeed_tpu.ops.deepspeed4science import DS4Sci_EvoformerAttention
+
+        return DS4Sci_EvoformerAttention
+
+
+@register_op
+class SpatialInferenceBuilder(PallasOpBuilder):
+    NAME = "spatial_inference"
+
+    def _build(self):
+        from deepspeed_tpu.ops import spatial
+
+        return spatial
+
+
+@register_op
+class RandomLTDBuilder(PallasOpBuilder):
+    NAME = "random_ltd"
+
+    def _build(self):
+        from deepspeed_tpu.ops import random_ltd
+
+        return random_ltd
+
+
+@register_op
+class FPQuantizerBuilder(PallasOpBuilder):
+    NAME = "fp_quantizer"
+
+    def _build(self):
+        from deepspeed_tpu.ops.quantizer import block_quant
+
+        return block_quant
+
+
 # Native (C++ host) ops register themselves on import of their modules.
 from deepspeed_tpu.ops import aio as _aio  # noqa: F401  (registers async_io)
 from deepspeed_tpu.ops.adam import cpu_adam as _cpu_adam  # noqa: F401  (registers cpu_adam)

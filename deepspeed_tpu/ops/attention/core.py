@@ -114,6 +114,21 @@ def _flash_sharded(q, k, v, causal, segment_ids, scale):
     sharding = jax.sharding.NamedSharding(topo.mesh, spec)
     q, k, v = (jax.lax.with_sharding_constraint(x, sharding) for x in (q, k, v))
 
+    if segment_ids is not None:
+        seg_spec = P(BATCH_AXES, None)
+        segment_ids = jax.lax.with_sharding_constraint(
+            segment_ids, jax.sharding.NamedSharding(topo.mesh, seg_spec)
+        )
+        fn = jax.shard_map(
+            lambda q_, k_, v_, s_: flash_attention(q_, k_, v_, causal=causal, segment_ids=s_, scale=scale),
+            mesh=topo.mesh,
+            in_specs=(spec, spec, spec, seg_spec),
+            out_specs=spec,
+            axis_names=set(topo.mesh.axis_names),
+            check_vma=False,
+        )
+        return fn(q, k, v, segment_ids)
+
     fn = jax.shard_map(
         lambda q_, k_, v_: flash_attention(q_, k_, v_, causal=causal, segment_ids=None, scale=scale),
         mesh=topo.mesh,
@@ -142,7 +157,6 @@ def attention(
         impl is None
         and _flash_available()
         and bias is None
-        and segment_ids is None
         and d in (64, 128, 256)
         and sq % 128 == 0
         and sk % 128 == 0

@@ -36,14 +36,17 @@ NEG_INF = -1e30
 LANES = 128
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq, bk):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq, bk,
+                seg_q_ref=None, seg_k_ref=None):
     # q_ref: [bq, d]; k_ref/v_ref: [s, d]; o_ref: [bq, d]; lse_ref: [bq, LANES]
+    # seg_q_ref: [bq] / seg_k_ref: [s] int32 segment ids (packed sequences)
     qi = pl.program_id(2)
     s = k_ref.shape[0]
     d = q_ref.shape[1]
     nk = s // bk
 
     q = q_ref[:].astype(jnp.float32) * scale
+    seg_q = seg_q_ref[:] if seg_q_ref is not None else None
 
     m0 = jnp.full((bq,), NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq,), jnp.float32)
@@ -61,6 +64,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq, bk):
         if causal:
             k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
+        if seg_q is not None:
+            seg_k = seg_k_ref[pl.ds(ki * bk, bk)]
+            logits = jnp.where(seg_q[:, None] == seg_k[None, :], logits, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
         p = jnp.exp(logits - m_new[:, None])
         corr = jnp.exp(m - m_new)
@@ -81,7 +87,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq, bk):
     lse_ref[:] = jnp.broadcast_to((m + jnp.log(l_safe))[:, None], (bq, LANES))
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, *, scale, causal, bq, bk):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, *, scale, causal, bq, bk,
+                   seg_q_ref=None, seg_k_ref=None):
     qi = pl.program_id(2)
     s = k_ref.shape[0]
     d = q_ref.shape[1]
@@ -92,6 +99,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, *, scale
     lse = lse_ref[:, 0]
     delta = jnp.sum(do * o_ref[:].astype(jnp.float32), axis=-1)  # [bq]
     q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    seg_q = seg_q_ref[:] if seg_q_ref is not None else None
 
     def body(ki, dq):
         k = k_ref[pl.ds(ki * bk, bk), :].astype(jnp.float32)
@@ -102,6 +110,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, *, scale
         if causal:
             k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
+        if seg_q is not None:
+            seg_k = seg_k_ref[pl.ds(ki * bk, bk)]
+            logits = jnp.where(seg_q[:, None] == seg_k[None, :], logits, NEG_INF)
         p = jnp.exp(logits - lse[:, None])  # [bq, bk]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -117,7 +128,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, *, scale
 
 
 def _bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref, *, scale, causal, bq, bk
+    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref, *, scale, causal, bq, bk,
+    seg_q_ref=None, seg_k_ref=None
 ):
     ki = pl.program_id(2)
     sq = q_ref.shape[0]
@@ -127,6 +139,7 @@ def _bwd_dkv_kernel(
     k = k_ref[:].astype(jnp.float32)
     v = v_ref[:].astype(jnp.float32)
     k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    seg_k = seg_k_ref[:] if seg_k_ref is not None else None
 
     def body(qj, carry):
         dk, dv = carry
@@ -141,6 +154,9 @@ def _bwd_dkv_kernel(
         if causal:
             q_pos = qj * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
+        if seg_k is not None:
+            seg_q = seg_q_ref[pl.ds(qj * bq, bq)]
+            logits = jnp.where(seg_q[:, None] == seg_k[None, :], logits, NEG_INF)
         p = jnp.exp(logits - lse[:, None])
         dv_new = dv + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -184,9 +200,6 @@ def _pick_block(s, target=None):
     return max(b, 1)
 
 
-@functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6)
-)
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -198,14 +211,31 @@ def flash_attention(
 ) -> jax.Array:
     """Flash attention. q: [b, h, s, d]; k, v: [b, h_kv, s, d] → [b, h, s, d].
 
-    ``segment_ids`` is not supported in the kernel path (dispatcher falls back
-    to the reference for packed sequences).
-    """
-    out, _ = _flash_fwd(q, k, v, causal, segment_ids, scale, interpret)
+    ``segment_ids``: optional [b, s] int32 — packed-sequence masking happens
+    IN the kernel (tokens attend only within their own segment), so packed
+    pretraining keeps the flash path."""
+    return _flash_core(q, k, v, segment_ids, causal, scale, interpret)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_core(q, k, v, segment_ids, causal, scale, interpret):
+    out, _ = _flash_fwd(q, k, v, segment_ids, causal, scale, interpret)
     return out
 
 
-def _flash_call(q, k, v, causal, scale, interpret):
+def _seg_specs(segment_ids, bq, s):
+    """(extra operands, extra in_specs) for the [b, s] segment-id planes:
+    a [bq] block aligned with the q block and the full [s] row."""
+    if segment_ids is None:
+        return [], []
+    seg = segment_ids.astype(jnp.int32)
+    return [seg, seg], [
+        pl.BlockSpec((1, bq), lambda b_, h_, i: (b_, i)),
+        pl.BlockSpec((1, s), lambda b_, h_, i: (b_, 0)),
+    ]
+
+
+def _flash_call(q, k, v, segment_ids, causal, scale, interpret):
     b, h, s, d = q.shape
     h_kv = k.shape[1]
     group = h // h_kv
@@ -214,18 +244,26 @@ def _flash_call(q, k, v, causal, scale, interpret):
     bk = _pick_block(s)
 
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk)
+    seg_ops, seg_specs = _seg_specs(segment_ids, bq, s)
+
+    def entry(qr, kr, vr, *rest):
+        if seg_ops:
+            sq_r, sk_r, orf, lr = rest
+            kernel(qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], orf.at[0, 0], lr.at[0, 0],
+                   seg_q_ref=sq_r.at[0], seg_k_ref=sk_r.at[0])
+        else:
+            orf, lr = rest
+            kernel(qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], orf.at[0, 0], lr.at[0, 0])
 
     out, lse = pl.pallas_call(
         # refs arrive with the leading (1, 1) block dims squeezed via .at
-        lambda qr, kr, vr, orf, lr: kernel(
-            qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], orf.at[0, 0], lr.at[0, 0]
-        ),
+        entry,
         grid=(b, h, s // bq),
         in_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
             pl.BlockSpec((1, 1, s, d), lambda b_, h_, i: (b_, h_ // group, 0, 0)),
             pl.BlockSpec((1, 1, s, d), lambda b_, h_, i: (b_, h_ // group, 0, 0)),
-        ],
+        ] + seg_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
             pl.BlockSpec((1, 1, bq, LANES), lambda b_, h_, i: (b_, h_, i, 0)),
@@ -235,18 +273,17 @@ def _flash_call(q, k, v, causal, scale, interpret):
             jax.ShapeDtypeStruct((b, h, s, LANES), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(q, k, v, *seg_ops)
     return out, lse
 
 
-def _flash_fwd(q, k, v, causal, segment_ids, scale, interpret):
-    assert segment_ids is None, "flash kernel does not take segment_ids; use the reference impl"
-    out, lse = _flash_call(q, k, v, causal, scale, interpret)
-    return out, (q, k, v, out, lse)
+def _flash_fwd(q, k, v, segment_ids, causal, scale, interpret):
+    out, lse = _flash_call(q, k, v, segment_ids, causal, scale, interpret)
+    return out, (q, k, v, segment_ids, out, lse)
 
 
-def _flash_bwd(causal, segment_ids, scale, interpret, res, g):
-    q, k, v, out, lse = res
+def _flash_bwd(causal, scale, interpret, res, g):
+    q, k, v, segment_ids, out, lse = res
     b, h, s, d = q.shape
     h_kv = k.shape[1]
     group = h // h_kv
@@ -255,11 +292,20 @@ def _flash_bwd(causal, segment_ids, scale, interpret, res, g):
     bk = _pick_block(s)
 
     dq_kernel = functools.partial(_bwd_dq_kernel, scale=scale_v, causal=causal, bq=bq, bk=bk)
+    seg_ops, seg_specs = _seg_specs(segment_ids, bq, s)
+
+    def dq_entry(qr, kr, vr, orf, dor, lr, *rest):
+        if seg_ops:
+            sq_r, sk_r, dqr = rest
+            dq_kernel(qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], orf.at[0, 0], dor.at[0, 0],
+                      lr.at[0, 0], dqr.at[0, 0], seg_q_ref=sq_r.at[0], seg_k_ref=sk_r.at[0])
+        else:
+            (dqr,) = rest
+            dq_kernel(qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], orf.at[0, 0], dor.at[0, 0],
+                      lr.at[0, 0], dqr.at[0, 0])
+
     dq = pl.pallas_call(
-        lambda qr, kr, vr, orf, dor, lr, dqr: dq_kernel(
-            qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], orf.at[0, 0], dor.at[0, 0], lr.at[0, 0],
-            dqr.at[0, 0],
-        ),
+        dq_entry,
         grid=(b, h, s // bq),
         in_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
@@ -268,19 +314,37 @@ def _flash_bwd(causal, segment_ids, scale, interpret, res, g):
             pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
             pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
             pl.BlockSpec((1, 1, bq, LANES), lambda b_, h_, i: (b_, h_, i, 0)),
-        ],
+        ] + seg_specs,
         out_specs=pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=interpret,
-    )(q, k, v, out, g, lse)
+    )(q, k, v, out, g, lse, *seg_ops)
 
     # dk/dv computed per q-head then reduced over the GQA group
     dkv_kernel = functools.partial(_bwd_dkv_kernel, scale=scale_v, causal=causal, bq=bq, bk=bk)
+    if segment_ids is None:
+        dkv_seg_ops, dkv_seg_specs = [], []
+    else:
+        seg = segment_ids.astype(jnp.int32)
+        dkv_seg_ops = [seg, seg]
+        dkv_seg_specs = [
+            pl.BlockSpec((1, s), lambda b_, h_, i: (b_, 0)),  # full q row
+            pl.BlockSpec((1, bk), lambda b_, h_, i: (b_, i)),  # this kv block
+        ]
+
+    def dkv_entry(qr, kr, vr, orf, dor, lr, *rest):
+        if dkv_seg_ops:
+            sq_r, sk_r, dkr, dvr = rest
+            dkv_kernel(qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], orf.at[0, 0], dor.at[0, 0],
+                       lr.at[0, 0], dkr.at[0, 0], dvr.at[0, 0],
+                       seg_q_ref=sq_r.at[0], seg_k_ref=sk_r.at[0])
+        else:
+            dkr, dvr = rest
+            dkv_kernel(qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], orf.at[0, 0], dor.at[0, 0],
+                       lr.at[0, 0], dkr.at[0, 0], dvr.at[0, 0])
+
     dk_h, dv_h = pl.pallas_call(
-        lambda qr, kr, vr, orf, dor, lr, dkr, dvr: dkv_kernel(
-            qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], orf.at[0, 0], dor.at[0, 0], lr.at[0, 0],
-            dkr.at[0, 0], dvr.at[0, 0],
-        ),
+        dkv_entry,
         grid=(b, h, s // bk),
         in_specs=[
             pl.BlockSpec((1, 1, s, d), lambda b_, h_, i: (b_, h_, 0, 0)),
@@ -289,7 +353,7 @@ def _flash_bwd(causal, segment_ids, scale, interpret, res, g):
             pl.BlockSpec((1, 1, s, d), lambda b_, h_, i: (b_, h_, 0, 0)),
             pl.BlockSpec((1, 1, s, d), lambda b_, h_, i: (b_, h_, 0, 0)),
             pl.BlockSpec((1, 1, s, LANES), lambda b_, h_, i: (b_, h_, 0, 0)),
-        ],
+        ] + dkv_seg_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bk, d), lambda b_, h_, i: (b_, h_, i, 0)),
             pl.BlockSpec((1, 1, bk, d), lambda b_, h_, i: (b_, h_, i, 0)),
@@ -299,14 +363,14 @@ def _flash_bwd(causal, segment_ids, scale, interpret, res, g):
             jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
         ],
         interpret=interpret,
-    )(q, k, v, out, g, lse)
+    )(q, k, v, out, g, lse, *dkv_seg_ops)
 
     if group > 1:
         dk = jnp.sum(dk_h.reshape(b, h_kv, group, s, d).astype(jnp.float32), axis=2).astype(k.dtype)
         dv = jnp.sum(dv_h.reshape(b, h_kv, group, s, d).astype(jnp.float32), axis=2).astype(v.dtype)
     else:
         dk, dv = dk_h, dv_h
-    return dq, dk, dv
+    return dq, dk, dv, None  # no cotangent for segment_ids
 
 
-flash_attention.defvjp(_flash_fwd, _flash_bwd)
+_flash_core.defvjp(_flash_fwd, _flash_bwd)

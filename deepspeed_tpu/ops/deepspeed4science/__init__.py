@@ -1,0 +1,6 @@
+"""DeepSpeed4Science ops (reference ``deepspeed/ops/deepspeed4science/`` +
+``csrc/deepspeed4science/evoformer_attn``)."""
+
+from deepspeed_tpu.ops.deepspeed4science.evoformer_attn import DS4Sci_EvoformerAttention
+
+__all__ = ["DS4Sci_EvoformerAttention"]

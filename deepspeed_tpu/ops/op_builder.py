@@ -103,16 +103,16 @@ def jit_native(name, sources, extra_flags=(), verbose=False):
     with _BUILD_LOCK:
         out_full = artifact(extra_flags)
         out_base = artifact(base_flags)
-        # Degraded (no-extra-flags) builds are cached under their OWN tag so a
-        # later host with the full toolchain rebuilds with full flags.
+        # Degraded (no-extra-flags) builds are cached under their OWN tag, and
+        # the full-flags compile is ALWAYS retried first when its artifact is
+        # missing/stale — a cached degraded build never pins a capable host to
+        # the slow path.
         if fresh(out_full):
             return out_full
-        if fresh(out_base):
-            return out_base
         os.makedirs(_BUILD_DIR, exist_ok=True)
         out = compile_to(out_full, extra_flags)
         if out is None and extra_flags:
-            out = compile_to(out_base, base_flags)
+            out = out_base if fresh(out_base) else compile_to(out_base, base_flags)
         if out is not None and verbose:
             logger.info(f"built native op {name} -> {out}")
         return out

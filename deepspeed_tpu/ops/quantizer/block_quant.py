@@ -265,6 +265,127 @@ def fp8_uncast(values: jax.Array, scale: jax.Array, dtype=jnp.float32):
 
 
 # ---------------------------------------------------------------------------
+# low-bit float quantization: fp6 (e3m2, FP6-LLM) and fp12 (e4m7)
+# Reference: csrc/fp_quantizer/ (quantize.cu templated on q_bits 6/8/12,
+# wrapped by ops/fp_quantizer/quantize.py FP_Quantize with group_size scaling)
+# ---------------------------------------------------------------------------
+_FP_FORMATS = {6: (3, 2), 8: (4, 3), 12: (4, 7)}  # bits -> (exp_bits, man_bits)
+
+
+def _round_to_fp(x, exp_bits, man_bits):
+    """Round |x| to the nearest representable e{exp_bits}m{man_bits} value
+    (RNE via float round-half-even of the mantissa grid), flushing
+    sub-subnormals to zero and saturating at the format max."""
+    bias = (1 << (exp_bits - 1)) - 1
+    emin = 1 - bias  # smallest normal exponent
+    emax = bias  # reserve nothing for inf/nan (reference formats are finite)
+    ax = jnp.abs(x)
+    e = jnp.floor(jnp.log2(jnp.maximum(ax, 1e-38)))
+    e = jnp.clip(e, emin, emax)
+    step = jnp.exp2(e - man_bits)
+    q = jnp.round(ax / step) * step
+    max_val = jnp.exp2(float(emax)) * (2.0 - jnp.exp2(-float(man_bits)))
+    q = jnp.minimum(q, max_val)
+    # below half the smallest subnormal -> 0
+    min_sub = jnp.exp2(float(emin - man_bits))
+    q = jnp.where(ax < min_sub / 2, 0.0, q)
+    return jnp.sign(x) * q
+
+
+def fp_quantize(x: jax.Array, q_bits: int = 6, group_size: int = 128):
+    """Group-scaled low-bit float quantization (reference FP_Quantize.quantize):
+    per-group absmax scaling into the format's range, then e/m rounding.
+    Returns (values fp32 [*, groups, group_size] SIMULATED in the format,
+    scales fp32) — the memory-format pack/unpack lives in ``fp_pack``."""
+    if q_bits not in _FP_FORMATS:
+        raise ValueError(f"q_bits must be one of {sorted(_FP_FORMATS)}, got {q_bits}")
+    exp_bits, man_bits = _FP_FORMATS[q_bits]
+    orig_shape = x.shape
+    flat, _ = _pad_to(x.astype(jnp.float32).reshape(-1), group_size)
+    groups = flat.reshape(-1, group_size)
+    bias = (1 << (exp_bits - 1)) - 1
+    fmt_max = 2.0 ** bias * (2.0 - 2.0 ** (-man_bits))
+    absmax = jnp.max(jnp.abs(groups), axis=-1, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / fmt_max, 1.0)
+    q = _round_to_fp(groups / scale, exp_bits, man_bits)
+    return q, scale, orig_shape
+
+
+def fp_dequantize(q, scale, orig_shape, dtype=jnp.float32):
+    n = 1
+    for s in orig_shape:
+        n *= s
+    return (q * scale).reshape(-1)[:n].reshape(orig_shape).astype(dtype)
+
+
+def fp_pack(q: jax.Array, q_bits: int, exp_bits: int = None, man_bits: int = None):
+    """Encode format-rounded values into integer codes and pack to uint8:
+    fp6 packs 4 codes into 3 bytes, fp12 packs 2 codes into 3 bytes
+    (reference swizzled packing, csrc/fp_quantizer/quantize.cu)."""
+    if exp_bits is None:
+        exp_bits, man_bits = _FP_FORMATS[q_bits]
+    bias = (1 << (exp_bits - 1)) - 1
+    sign = (q < 0).astype(jnp.uint32)
+    ax = jnp.abs(q)
+    e = jnp.clip(jnp.floor(jnp.log2(jnp.maximum(ax, 1e-38))), 1 - bias, bias)
+    # subnormal handling: values below 2^emin encode with biased exp 0
+    is_sub = ax < jnp.exp2(1.0 - bias)
+    man_scale = jnp.where(is_sub, jnp.exp2(float(1 - bias - man_bits)),
+                          jnp.exp2(e - man_bits))
+    man = jnp.round(jnp.where(is_sub, ax, ax / jnp.exp2(e) - 1.0) *
+                    jnp.where(is_sub, 1.0 / man_scale, 2.0 ** man_bits))
+    man = jnp.clip(man, 0, (1 << man_bits) - 1).astype(jnp.uint32)
+    biased = jnp.where(is_sub, 0, (e + bias).astype(jnp.uint32))
+    code = (sign << (exp_bits + man_bits)) | (biased << man_bits) | man
+    flat = code.reshape(-1)
+    if q_bits == 6:
+        flat, _ = _pad_to(flat, 4)
+        flat = flat.reshape(-1, 4).astype(jnp.uint32)
+        b0 = (flat[:, 0] | ((flat[:, 1] & 0x3) << 6)).astype(jnp.uint8)
+        b1 = ((flat[:, 1] >> 2) | ((flat[:, 2] & 0xF) << 4)).astype(jnp.uint8)
+        b2 = ((flat[:, 2] >> 4) | (flat[:, 3] << 2)).astype(jnp.uint8)
+        return jnp.stack([b0, b1, b2], -1).reshape(-1)
+    if q_bits == 12:
+        flat, _ = _pad_to(flat, 2)
+        flat = flat.reshape(-1, 2).astype(jnp.uint32)
+        b0 = (flat[:, 0] & 0xFF).astype(jnp.uint8)
+        b1 = ((flat[:, 0] >> 8) | ((flat[:, 1] & 0xF) << 4)).astype(jnp.uint8)
+        b2 = (flat[:, 1] >> 4).astype(jnp.uint8)
+        return jnp.stack([b0, b1, b2], -1).reshape(-1)
+    return flat.astype(jnp.uint8)  # q_bits == 8: one code per byte
+
+
+def fp_unpack(packed: jax.Array, n: int, q_bits: int):
+    """Inverse of fp_pack -> fp32 values (pre-scale)."""
+    exp_bits, man_bits = _FP_FORMATS[q_bits]
+    bias = (1 << (exp_bits - 1)) - 1
+    if q_bits == 6:
+        trip = packed.reshape(-1, 3).astype(jnp.uint32)
+        c0 = trip[:, 0] & 0x3F
+        c1 = ((trip[:, 0] >> 6) | (trip[:, 1] << 2)) & 0x3F
+        c2 = ((trip[:, 1] >> 4) | (trip[:, 2] << 4)) & 0x3F
+        c3 = (trip[:, 2] >> 2) & 0x3F
+        codes = jnp.stack([c0, c1, c2, c3], -1).reshape(-1)[:n]
+    elif q_bits == 12:
+        trip = packed.reshape(-1, 3).astype(jnp.uint32)
+        c0 = trip[:, 0] | ((trip[:, 1] & 0xF) << 8)
+        c1 = (trip[:, 1] >> 4) | (trip[:, 2] << 4)
+        codes = jnp.stack([c0, c1], -1).reshape(-1)[:n]
+    else:
+        codes = packed.astype(jnp.uint32)[:n]
+    sign = jnp.where((codes >> (exp_bits + man_bits)) & 1, -1.0, 1.0)
+    biased = (codes >> man_bits) & ((1 << exp_bits) - 1)
+    man = (codes & ((1 << man_bits) - 1)).astype(jnp.float32)
+    is_sub = biased == 0
+    mag = jnp.where(
+        is_sub,
+        man * jnp.exp2(float(1 - bias - man_bits)),
+        (1.0 + man * 2.0 ** (-man_bits)) * jnp.exp2(biased.astype(jnp.float32) - bias),
+    )
+    return sign * mag
+
+
+# ---------------------------------------------------------------------------
 # Pallas kernel path (TPU): fused absmax + scale + round in VMEM, optional
 # in-kernel stochastic rounding via the TPU PRNG
 # ---------------------------------------------------------------------------

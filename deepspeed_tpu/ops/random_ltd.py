@@ -1,0 +1,46 @@
+"""Random-LTD token sampling/gather/scatter ops.
+
+Reference: ``csrc/random_ltd/`` (token_sort.cu / gather_scatter kernels,
+~700 LoC) wrapped by ``deepspeed/ops/random_ltd`` — backing the
+random layer-token-drop pipeline (``runtime/data_pipeline/random_ltd.py``
+here). On TPU the gather/scatter lower to single XLA ops; the sampling is
+jax.random, keeping everything jit-compatible with static kept-token counts.
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def gpt_sample_tokens(rng: jax.Array, seq_len: int, kept: int, batch: int
+                      ) -> Tuple[jax.Array, jax.Array]:
+    """Sample ``kept`` token indices per batch row, SORTED ascending so the
+    causal order survives (reference gpt_sample_tokens: sorted random sample).
+    Returns (indices [batch, kept], mask [batch, seq_len])."""
+    keys = jax.random.split(rng, batch)
+    idx = jax.vmap(
+        lambda k: jnp.sort(jax.random.permutation(k, seq_len)[:kept])
+    )(keys).astype(jnp.int32)
+    mask = jnp.zeros((batch, seq_len), jnp.bool_)
+    mask = jax.vmap(lambda m, i: m.at[i].set(True))(mask, idx)
+    return idx, mask
+
+
+def bert_sample_tokens(rng: jax.Array, seq_len: int, kept: int, batch: int
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """Bidirectional variant: same sampling; sort kept for stable layouts."""
+    return gpt_sample_tokens(rng, seq_len, kept, batch)
+
+
+def token_gather(x: jax.Array, indices: jax.Array) -> jax.Array:
+    """Gather kept tokens: x [b, s, ...] + indices [b, k] -> [b, k, ...]
+    (reference token_gather kernel)."""
+    return jax.vmap(lambda row, i: jnp.take(row, i, axis=0))(x, indices)
+
+
+def token_scatter(full: jax.Array, kept_values: jax.Array, indices: jax.Array) -> jax.Array:
+    """Scatter processed kept tokens back into the full sequence; dropped
+    positions keep ``full``'s values (reference token_scatter_: the dropped
+    tokens bypass the layer)."""
+    return jax.vmap(lambda row, vals, i: row.at[i].set(vals))(full, kept_values, indices)

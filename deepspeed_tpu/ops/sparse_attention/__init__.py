@@ -1,0 +1,104 @@
+"""Block-sparse attention (reference ``deepspeed/ops/sparse_attention/``).
+
+Public surface parity: the sparsity configs, a ``SparseSelfAttention``
+module-equivalent, and the functional kernel entry. The Triton blocksparse
+matmul/softmax of the reference become one fused Pallas kernel
+(sparse_pallas.py) whose kv loop skips inactive blocks.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.ops.sparse_attention.config import (
+    BigBirdSparsityConfig,
+    BSLongformerSparsityConfig,
+    DenseSparsityConfig,
+    FixedSparsityConfig,
+    SparsityConfig,
+    VariableSparsityConfig,
+)
+from deepspeed_tpu.ops.sparse_attention.sparse_pallas import (
+    sparse_attention,
+    sparse_attention_reference,
+)
+
+
+class SparseSelfAttention:
+    """Functional analogue of the reference ``SparseSelfAttention`` module
+    (``sparse_self_attention.py``): holds a sparsity config, builds/caches
+    the block layout per sequence length, and applies the sparse kernel.
+
+    ``__call__(q, k, v)`` with [b, h, s, d] tensors; GQA kv is expanded to
+    the q head count first (the layout is per q head).
+    """
+
+    def __init__(self, sparsity_config: Optional[SparsityConfig] = None,
+                 key_padding_mask_mode: str = "add", attn_mask_mode: str = "mul",
+                 max_seq_length: int = 2048, interpret: bool = False):
+        self.sparsity_config = sparsity_config or FixedSparsityConfig(num_heads=4)
+        self.key_padding_mask_mode = key_padding_mask_mode
+        self.attn_mask_mode = attn_mask_mode
+        self.max_seq_length = max_seq_length
+        self.interpret = interpret
+        self._layouts = {}
+
+    def get_layout(self, seq_len: int) -> np.ndarray:
+        if seq_len not in self._layouts:
+            self._layouts[seq_len] = self.sparsity_config.make_layout(seq_len)
+        return self._layouts[seq_len]
+
+    def __call__(self, query, key, value, rpe=None, key_padding_mask=None, attn_mask=None):
+        b, h, s, d = query.shape
+        assert h == self.sparsity_config.num_heads, (h, self.sparsity_config.num_heads)
+        h_kv = key.shape[1]
+        if h_kv != h:
+            rep = h // h_kv
+            key = jnp.repeat(key, rep, axis=1)
+            value = jnp.repeat(value, rep, axis=1)
+        layout = self.get_layout(s)
+        causal = self.sparsity_config.attention == "unidirectional" if hasattr(
+            self.sparsity_config, "attention") else False
+        if rpe is not None or key_padding_mask is not None or attn_mask is not None:
+            # masked variants fall back to the dense reference with the block
+            # mask applied (reference applies these inside the softmax kernel:
+            # softmax.py rpe/key_padding_mask/attn_mask args)
+            bias = jnp.zeros((1, 1, s, s), jnp.float32)
+            if rpe is not None:
+                bias = bias + rpe.astype(jnp.float32)
+            if key_padding_mask is not None:  # [b, s] over keys
+                kpm = key_padding_mask.astype(jnp.float32)
+                if self.key_padding_mask_mode == "add":
+                    bias = bias + kpm[:, None, None, :]
+                else:  # "mul": 0 = masked
+                    bias = bias + jnp.where(kpm[:, None, None, :] != 0, 0.0, -1e30)
+            if attn_mask is not None:  # [s, s] (or broadcastable)
+                am = attn_mask.astype(jnp.float32)
+                am = am[None, None] if am.ndim == 2 else am
+                if self.attn_mask_mode == "add":
+                    bias = bias + am
+                else:
+                    bias = bias + jnp.where(am != 0, 0.0, -1e30)
+            return sparse_attention_reference(
+                query, key, value, jnp.asarray(layout), self.sparsity_config.block,
+                causal=causal, bias=bias,
+            )
+        return sparse_attention(
+            query, key, value, layout, self.sparsity_config.block, causal=causal,
+            interpret=self.interpret,
+        )
+
+
+__all__ = [
+    "SparsityConfig",
+    "DenseSparsityConfig",
+    "FixedSparsityConfig",
+    "BSLongformerSparsityConfig",
+    "BigBirdSparsityConfig",
+    "VariableSparsityConfig",
+    "SparseSelfAttention",
+    "sparse_attention",
+    "sparse_attention_reference",
+]

@@ -1,0 +1,33 @@
+"""Spatial (diffusion) ops.
+
+Reference: ``csrc/spatial/`` (opt_bias_add / opt_bias_add_add kernels) and
+the diffusers attention/groupnorm fusions used by stable-diffusion
+inference. On TPU these are XLA-fusable elementwise chains — the value of
+the module is the parity surface plus NHWC-layout discipline (channels-last
+keeps the lane dimension dense on the VPU)."""
+
+import jax
+import jax.numpy as jnp
+
+
+def nhwc_bias_add(activation: jax.Array, bias: jax.Array) -> jax.Array:
+    """act [n, h, w, c] + bias [c] (reference opt_bias_add)."""
+    return activation + bias.astype(activation.dtype)
+
+
+def bias_add_add(activation: jax.Array, other: jax.Array, bias: jax.Array) -> jax.Array:
+    """act + other + bias (reference opt_bias_add_add — the residual form)."""
+    return activation + other + bias.astype(activation.dtype)
+
+
+def nhwc_group_norm(x: jax.Array, gamma: jax.Array, beta: jax.Array,
+                    num_groups: int, eps: float = 1e-5) -> jax.Array:
+    """GroupNorm over NHWC (channels last; diffusion UNet blocks)."""
+    n, h, w, c = x.shape
+    assert c % num_groups == 0
+    g = x.astype(jnp.float32).reshape(n, h, w, num_groups, c // num_groups)
+    mean = jnp.mean(g, axis=(1, 2, 4), keepdims=True)
+    var = jnp.mean(jnp.square(g - mean), axis=(1, 2, 4), keepdims=True)
+    out = (g - mean) * jax.lax.rsqrt(var + eps)
+    out = out.reshape(n, h, w, c)
+    return (out * gamma.astype(jnp.float32) + beta.astype(jnp.float32)).astype(x.dtype)

@@ -133,12 +133,66 @@ class DeepSpeedEngine:
         # DeepNVMe analogue exists)
         zcfg = config.zero_optimization
         self.zero_stage = zcfg.stage
-        offload_opt = zcfg.offload_optimizer.device != "none"
-        offload_par = zcfg.offload_param.device != "none"
-        if zcfg.offload_optimizer.device == "nvme" or zcfg.offload_param.device == "nvme":
+        # Host-optimizer tiers: the jitted step ends at gradients and the
+        # update runs outside jit through the native C++ CPU-Adam.
+        #   nvme          — ZeRO-Infinity (reference swap_tensor/): state in
+        #                   NVMe files, pipelined per-leaf swap
+        #   super_offload — reference superoffload_stage3.py: state resident
+        #                   in host RAM, no swap traffic
+        self._super_offload = (
+            zcfg.offload_optimizer.device == "cpu"
+            and getattr(zcfg.offload_optimizer, "super_offload", False)
+        )
+        self._host_opt_requested = (
+            zcfg.offload_optimizer.device == "nvme" or self._super_offload
+        )
+        # The host tiers run CPU-Adam single-process; anything else falls
+        # back to the pinned-host in-jit tier (the pre-NVMe behavior) with a
+        # warning instead of refusing to train.
+        if self._host_opt_requested:
+            opt_name = (config.optimizer.type or "adamw").lower() if optimizer is None else None
+            adam_family = opt_name in ("adam", "adamw", "deepspeedcpuadam")
+            reason = None
+            if config.zenflow:
+                reason = "zenflow runs its own selective/offload schedule"
+            elif optimizer is not None or not adam_family:
+                reason = f"optimizer {opt_name or type(optimizer).__name__} is not CPU-Adam-compatible"
+            elif jax.process_count() > 1:
+                reason = "multi-process runs are not supported by the host tier yet"
+            elif zcfg.offload_optimizer.device == "nvme" and not zcfg.offload_optimizer.nvme_path:
+                reason = "offload_optimizer.nvme_path is not set"
+            if reason is not None:
+                log_dist(
+                    f"offload_optimizer.device={zcfg.offload_optimizer.device}: "
+                    f"{reason}; falling back to the pinned-host tier", ranks=[0],
+                )
+                self._host_opt_requested = False
+                self._super_offload = False
+        offload_opt = (
+            zcfg.offload_optimizer.device in ("cpu", "nvme") and not self._host_opt_requested
+        )
+        if config.zenflow and optimizer is not None:
+            # client optimizers bypass build_optimizer, where zenflow wraps in
+            logger.warning(
+                "zenflow config section is ignored when a client optimizer is "
+                "passed to initialize(); remove one of the two"
+            )
+        if config.zenflow and optimizer is None and offload_opt:
+            # ZenFlow owns the offload economics: its lax.cond schedule only
+            # touches master/moments on boundary steps, so state stays
+            # device-resident (XLA's host-compute path cannot compile the
+            # selective gathers/scatters in a pinned_host region today)
             log_dist(
-                "offload device 'nvme' maps to the host-memory tier on TPU "
-                "(no NVMe swap yet)", ranks=[0],
+                "zenflow active: optimizer state stays device-resident; the "
+                "boundary-interval schedule replaces pinned-host placement",
+                ranks=[0],
+            )
+            offload_opt = False
+        offload_par = zcfg.offload_param.device != "none"
+        if zcfg.offload_param.device == "nvme":
+            log_dist(
+                "offload_param device 'nvme' maps to the host-memory tier on "
+                "TPU (param NVMe swap not implemented)", ranks=[0],
             )
         # zero.Init deferred construction (reference partition_parameters.py:878):
         # a callable/zero.Init marker materializes UNDER jit with the plan's
@@ -187,6 +241,7 @@ class DeepSpeedEngine:
             param_zero_axes=param_zero_axes,
             offload_optimizer=offload_opt,
             offload_param=offload_par,
+            offload_ratio=zcfg.offload_optimizer.ratio,
         )
         # offload execution mode: the true host-offload path (host-kind
         # out_shardings + compute_on) is TPU-only; the CPU test mesh hits an
@@ -210,26 +265,35 @@ class DeepSpeedEngine:
 
         # optimizer (+ fp32 master, sharded per plan)
         self.optimizer = self._configure_optimizer(optimizer, config)
-        state_shapes = jax.eval_shape(self.optimizer.init, self.params)
-        if getattr(self.optimizer, "state_partition_specs", None) is not None:
-            # collective optimizers (1-bit Adam) own their state layout:
-            # per-worker error buffers shard over data, moments replicate
-            from jax.sharding import NamedSharding, PartitionSpec
-
-            specs = self.optimizer.state_partition_specs(state_shapes)
-            self._state_shardings = jax.tree.map(
-                lambda s: NamedSharding(self.topo.mesh, s),
-                specs,
-                is_leaf=lambda x: isinstance(x, PartitionSpec),
-            )
+        self._host_opt = None
+        self._host_step_jit = None
+        if self._host_opt_requested:
+            # state never materializes in device/host jax memory at all —
+            # it is seeded straight to NVMe files (ZeRO-Infinity semantics)
+            self._init_host_optimizer(zcfg)
+            self._state_shardings = {}
+            self.opt_state = {}
         else:
-            self._state_shardings = self.plan.state_shardings(state_shapes)
-        self.opt_state = jax.jit(
-            self.optimizer.init,
-            out_shardings=self.plan.device_shardings(self._state_shardings),
-        )(self.params)
-        if self.plan.offload_optimizer:
-            self.opt_state = jax.device_put(self.opt_state, self._state_shardings)
+            state_shapes = jax.eval_shape(self.optimizer.init, self.params)
+            if getattr(self.optimizer, "state_partition_specs", None) is not None:
+                # collective optimizers (1-bit Adam) own their state layout:
+                # per-worker error buffers shard over data, moments replicate
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                specs = self.optimizer.state_partition_specs(state_shapes)
+                self._state_shardings = jax.tree.map(
+                    lambda s: NamedSharding(self.topo.mesh, s),
+                    specs,
+                    is_leaf=lambda x: isinstance(x, PartitionSpec),
+                )
+            else:
+                self._state_shardings = self.plan.state_shardings(state_shapes)
+            self.opt_state = jax.jit(
+                self.optimizer.init,
+                out_shardings=self.plan.device_shardings(self._state_shardings),
+            )(self.params)
+            if self.plan.offload_optimizer:
+                self.opt_state = jax.device_put(self.opt_state, self._state_shardings)
         self.params = self._park_params(self.params)
 
         # loss scaling
@@ -348,6 +412,15 @@ class DeepSpeedEngine:
             raise ValueError(
                 "No optimizer: pass `optimizer=` to initialize() or set the config 'optimizer' section"
             )
+        if config.zenflow:
+            # ZenFlow selective-offload schedule (reference engine.py:351-356
+            # + runtime/zenflow/): adam-family only, like the reference
+            from deepspeed_tpu.runtime.zenflow import build_zenflow_optimizer
+
+            name = (config.optimizer.type or "").lower()
+            if name not in ("adam", "adamw", "zenflowselectiveadam"):
+                raise ValueError(f"zenflow requires an Adam-family optimizer, got {name}")
+            return build_zenflow_optimizer(config.zenflow, config.optimizer)
         return build_optimizer(
             config.optimizer,
             config.precision_dtype,
@@ -533,20 +606,34 @@ class DeepSpeedEngine:
         host_compute = (
             offload
             and self._offload_native
-            and self.optimizer.name not in ("muon", "fused_adam")
+            # Twin-Flow partial offload keeps a fraction of state in HBM:
+            # the update must run on-device so those leaves never cross PCIe
+            and self.plan.offload_ratio >= 1.0
+            and self.optimizer.name not in ("muon", "fused_adam", "zenflow")
         )
         if host_compute:
             from jax.experimental.compute_on import compute_on
             from jax.sharding import NamedSharding, PartitionSpec
 
             host_grads = jax.device_put(safe_grads, self.plan.master_shardings)
+            # params must live host-side inside the host-compute region too:
+            # elementwise ops tolerate mixed memory spaces, but gathers
+            # (zenflow's column selection) refuse them
+            host_params = jax.device_put(
+                params,
+                jax.tree.map(
+                    lambda s: NamedSharding(s.mesh, s.spec, memory_kind="pinned_host"),
+                    self.plan.device_shardings(self.plan.param_shardings),
+                    is_leaf=lambda x: isinstance(x, NamedSharding),
+                ),
+            )
             ov_host = jax.device_put(
                 overflow,
                 NamedSharding(self.topo.mesh, PartitionSpec(), memory_kind="pinned_host"),
             )
             with compute_on("device_host"):
                 new_params, new_opt_state = self.optimizer.step(
-                    host_grads, opt_state, params, lr
+                    host_grads, opt_state, host_params, lr
                 )
                 new_opt_state = _tree_select(ov_host, opt_state, new_opt_state)
             new_params = jax.device_put(
@@ -562,6 +649,82 @@ class DeepSpeedEngine:
         new_params = _tree_select(overflow, self._stage_params(params), new_params)
         new_opt_state = _tree_select(overflow, opt_state, new_opt_state)
         return new_params, new_opt_state
+
+    def _init_host_optimizer(self, zcfg):
+        """Host-optimizer tiers (NVMe swap / SuperOffload resident): fp32
+        master + moments live outside jax entirely; each step runs the native
+        CPU-Adam against them (reference partitioned_optimizer_swapper.py,
+        superoffload_stage3.py)."""
+        ocfg = zcfg.offload_optimizer
+        # capability checks already ran (with graceful fallback) in __init__;
+        # these are defensive
+        assert self.optimizer.name in ("adam", "adamw"), self.optimizer.name
+        assert jax.process_count() == 1
+        d = self.optimizer.defaults
+        kw = dict(
+            lr=d.get("lr", 1e-3),
+            betas=tuple(d.get("betas", (0.9, 0.999))),
+            eps=d.get("eps", 1e-8),
+            weight_decay=d.get("weight_decay", 0.0),
+            adamw_mode=self.optimizer.name == "adamw",
+        )
+        if self._super_offload:
+            from deepspeed_tpu.runtime.superoffload import SuperOffloadHostOptimizer
+
+            self._host_opt = SuperOffloadHostOptimizer(
+                cpuadam_cores_perc=getattr(ocfg, "cpuadam_cores_perc", 0.8), **kw
+            )
+        else:
+            from deepspeed_tpu.runtime.swap_tensor import NVMeOptimizerSwapper
+
+            if not ocfg.nvme_path:
+                raise ValueError("offload_optimizer.device=nvme requires nvme_path")
+            self._host_opt = NVMeOptimizerSwapper(
+                nvme_path=ocfg.nvme_path, buffer_count=ocfg.buffer_count, **kw
+            )
+        flat, treedef = jax.tree_util.tree_flatten_with_path(self.params)
+        self._host_leaf_names = [jax.tree_util.keystr(path) for path, _ in flat]
+        self._host_treedef = treedef
+        self._host_opt.init_from_params(
+            (name, np.asarray(leaf))
+            for name, (_, leaf) in zip(self._host_leaf_names, flat)
+        )
+
+    def _train_batch_hostopt(self, stacked):
+        """train_batch for the NVMe tier: grads-only compiled step on the
+        chip, then the pipelined NVMe/CPU-Adam update on the host (reference
+        stage3 step with _optimizer_states_and_gradient_swap_in/out,
+        stage3.py:1985/2035)."""
+        if self._host_step_jit is None:
+            self._host_step_jit = self._build_train_step(grads_only=True)
+        lr = self._lr_for_step()
+        self.tput_timer.start()
+        self.timers(STEP_GLOBAL_TIMER).start()
+        self._unpark_params()  # eager offload_param mode parks params host-side
+        shardings = self._batch_shardings(stacked, leading_gas_dim=True)
+        stacked = jax.device_put(stacked, shardings)
+        safe_grads, self.scaler_state, loss, grad_norm, overflow = self._host_step_jit(
+            self.params,
+            self.scaler_state,
+            jnp.int32(self.global_steps),
+            stacked,
+        )
+        if not bool(overflow):  # functional skip-step, decided on host here
+            flat_grads = jax.tree_util.tree_leaves(safe_grads)
+            # leaves stay jax arrays: the host optimizers pull D2H per leaf,
+            # overlapping the pull with the previous leaf's Adam compute
+            named = list(zip(self._host_leaf_names, flat_grads))
+            new_leaves = self._host_opt.step(named, lr=lr)
+            # device_put straight from numpy: one H2D per leaf (jnp.asarray
+            # first would stage through the default device and transfer twice)
+            params = jax.tree_util.tree_unflatten(
+                self._host_treedef, [new_leaves[n] for n in self._host_leaf_names]
+            )
+            self.params = jax.device_put(params, self.plan.param_shardings)
+        self.timers(STEP_GLOBAL_TIMER).stop()
+        self._after_step(loss, grad_norm, overflow)
+        self.tput_timer.stop(global_step=True)
+        return loss
 
     def _jit_param_shardings(self):
         if self.plan.offload_param and not self._offload_native:
@@ -699,7 +862,7 @@ class DeepSpeedEngine:
 
         return micro_grads
 
-    def _build_train_step(self):
+    def _build_train_step(self, grads_only=False):
         if getattr(self.optimizer, "collective_grad_exchange", False):
             if getattr(self.loss_fn, "custom_value_and_grad", None) is not None:
                 raise NotImplementedError(
@@ -779,12 +942,21 @@ class DeepSpeedEngine:
                 safe_grads, grad_norm = clip_by_global_norm(safe_grads, clip)
             else:
                 grad_norm = global_grad_norm(safe_grads)
+            new_scaler = ls.update_state(scaler_cfg, scaler_state, overflow)
+            mean_loss = jnp.mean(losses)
+            if grads_only:
+                # NVMe tier: the update happens on the host afterwards
+                return safe_grads, new_scaler, mean_loss, grad_norm, overflow
             # offload-aware update + functional skip-step on overflow
             # (reference step skipping, fp16)
             new_params, new_opt_state = self._opt_apply(safe_grads, opt_state, params, lr, overflow)
-            new_scaler = ls.update_state(scaler_cfg, scaler_state, overflow)
-            mean_loss = jnp.mean(losses)
             return new_params, new_opt_state, new_scaler, mean_loss, grad_norm, overflow
+
+        if grads_only:
+            def grads_step(params, scaler_state, step, batch):
+                return train_step(params, {}, scaler_state, step, None, batch)
+
+            return jax.jit(grads_step, donate_argnums=(1,))
 
         self._train_step_raw = train_step  # unjitted: profiler jaxpr walk
         return jax.jit(
@@ -1027,6 +1199,8 @@ class DeepSpeedEngine:
         assert (data_iter is None) != (batch is None), "pass exactly one of data_iter/batch"
         stacked = self._stack_batch(data_iter if data_iter is not None else batch)
         stacked = self._apply_curriculum(stacked)
+        if self._host_opt is not None:
+            return self._train_batch_hostopt(stacked)
         if self._train_step_jit is None:
             self._train_step_jit = self._build_train_step()
         lr = self._lr_for_step()
@@ -1162,6 +1336,12 @@ class DeepSpeedEngine:
         if not boundary:
             return
         assert self._acc_grads is not None, "step() with no accumulated gradients"
+        if self._host_opt is not None:
+            raise NotImplementedError(
+                "the NVMe optimizer tier supports the fused train_batch() API "
+                "only (the imperative forward/backward/step path would leave "
+                "accumulated grads on-device across the host update)"
+            )
         if self._apply_jit is None:
             self._apply_jit = self._build_apply()
         lr = self._lr_for_step()
@@ -1283,6 +1463,11 @@ class DeepSpeedEngine:
         tag = tag or f"global_step{self.global_steps}"
         state = self._client_state()
         state.update(client_state or {})
+        # NVMe tier: materialize the swapped state (leaf at a time) for the
+        # writer; self.opt_state itself is an empty placeholder
+        opt_payload = (
+            self._host_opt.as_state_tree() if self._host_opt is not None else self.opt_state
+        )
         writer = self.config.checkpoint.writer
         if writer:
             # pluggable engine path (reference checkpoint_engine/): async
@@ -1295,7 +1480,7 @@ class DeepSpeedEngine:
             eng.save(
                 {
                     "params": self.params,
-                    "opt_state": self.opt_state,
+                    "opt_state": opt_payload,
                     "scaler_state": self.scaler_state,
                     "__meta__": state,
                 },
@@ -1311,7 +1496,7 @@ class DeepSpeedEngine:
             save_dir,
             tag,
             params=self.params,
-            opt_state=self.opt_state,
+            opt_state=opt_payload,
             scaler_state=self.scaler_state,
             client_state=state,
             save_latest=save_latest,
@@ -1352,7 +1537,12 @@ class DeepSpeedEngine:
             data = eng.load(os.path.join(load_dir, tag, "state"))
             self.params = self._restore_tree(self.params, data["params"])
             if load_optimizer_states and not load_module_only and "opt_state" in data:
-                self.opt_state = self._restore_tree(self.opt_state, data["opt_state"])
+                if self._host_opt is not None:
+                    self._host_opt.load_state_tree(
+                        jax.tree.map(np.asarray, data["opt_state"])
+                    )
+                else:
+                    self.opt_state = self._restore_tree(self.opt_state, data["opt_state"])
             if "scaler_state" in data:
                 self.scaler_state = self._restore_tree(self.scaler_state, data["scaler_state"])
             client_state = data.get("__meta__", {})
@@ -1360,18 +1550,28 @@ class DeepSpeedEngine:
             return os.path.join(load_dir, tag), client_state
         from deepspeed_tpu.checkpoint.engine import load_checkpoint as _load
 
+        want_opt = load_optimizer_states and not load_module_only
+        if self._host_opt is not None:
+            # template mirrors the current swapped tree's structure/dtypes
+            # structure-only template: no need to read the live state back
+            opt_template = self._host_opt.state_tree_template() if want_opt else None
+        else:
+            opt_template = self.opt_state if want_opt else None
         out = _load(
             load_dir,
             tag,
             params_template=self.params,
-            opt_state_template=self.opt_state if load_optimizer_states and not load_module_only else None,
+            opt_state_template=opt_template,
             scaler_template=self.scaler_state,
         )
         if out is None:
             return None, {}
         self.params = out["params"]
         if out.get("opt_state") is not None:
-            self.opt_state = out["opt_state"]
+            if self._host_opt is not None:
+                self._host_opt.load_state_tree(jax.tree.map(np.asarray, out["opt_state"]))
+            else:
+                self.opt_state = out["opt_state"]
         if out.get("scaler_state") is not None:
             self.scaler_state = out["scaler_state"]
         client_state = out.get("client_state", {})

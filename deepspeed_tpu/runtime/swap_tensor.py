@@ -1,0 +1,253 @@
+"""NVMe tensor swapping — the ZeRO-Infinity optimizer-state tier.
+
+Reference: ``runtime/swap_tensor/partitioned_optimizer_swapper.py`` (+
+``pipelined_optimizer_swapper.py``, ``async_swapper.py``,
+``optimizer_utils.py``): fp32 master weights and Adam moments live in NVMe
+files, swapped in around each optimizer step through pinned buffers by the
+AIO engine, with reads/writes of neighbouring sub-groups overlapped against
+the current sub-group's CPU-Adam update.
+
+TPU-native form: the jitted train step ends at gradients (fwd/bwd + reduce +
+clip + overflow on the chip); the optimizer update runs on the host, one
+*leaf* at a time (the leaf plays the reference's sub-group role):
+
+    prefetch leaf i+1 (async NVMe reads)  ─┐ overlapped
+    CPU-Adam on leaf i (native C++ kernel) ┘
+    write-back leaf i (async NVMe writes)
+
+so peak host RAM is O(buffer_count * largest leaf), not O(model). The bf16
+params produced by each update go straight back to the device.
+"""
+
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam
+from deepspeed_tpu.ops.aio import AioHandle
+from deepspeed_tpu.utils.logging import logger
+
+STATE_KEYS = ("master", "exp_avg", "exp_avg_sq")
+
+
+class AsyncTensorSwapper:
+    """Flat numpy-array <-> file store over the AIO engine (reference
+    ``async_swapper.py:AsyncTensorSwapper``): one file per (leaf, key),
+    async writes fire-and-forget, reads prefetchable into caller buffers."""
+
+    def __init__(self, swap_dir: str, block_size: int = 1 << 20,
+                 intra_op_parallelism: int = 4):
+        self.swap_dir = swap_dir
+        os.makedirs(swap_dir, exist_ok=True)
+        # separate engines for reads and writes so a prefetch can be awaited
+        # without serializing behind in-flight write-backs (and vice versa)
+        self.read_handle = AioHandle(
+            block_size=block_size, intra_op_parallelism=intra_op_parallelism
+        )
+        self.write_handle = AioHandle(
+            block_size=block_size, intra_op_parallelism=intra_op_parallelism
+        )
+
+    def path(self, name: str) -> str:
+        return os.path.join(self.swap_dir, f"{name}.swp")
+
+    def swap_out(self, name: str, arr: np.ndarray, asynchronous: bool = True):
+        arr = np.ascontiguousarray(arr)
+        if asynchronous:
+            self.write_handle.async_pwrite(arr, self.path(name))
+        else:
+            self.write_handle.sync_pwrite(arr, self.path(name))
+        return arr  # caller must keep the buffer alive until drain_writes()
+
+    def swap_in(self, name: str, out: np.ndarray, asynchronous: bool = False):
+        if asynchronous:
+            self.read_handle.async_pread(out, self.path(name))
+        else:
+            self.read_handle.sync_pread(out, self.path(name))
+        return out
+
+    def drain_reads(self):
+        self.read_handle.wait()
+
+    def drain_writes(self):
+        self.write_handle.wait()
+
+    def drain(self):
+        """Wait for every in-flight async op (write-backs AND prefetches)."""
+        self.read_handle.wait()
+        self.write_handle.wait()
+
+
+class NVMeOptimizerSwapper:
+    """Adam/AdamW whose whole state lives in NVMe files (reference
+    ``partitioned_optimizer_swapper.py:31`` + ``cpu_adam``).
+
+    ``init_from_params`` seeds master weights from the current (half) params
+    and zero moments. ``step`` runs the pipelined per-leaf update described in
+    the module docstring and returns the new half-precision param leaves.
+    """
+
+    def __init__(self, nvme_path: str, lr: float = 1e-3, betas=(0.9, 0.999),
+                 eps: float = 1e-8, weight_decay: float = 0.0,
+                 adamw_mode: bool = True, buffer_count: int = 4,
+                 aio_block_size: int = 1 << 20, aio_parallelism: int = 4,
+                 pipeline_read: bool = True, pipeline_write: bool = True):
+        self.swapper = AsyncTensorSwapper(
+            os.path.join(nvme_path, "zero_stage_opt"),
+            block_size=aio_block_size, intra_op_parallelism=aio_parallelism,
+        )
+        self.cpu_adam = DeepSpeedCPUAdam(
+            lr=lr, betas=betas, eps=eps, weight_decay=weight_decay,
+            adamw_mode=adamw_mode,
+        )
+        # accepted for reference-config parity; the pipeline is fixed at
+        # double-buffered reads + double-buffered writes (4 sets total),
+        # which a wait-all write drain cannot exploit beyond 2 anyway
+        self.buffer_count = max(2, buffer_count)
+        self.pipeline_read = pipeline_read
+        self.pipeline_write = pipeline_write
+        self.steps = 0
+        # leaf name -> (shape, out_dtype) of the half-precision param
+        self.leaves: Dict[str, Any] = {}
+
+    # -- lifecycle --
+
+    def init_from_params(self, named_leaves):
+        """``named_leaves``: iterable of (name, numpy array). Writes fp32
+        master copies + zero moments to NVMe (reference _initialize_from_
+        swapped_fp16_params)."""
+        for name, leaf in named_leaves:
+            leaf = np.asarray(leaf)
+            self.leaves[name] = (leaf.shape, leaf.dtype)
+            master = np.ascontiguousarray(leaf.astype(np.float32).reshape(-1))
+            zeros = np.zeros(master.size, np.float32)
+            self.swapper.swap_out(f"{name}.master", master, asynchronous=False)
+            self.swapper.swap_out(f"{name}.exp_avg", zeros, asynchronous=False)
+            self.swapper.swap_out(f"{name}.exp_avg_sq", zeros, asynchronous=False)
+        nbytes = sum(
+            3 * 4 * int(np.prod(s)) for s, _ in self.leaves.values()
+        )
+        logger.info(
+            f"NVMe optimizer tier: {len(self.leaves)} leaves, "
+            f"{nbytes / 1e9:.2f} GB of fp32 state swapped out to "
+            f"{self.swapper.swap_dir}"
+        )
+
+    def _buffers(self, max_elems):
+        cached = getattr(self, "_bufcache", None)
+        if cached is None or cached[0] < max_elems:
+            readsets = [
+                {k: np.empty(max_elems, np.float32) for k in STATE_KEYS}
+                for _ in range(2)
+            ]
+            writesets = [
+                {k: np.empty(max_elems, np.float32) for k in STATE_KEYS}
+                for _ in range(2)
+            ]
+            cached = (max_elems, readsets, writesets)
+            self._bufcache = cached
+        return cached[1], cached[2]
+
+    def _read_state(self, name, n, bufs, asynchronous):
+        for key in STATE_KEYS:
+            self.swapper.swap_in(f"{name}.{key}", bufs[key][:n].reshape(-1),
+                                 asynchronous=asynchronous)
+
+    # -- the pipelined step --
+
+    def step(self, named_grads, lr: Optional[float] = None):
+        """``named_grads``: ordered list of (name, fp32 numpy grad). Returns
+        {name: updated half-precision numpy param}. Pipelines next-leaf
+        prefetch and previous-leaf write-back against the current CPU-Adam
+        call (reference pipelined_optimizer_swapper.py swap_in_optimizer_state
+        / swap_out_optimizer_state around _optimizer_step)."""
+        self.steps += 1
+        out: Dict[str, np.ndarray] = {}
+        if not named_grads:
+            return out
+        max_elems = max(int(np.prod(self.leaves[n][0])) for n, _ in named_grads)
+        # two rotating read sets (current + prefetch) and two rotating write
+        # sets (in-flight + filling): peak host RAM is 4 buffer sets of the
+        # largest leaf, independent of model size. Allocated once and reused
+        # across steps (this is the hot path).
+        readsets, writesets = self._buffers(max_elems)
+
+        names = [n for n, _ in named_grads]
+        n0 = int(np.prod(self.leaves[names[0]][0]))
+        self._read_state(names[0], n0, readsets[0], asynchronous=False)
+        prefetched = False
+
+        for i, (name, grad) in enumerate(named_grads):
+            shape, out_dtype = self.leaves[name]
+            n = int(np.prod(shape))
+            if i > 0:
+                if prefetched:
+                    self.swapper.drain_reads()  # prefetch must have landed
+                else:
+                    self._read_state(name, n, readsets[i % 2], asynchronous=False)
+            cur = readsets[i % 2]
+            # kick off next leaf's reads; they overlap this leaf's Adam call
+            prefetched = self.pipeline_read and i + 1 < len(names)
+            if prefetched:
+                nxt = names[i + 1]
+                self._read_state(nxt, int(np.prod(self.leaves[nxt][0])),
+                                 readsets[(i + 1) % 2], asynchronous=True)
+            g = np.ascontiguousarray(np.asarray(grad, dtype=np.float32).reshape(-1))
+            assert g.size == n, f"grad size {g.size} != leaf {name} size {n}"
+            master = cur["master"][:n]
+            m = cur["exp_avg"][:n]
+            v = cur["exp_avg_sq"][:n]
+            self.cpu_adam.step(master, g, m, v, lr=lr, step=self.steps)
+            # async write-back from a stable buffer set; waiting only when the
+            # set is about to be reused lets writes overlap the NEXT leaf's
+            # read+Adam (the reference pipelined swapper's write overlap)
+            ws = writesets[i % 2]
+            if i >= 2 and self.pipeline_write:
+                self.swapper.drain_writes()
+            for key, src in (("master", master), ("exp_avg", m), ("exp_avg_sq", v)):
+                np.copyto(ws[key][:n], src)
+                self.swapper.swap_out(f"{name}.{key}", ws[key][:n],
+                                      asynchronous=self.pipeline_write)
+            out[name] = master.reshape(shape).astype(out_dtype)
+        self.swapper.drain()
+        return out
+
+    # -- checkpoint support --
+
+    def as_state_tree(self) -> Dict[str, Any]:
+        """Materialize the full swapped state as numpy for checkpoint save.
+
+        NOTE: this holds the ENTIRE fp32 state (12 bytes/param) in host RAM
+        at once because the checkpoint writer takes a whole pytree. For
+        NVMe-scale models whose state exceeds host RAM, checkpoint the swap
+        files directly (they ARE a durable copy of the state — copy
+        ``swapper.swap_dir``) instead of calling this."""
+        tree: Dict[str, Any] = {"steps": self.steps}
+        for name, (shape, _) in self.leaves.items():
+            n = int(np.prod(shape))
+            for key in STATE_KEYS:
+                buf = np.empty(n, np.float32)
+                self.swapper.swap_in(f"{name}.{key}", buf, asynchronous=False)
+                tree[f"{name}.{key}"] = buf.reshape(shape)
+        return tree
+
+    def state_tree_template(self) -> Dict[str, Any]:
+        """Shape/dtype template matching ``as_state_tree`` WITHOUT reading the
+        swap files (checkpoint-restore templates need structure only)."""
+        tree: Dict[str, Any] = {"steps": self.steps}
+        for name, (shape, _) in self.leaves.items():
+            for key in STATE_KEYS:
+                tree[f"{name}.{key}"] = np.empty(shape, np.float32)
+        return tree
+
+    def load_state_tree(self, tree: Dict[str, Any]):
+        """Write a checkpointed state tree back out to NVMe files."""
+        self.steps = int(tree.get("steps", 0))
+        self.cpu_adam.steps = self.steps
+        for name, (shape, _) in self.leaves.items():
+            for key in STATE_KEYS:
+                arr = np.ascontiguousarray(
+                    np.asarray(tree[f"{name}.{key}"], np.float32).reshape(-1)
+                )
+                self.swapper.swap_out(f"{name}.{key}", arr, asynchronous=False)

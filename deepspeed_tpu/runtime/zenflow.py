@@ -1,0 +1,300 @@
+"""ZenFlow: stall-free selective-offload optimizer.
+
+Reference: ``runtime/zenflow/`` (``ZenFlowConfig`` zenflow_config.py:12,
+``ZenFlowZeroOptimizer`` zenflow_stage_1_and_2.py:47, selective AdamW
+``ops/adam/zenflow*``). The idea: the top-``topk_ratio`` most important
+gradient *columns* of each matrix are updated on the accelerator every step
+with a small selective Adam state; everything else is accumulated and applied
+to the (offloaded) fp32 master only every ``update_interval`` steps — cutting
+the per-step host<->device optimizer traffic that stalls plain ZeRO-Offload.
+
+TPU-native form: one functional optimizer whose whole schedule compiles into
+the train step. All shapes are static (k = ceil(ratio * cols) is fixed);
+selection indices are data, not structure, so reselection does not retrace.
+The off-boundary path is a ``lax.cond`` branch that never touches the master
+tree — with ``offload_optimizer`` the master/accumulator leaves live in
+pinned_host and XLA moves them only on boundary steps.
+
+Step semantics (c = step counter):
+  c <= warmup                : full AdamW on master with this step's grads
+  off-boundary step          : selective AdamW on the selected columns of
+                               each 2-D param (in compute dtype); grads with
+                               selected columns zeroed accumulate into ``acc``
+  c % update_interval == 0   : fold selectively-updated columns back into the
+                               master, full AdamW with the accumulated mean
+                               grad, re-derive params, reselect indices from
+                               this step's grad column norms, reset selective
+                               moments and ``acc``
+"""
+
+from dataclasses import dataclass
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.config_utils import ConfigError, DSConfigModel
+from deepspeed_tpu.runtime.optimizers import DeepSpeedOptimizer
+
+
+@dataclass
+class ZenFlowConfig(DSConfigModel):
+    """``zenflow`` config section (reference zenflow_config.py:12)."""
+
+    topk_ratio: float = 0.1
+    select_strategy: str = "auto"  # auto | step | epoch
+    select_interval: Any = "auto"
+    update_interval: Any = "auto"
+    overlap_step: bool = False  # [compat] XLA schedules the overlap
+    offload: bool = False
+    auto_ratio: float = 0.99  # [compat] auto-interval heuristic input
+    full_warm_up_rounds: int = 0
+    steps_per_epoch: Any = None
+    pt_reserved_cores_perc: float = 0.5  # [compat] host-thread split
+
+    def _validate(self):
+        if not 0.0 <= self.topk_ratio <= 1.0:
+            raise ConfigError("zenflow.topk_ratio must be in [0, 1]")
+        if self.select_strategy not in ("auto", "step", "epoch"):
+            raise ConfigError("zenflow.select_strategy must be auto|step|epoch")
+
+    def resolved_intervals(self):
+        """Concrete (select_interval, update_interval) steps. 'auto' maps to
+        the reference defaults: reselect each "epoch" (steps_per_epoch when
+        known, else every 100 steps), apply the accumulator every 4 steps."""
+        sel = self.select_interval
+        if sel == "auto" or sel is None:
+            sel = self.steps_per_epoch or 100
+        upd = self.update_interval
+        if upd == "auto" or upd is None:
+            upd = 4
+        sel, upd = int(sel), int(upd)
+        # selection must happen on boundaries: round it to a multiple
+        if sel % upd:
+            sel = max(upd, (sel // upd) * upd)
+        return sel, upd
+
+
+class ZenFlowLeafState(NamedTuple):
+    indices: Any  # [k] int32 selected columns (2-D leaves; else size-0)
+    sel_m: Any  # [rows, k] fp32 selective first moment
+    sel_v: Any  # [rows, k] fp32 selective second moment
+    acc: Any  # full-shape fp32 accumulated "unimportant" grads
+    master: Any  # full-shape fp32 master weights
+    m: Any  # full-shape fp32 Adam first moment
+    v: Any  # full-shape fp32 Adam second moment
+
+
+class ZenFlowState(NamedTuple):
+    leaves: Any  # pytree of ZenFlowLeafState
+    count: Any  # int32 total steps taken
+    full_steps: Any  # int32 number of full (boundary) updates taken
+    sel_steps: Any  # int32 number of selective updates since reselect
+    acc_steps: Any  # int32 steps accumulated into acc since last boundary
+
+
+def _is_matrix(p):
+    return getattr(p, "ndim", 0) == 2
+
+
+class ZenFlowOptimizer(DeepSpeedOptimizer):
+    """Drop-in DeepSpeedOptimizer whose ``step`` runs the ZenFlow schedule.
+
+    Constructed by ``build_zenflow_optimizer``; the engine treats it exactly
+    like any optimizer (state through the ZeRO plan, overflow skip-step
+    outside).
+    """
+
+    def __init__(self, cfg: ZenFlowConfig, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=0.0):
+        self.cfg = cfg
+        self.b1, self.b2 = betas
+        self.eps = eps
+        self.wd = weight_decay
+        self.select_interval, self.update_interval = cfg.resolved_intervals()
+        self.warmup = int(cfg.full_warm_up_rounds)
+        super().__init__(tx=None, name="zenflow", defaults={
+            "lr": lr, "betas": betas, "eps": eps, "weight_decay": weight_decay,
+        })
+
+    # -- state --
+
+    def _k(self, p):
+        if not _is_matrix(p):
+            return 0
+        cols = p.shape[1]
+        k = max(1, int(round(self.cfg.topk_ratio * cols)))
+        return min(k, cols)
+
+    def init(self, params) -> ZenFlowState:
+        def leaf(p):
+            k = self._k(p)
+            rows = p.shape[0] if _is_matrix(p) else 0
+            f32 = jnp.float32
+            return ZenFlowLeafState(
+                # distinct initial columns: duplicate indices would corrupt
+                # the one-hot scatter mask
+                indices=jnp.arange(k, dtype=jnp.int32),
+                sel_m=jnp.zeros((rows, k), f32),
+                sel_v=jnp.zeros((rows, k), f32),
+                acc=jnp.zeros(p.shape, f32),
+                master=p.astype(f32),
+                m=jnp.zeros(p.shape, f32),
+                v=jnp.zeros(p.shape, f32),
+            )
+
+        return ZenFlowState(
+            leaves=jax.tree.map(leaf, params),
+            count=jnp.int32(0),
+            full_steps=jnp.int32(0),
+            sel_steps=jnp.int32(0),
+            acc_steps=jnp.int32(0),
+        )
+
+    # -- math helpers --
+
+    def _adam(self, g, m, v, t, lr):
+        b1, b2, eps = self.b1, self.b2, self.eps
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * jnp.square(g)
+        t = jnp.maximum(t, 1).astype(jnp.float32)
+        mhat = m / (1 - b1**t)
+        vhat = v / (1 - b2**t)
+        return lr * mhat / (jnp.sqrt(vhat) + eps), m, v
+
+    # -- the schedule --
+
+    def step(self, grads, state: ZenFlowState, params, lr):
+        cfg = self.cfg
+        c = state.count + 1
+        warm = c <= self.warmup
+        boundary = jnp.logical_or(warm, (c % self.update_interval) == 0)
+        resel_due = jnp.logical_or(
+            # first post-warmup boundary picks the initial columns (during
+            # warmup full_steps advances once per step, so == warmup exactly
+            # at the first real boundary)
+            state.full_steps == jnp.int32(self.warmup),
+            (c % self.select_interval) == 0,
+        )
+        lr = jnp.float32(lr)
+        is_leaf = lambda x: isinstance(x, ZenFlowLeafState)
+
+        # ---- every-step selective branch (skipped during warmup) ----
+        def selective(p, g, st: ZenFlowLeafState):
+            if not _is_matrix(p):
+                # non-matrix leaves ride the accumulator only
+                return p, st._replace(acc=st.acc + g.astype(jnp.float32))
+            g32 = g.astype(jnp.float32)
+            gsel = g32.at[:, st.indices].get(mode='promise_in_bounds')  # [rows, k]
+            psel = p.at[:, st.indices].get(mode='promise_in_bounds').astype(jnp.float32)
+            upd, m, v = self._adam(gsel, st.sel_m, st.sel_v, state.sel_steps + 1, lr)
+            if self.wd:
+                upd = upd + lr * self.wd * psel
+            new_psel = (psel - upd).astype(p.dtype)
+            # scatters only (indices are distinct) — no fresh mask constants,
+            # so every array derives from the operands and shares one memory
+            # space under the engine's compute_on("device_host") region
+            newp = p.at[:, st.indices].set(new_psel, mode='promise_in_bounds')
+            # accumulate everything, then cancel the selected columns
+            acc = (st.acc + g32).at[:, st.indices].add(-gsel, mode='promise_in_bounds')
+            # during warmup the boundary branch handles everything
+            keep = warm
+            return (
+                jnp.where(keep, p, newp),
+                st._replace(
+                    sel_m=jnp.where(keep, st.sel_m, m),
+                    sel_v=jnp.where(keep, st.sel_v, v),
+                    acc=jnp.where(keep, st.acc + g32, acc),
+                ),
+            )
+
+        new_params, leaves = _tree_map2(selective, params, grads, state.leaves, is_leaf)
+        mid = state._replace(
+            leaves=leaves,
+            count=c,
+            sel_steps=jnp.where(warm, state.sel_steps, state.sel_steps + 1),
+            acc_steps=state.acc_steps + 1,
+        )
+
+        # ---- boundary branch: full update on master with the accumulator ----
+        def boundary_fn(operand):
+            params_b, st_b = operand
+            # actual steps accumulated since the last boundary (the first
+            # post-warmup boundary can arrive with < update_interval of them)
+            nsteps = jnp.maximum(st_b.acc_steps, 1).astype(jnp.float32)
+            t = st_b.full_steps + 1
+
+            def per_leaf(p, g, st: ZenFlowLeafState):
+                master = st.master
+                if _is_matrix(p):
+                    # fold selectively-updated columns back into the master
+                    # (no-op during warmup, when params came FROM the master)
+                    fold = jnp.where(
+                        warm,
+                        master.at[:, st.indices].get(mode='promise_in_bounds'),
+                        p.at[:, st.indices].get(mode='promise_in_bounds').astype(jnp.float32),
+                    )
+                    master = master.at[:, st.indices].set(fold, mode='promise_in_bounds')
+                gmean = st.acc / nsteps
+                upd, m, v = self._adam(gmean, st.m, st.v, t, lr)
+                if self.wd:
+                    upd = upd + lr * self.wd * master
+                master = master - upd
+                newp = master.astype(p.dtype)
+                # reselect columns from THIS step's raw grad importance
+                if _is_matrix(p):
+                    g32 = g.astype(jnp.float32)
+                    imp = jnp.sum(jnp.square(g32), axis=0)  # column importance
+                    _, top = jax.lax.top_k(imp, st.indices.shape[0])
+                    idx = jnp.where(resel_due, top.astype(jnp.int32), st.indices)
+                    # operand-derived zeros: fresh constants land in device
+                    # space and clash with host-resident state under the
+                    # engine's compute_on("device_host") offload region
+                    zeros = st.sel_m * 0.0
+                    sel_m = jnp.where(resel_due, zeros, st.sel_m)
+                    sel_v = jnp.where(resel_due, zeros, st.sel_v)
+                else:
+                    idx, sel_m, sel_v = st.indices, st.sel_m, st.sel_v
+                return newp, ZenFlowLeafState(
+                    indices=idx, sel_m=sel_m, sel_v=sel_v,
+                    acc=st.acc * 0.0, master=master, m=m, v=v,
+                )
+
+            newp, newl = _tree_map2(per_leaf, params_b, grads, st_b.leaves, is_leaf)
+            return newp, st_b._replace(
+                leaves=newl,
+                full_steps=t,
+                sel_steps=jnp.where(resel_due, jnp.int32(0), st_b.sel_steps),
+                acc_steps=jnp.int32(0),
+            )
+
+        def passthrough(operand):
+            return operand
+
+        return jax.lax.cond(boundary, boundary_fn, passthrough, (new_params, mid))
+
+
+def _tree_map2(fn, params, grads, leaves, is_leaf):
+    """Map fn(param, grad, leaf_state) -> (new_param, new_leaf_state) over
+    parallel trees, returning the two result trees."""
+    flat_p, treedef = jax.tree_util.tree_flatten(params)
+    flat_g = treedef.flatten_up_to(grads)
+    flat_l = jax.tree_util.tree_flatten(leaves, is_leaf=is_leaf)[0]
+    outs = [fn(p, g, l) for p, g, l in zip(flat_p, flat_g, flat_l)]
+    new_p = jax.tree_util.tree_unflatten(treedef, [o[0] for o in outs])
+    new_l = jax.tree_util.tree_unflatten(treedef, [o[1] for o in outs])
+    return new_p, new_l
+
+
+def build_zenflow_optimizer(zf_cfg_dict, opt_config) -> ZenFlowOptimizer:
+    """Engine hook: ``zenflow`` config section + adam-family optimizer section
+    (reference engine lambdas engine.py:351-356 route to ZenFlowZeroOptimizer)."""
+    cfg = ZenFlowConfig.from_dict(dict(zf_cfg_dict))
+    p = dict(opt_config.params or {})
+    return ZenFlowOptimizer(
+        cfg,
+        lr=p.get("lr", 1e-3),
+        betas=tuple(p.get("betas", (0.9, 0.999))),
+        eps=p.get("eps", 1e-8),
+        weight_decay=p.get("weight_decay", 0.0),
+    )

@@ -55,11 +55,20 @@ class DeepSpeedZeroOffloadOptimizerConfig(DSConfigModel):
     pipeline_read: bool = False
     pipeline_write: bool = False
     fast_init: bool = False
+    # Twin-Flow partial offload (reference engine.py:921 zero_partial_offload):
+    # fraction of optimizer-state bytes placed in host memory; the rest stays
+    # in HBM so only `ratio` of the state crosses the link each step
     ratio: float = 1.0
+    # SuperOffload (reference engine.py:924 + superoffload_stage3.py): run the
+    # whole optimizer host-side against RAM-resident state via CPU-Adam
+    super_offload: bool = False
+    cpuadam_cores_perc: float = 0.8
 
     def _validate(self):
         if self.device not in (OffloadDeviceEnum.none, OffloadDeviceEnum.cpu, OffloadDeviceEnum.nvme):
             raise ConfigError(f"Invalid offload device {self.device}")
+        if not 0.0 <= self.ratio <= 1.0:
+            raise ConfigError("offload_optimizer.ratio must be in [0, 1]")
 
 
 @dataclass

@@ -124,6 +124,10 @@ class ZeroShardingPlan:
     # host memory ("pinned_host" memory kind) instead of HBM
     offload_optimizer: bool = False
     offload_param: bool = False
+    # Twin-Flow partial offload (reference engine.py:921): fraction of
+    # optimizer-state BYTES placed host-side; largest leaves offload first so
+    # the fewest leaves pay the transfer. 1.0 = everything offloads.
+    offload_ratio: float = 1.0
     # MiCS/hpZ: which mesh axes params vs optimizer state shard over
     # (ZERO_AXES = full dp; ("zero",) = within the shard group only)
     param_zero_axes: tuple = ZERO_AXES
@@ -164,8 +168,11 @@ class ZeroShardingPlan:
         stage = self.stage
 
         kind = self.state_memory_kind
+        # Twin-Flow: offload only `offload_ratio` of the state bytes —
+        # largest leaves first — leaving the rest in HBM
+        host_leaf = self._partial_offload_mask(state_shape_tree) if kind else None
 
-        def leaf_sharding(leaf):
+        def leaf_sharding(leaf, offloaded=True):
             shape = tuple(getattr(leaf, "shape", ()))
             if stage >= 1 and shape:
                 spec = choose_zero_spec(shape, axis_size, None, axes=axes or (DATA_AXIS,))
@@ -174,11 +181,34 @@ class ZeroShardingPlan:
             # scalars (step counts) stay in device memory: XLA's SPMD
             # partitioner rejects host-placement annotations on scalar
             # side-effect custom-calls, and 4 bytes buys nothing offloaded
-            if kind is not None and shape:
+            if kind is not None and shape and offloaded:
                 return NamedSharding(mesh, spec, memory_kind=kind)
             return NamedSharding(mesh, spec)
 
-        return jax.tree.map(leaf_sharding, state_shape_tree)
+        if host_leaf is None:
+            return jax.tree.map(leaf_sharding, state_shape_tree)
+        return jax.tree.map(leaf_sharding, state_shape_tree, host_leaf)
+
+    def _partial_offload_mask(self, state_shape_tree):
+        """Boolean-per-leaf tree: True = leaf lives host-side. Greedy by
+        descending size until ``offload_ratio`` of total bytes is host-bound."""
+        flat, treedef = jax.tree_util.tree_flatten(state_shape_tree)
+        sizes = [
+            int(np.prod(getattr(l, "shape", ()) or (1,)))
+            * np.dtype(getattr(l, "dtype", np.float32)).itemsize
+            for l in flat
+        ]
+        if self.offload_ratio >= 1.0:
+            return jax.tree_util.tree_unflatten(treedef, [True] * len(flat))
+        budget = self.offload_ratio * sum(sizes)
+        mask = [False] * len(flat)
+        cum = 0
+        for i in sorted(range(len(flat)), key=lambda j: -sizes[j]):
+            if cum >= budget:
+                break
+            mask[i] = True
+            cum += sizes[i]
+        return jax.tree_util.tree_unflatten(treedef, mask)
 
 
 def build_zero_plan(
@@ -191,6 +221,7 @@ def build_zero_plan(
     param_zero_axes=None,
     offload_optimizer: bool = False,
     offload_param: bool = False,
+    offload_ratio: float = 1.0,
 ) -> ZeroShardingPlan:
     """Construct the stage's sharding plan over a params pytree.
 
@@ -277,6 +308,7 @@ def build_zero_plan(
         persistence_threshold=persistence_threshold,
         offload_optimizer=offload_optimizer,
         offload_param=offload_param,
+        offload_ratio=offload_ratio,
         param_zero_axes=tuple(param_zero_axes),
         state_zero_axes=tuple(zero_axes),
     )

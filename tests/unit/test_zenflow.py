@@ -1,0 +1,144 @@
+"""ZenFlow selective-offload optimizer (runtime/zenflow.py; reference
+runtime/zenflow/zenflow_stage_1_and_2.py + ops ZenFlowSelectiveAdamW)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.zenflow import (
+    ZenFlowConfig,
+    ZenFlowOptimizer,
+    build_zenflow_optimizer,
+)
+
+from tests.unit.simple_model import batch_of, make_mlp_params, mlp_loss_fn, random_dataset
+
+LR = 1e-2
+
+
+def _make(topk=0.25, update_interval=2, select_interval=4, warmup=0, wd=0.0):
+    cfg = ZenFlowConfig.from_dict({
+        "topk_ratio": topk,
+        "update_interval": update_interval,
+        "select_interval": select_interval,
+        "full_warm_up_rounds": warmup,
+    })
+    return ZenFlowOptimizer(cfg, lr=LR, weight_decay=wd)
+
+
+class TestZenFlowUnit:
+    def test_warmup_matches_adamw(self):
+        """During full_warm_up_rounds every step is a full AdamW update —
+        trajectory must match optax.adamw exactly."""
+        rng = jax.random.key(0)
+        params = {"w": jax.random.normal(rng, (8, 16)), "b": jnp.zeros((16,))}
+        grads = {"w": jax.random.normal(jax.random.key(1), (8, 16)),
+                 "b": jnp.ones((16,)) * 0.1}
+        zf = _make(warmup=3, update_interval=2)
+        state = zf.init(params)
+        tx = optax.adamw(LR, weight_decay=0.0)
+        ref_p, ref_s = params, tx.init(params)
+        p = params
+        for _ in range(3):
+            p, state = jax.jit(zf.step)(grads, state, p, LR)
+            upd, ref_s = tx.update(grads, ref_s, ref_p)
+            ref_p = optax.apply_updates(ref_p, upd)
+        for a, b in zip(jax.tree.leaves(p), jax.tree.leaves(ref_p)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+    def test_off_boundary_touches_only_selected_columns(self):
+        """Between boundaries only the selected k columns of a matrix (and no
+        non-matrix leaf) may change."""
+        params = {"w": jnp.ones((4, 8)), "b": jnp.ones((8,))}
+        grads = {"w": jnp.ones((4, 8)), "b": jnp.ones((8,))}
+        zf = _make(topk=0.25, update_interval=4, select_interval=4)
+        state = zf.init(params)
+        step = jax.jit(zf.step)
+        p1, s1 = step(grads, state, params, LR)  # step 1: off-boundary
+        w = np.asarray(p1["w"])
+        changed_cols = np.where(np.any(w != 1.0, axis=0))[0]
+        k = 2  # ceil(0.25 * 8)
+        assert len(changed_cols) == k, changed_cols
+        np.testing.assert_array_equal(np.asarray(p1["b"]), np.ones(8))
+        # accumulator holds the unselected grads, zero on selected columns
+        acc = np.asarray(s1.leaves["w"].acc)
+        assert np.all(acc[:, changed_cols] == 0)
+        unsel = [c for c in range(8) if c not in changed_cols]
+        assert np.all(acc[:, unsel] == 1.0)
+
+    def test_boundary_applies_accumulator_and_reselects(self):
+        params = {"w": jnp.ones((4, 8))}
+        zf = _make(topk=0.25, update_interval=2, select_interval=2)
+        state = zf.init(params)
+        step = jax.jit(zf.step)
+        # make column 5 most important at the boundary
+        g_skewed = jnp.ones((4, 8)).at[:, 5].set(10.0)
+        p, s = step({"w": jnp.ones((4, 8))}, state, params, LR)
+        p, s = step({"w": g_skewed}, s, p, LR)  # step 2 = boundary + reselect
+        idx = np.asarray(s.leaves["w"].indices)
+        assert 5 in idx, idx
+        # accumulator reset after boundary
+        assert np.all(np.asarray(s.leaves["w"].acc) == 0)
+        # all columns moved at the boundary (full update applied)
+        assert np.all(np.asarray(p["w"]) != 1.0)
+
+    def test_counter_and_master_consistency(self):
+        """Selectively-updated columns must fold back into the master at the
+        boundary: running many steps keeps params == cast(master) right after
+        every boundary."""
+        rng = jax.random.key(2)
+        params = {"w": jax.random.normal(rng, (6, 12))}
+        zf = _make(topk=0.3, update_interval=3, select_interval=6)
+        state = zf.init(params)
+        step = jax.jit(zf.step)
+        p = params
+        for i in range(1, 10):
+            g = {"w": jax.random.normal(jax.random.key(i), (6, 12))}
+            p, state = step(g, state, p, LR)
+            if i % 3 == 0:  # boundary
+                np.testing.assert_allclose(
+                    np.asarray(p["w"]),
+                    np.asarray(state.leaves["w"].master),
+                    rtol=1e-6, atol=1e-7,
+                )
+
+
+class TestZenFlowEngine:
+    def test_zenflow_trains(self, devices8):
+        dataset = random_dataset(n=512)
+        params = make_mlp_params(jax.random.key(0))
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=mlp_loss_fn,
+            model_parameters=params,
+            config={
+                "train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 2,
+                                      "offload_optimizer": {"device": "cpu"}},
+                "zenflow": {"topk_ratio": 0.2, "update_interval": 2,
+                            "select_interval": 4, "full_warm_up_rounds": 1},
+                "steps_per_print": 1000,
+            },
+        )
+        assert engine.optimizer.name == "zenflow"
+        fixed = batch_of(dataset, 0, 8)
+        losses = [float(engine.train_batch(batch=fixed)) for _ in range(10)]
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0], f"zenflow should converge: {losses}"
+
+    def test_zenflow_rejects_non_adam(self, devices8):
+        params = make_mlp_params(jax.random.key(0))
+        with pytest.raises(ValueError, match="Adam-family"):
+            deepspeed_tpu.initialize(
+                model=mlp_loss_fn,
+                model_parameters=params,
+                config={
+                    "train_micro_batch_size_per_gpu": 1,
+                    "optimizer": {"type": "SGD", "params": {"lr": 1e-3}},
+                    "zenflow": {"topk_ratio": 0.2},
+                    "steps_per_print": 1000,
+                },
+            )
