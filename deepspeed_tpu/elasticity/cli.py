@@ -1,0 +1,36 @@
+"""dstpu_elastic: elastic-config explorer CLI (reference ``bin/ds_elastic``):
+reads a config JSON with an ``elasticity`` section and prints the compatible
+(batch size, chip count) schedule, optionally for a specific world size."""
+
+import argparse
+import json
+
+from deepspeed_tpu.elasticity.elasticity import compute_elastic_config
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("dstpu_elastic", description=__doc__)
+    p.add_argument("-c", "--config", required=True, help="DeepSpeed config json")
+    p.add_argument("-w", "--world-size", type=int, default=0,
+                   help="report micro-batch/gas for this chip count")
+    args = p.parse_args(argv)
+
+    with open(args.config) as f:
+        ds_config = json.load(f)
+    if args.world_size:
+        batch, valid, mbs = compute_elastic_config(
+            ds_config, world_size=args.world_size, return_microbatch=True
+        )
+        print(json.dumps({
+            "world_size": args.world_size,
+            "final_batch_size": batch,
+            "micro_batch_per_chip": mbs,
+            "valid_chip_counts": valid,
+        }, indent=2))
+    else:
+        batch, valid, _ = compute_elastic_config(ds_config)
+        print(json.dumps({
+            "final_batch_size": batch,
+            "valid_chip_counts": valid,
+        }, indent=2))
+    return 0

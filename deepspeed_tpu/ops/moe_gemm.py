@@ -1,0 +1,95 @@
+"""Grouped (ragged) GEMM for MoE expert FFNs.
+
+Reference: ``deepspeed/inference/v2/kernels/cutlass_ops/moe_gemm`` (grouped
+GEMM over variable tokens-per-expert) + ``mixed_gemm``. The TPU-native form
+is ``jax.lax.ragged_dot``: tokens sorted by expert with a ``group_sizes``
+vector, lowered by XLA to an MXU grouped matmul — no capacity padding, no
+dropped tokens. On top of it, ``moe_mlp_dropless`` is a MegaBlocks-style
+dropless expert MLP: sort tokens by assigned expert, two ragged GEMMs,
+scatter-add back weighted by the gate.
+
+(The training MoE layer in parallel/moe/sharded_moe.py keeps the GShard
+capacity-padded einsum dispatch — batched GEMMs with static shapes — which
+is itself the grouped-GEMM fast path when capacity padding is acceptable.)
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def grouped_gemm(x: jax.Array, weights: jax.Array, group_sizes: jax.Array) -> jax.Array:
+    """x: [n, h] sorted so the first group_sizes[0] rows belong to expert 0,
+    etc.; weights: [E, h, f]; group_sizes: [E] int32 summing to n.
+    Returns [n, f] where row i is multiplied by its expert's weight."""
+    return jax.lax.ragged_dot(x, weights, group_sizes.astype(jnp.int32))
+
+
+def _sort_by_expert(expert_of: jax.Array):
+    """Stable sort token slots by expert id. Returns (order, inverse)."""
+    order = jnp.argsort(expert_of, stable=True)
+    inv = jnp.argsort(order, stable=True)
+    return order, inv
+
+
+def moe_mlp_dropless(
+    tokens: jax.Array,  # [t, h]
+    logits: jax.Array,  # [t, E] gate logits
+    w_up: jax.Array,  # [E, h, f]
+    w_down: jax.Array,  # [E, f, h]
+    w_gate: Optional[jax.Array] = None,  # [E, h, f] (gated MLPs)
+    top_k: int = 2,
+    activation=jax.nn.silu,
+):
+    """Dropless top-k expert MLP via grouped GEMMs (no capacity, no drops).
+
+    Each token is routed to its top-k experts with softmax-renormalized
+    weights (reference topkgating semantics minus the capacity machinery);
+    outputs scatter-add back. Compute cost is exactly t*k expert-row GEMMs.
+    """
+    t, h = tokens.shape
+    E = logits.shape[-1]
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    top_vals, top_idx = jax.lax.top_k(gates, top_k)  # [t, k]
+    top_vals = top_vals / jnp.maximum(top_vals.sum(-1, keepdims=True), 1e-9)
+
+    flat_expert = top_idx.reshape(-1)  # [t*k]
+    flat_weight = top_vals.reshape(-1)
+    flat_token = jnp.repeat(jnp.arange(t), top_k)
+
+    order, _ = _sort_by_expert(flat_expert)
+    sorted_tokens = tokens[flat_token[order]]  # [t*k, h]
+    group_sizes = jnp.bincount(flat_expert, length=E).astype(jnp.int32)
+
+    up = grouped_gemm(sorted_tokens, w_up, group_sizes)
+    if w_gate is not None:
+        up = activation(grouped_gemm(sorted_tokens, w_gate, group_sizes)) * up
+    else:
+        up = activation(up)
+    down = grouped_gemm(up, w_down, group_sizes)  # [t*k, h]
+
+    down = down * flat_weight[order][:, None].astype(down.dtype)
+    out = jnp.zeros_like(tokens).at[flat_token[order]].add(down)
+    return out, group_sizes
+
+
+def moe_mlp_dropless_reference(tokens, logits, w_up, w_down, w_gate=None,
+                               top_k=2, activation=jax.nn.silu):
+    """Dense per-token loop reference (einsum over all experts, masked)."""
+    t, h = tokens.shape
+    E = logits.shape[-1]
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    top_vals, top_idx = jax.lax.top_k(gates, top_k)
+    top_vals = top_vals / jnp.maximum(top_vals.sum(-1, keepdims=True), 1e-9)
+    out = jnp.zeros_like(tokens)
+    for e in range(E):
+        up = tokens @ w_up[e]
+        if w_gate is not None:
+            up = activation(tokens @ w_gate[e]) * up
+        else:
+            up = activation(up)
+        y = up @ w_down[e]  # [t, h]
+        w = jnp.where(top_idx == e, top_vals, 0.0).sum(-1)  # [t]
+        out = out + y * w[:, None].astype(y.dtype)
+    return out

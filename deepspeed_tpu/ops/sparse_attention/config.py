@@ -141,7 +141,6 @@ class BigBirdSparsityConfig(SparsityConfig):
         layout = self.setup_layout(seq_len)
         n = layout.shape[1]
         w = self.num_sliding_window_blocks // 2
-        rng = np.random.default_rng(self.seed)
         for h in range(self.num_heads):
             hh = h if self.different_layout_per_head else 0
             rs = np.random.default_rng(self.seed + hh)
@@ -154,7 +153,6 @@ class BigBirdSparsityConfig(SparsityConfig):
             layout[h, : min(self.num_global_blocks, n), :] = 1
         if self.attention == "unidirectional":
             layout = np.tril(layout)
-        _ = rng
         return layout
 
 

@@ -49,7 +49,10 @@ def _sparse_fwd_kernel(q_ref, k_ref, v_ref, lay_ref, o_ref, lse_ref, *, scale, c
             k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
-        p = jnp.exp(logits - m_new[:, None])
+        # guard: when every visited logit is still NEG_INF, logits - m_new
+        # is 0 and exp() would emit 1s — a fully-masked row would then
+        # average the masked V instead of producing zeros
+        p = jnp.where(logits > NEG_INF / 2, jnp.exp(logits - m_new[:, None]), 0.0)
         corr = jnp.exp(m - m_new)
         l_new = l * corr + jnp.sum(p, axis=-1)
         acc_new = acc * corr[:, None] + jax.lax.dot_general(
@@ -90,7 +93,7 @@ def _sparse_bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, lay_ref, 
         if causal:
             k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
-        p = jnp.exp(logits - lse[:, None])
+        p = jnp.where(logits > NEG_INF / 2, jnp.exp(logits - lse[:, None]), 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -132,7 +135,7 @@ def _sparse_bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, col_ref,
         if causal:
             q_pos = qj * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
-        p = jnp.exp(logits - lse[:, None])
+        p = jnp.where(logits > NEG_INF / 2, jnp.exp(logits - lse[:, None]), 0.0)
         dv_new = dv + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )

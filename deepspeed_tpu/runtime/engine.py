@@ -1538,9 +1538,16 @@ class DeepSpeedEngine:
             self.params = self._restore_tree(self.params, data["params"])
             if load_optimizer_states and not load_module_only and "opt_state" in data:
                 if self._host_opt is not None:
-                    self._host_opt.load_state_tree(
-                        jax.tree.map(np.asarray, data["opt_state"])
-                    )
+                    # pluggable writers may hand back a FLAT leaf list; rebuild
+                    # the name-keyed dict from the template's structure
+                    tmpl = self._host_opt.state_tree_template()
+                    loaded = data["opt_state"]
+                    if not isinstance(loaded, dict):
+                        loaded = jax.tree_util.tree_unflatten(
+                            jax.tree_util.tree_structure(tmpl),
+                            jax.tree_util.tree_leaves(loaded),
+                        )
+                    self._host_opt.load_state_tree(jax.tree.map(np.asarray, loaded))
                 else:
                     self.opt_state = self._restore_tree(self.opt_state, data["opt_state"])
             if "scaler_state" in data:

@@ -95,7 +95,9 @@ class SuperOffloadHostOptimizer:
         tree: Dict[str, Any] = {"steps": self.steps}
         for name, (shape, _) in self.leaves.items():
             for key in ("master", "exp_avg", "exp_avg_sq"):
-                tree[f"{name}.{key}"] = self._state[f"{name}.{key}"].reshape(shape)
+                # COPY, not view: async checkpoint writers serialize in the
+                # background while cpu_adam.step mutates these buffers in place
+                tree[f"{name}.{key}"] = self._state[f"{name}.{key}"].reshape(shape).copy()
         return tree
 
     def state_tree_template(self) -> Dict[str, Any]:

@@ -14,6 +14,9 @@ setup(
         "console_scripts": [
             "dstpu=deepspeed_tpu.launcher.runner:main",
             "dstpu_report=deepspeed_tpu.env_report:main",
+            "dstpu_io=deepspeed_tpu.utils.io_bench:main",
+            "dstpu_bench=deepspeed_tpu.utils.comm_bench:main",
+            "dstpu_elastic=deepspeed_tpu.elasticity.cli:main",
         ]
     },
 )

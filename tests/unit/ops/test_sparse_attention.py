@@ -70,6 +70,22 @@ class TestSparseKernel:
         ref = sparse_attention_reference(q, k, v, layout, BLOCK, causal=causal)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
 
+    def test_causally_dead_rows_emit_zeros(self):
+        """Regression: a q row whose only active layout block lies entirely in
+        the causal future must output zeros, not the mean of the future V."""
+        q, k, v = _qkv(h=1, s=128)
+        layout = np.zeros((1, 2, 2), np.int32)
+        layout[0, 0, 1] = 1  # q block 0 attends ONLY future k block 1
+        layout[0, 1, :] = 1  # q block 1 attends everything (sane rows)
+        out = sparse_attention(q, k, v, layout, BLOCK, causal=True, interpret=True)
+        np.testing.assert_array_equal(np.asarray(out[:, :, :BLOCK]), 0.0)
+        ref = sparse_attention_reference(q, k, v, layout, BLOCK, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+        # grads through the dead rows must be finite (not inf/NaN from lse)
+        g = jax.grad(lambda q: jnp.sum(jnp.square(
+            sparse_attention(q, k, v, layout, BLOCK, causal=True, interpret=True))))(q)
+        assert np.isfinite(np.asarray(g)).all()
+
     def test_per_head_layouts_differ(self):
         """different_layout_per_head: heads see different sparsity."""
         q, k, v = _qkv(h=4, s=256)
