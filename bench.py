@@ -9,6 +9,7 @@ vs_baseline = achieved_MFU / 0.40.
 """
 
 import json
+import os
 import time
 
 import jax
@@ -55,6 +56,7 @@ def main():
             vocab_size=32000, hidden_size=1536, n_layers=20, n_heads=12,
             n_kv_heads=6, ffn_hidden_size=4096, max_seq_len=2048,
             dtype="bfloat16",
+            remat_policy=os.environ.get("DSTPU_REMAT_POLICY", "dots_with_no_batch_dims"),
         )
         bsz, seq, steps, warmup = 4, 2048, 10, 4
     else:  # smoke-test path for CPU dev boxes

@@ -28,7 +28,7 @@ def main(argv=None) -> int:
             "valid_chip_counts": valid,
         }, indent=2))
     else:
-        batch, valid, _ = compute_elastic_config(ds_config)
+        batch, valid = compute_elastic_config(ds_config)
         print(json.dumps({
             "final_batch_size": batch,
             "valid_chip_counts": valid,

@@ -27,10 +27,8 @@ def grouped_gemm(x: jax.Array, weights: jax.Array, group_sizes: jax.Array) -> ja
 
 
 def _sort_by_expert(expert_of: jax.Array):
-    """Stable sort token slots by expert id. Returns (order, inverse)."""
-    order = jnp.argsort(expert_of, stable=True)
-    inv = jnp.argsort(order, stable=True)
-    return order, inv
+    """Stable sort token slots by expert id."""
+    return jnp.argsort(expert_of, stable=True)
 
 
 def moe_mlp_dropless(
@@ -58,7 +56,7 @@ def moe_mlp_dropless(
     flat_weight = top_vals.reshape(-1)
     flat_token = jnp.repeat(jnp.arange(t), top_k)
 
-    order, _ = _sort_by_expert(flat_expert)
+    order = _sort_by_expert(flat_expert)
     sorted_tokens = tokens[flat_token[order]]  # [t*k, h]
     group_sizes = jnp.bincount(flat_expert, length=E).astype(jnp.int32)
 

@@ -85,9 +85,9 @@ class DeepSpeedTransformerLayer:
         nh = c.heads
         hd = h // nh
         eps = c.layer_norm_eps
-        r1 = r2 = None
+        r1 = r2 = r_attn = None
         if rng is not None:
-            r1, r2 = jax.random.split(rng)
+            r1, r2, r_attn = jax.random.split(rng, 3)
 
         x = hidden_states
         attn_in = layer_norm_reference(x, params["attn_nw"], params["attn_nb"], eps) \
@@ -102,7 +102,20 @@ class DeepSpeedTransformerLayer:
         if attention_mask is not None:
             # reference: additive mask broadcast over heads ([b, 1, 1, s])
             bias = attention_mask.astype(jnp.float32).reshape(b, 1, 1, s)
-        ctx = attention(heads(q), heads(k), heads(v), causal=False, bias=bias)
+        if c.attn_dropout_ratio > 0.0 and rng is not None:
+            # probability dropout needs the dense softmax weights — compute
+            # attention inline (the fused kernel path requires ratio 0, like
+            # most flash implementations)
+            qh, kh, vh = heads(q), heads(k), heads(v)
+            logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
+                                preferred_element_type=jnp.float32) * (hd ** -0.5)
+            if bias is not None:
+                logits = logits + bias
+            w = jax.nn.softmax(logits, axis=-1)
+            w = self._dropout(r_attn, w, c.attn_dropout_ratio)
+            ctx = jnp.einsum("bhqk,bhkd->bhqd", w.astype(vh.dtype), vh)
+        else:
+            ctx = attention(heads(q), heads(k), heads(v), causal=False, bias=bias)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h)
         attn_out = ctx @ params["attn_ow"] + params["attn_ob"]
         attn_out = self._dropout(r1, attn_out, c.hidden_dropout_ratio)

@@ -64,8 +64,11 @@ def main(argv=None) -> int:
             collective, mesh=mesh, in_specs=P("x"), out_specs=P("x") if args.op in ("all_reduce",) else P(),
             check_vma=False,
         ))
-        # per-shard input
-        x = jnp.ones((n,), jnp.float32)
+        # x is the GLOBAL array under shard_map(in_specs=P("x")): each rank's
+        # collective message is n/w elements — size the global input so the
+        # PER-RANK message matches the sweep size
+        n_global = n * w
+        x = jnp.ones((n_global,), jnp.float32)
         try:
             out = fn(x)
             jax.block_until_ready(out)
@@ -76,7 +79,7 @@ def main(argv=None) -> int:
                 out = fn(x)
             jax.block_until_ready(out)
             dt = (time.perf_counter() - t0) / args.iters
-            nbytes = n * 4
+            nbytes = n * 4  # per-rank message bytes
             algbw = nbytes / dt / 1e9
             print(json.dumps({
                 "op": args.op, "size_bytes": nbytes, "time_us": round(dt * 1e6, 1),

@@ -43,6 +43,21 @@ def test_attention_mask_blocks_padding():
     )
 
 
+def test_attn_dropout_is_applied():
+    """attn_dropout_ratio must actually regularize (regression: it was
+    silently ignored)."""
+    cfg = DeepSpeedTransformerConfig(hidden_size=32, intermediate_size=64, heads=2,
+                                     attn_dropout_ratio=0.5)
+    layer = DeepSpeedTransformerLayer(cfg)
+    params = layer.init_params(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (1, 8, 32))
+    det = layer(params, x)  # no rng: deterministic, no dropout
+    a = layer(params, x, rng=jax.random.key(2))
+    b = layer(params, x, rng=jax.random.key(3))
+    assert not np.allclose(np.asarray(a), np.asarray(det))
+    assert not np.allclose(np.asarray(a), np.asarray(b))
+
+
 def test_layer_is_differentiable():
     cfg = DeepSpeedTransformerConfig(hidden_size=32, intermediate_size=64, heads=2)
     layer = DeepSpeedTransformerLayer(cfg)

@@ -109,3 +109,40 @@ def test_save_16bit_model(devices8, tmp_path):
     out = engine.save_16bit_model(str(tmp_path))
     data = np.load(out)
     assert any("layer_0" in k for k in data.files)
+
+
+def test_zero_to_fp32_offline_converter(devices8, tmp_path):
+    """The standalone recovery script (reference utils/zero_to_fp32.py,
+    shipped into every checkpoint dir) must rebuild exact fp32 masters in a
+    fresh single-device process."""
+    import subprocess
+    import sys
+
+    params = make_mlp_params(jax.random.key(0))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=mlp_loss_fn,
+        model_parameters=params,
+        config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 2},
+            "bf16": {"enabled": True},
+            "steps_per_print": 1000,
+        },
+    )
+    dataset = random_dataset(n=64)
+    engine.train_batch(batch=batch_of(dataset, 0, 8))
+    engine.save_checkpoint(str(tmp_path), tag="zf")
+    script = tmp_path / "zero_to_fp32.py"
+    assert script.exists(), "recovery script must ship with the checkpoint"
+    out = tmp_path / "fp32"
+    r = subprocess.run(
+        [sys.executable, str(script), str(tmp_path), str(out)],
+        capture_output=True, text=True, timeout=300,
+        env={"PATH": "/usr/bin:/bin", "HOME": "/root", "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0, r.stderr[-800:]
+    sd = np.load(str(out) + ".npz")
+    master = np.asarray(jax.device_get(engine.opt_state.master["layer_0"]["w"]))
+    np.testing.assert_array_equal(sd["layer_0.w"], master)
+    assert sd["layer_0.w"].dtype == np.float32
