@@ -56,9 +56,9 @@ def main():
             vocab_size=32000, hidden_size=1536, n_layers=20, n_heads=12,
             n_kv_heads=6, ffn_hidden_size=4096, max_seq_len=2048,
             dtype="bfloat16",
-            remat_policy=os.environ.get("DSTPU_REMAT_POLICY", "dots_with_no_batch_dims"),
+            remat_policy=os.environ.get("DSTPU_REMAT_POLICY", "nothing"),
         )
-        bsz, seq, steps, warmup = 4, 2048, 10, 4
+        bsz, seq, steps, warmup = int(os.environ.get("DSTPU_BENCH_BSZ", 6)), 2048, 10, 4
     else:  # smoke-test path for CPU dev boxes
         cfg = TransformerConfig(
             vocab_size=512, hidden_size=128, n_layers=2, n_heads=4,

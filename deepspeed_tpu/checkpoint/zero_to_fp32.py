@@ -24,12 +24,15 @@ import sys
 
 
 def _flatten(prefix, tree, out):
+    # key scheme matches checkpoint/engine.py save_16bit_model exactly
+    # (including its unconditional ".{i}" for sequences) so the two .npz
+    # exports line up key-for-key
     if isinstance(tree, dict):
         for k, v in tree.items():
             _flatten(f"{prefix}.{k}" if prefix else str(k), v, out)
     elif isinstance(tree, (list, tuple)):
         for i, v in enumerate(tree):
-            _flatten(f"{prefix}.{i}" if prefix else str(i), v, out)
+            _flatten(f"{prefix}.{i}", v, out)
     elif hasattr(tree, "shape"):
         out[prefix] = tree
     return out
@@ -84,7 +87,11 @@ def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag=None):
             pruned["scaler_state"] = None
         try:
             restored = ckptr.restore(state_path, pruned)
-        except Exception:  # orbax version refuses partial targets: read all
+        except (ValueError, TypeError) as e:
+            # this orbax version refuses partial (None-subtree) targets —
+            # surface the cause, then pay for the full read
+            print(f"partial restore unsupported ({e}); reading full state",
+                  file=sys.stderr)
             restored = ckptr.restore(state_path, target)
 
     params = restored.get("params", {})

@@ -66,9 +66,14 @@ def main(argv=None) -> int:
         ))
         # x is the GLOBAL array under shard_map(in_specs=P("x")): each rank's
         # collective message is n/w elements — size the global input so the
-        # PER-RANK message matches the sweep size
+        # PER-RANK message matches the sweep size. Build it PRE-SHARDED: an
+        # unsharded global array would materialize entirely on device 0 and
+        # OOM at large sweep sizes on large meshes.
         n_global = n * w
-        x = jnp.ones((n_global,), jnp.float32)
+        x = jax.device_put(
+            jnp.ones((n_global,), jnp.float32),
+            jax.sharding.NamedSharding(mesh, P("x")),
+        )
         try:
             out = fn(x)
             jax.block_until_ready(out)
