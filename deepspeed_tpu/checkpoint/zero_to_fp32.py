@@ -87,11 +87,11 @@ def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag=None):
             pruned["scaler_state"] = None
         try:
             restored = ckptr.restore(state_path, pruned)
-        except (ValueError, TypeError) as e:
-            # this orbax version refuses partial (None-subtree) targets —
-            # surface the cause, then pay for the full read
-            print(f"partial restore unsupported ({e}); reading full state",
-                  file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 — orbax's refusal type varies
+            # by version for partial (None-subtree) targets; surface the
+            # cause, then pay for the full read (which re-raises real errors)
+            print(f"partial restore failed ({type(e).__name__}: {e}); "
+                  "reading full state", file=sys.stderr)
             restored = ckptr.restore(state_path, target)
 
     params = restored.get("params", {})

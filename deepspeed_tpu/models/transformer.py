@@ -73,6 +73,10 @@ class TransformerConfig:
     # remat policy knob (reference activation_checkpointing config; VERDICT
     # asked for this to be tunable): see remat_policy() for the names
     remat_policy: str = "dots_with_no_batch_dims"
+    # Pallas fused head+CE (ops/fused_ce.py): skip materializing [b*s, V]
+    # logits. Takes effect on single-device TPU; multi-chip uses the sharded
+    # dense head.
+    fused_ce: bool = False
     # MoE (0 → dense). When n_experts > 0 the MLP becomes a top-k gated MoE
     # over the `expert` mesh axis (parallel/moe/).
     n_experts: int = 0
@@ -556,7 +560,32 @@ def make_loss_fn(config: TransformerConfig):
 
     def loss_fn(params, batch):
         inputs, labels, mask, positions, segment_ids = split_lm_batch(batch)
-        if config.loss_tiles > 1:
+        if config.fused_ce and jax.default_backend() == "tpu" and get_topology().world_size == 1:
+            # Pallas fused head+CE: logits never materialize in HBM
+            # (ops/fused_ce.py). Single-device only: pallas_call is opaque to
+            # GSPMD, and the head matmul wants the model-axis sharding on
+            # multi-chip meshes.
+            from deepspeed_tpu.ops.fused_ce import fused_ce_loss
+
+            x, aux = forward_hidden(params, inputs, config, positions=positions, segment_ids=segment_ids)
+            b, s, h = x.shape
+            w = _lm_head_matrix(params, config, x.dtype)
+            m = mask if mask is not None else jnp.ones(labels.shape, jnp.float32)
+            # pad rows to the kernel's tile size: b*s is often 2^k - b (labels
+            # shift drops one position), and a degenerate row block would
+            # explode the Pallas grid
+            n = b * s
+            pad = (-n) % 256
+            flat_x = x.reshape(n, h)
+            flat_l = labels.reshape(-1)
+            flat_m = m.reshape(-1)
+            if pad:
+                flat_x = jnp.concatenate([flat_x, jnp.zeros((pad, h), x.dtype)])
+                flat_l = jnp.concatenate([flat_l, jnp.zeros((pad,), flat_l.dtype)])
+                flat_m = jnp.concatenate([flat_m, jnp.zeros((pad,), flat_m.dtype)])
+            per_row = fused_ce_loss(flat_x, w, flat_l)
+            loss = jnp.sum(per_row * flat_m) / jnp.maximum(jnp.sum(flat_m), 1.0)
+        elif config.loss_tiles > 1:
             from deepspeed_tpu.parallel.sequence.tiled import tiled_logits_loss
 
             x, aux = forward_hidden(params, inputs, config, positions=positions, segment_ids=segment_ids)

@@ -10,6 +10,8 @@ import argparse
 import json
 import time
 
+import numpy as np
+
 
 def _bus_factor(op, w):
     # bytes actually moved per rank vs message size (ring algorithms)
@@ -70,9 +72,12 @@ def main(argv=None) -> int:
         # unsharded global array would materialize entirely on device 0 and
         # OOM at large sweep sizes on large meshes.
         n_global = n * w
-        x = jax.device_put(
-            jnp.ones((n_global,), jnp.float32),
-            jax.sharding.NamedSharding(mesh, P("x")),
+        # build only the per-device shards (n*4 bytes each): neither host nor
+        # any device ever holds the global array
+        sharding = jax.sharding.NamedSharding(mesh, P("x"))
+        x = jax.make_array_from_callback(
+            (n_global,), sharding,
+            lambda idx: np.ones((n,), np.float32),
         )
         try:
             out = fn(x)

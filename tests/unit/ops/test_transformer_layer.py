@@ -2,6 +2,7 @@
 tests/unit/ops/transformer + launcher CLI tests)."""
 
 import json
+import os
 import subprocess
 import sys
 
@@ -99,7 +100,7 @@ class TestCLIs:
              "--cpu_devices", "4", "--minsize", "1048576", "--maxsize", "1048576",
              "--iters", "2", "--warmup", "1"],
             capture_output=True, text=True, timeout=300,
-            env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
         )
         assert r.returncode == 0, r.stderr
         out = json.loads(r.stdout.strip().splitlines()[-1])

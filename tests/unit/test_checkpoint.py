@@ -2,6 +2,8 @@
 checkpoints, tag handling, and universal-checkpoint resume at different
 parallelism — test_universal_checkpoint.py)."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -139,7 +141,7 @@ def test_zero_to_fp32_offline_converter(devices8, tmp_path):
     r = subprocess.run(
         [sys.executable, str(script), str(tmp_path), str(out)],
         capture_output=True, text=True, timeout=300,
-        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
     )
     assert r.returncode == 0, r.stderr[-800:]
     sd = np.load(str(out) + ".npz")
