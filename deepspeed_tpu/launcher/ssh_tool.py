@@ -1,0 +1,53 @@
+"""dstpu_ssh: run a command on every host in a hostfile (reference
+``bin/ds_ssh`` — a pdsh/ssh fan-out convenience for cluster admin:
+checking versions, clearing caches, killing stray jobs)."""
+
+import argparse
+import subprocess
+import sys
+from concurrent.futures import ThreadPoolExecutor
+
+from deepspeed_tpu.launcher.runner import parse_hostfile
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("dstpu_ssh", description=__doc__)
+    p.add_argument("-f", "--hostfile", default="/job/hostfile")
+    p.add_argument("--ssh_port", type=int, default=22)
+    p.add_argument("--timeout", type=int, default=60)
+    p.add_argument("--dry_run", action="store_true",
+                   help="print the per-host ssh commands without running them")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="command to run on every host")
+    args = p.parse_args(argv)
+    if not args.command:
+        p.error("no command given")
+    cmd = " ".join(args.command)
+    hosts = list(parse_hostfile(args.hostfile))
+    if not hosts:
+        print(f"dstpu_ssh: no hosts in {args.hostfile!r} (missing or empty hostfile)",
+              file=sys.stderr)
+        return 1
+    if args.dry_run:
+        for host in hosts:
+            print(f"ssh -o StrictHostKeyChecking=no -p {args.ssh_port} {host} {cmd}")
+        return 0
+
+    def run(host):
+        r = subprocess.run(
+            ["ssh", "-o", "StrictHostKeyChecking=no", "-p", str(args.ssh_port),
+             host, cmd],
+            capture_output=True, text=True, timeout=args.timeout,
+        )
+        return host, r.returncode, r.stdout, r.stderr
+
+    rc = 0
+    with ThreadPoolExecutor(max_workers=min(32, len(hosts))) as pool:
+        for host, code, out, err in pool.map(run, hosts):
+            prefix = f"[{host}] "
+            for line in (out or "").splitlines():
+                print(prefix + line)
+            for line in (err or "").splitlines():
+                print(prefix + line, file=sys.stderr)
+            rc = rc or code
+    return rc

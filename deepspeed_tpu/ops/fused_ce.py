@@ -27,11 +27,30 @@ from jax.experimental import pallas as pl
 LANES = 128
 
 
-def _pick(n, target):
-    b = min(target, n)
-    while n % b:
-        b //= 2
-    return max(b, 1)
+def _pick(n, target, multiple=1):
+    """Largest divisor of n that is <= target and a multiple of ``multiple``
+    (Pallas TPU wants block dims divisible by (8, 128)); falls back to the
+    largest plain divisor (== n covers the 'whole array' escape hatch)."""
+    best = 0
+    d = 1
+    while d * d <= n:
+        if n % d == 0:
+            for c in (d, n // d):
+                if c <= target and c % multiple == 0:
+                    best = max(best, c)
+        d += 1
+    if best:
+        return best
+    # no aligned divisor: largest divisor <= target (tiny/odd test shapes)
+    best = 1
+    d = 1
+    while d * d <= n:
+        if n % d == 0:
+            for c in (d, n // d):
+                if c <= target:
+                    best = max(best, c)
+        d += 1
+    return best
 
 
 def _fwd_kernel(x_ref, w_ref, lbl_ref, loss_ref, lse_ref, acc_ref, *, bn, bv, nv):
@@ -45,12 +64,14 @@ def _fwd_kernel(x_ref, w_ref, lbl_ref, loss_ref, lse_ref, acc_ref, *, bn, bv, nv
         acc_ref[:, :LANES] = jnp.full((bn, LANES), -1e30, jnp.float32)
         acc_ref[:, LANES:] = jnp.zeros((bn, 2 * LANES), jnp.float32)
 
-    x = x_ref[:].astype(jnp.float32)
-    w = w_ref[:].astype(jnp.float32)
+    # feed the MXU the native (bf16) operands with an fp32 accumulator —
+    # fp32 VMEM copies of x/w would blow the scoped-vmem budget
+    x = x_ref[:]
+    w = w_ref[:]
     lbl = lbl_ref[0, :]
     logits = jax.lax.dot_general(
         x, w, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-    )  # [bn, bv]
+    )  # [bn, bv] fp32
     m = acc_ref[:, 0]
     l = acc_ref[:, LANES]
     tgt = acc_ref[:, 2 * LANES]
@@ -71,16 +92,17 @@ def _fwd_kernel(x_ref, w_ref, lbl_ref, loss_ref, lse_ref, acc_ref, *, bn, bv, nv
         lse_ref[:] = jnp.broadcast_to(lse[:, None], (bn, LANES))
 
 
-def _bwd_dx_kernel(x_ref, w_ref, lbl_ref, lse_ref, g_ref, dx_ref, *, bn, bv, nv):
-    # grid (rows, vocab); dx_ref block is constant across j -> accumulate into it
+def _bwd_dx_kernel(x_ref, w_ref, lbl_ref, lse_ref, g_ref, dx_ref, acc_ref, *, bn, bv, nv):
+    # grid (rows, vocab); fp32 scratch accumulates across vocab tiles — a
+    # bf16 += per tile would round 100+ times and corrupt the gradient
     j = pl.program_id(1)
 
     @pl.when(j == 0)
     def _init():
-        dx_ref[:] = jnp.zeros_like(dx_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    x = x_ref[:].astype(jnp.float32)
-    w = w_ref[:].astype(jnp.float32)
+    x = x_ref[:]
+    w = w_ref[:]
     lbl = lbl_ref[0, :]
     lse = lse_ref[:, 0]
     g = g_ref[:, 0]
@@ -90,22 +112,29 @@ def _bwd_dx_kernel(x_ref, w_ref, lbl_ref, lse_ref, g_ref, dx_ref, *, bn, bv, nv)
     p = jnp.exp(logits - lse[:, None])
     cols = j * bv + jax.lax.broadcasted_iota(jnp.int32, (bn, bv), 1)
     d = (p - (cols == lbl[:, None]).astype(jnp.float32)) * g[:, None]
-    dx_ref[:] += jax.lax.dot_general(
-        d, w, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ).astype(dx_ref.dtype)
+    # d in the operand dtype (matches what XLA autodiff of a bf16 matmul
+    # feeds its transpose); accumulation stays fp32 in scratch
+    acc_ref[:] += jax.lax.dot_general(
+        d.astype(w.dtype), w, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(j == nv - 1)
+    def _done():
+        dx_ref[:] = acc_ref[:].astype(dx_ref.dtype)
 
 
-def _bwd_dw_kernel(x_ref, w_ref, lbl_ref, lse_ref, g_ref, dw_ref, *, bn, bv, nr):
-    # grid (vocab, rows); dw_ref block is constant across i -> accumulate
+def _bwd_dw_kernel(x_ref, w_ref, lbl_ref, lse_ref, g_ref, dw_ref, acc_ref, *, bn, bv, nr):
+    # grid (vocab, rows); fp32 scratch accumulates across row blocks
     vj = pl.program_id(0)
     i = pl.program_id(1)
 
     @pl.when(i == 0)
     def _init():
-        dw_ref[:] = jnp.zeros_like(dw_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    x = x_ref[:].astype(jnp.float32)
-    w = w_ref[:].astype(jnp.float32)
+    x = x_ref[:]
+    w = w_ref[:]
     lbl = lbl_ref[0, :]
     lse = lse_ref[:, 0]
     g = g_ref[:, 0]
@@ -115,9 +144,14 @@ def _bwd_dw_kernel(x_ref, w_ref, lbl_ref, lse_ref, g_ref, dw_ref, *, bn, bv, nr)
     p = jnp.exp(logits - lse[:, None])
     cols = vj * bv + jax.lax.broadcasted_iota(jnp.int32, (bn, bv), 1)
     d = (p - (cols == lbl[:, None]).astype(jnp.float32)) * g[:, None]
-    dw_ref[:] += jax.lax.dot_general(
-        x, d, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-    ).astype(dw_ref.dtype)
+    acc_ref[:] += jax.lax.dot_general(
+        x, d.astype(x.dtype), (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(i == nr - 1)
+    def _done():
+        dw_ref[:] = acc_ref[:].astype(dw_ref.dtype)
 
 
 def fused_ce_loss(x: jax.Array, w: jax.Array, labels: jax.Array,
@@ -139,8 +173,8 @@ def _ce_call(x, w, labels, interpret):
 
     n, h = x.shape
     V = w.shape[1]
-    bn = _pick(n, 256)
-    bv = _pick(V, 2048)
+    bn = _pick(n, 256, multiple=8)
+    bv = _pick(V, 2048, multiple=128)
     nv = V // bv
     kernel = functools.partial(_fwd_kernel, bn=bn, bv=bv, nv=nv)
     loss, lse = pl.pallas_call(
@@ -173,12 +207,18 @@ def _ce_fwd(x, w, labels, interpret):
 
 
 def _ce_bwd(interpret, res, g):
+    from jax.experimental.pallas import tpu as pltpu
+
     x, w, labels, lse = res
     n, h = x.shape
     V = w.shape[1]
-    bn = _pick(n, 256)
-    bv = _pick(V, 2048)
+    bn = _pick(n, 256, multiple=8)
+    bv = _pick(V, 2048, multiple=128)
     nv = V // bv
+    # the dW pass holds an [h, bv] fp32 scratch accumulator — cap its vocab
+    # tile so scratch + weight tile fit scoped VMEM
+    bv_w = _pick(V, 512, multiple=128)
+    nv_w = V // bv_w
     nr = n // bn
     g2 = jnp.broadcast_to(g.astype(jnp.float32)[:, None], (n, LANES))
     lbl2 = labels.astype(jnp.int32).reshape(1, -1)
@@ -195,21 +235,23 @@ def _ce_bwd(interpret, res, g):
         ],
         out_specs=pl.BlockSpec((bn, h), lambda i, j: (i, 0)),
         out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        scratch_shapes=[pltpu.VMEM((bn, h), jnp.float32)],
         interpret=interpret,
     )(x, w, lbl2, lse, g2)
 
     dw = pl.pallas_call(
-        functools.partial(_bwd_dw_kernel, bn=bn, bv=bv, nr=nr),
-        grid=(nv, nr),
+        functools.partial(_bwd_dw_kernel, bn=bn, bv=bv_w, nr=nr),
+        grid=(nv_w, nr),
         in_specs=[
             pl.BlockSpec((bn, h), lambda j, i: (i, 0)),
-            pl.BlockSpec((h, bv), lambda j, i: (0, j)),
+            pl.BlockSpec((h, bv_w), lambda j, i: (0, j)),
             pl.BlockSpec((1, bn), lambda j, i: (0, i)),
             pl.BlockSpec((bn, LANES), lambda j, i: (i, 0)),
             pl.BlockSpec((bn, LANES), lambda j, i: (i, 0)),
         ],
-        out_specs=pl.BlockSpec((h, bv), lambda j, i: (0, j)),
+        out_specs=pl.BlockSpec((h, bv_w), lambda j, i: (0, j)),
         out_shape=jax.ShapeDtypeStruct(w.shape, w.dtype),
+        scratch_shapes=[pltpu.VMEM((h, bv_w), jnp.float32)],
         interpret=interpret,
     )(x, w, lbl2, lse, g2)
     return dx, dw, None  # labels get no cotangent

@@ -173,3 +173,33 @@ ALL_OPS = {}
 def register_op(builder_cls):
     ALL_OPS[builder_cls.NAME] = builder_cls
     return builder_cls
+
+
+def build_all_ops(verbose=True):
+    """AOT-build every native op now (reference ``DS_BUILD_OPS=1`` setup.py
+    path — pre-compiling instead of JIT on first use). Pallas ops have no
+    build step; native ones compile their .so. Returns {name: ok}."""
+    import deepspeed_tpu.ops  # noqa: F401 — populate the registry
+
+    results = {}
+    for name, cls in sorted(ALL_OPS.items()):
+        builder = cls()
+        if isinstance(builder, NativeOpBuilder):
+            results[name] = cls.lib() is not None
+        else:
+            try:
+                builder.load(verbose=False)
+                results[name] = True
+            except Exception as e:
+                logger.warning(f"build_all_ops: {name} failed: {e!r}")
+                results[name] = False
+        if verbose:
+            logger.info(f"build_all_ops: {name} -> {'ok' if results[name] else 'FAILED'}")
+    return results
+
+
+if __name__ == "__main__":  # python -m deepspeed_tpu.ops.op_builder
+    import sys
+
+    ok = build_all_ops()
+    sys.exit(0 if all(ok.values()) else 1)

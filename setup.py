@@ -17,6 +17,7 @@ setup(
             "dstpu_io=deepspeed_tpu.utils.io_bench:main",
             "dstpu_bench=deepspeed_tpu.utils.comm_bench:main",
             "dstpu_elastic=deepspeed_tpu.elasticity.cli:main",
+            "dstpu_ssh=deepspeed_tpu.launcher.ssh_tool:main",
         ]
     },
 )

@@ -47,6 +47,27 @@ def test_bf16_inputs():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-2, atol=2e-2)
 
 
+def test_bf16_grads_many_tiles():
+    """Regression: bf16 dx/dW accumulated across many vocab tiles must NOT
+    degrade — the kernels accumulate in fp32 scratch and cast once. V=4000
+    forces bv=2000 & an uneven divisor; n=512/bn=256 gives 2 row blocks."""
+    x, w, labels = _data(n=512, h=32, V=4000, dtype=jnp.bfloat16)
+
+    def loss_fused(x, w):
+        return jnp.mean(fused_ce_loss(x, w, labels, interpret=True))
+
+    def loss_ref(x, w):
+        return jnp.mean(fused_ce_reference(x, w, labels))
+
+    gf = jax.grad(loss_fused, argnums=(0, 1))(x, w)
+    gr = jax.grad(loss_ref, argnums=(0, 1))(x, w)
+    for a, b, name in zip(gf, gr, ["dx", "dw"]):
+        af, bf = np.asarray(a, np.float32), np.asarray(b, np.float32)
+        denom = np.maximum(np.abs(bf), 1e-6)
+        # single final bf16 cast: error bounded by bf16 epsilon, not tiles
+        assert np.median(np.abs(af - bf) / denom) < 5e-3, name
+
+
 def test_weighted_rows_scale_grads():
     """Non-uniform dloss (masked/mean losses) must scale per-row grads."""
     x, w, labels = _data(n=256, h=32, V=2048)

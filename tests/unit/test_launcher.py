@@ -226,3 +226,22 @@ def test_env_report_runs():
     assert out.returncode == 0, out.stderr
     assert "deepspeed_tpu" in out.stdout
     assert "op availability" in out.stdout
+
+
+def test_dstpu_ssh_dry_run(tmp_path):
+    """dstpu_ssh (reference bin/ds_ssh): hostfile fan-out command assembly."""
+    import subprocess
+    import sys
+
+    hf = tmp_path / "hosts"
+    hf.write_text("worker-0 slots=4\nworker-1 slots=4\n")
+    r = subprocess.run(
+        [sys.executable, "bin/dstpu_ssh", "-f", str(hf), "--dry_run",
+         "--ssh_port", "2222", "uptime", "-p"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0, r.stderr
+    lines = r.stdout.strip().splitlines()
+    assert len(lines) == 2
+    assert lines[0].endswith("-p 2222 worker-0 uptime -p")
+    assert "worker-1" in lines[1]
