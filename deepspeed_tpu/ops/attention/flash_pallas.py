@@ -45,7 +45,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq, bk,
     d = q_ref.shape[1]
     nk = s // bk
 
-    q = q_ref[:].astype(jnp.float32) * scale
+    # operands stay in their storage dtype (bf16 on TPU): the MXU reads them
+    # natively with an fp32 accumulator; fp32 VMEM copies of q/k/v would
+    # double the kernel's working set. The softmax scale moves onto the fp32
+    # logits (same value as pre-scaling q).
+    q = q_ref[:]
     seg_q = seg_q_ref[:] if seg_q_ref is not None else None
 
     m0 = jnp.full((bq,), NEG_INF, jnp.float32)
@@ -56,11 +60,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq, bk,
 
     def body(ki, carry):
         m, l, acc = carry
-        k = k_ref[pl.ds(ki * bk, bk), :].astype(jnp.float32)
-        v = v_ref[pl.ds(ki * bk, bk), :].astype(jnp.float32)
+        k = k_ref[pl.ds(ki * bk, bk), :]
+        v = v_ref[pl.ds(ki * bk, bk), :]
         logits = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [bq, bk]
+        ) * scale  # [bq, bk] fp32
         if causal:
             k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
@@ -72,7 +76,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq, bk,
         corr = jnp.exp(m - m_new)
         l_new = l * corr + jnp.sum(p, axis=-1)
         acc_new = acc * corr[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         return m_new, l_new, acc_new
 
@@ -94,19 +99,19 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, *, scale
     d = q_ref.shape[1]
     nk = s // bk
 
-    q = q_ref[:].astype(jnp.float32) * scale
-    do = do_ref[:].astype(jnp.float32)
+    q = q_ref[:]
+    do = do_ref[:]
     lse = lse_ref[:, 0]
-    delta = jnp.sum(do * o_ref[:].astype(jnp.float32), axis=-1)  # [bq]
+    delta = jnp.sum(do.astype(jnp.float32) * o_ref[:].astype(jnp.float32), axis=-1)  # [bq]
     q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     seg_q = seg_q_ref[:] if seg_q_ref is not None else None
 
     def body(ki, dq):
-        k = k_ref[pl.ds(ki * bk, bk), :].astype(jnp.float32)
-        v = v_ref[pl.ds(ki * bk, bk), :].astype(jnp.float32)
+        k = k_ref[pl.ds(ki * bk, bk), :]
+        v = v_ref[pl.ds(ki * bk, bk), :]
         logits = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
+        ) * scale
         if causal:
             k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
@@ -119,7 +124,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, *, scale
         )
         ds = p * (dp - delta[:, None])  # [bq, bk]
         return dq + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
 
     hi = jnp.minimum((qi * bq + bq + bk - 1) // bk, nk) if causal else nk
@@ -136,21 +142,21 @@ def _bwd_dkv_kernel(
     d = k_ref.shape[1]
     nq = sq // bq
 
-    k = k_ref[:].astype(jnp.float32)
-    v = v_ref[:].astype(jnp.float32)
+    k = k_ref[:]
+    v = v_ref[:]
     k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
     seg_k = seg_k_ref[:] if seg_k_ref is not None else None
 
     def body(qj, carry):
         dk, dv = carry
-        q = q_ref[pl.ds(qj * bq, bq), :].astype(jnp.float32) * scale
-        do = do_ref[pl.ds(qj * bq, bq), :].astype(jnp.float32)
-        o = o_ref[pl.ds(qj * bq, bq), :].astype(jnp.float32)
+        q = q_ref[pl.ds(qj * bq, bq), :]
+        do = do_ref[pl.ds(qj * bq, bq), :]
+        o = o_ref[pl.ds(qj * bq, bq), :]
         lse = lse_ref[pl.ds(qj * bq, bq), 0]
-        delta = jnp.sum(do * o, axis=-1)  # [bq]
+        delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [bq]
         logits = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [bq, bk]
+        ) * scale  # [bq, bk]
         if causal:
             q_pos = qj * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
@@ -159,14 +165,16 @@ def _bwd_dkv_kernel(
             logits = jnp.where(seg_q[:, None] == seg_k[None, :], logits, NEG_INF)
         p = jnp.exp(logits - lse[:, None])
         dv_new = dv + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )  # [bk, d]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta[:, None])
         dk_new = dk + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         return dk_new, dv_new
 
@@ -176,8 +184,8 @@ def _bwd_dkv_kernel(
         lo = 0
     zeros = jnp.zeros((bk, d), jnp.float32)
     dk, dv = jax.lax.fori_loop(lo, nq, body, (zeros, zeros))
-    # q was pre-scaled in body, so dk already carries the softmax scale
-    dk_ref[:] = dk.astype(dk_ref.dtype)
+    # scale moved onto the logits, so dk picks it up here (dlogits/dk = scale*q)
+    dk_ref[:] = (dk * scale).astype(dk_ref.dtype)
     dv_ref[:] = dv.astype(dv_ref.dtype)
 
 
