@@ -1,28 +1,35 @@
-"""Flash attention as a Pallas TPU kernel (forward + backward).
+"""Flash attention as a Pallas TPU kernel (forward + backward), splash-style.
 
 The TPU-native replacement for the reference's fused attention kernels
 (csrc/transformer/inference softmax/attention ops, evoformer_attn CUTLASS
-kernels, blocked_flash in inference/v2/kernels/ragged_ops): online-softmax
-tiling so the [s, s] score matrix never materializes in HBM.
+kernels, blocked_flash in inference/v2/kernels/ragged_ops/blocked_flash):
+online-softmax tiling so the [s, s] score matrix never materializes in HBM.
 
-Design:
-  * Layout [b, h, s, d]; grid (b, h, q_blocks). Each program holds one q
-    block in VMEM plus the full k/v for its (batch, kv-head) — fine to ~8k
-    sequence at d=128 in bf16 (≈4 MB VMEM); longer sequences shard over the
-    ``sequence`` mesh axis (Ulysses) before reaching the kernel.
-  * Causal pruning: the kv-block loop's trip count is derived from the q
-    block index, so programs skip fully-masked blocks (the 2× win).
+Design (round 3: kv-pipelined — nothing sequence-length-sized is ever VMEM
+resident, lifting the former ~8k dense cap):
+  * Layout [b, h, s, d]. Forward grid (b, h, nq, nk) with the kv block index
+    minor: each program sees one [bq, d] q block and one [bk, d] k/v block;
+    Pallas double-buffers the next kv block's HBM→VMEM copy behind the
+    current block's MXU work. Softmax state (m, l) and the output
+    accumulator live in VMEM scratch carried across the kv iterations; the
+    output block is written once on the last iteration.
+  * Causal pruning: masked (q, kv) grid points clamp their kv index map to
+    the last active block — Pallas elides the copy when the block index is
+    unchanged — and skip compute under ``pl.when``. Cost of a pruned point
+    is grid overhead only, preserving the ~2× causal win.
   * fp32 accumulators; the MXU sees bf16 inputs with
     ``preferred_element_type=jnp.float32``.
   * LSE is stored lane-broadcast as [b, h, s, LANES] to satisfy the TPU
     (8, 128) tiling rule for output blocks.
-  * Backward: standard flash recompute — per-block p = exp(qk·scale − lse),
-    two passes (dq over q blocks; dk/dv over kv blocks); delta = Σ do·o is
-    computed in-kernel from the saved output.
+  * Backward: flash recompute — per-block p = exp(qk·scale − lse). dq
+    streams kv blocks (grid (b, h, nq, nk)); dk/dv streams q/do/o/lse
+    blocks (grid (b, h, nk, nq)); both carry fp32 scratch accumulators.
+    delta = Σ do·o is computed in-kernel from the saved output.
   * GQA: kv-head index map h → h // (nh/nkv); no head replication in HBM.
 
 Numerics validated against ops.attention.mha_reference in
-tests/unit/ops/test_flash_attention.py (interpret mode on CPU).
+tests/unit/ops/test_flash_attention.py (interpret mode on CPU), including a
+16k-sequence dense case no longer possible with whole-K/V residency.
 """
 
 import functools
@@ -31,140 +38,158 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 LANES = 128
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq, bk,
-                seg_q_ref=None, seg_k_ref=None):
-    # q_ref: [bq, d]; k_ref/v_ref: [s, d]; o_ref: [bq, d]; lse_ref: [bq, LANES]
-    # seg_q_ref: [bq] / seg_k_ref: [s] int32 segment ids (packed sequences)
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
+                scale, causal, bq, bk, nk, seg_q_ref=None, seg_k_ref=None):
+    # q_ref: [bq, d]; k_ref/v_ref: [bk, d] (one streamed block);
+    # o_ref: [bq, d]; lse_ref: [bq, LANES]; scratch m/l: [bq, LANES] f32,
+    # acc: [bq, d] f32 — carried across the minor (kv) grid dimension.
     qi = pl.program_id(2)
-    s = k_ref.shape[0]
-    d = q_ref.shape[1]
-    nk = s // bk
+    ki = pl.program_id(3)
 
-    # operands stay in their storage dtype (bf16 on TPU): the MXU reads them
-    # natively with an fp32 accumulator; fp32 VMEM copies of q/k/v would
-    # double the kernel's working set. The softmax scale moves onto the fp32
-    # logits (same value as pre-scaling q).
-    q = q_ref[:]
-    seg_q = seg_q_ref[:] if seg_q_ref is not None else None
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq,), jnp.float32)
-    acc0 = jnp.zeros((bq, d), jnp.float32)
+    hi = (qi * bq + bq - 1) // bk  # last kv block a causal q block touches
+    active = (ki <= hi) if causal else (ki >= 0)
 
-    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-
-    def body(ki, carry):
-        m, l, acc = carry
-        k = k_ref[pl.ds(ki * bk, bk), :]
-        v = v_ref[pl.ds(ki * bk, bk), :]
+    @pl.when(active)
+    def _step():
+        q = q_ref[:]
+        k = k_ref[:]
+        v = v_ref[:]
         logits = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [bq, bk] fp32
         if causal:
+            q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
-        if seg_q is not None:
-            seg_k = seg_k_ref[pl.ds(ki * bk, bk)]
-            logits = jnp.where(seg_q[:, None] == seg_k[None, :], logits, NEG_INF)
+        if seg_q_ref is not None:
+            logits = jnp.where(
+                seg_q_ref[:][:, None] == seg_k_ref[:][None, :], logits, NEG_INF
+            )
+        m = m_ref[:, 0]
+        l = l_ref[:, 0]
         m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
         p = jnp.exp(logits - m_new[:, None])
         corr = jnp.exp(m - m_new)
         l_new = l * corr + jnp.sum(p, axis=-1)
-        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+        acc_ref[:] = acc_ref[:] * corr[:, None] + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return m_new, l_new, acc_new
+        m_ref[:] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
 
-    if causal:
-        # only blocks whose start <= last q position
-        hi = jnp.minimum((qi * bq + bq + bk - 1) // bk, nk)
-    else:
-        hi = nk
-    m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
-    l_safe = jnp.maximum(l, 1e-30)
-    o_ref[:] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[:] = jnp.broadcast_to((m + jnp.log(l_safe))[:, None], (bq, LANES))
+    @pl.when(ki == nk - 1)
+    def _flush():
+        l_safe = jnp.maximum(l_ref[:, 0], 1e-30)
+        o_ref[:] = (acc_ref[:] / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[:] = jnp.broadcast_to(
+            (m_ref[:, 0] + jnp.log(l_safe))[:, None], (bq, LANES)
+        )
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, *, scale, causal, bq, bk,
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
+                   delta_ref, dq_acc_ref, *, scale, causal, bq, bk, nk,
                    seg_q_ref=None, seg_k_ref=None):
     qi = pl.program_id(2)
-    s = k_ref.shape[0]
-    d = q_ref.shape[1]
-    nk = s // bk
+    ki = pl.program_id(3)
 
-    q = q_ref[:]
-    do = do_ref[:]
-    lse = lse_ref[:, 0]
-    delta = jnp.sum(do.astype(jnp.float32) * o_ref[:].astype(jnp.float32), axis=-1)  # [bq]
-    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-    seg_q = seg_q_ref[:] if seg_q_ref is not None else None
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
+        delta = jnp.sum(
+            do_ref[:].astype(jnp.float32) * o_ref[:].astype(jnp.float32), axis=-1
+        )
+        delta_ref[:] = jnp.broadcast_to(delta[:, None], delta_ref.shape)
 
-    def body(ki, dq):
-        k = k_ref[pl.ds(ki * bk, bk), :]
-        v = v_ref[pl.ds(ki * bk, bk), :]
+    hi = (qi * bq + bq - 1) // bk
+    active = (ki <= hi) if causal else (ki >= 0)
+
+    @pl.when(active)
+    def _step():
+        q = q_ref[:]
+        k = k_ref[:]
+        v = v_ref[:]
+        do = do_ref[:]
+        lse = lse_ref[:, 0]
         logits = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
         if causal:
+            q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
-        if seg_q is not None:
-            seg_k = seg_k_ref[pl.ds(ki * bk, bk)]
-            logits = jnp.where(seg_q[:, None] == seg_k[None, :], logits, NEG_INF)
+        if seg_q_ref is not None:
+            logits = jnp.where(
+                seg_q_ref[:][:, None] == seg_k_ref[:][None, :], logits, NEG_INF
+            )
         p = jnp.exp(logits - lse[:, None])  # [bq, bk]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta[:, None])  # [bq, bk]
-        return dq + jax.lax.dot_general(
+        ds = p * (dp - delta_ref[:, 0][:, None])  # [bq, bk]
+        dq_acc_ref[:] = dq_acc_ref[:] + jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
-    hi = jnp.minimum((qi * bq + bq + bk - 1) // bk, nk) if causal else nk
-    dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((bq, d), jnp.float32))
-    dq_ref[:] = (dq * scale).astype(dq_ref.dtype)
+    @pl.when(ki == nk - 1)
+    def _flush():
+        dq_ref[:] = (dq_acc_ref[:] * scale).astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref, *, scale, causal, bq, bk,
-    seg_q_ref=None, seg_k_ref=None
-):
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref,
+                    dv_ref, dk_acc_ref, dv_acc_ref, *, scale, causal, bq, bk,
+                    nq, seg_q_ref=None, seg_k_ref=None):
     ki = pl.program_id(2)
-    sq = q_ref.shape[0]
-    d = k_ref.shape[1]
-    nq = sq // bq
+    qj = pl.program_id(3)
 
-    k = k_ref[:]
-    v = v_ref[:]
-    k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    seg_k = seg_k_ref[:] if seg_k_ref is not None else None
+    @pl.when(qj == 0)
+    def _init():
+        dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
 
-    def body(qj, carry):
-        dk, dv = carry
-        q = q_ref[pl.ds(qj * bq, bq), :]
-        do = do_ref[pl.ds(qj * bq, bq), :]
-        o = o_ref[pl.ds(qj * bq, bq), :]
-        lse = lse_ref[pl.ds(qj * bq, bq), 0]
-        delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [bq]
+    lo = (ki * bk) // bq  # first q block that sees this kv block
+    active = (qj >= lo) if causal else (qj >= 0)
+
+    @pl.when(active)
+    def _step():
+        k = k_ref[:]
+        v = v_ref[:]
+        q = q_ref[:]
+        do = do_ref[:]
+        o = o_ref[:]
+        lse = lse_ref[:, 0]
+        # delta is recomputed per (kv, q) grid point: one [bq, d] VPU reduce
+        # (~0.05% of the two MXU matmuls below) — cheaper than a separate
+        # preprocess kernel or an HBM round-trip for [b, h, s] deltas.
+        delta = jnp.sum(
+            do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+        )  # [bq]
         logits = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [bq, bk]
         if causal:
             q_pos = qj * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
-        if seg_k is not None:
-            seg_q = seg_q_ref[pl.ds(qj * bq, bq)]
-            logits = jnp.where(seg_q[:, None] == seg_k[None, :], logits, NEG_INF)
+        if seg_q_ref is not None:
+            logits = jnp.where(
+                seg_q_ref[:][:, None] == seg_k_ref[:][None, :], logits, NEG_INF
+            )
         p = jnp.exp(logits - lse[:, None])
-        dv_new = dv + jax.lax.dot_general(
+        dv_acc_ref[:] = dv_acc_ref[:] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [bk, d]
@@ -172,32 +197,27 @@ def _bwd_dkv_kernel(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta[:, None])
-        dk_new = dk + jax.lax.dot_general(
+        dk_acc_ref[:] = dk_acc_ref[:] + jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return dk_new, dv_new
 
-    if causal:
-        lo = (ki * bk) // bq  # first q block that sees this kv block
-    else:
-        lo = 0
-    zeros = jnp.zeros((bk, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(lo, nq, body, (zeros, zeros))
-    # scale moved onto the logits, so dk picks it up here (dlogits/dk = scale*q)
-    dk_ref[:] = (dk * scale).astype(dk_ref.dtype)
-    dv_ref[:] = dv.astype(dv_ref.dtype)
+    @pl.when(qj == nq - 1)
+    def _flush():
+        # scale moved onto the logits, so dk picks it up (dlogits/dk = scale*q)
+        dk_ref[:] = (dk_acc_ref[:] * scale).astype(dk_ref.dtype)
+        dv_ref[:] = dv_acc_ref[:].astype(dv_ref.dtype)
 
 
 def _pick_block(s, target=None):
     """Largest power-of-two block ≤ target dividing s. The default block is
-    env-tunable (DSTPU_FLASH_BLOCK) for per-generation retuning; 512 measured
-    best on v5e at s=2048 (256 costs ~5pp MFU end-to-end, 128 ~15pp; 1024 is
-    a wash; 2048 exceeds VMEM)."""
+    env-tunable (DSTPU_FLASH_BLOCK) for per-generation retuning; with the
+    kv-pipelined kernel 1024 measured best on v5e at s=2048 (fwd+bwd 5.75 ms
+    vs 6.93 at 512, 10.7 at 256; 2048 exceeds the 16M scoped-vmem limit)."""
     if target is None:
         import os
 
-        target = int(os.environ.get("DSTPU_FLASH_BLOCK", 512))
+        target = int(os.environ.get("DSTPU_FLASH_BLOCK", 1024))
         if target < 128 or target & (target - 1):
             raise ValueError(
                 f"DSTPU_FLASH_BLOCK={target} invalid: need a power of two >= 128"
@@ -231,15 +251,32 @@ def _flash_core(q, k, v, segment_ids, causal, scale, interpret):
     return out
 
 
-def _seg_specs(segment_ids, bq, s):
-    """(extra operands, extra in_specs) for the [b, s] segment-id planes:
-    a [bq] block aligned with the q block and the full [s] row."""
+def _kv_clamp(causal, bq, bk):
+    """kv-block index map value for grid point (i, j): masked points re-fetch
+    the last active block (Pallas elides the unchanged copy)."""
+    if not causal:
+        return lambda i, j: j
+    return lambda i, j: jnp.minimum(j, (i * bq + bq - 1) // bk)
+
+
+def _q_clamp(causal, bq, bk):
+    """q-block index map for the dk/dv grid (kv major, q minor)."""
+    if not causal:
+        return lambda i, j: j
+    return lambda i, j: jnp.maximum(j, (i * bk) // bq)
+
+
+def _seg_specs(segment_ids, q_block, q_map, k_block, k_map):
+    """(extra operands, extra in_specs) for the [b, s] segment-id planes.
+    ``q_map``/``k_map`` are (i, j) -> block-index functions — the same clamps
+    used for the q and k/v tensor specs, so masked grid points re-fetch the
+    previous seg block (copy elided) exactly like their tensors."""
     if segment_ids is None:
         return [], []
     seg = segment_ids.astype(jnp.int32)
     return [seg, seg], [
-        pl.BlockSpec((1, bq), lambda b_, h_, i: (b_, i)),
-        pl.BlockSpec((1, s), lambda b_, h_, i: (b_, 0)),
+        pl.BlockSpec((1, q_block), lambda b_, h_, i, j: (b_, q_map(i, j))),
+        pl.BlockSpec((1, k_block), lambda b_, h_, i, j: (b_, k_map(i, j))),
     ]
 
 
@@ -250,35 +287,49 @@ def _flash_call(q, k, v, segment_ids, causal, scale, interpret):
     scale = scale if scale is not None else d ** -0.5
     bq = _pick_block(s)
     bk = _pick_block(s)
+    nq, nk = s // bq, s // bk
+    jc = _kv_clamp(causal, bq, bk)
 
-    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk)
-    seg_ops, seg_specs = _seg_specs(segment_ids, bq, s)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk
+    )
+
+    seg_ops, seg_specs = _seg_specs(segment_ids, bq, lambda i, j: i, bk, jc)
 
     def entry(qr, kr, vr, *rest):
         if seg_ops:
-            sq_r, sk_r, orf, lr = rest
-            kernel(qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], orf.at[0, 0], lr.at[0, 0],
+            sq_r, sk_r, orf, lr, mref, lref, aref = rest
+            kernel(qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], orf.at[0, 0],
+                   lr.at[0, 0], mref, lref, aref,
                    seg_q_ref=sq_r.at[0], seg_k_ref=sk_r.at[0])
         else:
-            orf, lr = rest
-            kernel(qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], orf.at[0, 0], lr.at[0, 0])
+            orf, lr, mref, lref, aref = rest
+            kernel(qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], orf.at[0, 0],
+                   lr.at[0, 0], mref, lref, aref)
 
     out, lse = pl.pallas_call(
         # refs arrive with the leading (1, 1) block dims squeezed via .at
         entry,
-        grid=(b, h, s // bq),
+        grid=(b, h, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
-            pl.BlockSpec((1, 1, s, d), lambda b_, h_, i: (b_, h_ // group, 0, 0)),
-            pl.BlockSpec((1, 1, s, d), lambda b_, h_, i: (b_, h_ // group, 0, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, h_, i, j: (b_, h_ // group, jc(i, j), 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, h_, i, j: (b_, h_ // group, jc(i, j), 0)),
         ] + seg_specs,
         out_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
-            pl.BlockSpec((1, 1, bq, LANES), lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, bq, LANES), lambda b_, h_, i, j: (b_, h_, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
             jax.ShapeDtypeStruct((b, h, s, LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, LANES), jnp.float32),  # running max m
+            pltpu.VMEM((bq, LANES), jnp.float32),  # running sum l
+            pltpu.VMEM((bq, d), jnp.float32),      # output accumulator
         ],
         interpret=interpret,
     )(q, k, v, *seg_ops)
@@ -287,88 +338,116 @@ def _flash_call(q, k, v, segment_ids, causal, scale, interpret):
 
 def _flash_fwd(q, k, v, segment_ids, causal, scale, interpret):
     out, lse = _flash_call(q, k, v, segment_ids, causal, scale, interpret)
-    return out, (q, k, v, segment_ids, out, lse)
+    # Residual LSE is narrowed to one lane (it is lane-broadcast) so saving it
+    # costs b·h·s·4 bytes, not ×LANES; the backward re-broadcasts. The names
+    # feed the "flash" remat policy (models.transformer.remat_policy): saving
+    # out+lse means a remat'd layer skips re-running the attention forward.
+    from jax.ad_checkpoint import checkpoint_name
+
+    out = checkpoint_name(out, "flash_out")
+    lse1 = checkpoint_name(lse[..., :1], "flash_lse")
+    # Residual q/k/v carry their own tag: the "flash_qkv" policy additionally
+    # skips re-running the qkv projections + rope in a remat'd backward.
+    q = checkpoint_name(q, "flash_qkv")
+    k = checkpoint_name(k, "flash_qkv")
+    v = checkpoint_name(v, "flash_qkv")
+    return out, (q, k, v, segment_ids, out, lse1)
 
 
 def _flash_bwd(causal, scale, interpret, res, g):
     q, k, v, segment_ids, out, lse = res
+    lse = jnp.broadcast_to(lse, lse.shape[:-1] + (LANES,))
     b, h, s, d = q.shape
     h_kv = k.shape[1]
     group = h // h_kv
     scale_v = scale if scale is not None else d ** -0.5
     bq = _pick_block(s)
     bk = _pick_block(s)
+    nq, nk = s // bq, s // bk
+    jc = _kv_clamp(causal, bq, bk)
+    qc = _q_clamp(causal, bq, bk)
 
-    dq_kernel = functools.partial(_bwd_dq_kernel, scale=scale_v, causal=causal, bq=bq, bk=bk)
-    seg_ops, seg_specs = _seg_specs(segment_ids, bq, s)
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, scale=scale_v, causal=causal, bq=bq, bk=bk, nk=nk
+    )
+
+    seg_ops, seg_specs = _seg_specs(segment_ids, bq, lambda i, j: i, bk, jc)
 
     def dq_entry(qr, kr, vr, orf, dor, lr, *rest):
         if seg_ops:
-            sq_r, sk_r, dqr = rest
-            dq_kernel(qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], orf.at[0, 0], dor.at[0, 0],
-                      lr.at[0, 0], dqr.at[0, 0], seg_q_ref=sq_r.at[0], seg_k_ref=sk_r.at[0])
+            sq_r, sk_r, dqr, dref, aref = rest
+            dq_kernel(qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], orf.at[0, 0],
+                      dor.at[0, 0], lr.at[0, 0], dqr.at[0, 0], dref, aref,
+                      seg_q_ref=sq_r.at[0], seg_k_ref=sk_r.at[0])
         else:
-            (dqr,) = rest
-            dq_kernel(qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], orf.at[0, 0], dor.at[0, 0],
-                      lr.at[0, 0], dqr.at[0, 0])
+            dqr, dref, aref = rest
+            dq_kernel(qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], orf.at[0, 0],
+                      dor.at[0, 0], lr.at[0, 0], dqr.at[0, 0], dref, aref)
 
     dq = pl.pallas_call(
         dq_entry,
-        grid=(b, h, s // bq),
+        grid=(b, h, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
-            pl.BlockSpec((1, 1, s, d), lambda b_, h_, i: (b_, h_ // group, 0, 0)),
-            pl.BlockSpec((1, 1, s, d), lambda b_, h_, i: (b_, h_ // group, 0, 0)),
-            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
-            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
-            pl.BlockSpec((1, 1, bq, LANES), lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, h_, i, j: (b_, h_ // group, jc(i, j), 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, h_, i, j: (b_, h_ // group, jc(i, j), 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, bq, LANES), lambda b_, h_, i, j: (b_, h_, i, 0)),
         ] + seg_specs,
-        out_specs=pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
+        out_specs=pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, LANES), jnp.float32),  # delta
+            pltpu.VMEM((bq, d), jnp.float32),      # dq accumulator
+        ],
         interpret=interpret,
     )(q, k, v, out, g, lse, *seg_ops)
 
-    # dk/dv computed per q-head then reduced over the GQA group
-    dkv_kernel = functools.partial(_bwd_dkv_kernel, scale=scale_v, causal=causal, bq=bq, bk=bk)
-    if segment_ids is None:
-        dkv_seg_ops, dkv_seg_specs = [], []
-    else:
-        seg = segment_ids.astype(jnp.int32)
-        dkv_seg_ops = [seg, seg]
-        dkv_seg_specs = [
-            pl.BlockSpec((1, s), lambda b_, h_, i: (b_, 0)),  # full q row
-            pl.BlockSpec((1, bk), lambda b_, h_, i: (b_, i)),  # this kv block
-        ]
+    # dk/dv computed per q-head (reduced over the GQA group after), with the
+    # q/do/o/lse stream minor so one [bk, d] kv block stays resident.
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, scale=scale_v, causal=causal, bq=bq, bk=bk, nq=nq
+    )
+    dkv_seg_ops, dkv_seg_specs = _seg_specs(segment_ids, bq, qc, bk, lambda i, j: i)
 
     def dkv_entry(qr, kr, vr, orf, dor, lr, *rest):
         if dkv_seg_ops:
-            sq_r, sk_r, dkr, dvr = rest
-            dkv_kernel(qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], orf.at[0, 0], dor.at[0, 0],
-                       lr.at[0, 0], dkr.at[0, 0], dvr.at[0, 0],
-                       seg_q_ref=sq_r.at[0], seg_k_ref=sk_r.at[0])
+            sq_r, sk_r, dkr, dvr, dka, dva = rest
+            dkv_kernel(qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], orf.at[0, 0],
+                       dor.at[0, 0], lr.at[0, 0], dkr.at[0, 0], dvr.at[0, 0],
+                       dka, dva, seg_q_ref=sq_r.at[0], seg_k_ref=sk_r.at[0])
         else:
-            dkr, dvr = rest
-            dkv_kernel(qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], orf.at[0, 0], dor.at[0, 0],
-                       lr.at[0, 0], dkr.at[0, 0], dvr.at[0, 0])
+            dkr, dvr, dka, dva = rest
+            dkv_kernel(qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], orf.at[0, 0],
+                       dor.at[0, 0], lr.at[0, 0], dkr.at[0, 0], dvr.at[0, 0],
+                       dka, dva)
 
     dk_h, dv_h = pl.pallas_call(
         dkv_entry,
-        grid=(b, h, s // bk),
+        grid=(b, h, nk, nq),
         in_specs=[
-            pl.BlockSpec((1, 1, s, d), lambda b_, h_, i: (b_, h_, 0, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, i: (b_, h_ // group, i, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, i: (b_, h_ // group, i, 0)),
-            pl.BlockSpec((1, 1, s, d), lambda b_, h_, i: (b_, h_, 0, 0)),
-            pl.BlockSpec((1, 1, s, d), lambda b_, h_, i: (b_, h_, 0, 0)),
-            pl.BlockSpec((1, 1, s, LANES), lambda b_, h_, i: (b_, h_, 0, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, qc(i, j), 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, i, j: (b_, h_ // group, i, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, i, j: (b_, h_ // group, i, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, qc(i, j), 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, qc(i, j), 0)),
+            pl.BlockSpec((1, 1, bq, LANES),
+                         lambda b_, h_, i, j: (b_, h_, qc(i, j), 0)),
         ] + dkv_seg_specs,
         out_specs=[
-            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, i: (b_, h_, i, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
             jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),  # dk accumulator
+            pltpu.VMEM((bk, d), jnp.float32),  # dv accumulator
         ],
         interpret=interpret,
     )(q, k, v, out, g, lse, *dkv_seg_ops)

@@ -20,7 +20,13 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from deepspeed_tpu.parallel.topology import PIPE_AXIS, Topology, get_topology
+from deepspeed_tpu.parallel.topology import (
+    BATCH_AXES,
+    PIPE_AXIS,
+    Topology,
+    constrain,
+    get_topology,
+)
 
 
 def _tree_index(tree, i):
@@ -168,6 +174,11 @@ def make_pipelined_loss_fn(config, micro_batches: int, topo: Topology = None):
         x = T.embed_tokens(params, inputs, positions, c)
         mb = b // micro_batches
         x_micro = x.reshape((micro_batches, mb) + x.shape[1:])
+        # Pre-shard the microbatch stack to the exact layout the pipe
+        # shard_map consumes (replicated over pipe, batch over data): without
+        # this GSPMD bridges the gap with an involuntary full
+        # rematerialization — a whole-tensor replicate per step (VERDICT r2).
+        x_micro = constrain(x_micro, None, BATCH_AXES)
         aux_micro = jnp.zeros((micro_batches,), jnp.float32)
         # per-microbatch metadata (packed batches) travels with the rotating
         # state; shared [s] positions ride as a plain broadcast arg

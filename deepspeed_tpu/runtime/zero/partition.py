@@ -260,13 +260,35 @@ def build_zero_plan(
     def leaf_shape(p):
         return tuple(p.shape) if hasattr(p, "shape") else ()
 
+    def strip_trivial(base):
+        """Drop size-1 mesh axes from a base spec so the dims they nominally
+        occupy stay candidates for the zero axes. Without this an embed table
+        with base P('model', None) under model=1 pushes the zero shard onto
+        the hidden dim — and the backward scatter-add then reshards the
+        batch-sharded cotangent to hidden-sharded via an involuntary full
+        rematerialization (whole-tensor replication per step)."""
+        if base is None:
+            return None
+        out = []
+        for e in tuple(base):
+            if isinstance(e, tuple):
+                kept = tuple(a for a in e if topology.axis_size(a) > 1)
+                out.append(kept if len(kept) > 1 else (kept[0] if kept else None))
+            elif e is None or topology.axis_size(e) > 1:
+                out.append(e)
+            else:
+                out.append(None)
+        return PartitionSpec(*out)
+
     def sharded_spec(axes, axis_size):
         def fn(p, base, threshold=0):
             shape = leaf_shape(p)
             n = int(np.prod(shape)) if shape else 1
             if n < threshold or not shape:
                 return PartitionSpec(*base) if base is not None else PartitionSpec()
-            return choose_zero_spec(shape, axis_size, base, axes=axes or (DATA_AXIS,))
+            return choose_zero_spec(
+                shape, axis_size, strip_trivial(base), axes=axes or (DATA_AXIS,)
+            )
 
         return fn
 

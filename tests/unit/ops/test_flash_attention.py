@@ -151,3 +151,61 @@ def test_gqa_grads():
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b_), rtol=5e-4, atol=5e-4, err_msg=f"d{name}"
         )
+
+
+def test_dense_16k_forward():
+    """The kv-pipelined kernel has no sequence-length VMEM residency: a 16k
+    dense causal sequence (impossible with whole-K/V-resident programs) must
+    match the reference. Head dim kept small so interpret mode stays fast."""
+    q, k, v = _qkv(b=1, h=1, s=16384, d=64)
+    out = flash_attention(q, k, v, True, None, None, True)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_long_seq_grads_4k(monkeypatch):
+    """Backward streams q/do/o blocks too — check grads at 4k with explicit
+    512 blocks (8x8 grid) so the streamed multi-block path is exercised
+    regardless of the DSTPU_FLASH_BLOCK default."""
+    monkeypatch.setenv("DSTPU_FLASH_BLOCK", "512")
+    q, k, v = _qkv(b=1, h=1, s=4096, d=64)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(jnp.square(flash_attention(q, k, v, True, None, None, True)))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.square(mha_reference(q, k, v, causal=True)))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b_), rtol=5e-3, atol=5e-3, err_msg=f"d{name}"
+        )
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_segment_ids_multiblock(monkeypatch, causal):
+    """Segment planes stream through the clamped BlockSpecs only when the
+    grid has multiple kv blocks — force 128 blocks at s=512 (4x4 grid) so the
+    seg_q/seg_k index-map clamps are actually exercised (fwd + grads)."""
+    monkeypatch.setenv("DSTPU_FLASH_BLOCK", "128")
+    q, k, v = _qkv(b=1, h=2, s=512, d=64)
+    seg = _packed_segments(1, 512, n_seg=3)
+    out = flash_attention(q, k, v, causal=causal, segment_ids=seg, interpret=True)
+    ref = mha_reference(q, k, v, causal=causal, segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(jnp.square(
+            flash_attention(q, k, v, causal=causal, segment_ids=seg, interpret=True)))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.square(mha_reference(q, k, v, causal=causal, segment_ids=seg)))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b_), rtol=5e-4, atol=5e-4, err_msg=f"d{name}"
+        )
