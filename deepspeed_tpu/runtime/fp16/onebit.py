@@ -9,14 +9,20 @@ and the momenta are averaged with the two-phase error-feedback sign
 compression — packed sign bits + per-chunk scales are what crosses ICI
 (:mod:`deepspeed_tpu.runtime.comm.compressed`).
 
-Two forms:
+The family (collective forms all run INSIDE a ``shard_map`` manual region
+over the data axis with *local* unreduced gradients — the engine's 1-bit
+train step, ``engine._build_onebit_train_step``, provides that; error
+buffers are per-worker state, leading ``[W]`` dim sharded over data):
   * :func:`onebit_adam_transform` — single-device form (no collective; the
     compression + error feedback still runs so trajectories are comparable).
-  * :func:`onebit_adam_collective_transform` — the multi-worker form. Its
-    ``update`` MUST run inside a ``shard_map`` manual region over the data
-    axis with *local* (unreduced) gradients; the engine's 1-bit train step
-    (``engine._build_onebit_train_step``) provides that. Error-feedback
-    buffers are per-worker state (leading ``[W]`` dim sharded over data).
+  * :func:`onebit_adam_collective_transform` — multi-worker 1-bit Adam.
+  * :func:`zero_one_adam_collective_transform` — TRUE 0/1 Adam (reference
+    ``onebit/zoadam.py``): variance-interval exact/compressed gradient
+    rounds, then frozen-variance local steps with periodic compressed
+    momentum reconciliation (sync skipping).
+  * :func:`onebit_lamb_collective_transform` — 1-bit Lamb (reference
+    ``onebit/lamb.py``): frozen trust ratios + scaled fused momentum
+    compression with fresh-variance factor recalibration.
 """
 
 from typing import Any, NamedTuple
@@ -45,6 +51,29 @@ def compress_sign(x, error):
     compressed = jnp.sign(corrected) * scale
     new_error = corrected - compressed
     return compressed, new_error
+
+
+def _fused_sizes(tree, world):
+    """(per-leaf sizes, total, padded total) for one fused comm buffer."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    sizes = [int(np.prod(p.shape)) if p.shape else 1 for p in leaves]
+    total = sum(sizes)
+    return sizes, total, padded_size(total, world)
+
+
+def _fused_compressed_allreduce(flat_list, sizes, total, n_pad, we, se, axis_name):
+    """Concat → pad → one compressed_allreduce → slice back per leaf.
+    ``we``/``se`` arrive per-worker as [1, n_pad]/[1, n_pad//W] shards and
+    are returned the same way. Shared by the whole 1-bit family so padding /
+    error-buffer handling can never diverge between optimizers."""
+    fused = jnp.concatenate(flat_list) if len(flat_list) > 1 else flat_list[0]
+    fused = jnp.pad(fused, (0, n_pad - total))
+    avg, we_new, se_new = compressed_allreduce(fused, we[0], se[0], axis_name)
+    out, off = [], 0
+    for n in sizes:
+        out.append(avg[off: off + n])
+        off += n
+    return out, we_new[None], se_new[None]
 
 
 def onebit_adam_transform(b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.0, freeze_step=100000):
@@ -117,20 +146,14 @@ def onebit_adam_collective_transform(
     all_gather regardless of leaf count; the error buffers shard their
     leading ``[W]`` dim over the data axis.
 
-    ``var_freeze_step`` (reference 0/1-Adam knob, onebit/zoadam.py): in this
-    implementation the variance-freeze point and the compression onset are a
-    single threshold — supplying ``var_freeze_step`` sets that threshold
-    (i.e. it delays BOTH the variance freeze and the start of compressed
-    communication). The reference 0/1-Adam's decoupled learning-rate/variance
-    schedules are not modeled.
+    ``var_freeze_step``: legacy alias for ``freeze_step`` kept for configs
+    that used it when ZeroOneAdam was an alias of this optimizer. The TRUE
+    0/1 Adam (variance-interval + local-step sync skipping) lives in
+    :func:`zero_one_adam_collective_transform`.
     """
     freeze = var_freeze_step if var_freeze_step is not None else freeze_step
 
-    def fused_sizes(tree):
-        leaves = jax.tree_util.tree_leaves(tree)
-        sizes = [int(np.prod(p.shape)) if p.shape else 1 for p in leaves]
-        total = sum(sizes)
-        return sizes, total, padded_size(total, world)
+    fused_sizes = lambda tree: _fused_sizes(tree, world)
 
     def init(params):
         zeros = lambda: jax.tree.map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params)
@@ -204,10 +227,12 @@ def onebit_adam_collective_transform(
 
 
 def onebit_state_partition_specs(state_shapes, data_axis: str):
-    """PartitionSpec tree for an OptState(master, OnebitCollectiveState):
+    """PartitionSpec tree for an OptState(master, <1-bit family state>):
     everything replicated except the per-worker error buffers, which shard
-    their leading [W] dim over the data axis. Consumed by the engine in place
-    of the generic ZeRO state-sharding rule."""
+    their leading [W] dim over the data axis. Works for all three collective
+    states (OnebitCollectiveState / ZeroOneAdamState / OnebitLambState) by
+    field name. Consumed by the engine in place of the generic ZeRO
+    state-sharding rule."""
     from jax.sharding import PartitionSpec as P
 
     def build(tree, spec):
@@ -215,13 +240,439 @@ def onebit_state_partition_specs(state_shapes, data_axis: str):
 
     master_specs = build(state_shapes.master, P())
     inner = state_shapes.inner
-    return type(state_shapes)(
-        master=master_specs,
-        inner=OnebitCollectiveState(
-            mu=build(inner.mu, P()),
-            nu=build(inner.nu, P()),
-            worker_error=P(data_axis),
-            server_error=P(data_axis),
-            count=P(),
-        ),
-    )
+    fields = {}
+    for name in type(inner)._fields:
+        sub = getattr(inner, name)
+        if name in ("worker_error", "server_error"):
+            fields[name] = P(data_axis)
+        elif name in ("mu", "u") and type(inner).__name__ == "ZeroOneAdamState":
+            # per-worker leaves with a leading [W] dim (see ZeroOneAdamState)
+            fields[name] = build(sub, P(data_axis))
+        else:
+            fields[name] = build(sub, P())
+    return type(state_shapes)(master=master_specs, inner=type(inner)(**fields))
+
+
+# ---------------------------------------------------------------------------
+# 0/1 Adam — variance-interval + local-step sync skipping (arXiv 2202.06009)
+# ---------------------------------------------------------------------------
+class ZeroOneAdamState(NamedTuple):
+    """Reference ``runtime/fp16/onebit/zoadam.py`` (ZeroOneAdam:14) state,
+    functional form. ``u`` is the momentum accumulator (the paper's u
+    variable): the sum of locally-applied updates since the last sync round.
+    ``comm_rounds``/``exact_rounds`` are diagnostics counting executed
+    compressed / full-precision collective rounds — the sync-skipping proof
+    consumed by tests."""
+
+    mu: Any  # leaves lead with [W] (sharded over data): phase-2 local steps
+    nu: Any  # make momentum genuinely per-worker between sync rounds
+    u: Any  # same [W] leading dim as mu (per-worker accumulated updates)
+    lrs: jnp.ndarray  # accumulated lr since last sync (phase 2)
+    worker_error: jnp.ndarray  # [W, n_pad] fp32 (sharded over data)
+    server_error: jnp.ndarray  # [W, n_pad // W] fp32
+    count: jnp.ndarray
+    var_interval: jnp.ndarray  # current variance-update interval (phase 1)
+    var_counter: jnp.ndarray
+    local_interval: jnp.ndarray  # current local-step interval (phase 2)
+    local_counter: jnp.ndarray
+    comm_rounds: jnp.ndarray
+    exact_rounds: jnp.ndarray
+
+
+def zero_one_adam_collective_transform(
+    axis_name: str,
+    world: int,
+    b1=0.9,
+    b2=0.999,
+    eps=1e-8,
+    weight_decay=0.0,
+    var_freeze_step=100000,
+    var_update_scaler=16,
+    local_step_scaler=32678,
+    local_step_clipper=16,
+):
+    """Multi-worker 0/1 Adam (reference onebit/zoadam.py:14). Runs INSIDE
+    shard_map over ``axis_name`` with LOCAL grads.
+
+    Phase 1 (count <= var_freeze_step): on variance steps
+    (count % var_interval == 0) gradients are exchanged exactly (pmean) and
+    both moments update; between them the 1-bit compressed exchange carries
+    the gradient and only momentum updates. ``var_interval`` doubles every
+    ``var_update_scaler`` variance updates (the paper's kappa).
+
+    Phase 2 (count > var_freeze_step): variance frozen; steps are LOCAL (no
+    collective at all — the sync skipping that is 0/1 Adam's point), with
+    applied updates accumulated in ``u``. Every ``local_interval`` steps one
+    compressed sync round reconciles: local drift is rolled back, the
+    accumulated update (momentum-scaled) is averaged over workers with
+    error-feedback sign compression, momentum is rebuilt as -avg/lrs, and
+    the averaged delta is applied. ``local_interval`` doubles every
+    ``local_step_scaler`` steps, clipped at ``local_step_clipper`` (the
+    paper's H).
+    """
+
+    fused_sizes = lambda tree: _fused_sizes(tree, world)
+
+    def init(params):
+        zeros = lambda: jax.tree.map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params)
+        _, _, n_pad = fused_sizes(params)
+        i32 = lambda v: jnp.asarray(v, jnp.int32)
+        # mu/u lead with the worker dim: their values diverge across workers
+        # during phase-2 local steps, so the engine must NOT mark them
+        # replicated (a mid-interval state fetch would collapse them to
+        # device 0's copy and corrupt the next sync's drift rollback)
+        pw = lambda t: jax.tree.map(
+            lambda x: jnp.zeros((world,) + x.shape, jnp.float32), t
+        )
+        return ZeroOneAdamState(
+            mu=pw(zeros()), nu=zeros(), u=pw(zeros()),
+            lrs=jnp.zeros((), jnp.float32),
+            worker_error=jnp.zeros((world, n_pad), jnp.float32),
+            server_error=jnp.zeros((world, n_pad // world), jnp.float32),
+            count=i32(0), var_interval=i32(1), var_counter=i32(0),
+            local_interval=i32(1), local_counter=i32(0),
+            comm_rounds=i32(0), exact_rounds=i32(0),
+        )
+
+    def fused_allreduce(flat_list, sizes, total, n_pad, we, se):
+        return _fused_compressed_allreduce(
+            flat_list, sizes, total, n_pad, we, se, axis_name
+        )
+
+    def update(grads, state, params=None, *, lr):
+        if params is None and weight_decay:
+            raise ValueError("0/1 adam with weight_decay requires params in update()")
+        count = state.count + 1
+        flat_g, treedef = jax.tree_util.tree_flatten(grads)
+        flat_g = [g.astype(jnp.float32) for g in flat_g]
+        # mu/u arrive as this worker's [1, ...] shard of the [W, ...] state
+        flat_mu = [m[0] for m in treedef.flatten_up_to(state.mu)]
+        flat_nu = treedef.flatten_up_to(state.nu)
+        flat_u = [u[0] for u in treedef.flatten_up_to(state.u)]
+        flat_p = treedef.flatten_up_to(params) if params is not None else flat_g
+        sizes, total, n_pad = fused_sizes(grads)
+        phase2 = count > var_freeze_step
+        # error buffers log a different metric in phase 2 (accumulated
+        # momentum, not gradients): re-zero once at the transition
+        # (reference reinitial_error_buffer)
+        first_p2 = count == var_freeze_step + 1
+        we = jnp.where(first_p2, jnp.zeros_like(state.worker_error), state.worker_error)
+        se = jnp.where(first_p2, jnp.zeros_like(state.server_error), state.server_error)
+
+        def phase1(args):
+            flat_g, flat_mu, flat_nu, flat_u, we, se = args
+            var_step = (count % state.var_interval) == 0
+
+            def exact(op):
+                flat_g, flat_mu, flat_nu, we, se = op
+                mu_o, nu_o = [], []
+                for g, mu, nu in zip(flat_g, flat_mu, flat_nu):
+                    g_avg = jax.lax.pmean(g, axis_name)
+                    mu_o.append(b1 * mu + (1 - b1) * g_avg)
+                    nu_o.append(b2 * nu + (1 - b2) * jnp.square(g_avg))
+                return mu_o, nu_o, we, se, jnp.int32(0), jnp.int32(1)
+
+            def compressed(op):
+                flat_g, flat_mu, flat_nu, we, se = op
+                avg, we_n, se_n = fused_allreduce(
+                    [g.reshape(-1) for g in flat_g], sizes, total, n_pad, we, se
+                )
+                mu_o = [
+                    b1 * mu + (1 - b1) * a.reshape(mu.shape)
+                    for mu, a in zip(flat_mu, avg)
+                ]
+                return mu_o, list(flat_nu), we_n, se_n, jnp.int32(1), jnp.int32(0)
+
+            mu_n, nu_n, we_n, se_n, c_comp, c_exact = jax.lax.cond(
+                var_step, exact, compressed, (flat_g, flat_mu, flat_nu, we, se)
+            )
+            upd = []
+            for mu, nu, p in zip(mu_n, nu_n, flat_p):
+                step_u = mu / (jnp.sqrt(nu) + eps)
+                if weight_decay:
+                    step_u = step_u + weight_decay * p.astype(jnp.float32)
+                upd.append(-lr * step_u)
+            # variance-interval bookkeeping (exponential policy)
+            vc = jnp.where(var_step, state.var_counter + 1, state.var_counter)
+            doubled = vc == var_update_scaler
+            vi = jnp.where(doubled, state.var_interval * 2, state.var_interval)
+            vc = jnp.where(doubled, 0, vc)
+            return (upd, mu_n, nu_n, list(flat_u), state.lrs, we_n, se_n,
+                    vi, vc, state.local_interval, state.local_counter,
+                    c_comp, c_exact)
+
+        def phase2_fn(args):
+            flat_g, flat_mu, flat_nu, flat_u, we, se = args
+            mu_l, delta = [], []
+            for g, mu, nu, p in zip(flat_g, flat_mu, flat_nu, flat_p):
+                m = b1 * mu + (1 - b1) * g
+                d = m / (jnp.sqrt(nu) + eps)
+                if weight_decay:
+                    d = d + weight_decay * p.astype(jnp.float32)
+                mu_l.append(m)
+                delta.append(-lr * d)
+            u_acc = [u + d for u, d in zip(flat_u, delta)]
+            lrs = state.lrs + lr
+            sync = (count % state.local_interval) == 0
+
+            def sync_round(op):
+                mu_l, u_acc, we, se = op
+                scaled = [
+                    (u * (jnp.sqrt(nu) + eps)).reshape(-1)
+                    for u, nu in zip(u_acc, flat_nu)
+                ]
+                avg, we_n, se_n = fused_allreduce(scaled, sizes, total, n_pad, we, se)
+                mu_o, upd_o, u_o = [], [], []
+                for d, u, nu, a in zip(delta, u_acc, flat_nu, avg):
+                    a = a.reshape(u.shape)
+                    denom = jnp.sqrt(nu) + eps
+                    # the exchanged buffer is momentum-scaled (u*denom), so
+                    # the momentum rebuild divides by accumulated lr only
+                    mu_o.append(-a / jnp.maximum(lrs, 1e-20))
+                    # roll back local drift, apply the worker-averaged delta
+                    upd_o.append(d - u + a / denom)
+                    u_o.append(jnp.zeros_like(u))
+                return (mu_o, upd_o, u_o, jnp.zeros_like(lrs), we_n, se_n,
+                        jnp.int32(1))
+
+            def local_round(op):
+                mu_l, u_acc, we, se = op
+                return (mu_l, delta, u_acc, lrs, we, se, jnp.int32(0))
+
+            mu_n, upd, u_n, lrs_n, we_n, se_n, c_comp = jax.lax.cond(
+                sync, sync_round, local_round, (mu_l, u_acc, we, se)
+            )
+            # local-step-interval bookkeeping
+            lc = state.local_counter + 1
+            grown = lc == local_step_scaler
+            li = jnp.where(
+                grown,
+                jnp.minimum(local_step_clipper, state.local_interval * 2),
+                state.local_interval,
+            )
+            lc = jnp.where(grown, 0, lc)
+            return (upd, mu_n, list(flat_nu), u_n, lrs_n, we_n, se_n,
+                    state.var_interval, state.var_counter, li, lc,
+                    c_comp, jnp.int32(0))
+
+        (upd, mu_n, nu_n, u_n, lrs_n, we_n, se_n, vi, vc, li, lc,
+         c_comp, c_exact) = jax.lax.cond(
+            phase2, phase2_fn, phase1, (flat_g, flat_mu, flat_nu, flat_u, we, se)
+        )
+        new_state = ZeroOneAdamState(
+            mu=treedef.unflatten([m.reshape(g.shape)[None] for m, g in zip(mu_n, flat_g)]),
+            nu=treedef.unflatten(nu_n),
+            u=treedef.unflatten([u[None] for u in u_n]),
+            lrs=lrs_n,
+            worker_error=we_n, server_error=se_n,
+            count=count, var_interval=vi, var_counter=vc,
+            local_interval=li, local_counter=lc,
+            comm_rounds=state.comm_rounds + c_comp,
+            exact_rounds=state.exact_rounds + c_exact,
+        )
+        updates = treedef.unflatten(
+            [u.reshape(g.shape).astype(g0.dtype)
+             for u, g, g0 in zip(upd, flat_g, jax.tree_util.tree_leaves(grads))]
+        )
+        return updates, new_state
+
+    return optax.GradientTransformation(init, update)
+
+
+# ---------------------------------------------------------------------------
+# 1-bit Lamb — compressed momentum exchange + frozen trust ratios
+# ---------------------------------------------------------------------------
+class OnebitLambState(NamedTuple):
+    """Reference ``runtime/fp16/onebit/lamb.py`` (OnebitLamb:15) state.
+    Per-leaf scalars ride as [L]-stacked arrays (leaf order = tree_leaves):
+    ``scaling_coeff`` (momentum pre-conditioner fixed at compression onset),
+    ``lamb_coeff_freeze`` (EMA of warmup trust ratios), ``last_factor``
+    (clipped recalibration factor from the fresh-variance estimate)."""
+
+    mu: Any
+    nu: Any  # frozen at freeze_step for the trust-ratio denominator
+    nu_fresh: Any  # keeps updating from reconstructed grads (factor source)
+    scaling_coeff: jnp.ndarray  # [L]
+    lamb_coeff_freeze: jnp.ndarray  # [L]
+    last_factor: jnp.ndarray  # [L]
+    worker_error: jnp.ndarray
+    server_error: jnp.ndarray
+    count: jnp.ndarray
+    comm_rounds: jnp.ndarray
+
+
+def onebit_lamb_collective_transform(
+    axis_name: str,
+    world: int,
+    b1=0.9,
+    b2=0.999,
+    eps=1e-8,
+    weight_decay=0.0,
+    freeze_step=100000,
+    max_coeff=10.0,
+    min_coeff=0.01,
+    coeff_beta=0.9,
+    factor_max=4.0,
+    factor_min=0.5,
+    factor_threshold=0.1,
+):
+    """Multi-worker 1-bit Lamb. Runs INSIDE shard_map over ``axis_name``
+    with LOCAL grads.
+
+    Warmup (count <= freeze_step): exact LAMB on pmean'd grads; per-leaf
+    trust ratios clip(||w||/||update||) are applied and EMA'd into
+    ``lamb_coeff_freeze`` (reference coeff_beta). At the freeze step the
+    variance is cloned into ``nu_fresh`` and each leaf's momentum
+    ``scaling_coeff`` = united_scale / leaf_rms is fixed (united_scale =
+    mean of leaf RMS norms) so the single fused compression scale fits all
+    leaves.
+
+    Compressed phase: momentum updates locally, is multiplied by its
+    scaling_coeff, exchanged through ONE fused error-feedback sign
+    compression, and divided back. The gradient is reconstructed from the
+    momentum delta to keep ``nu_fresh`` updating; the trust ratio becomes
+    lamb_coeff_freeze x factor where factor = max(frozen_denom/fresh_denom),
+    clipped to [factor_min, factor_max] and to ±factor_threshold relative
+    drift per step (reference lamb.py:347-363)."""
+
+    fused_sizes = lambda tree: _fused_sizes(tree, world)
+
+    def init(params):
+        zeros = lambda: jax.tree.map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params)
+        leaves = jax.tree_util.tree_leaves(params)
+        L = len(leaves)
+        _, _, n_pad = fused_sizes(params)
+        return OnebitLambState(
+            mu=zeros(), nu=zeros(), nu_fresh=zeros(),
+            scaling_coeff=jnp.ones((L,), jnp.float32),
+            lamb_coeff_freeze=jnp.zeros((L,), jnp.float32),
+            last_factor=jnp.ones((L,), jnp.float32),
+            worker_error=jnp.zeros((world, n_pad), jnp.float32),
+            server_error=jnp.zeros((world, n_pad // world), jnp.float32),
+            count=jnp.zeros((), jnp.int32),
+            comm_rounds=jnp.zeros((), jnp.int32),
+        )
+
+    def update(grads, state, params=None, *, lr):
+        if params is None:
+            raise ValueError("1-bit Lamb requires params in update() (trust ratios)")
+        count = state.count + 1
+        flat_g, treedef = jax.tree_util.tree_flatten(grads)
+        flat_g = [g.astype(jnp.float32) for g in flat_g]
+        flat_mu = treedef.flatten_up_to(state.mu)
+        flat_nu = treedef.flatten_up_to(state.nu)
+        flat_nf = treedef.flatten_up_to(state.nu_fresh)
+        flat_p = [p.astype(jnp.float32) for p in treedef.flatten_up_to(params)]
+        sizes, total, n_pad = fused_sizes(grads)
+
+        def warmup(args):
+            flat_g, flat_mu, flat_nu, flat_nf, we, se = args
+            mu_n, nu_n, nf_n, upd, coeffs = [], [], [], [], []
+            for i, (g, mu, nu, nf, p) in enumerate(
+                zip(flat_g, flat_mu, flat_nu, flat_nf, flat_p)
+            ):
+                g_avg = jax.lax.pmean(g, axis_name)
+                m = b1 * mu + (1 - b1) * g_avg
+                v = b2 * nu + (1 - b2) * jnp.square(g_avg)
+                step_u = m / (jnp.sqrt(v) + eps)
+                if weight_decay:
+                    step_u = step_u + weight_decay * p
+                w_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+                u_norm = jnp.sqrt(jnp.sum(jnp.square(step_u)))
+                coeff = jnp.where(
+                    (w_norm > 0) & (u_norm > 0),
+                    jnp.clip(w_norm / jnp.maximum(u_norm, 1e-30), min_coeff, max_coeff),
+                    1.0,
+                )
+                mu_n.append(m)
+                nu_n.append(v)
+                # variance snapshot for the compressed-phase factor source
+                nf_n.append(jnp.where(count == freeze_step, v, nf))
+                upd.append(-lr * coeff * step_u)
+                coeffs.append(coeff)
+            coeffs = jnp.stack(coeffs)
+            freeze_ema = jnp.where(
+                coeffs != 1.0,
+                coeff_beta * state.lamb_coeff_freeze + (1 - coeff_beta) * coeffs,
+                state.lamb_coeff_freeze,
+            )
+            # momentum scaling coefficients fixed at the end of warmup
+            rms = jnp.stack([
+                jnp.sqrt(jnp.sum(jnp.square(m)) / np.prod(m.shape)) for m in mu_n
+            ])
+            united = jnp.mean(rms)
+            scaling = jnp.where(
+                count == freeze_step,
+                united / jnp.maximum(rms, 1e-30),
+                state.scaling_coeff,
+            )
+            return (upd, mu_n, nu_n, nf_n, scaling, freeze_ema,
+                    state.last_factor, we, se, jnp.int32(0))
+
+        def compressed(args):
+            flat_g, flat_mu, flat_nu, flat_nf, we, se = args
+            mu_last = flat_mu
+            scaled = []
+            for i, (g, mu) in enumerate(zip(flat_g, flat_mu)):
+                m = (b1 * mu + (1 - b1) * g) * state.scaling_coeff[i]
+                scaled.append(m.reshape(-1))
+            fused = jnp.concatenate(scaled) if len(scaled) > 1 else scaled[0]
+            fused = jnp.pad(fused, (0, n_pad - total))
+            avg, we_n, se_n = compressed_allreduce(fused, we[0], se[0], axis_name)
+            mu_n, nf_n, upd, factors = [], [], [], []
+            off = 0
+            for i, (mu_prev, nu, nf, p, n) in enumerate(
+                zip(mu_last, flat_nu, flat_nf, flat_p, sizes)
+            ):
+                m = avg[off: off + n].reshape(mu_prev.shape) / state.scaling_coeff[i]
+                off += n
+                g_recon = (m - mu_prev * b1) / (1 - b1)
+                v_fresh = b2 * nf + (1 - b2) * jnp.square(g_recon)
+                denom = jnp.sqrt(nu) + eps
+                denom_real = jnp.sqrt(v_fresh) + eps
+                step_prelim = m / denom
+                step_u = step_prelim + weight_decay * p if weight_decay else step_prelim
+                factor = jnp.max(denom / denom_real)
+                if weight_decay:
+                    un = jnp.sqrt(jnp.sum(jnp.square(step_u)))
+                    upn = jnp.sqrt(jnp.sum(jnp.square(step_prelim)))
+                    ratio = jnp.minimum(1.0, upn / jnp.maximum(un, 1e-30))
+                    factor = factor * ratio + (1.0 - ratio)
+                factor = jnp.clip(factor, factor_min, factor_max)
+                factor = jnp.clip(
+                    factor,
+                    state.last_factor[i] * (1.0 - factor_threshold),
+                    state.last_factor[i] * (1.0 + factor_threshold),
+                )
+                coeff = state.lamb_coeff_freeze[i] * factor
+                mu_n.append(m)
+                nf_n.append(v_fresh)
+                upd.append(-lr * coeff * step_u)
+                factors.append(factor)
+            return (upd, mu_n, list(flat_nu), nf_n, state.scaling_coeff,
+                    state.lamb_coeff_freeze, jnp.stack(factors), we_n[None],
+                    se_n[None], jnp.int32(1))
+
+        (upd, mu_n, nu_n, nf_n, scaling, freeze_ema, last_factor, we_n, se_n,
+         c_comp) = jax.lax.cond(
+            count <= freeze_step, warmup, compressed,
+            (flat_g, flat_mu, flat_nu, flat_nf,
+             state.worker_error, state.server_error),
+        )
+        new_state = OnebitLambState(
+            mu=treedef.unflatten(mu_n),
+            nu=treedef.unflatten(nu_n),
+            nu_fresh=treedef.unflatten(nf_n),
+            scaling_coeff=scaling,
+            lamb_coeff_freeze=freeze_ema,
+            last_factor=last_factor,
+            worker_error=we_n, server_error=se_n,
+            count=count,
+            comm_rounds=state.comm_rounds + c_comp,
+        )
+        updates = treedef.unflatten(
+            [u.astype(g0.dtype) for u, g0 in zip(upd, jax.tree_util.tree_leaves(grads))]
+        )
+        return updates, new_state
+
+    return optax.GradientTransformation(init, update)

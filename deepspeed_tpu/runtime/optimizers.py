@@ -164,13 +164,30 @@ def build_optimizer(
                 tx = optax.chain(optax.add_decayed_weights(weight_decay), tx)
         canonical = "adamw" if is_adamw else "adam"
     elif name == ONEBIT_LAMB_OPTIMIZER:
-        # The reference OnebitLamb (fp16/onebit/lamb.py) fuses compressed
-        # momentum exchange with Lamb's per-layer trust-ratio bookkeeping;
-        # silently substituting plain Lamb would compress nothing. Refuse
-        # until the compressed Lamb exchange exists.
-        raise NotImplementedError(
-            "OnebitLamb is not implemented; use OnebitAdam (compressed) or Lamb (uncompressed)"
+        from deepspeed_tpu.parallel.topology import DATA_AXIS
+        from deepspeed_tpu.runtime.fp16.onebit import onebit_lamb_collective_transform
+
+        dp = mesh.shape.get(DATA_AXIS, 1) if mesh is not None else 1
+        if dp <= 1:
+            # Compression without a wire would silently be plain Lamb with
+            # extra state; refuse like the reference (which requires a
+            # distributed backend) rather than mislabel.
+            raise NotImplementedError(
+                "OnebitLamb requires data-parallel world > 1 (its point is the "
+                "compressed momentum exchange); use Lamb for single-worker runs"
+            )
+        tx = onebit_lamb_collective_transform(
+            axis_name=DATA_AXIS, world=dp,
+            b1=betas[0], b2=betas[1], eps=eps, weight_decay=weight_decay,
+            freeze_step=params.pop("freeze_step", 100000),
+            max_coeff=params.pop("max_coeff", 10.0),
+            min_coeff=params.pop("min_coeff", 0.01),
+            coeff_beta=params.pop("coeff_beta", 0.9),
+            factor_max=params.pop("factor_max", 4.0),
+            factor_min=params.pop("factor_min", 0.5),
+            factor_threshold=params.pop("factor_threshold", 0.1),
         )
+        canonical = "onebitlamb"
     elif name in (LAMB_OPTIMIZER, FUSED_LAMB):
         tx = _InjectLR.wrap(optax.lamb, b1=betas[0], b2=betas[1], eps=eps, weight_decay=weight_decay)
         canonical = "lamb"
@@ -189,7 +206,35 @@ def build_optimizer(
     elif name == MUON_OPTIMIZER:
         tx = _muon(beta=params.pop("momentum", 0.95), weight_decay=weight_decay, adam_betas=betas, eps=eps)
         canonical = "muon"
-    elif name in (ONEBIT_ADAM_OPTIMIZER, ZERO_ONE_ADAM_OPTIMIZER):
+    elif name == ZERO_ONE_ADAM_OPTIMIZER:
+        from deepspeed_tpu.parallel.topology import DATA_AXIS
+        from deepspeed_tpu.runtime.fp16.onebit import (
+            onebit_adam_transform,
+            zero_one_adam_collective_transform,
+        )
+
+        dp = mesh.shape.get(DATA_AXIS, 1) if mesh is not None else 1
+        var_freeze_step = params.pop("var_freeze_step", 100000)
+        if dp > 1:
+            # true 0/1 Adam: variance-interval grad exchange + local-step
+            # sync skipping (reference onebit/zoadam.py:14)
+            tx = zero_one_adam_collective_transform(
+                axis_name=DATA_AXIS, world=dp,
+                b1=betas[0], b2=betas[1], eps=eps, weight_decay=weight_decay,
+                var_freeze_step=var_freeze_step,
+                var_update_scaler=params.pop("var_update_scaler", 16),
+                local_step_scaler=params.pop("local_step_scaler", 32678),
+                local_step_clipper=params.pop("local_step_clipper", 16),
+            )
+        else:
+            # single worker: the sync schedule has nothing to skip — the
+            # trajectory-comparable form is 1-bit Adam's frozen-variance path
+            tx = onebit_adam_transform(
+                b1=betas[0], b2=betas[1], eps=eps, weight_decay=weight_decay,
+                freeze_step=var_freeze_step,
+            )
+        canonical = name
+    elif name == ONEBIT_ADAM_OPTIMIZER:
         from deepspeed_tpu.parallel.topology import DATA_AXIS
         from deepspeed_tpu.runtime.fp16.onebit import (
             onebit_adam_collective_transform,
@@ -221,7 +266,10 @@ def build_optimizer(
     logger.info(f"Using optimizer: {canonical} (lr={lr}, wd={weight_decay})")
     opt = DeepSpeedOptimizer(tx, canonical, {"lr": lr, "betas": betas, "eps": eps, "weight_decay": weight_decay})
     opt.set_lr(lr)
-    if name in (ONEBIT_ADAM_OPTIMIZER, ZERO_ONE_ADAM_OPTIMIZER) and mesh is not None:
+    if (
+        name in (ONEBIT_ADAM_OPTIMIZER, ZERO_ONE_ADAM_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER)
+        and mesh is not None
+    ):
         from deepspeed_tpu.parallel.topology import DATA_AXIS as _DA
 
         if mesh.shape.get(_DA, 1) > 1:

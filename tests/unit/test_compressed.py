@@ -188,18 +188,27 @@ def test_onebit_wire_is_packed_bits(devices8):
     assert any(t == "u8" for t in a2a_types), f"no u8 all-to-all found: {set(a2a_types)}"
 
 
-def test_onebit_lamb_refused():
+def test_onebit_lamb_single_worker_refused():
+    """OnebitLamb now exists (tests/unit/test_zero_one_lamb.py) but still
+    refuses a 1-worker world, where compression has no wire to save."""
     params = make_mlp_params(jax.random.key(0))
-    with pytest.raises(NotImplementedError):
-        deepspeed_tpu.initialize(
-            model=mlp_loss_fn,
-            model_parameters=params,
-            config={
-                "train_micro_batch_size_per_gpu": 8,
-                "optimizer": {"type": "OneBitLamb", "params": {"lr": LR}},
-                "steps_per_print": 1000,
-            },
-        )
+    from deepspeed_tpu.parallel.topology import Topology, reset_topology
+
+    reset_topology()
+    try:
+        with pytest.raises(NotImplementedError):
+            deepspeed_tpu.initialize(
+                model=mlp_loss_fn,
+                model_parameters=params,
+                mpu=Topology(data=1, devices=jax.devices()[:1]),
+                config={
+                    "train_micro_batch_size_per_gpu": 8,
+                    "optimizer": {"type": "OneBitLamb", "params": {"lr": LR}},
+                    "steps_per_print": 1000,
+                },
+            )
+    finally:
+        reset_topology()
 
 
 # ---------------------------------------------------------------------------
