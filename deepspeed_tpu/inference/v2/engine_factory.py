@@ -31,10 +31,18 @@ def register_model_implementation(*arch_names: str):
 
 
 def _register_builtins():
-    from deepspeed_tpu.models.hf import load_hf_llama
+    from deepspeed_tpu.models.hf import load_hf_model
 
-    for arch in ("LlamaForCausalLM", "MistralForCausalLM"):
-        POLICY_REGISTRY.setdefault(arch, load_hf_llama)
+    for arch in (
+        "LlamaForCausalLM",
+        "MistralForCausalLM",
+        "Qwen2ForCausalLM",
+        "Qwen2MoeForCausalLM",
+        "FalconForCausalLM",
+        "PhiForCausalLM",
+        "Phi3ForCausalLM",
+    ):
+        POLICY_REGISTRY.setdefault(arch, load_hf_model)
 
 
 def load_model_implementation(path: str, dtype: str = "bfloat16"):

@@ -6,7 +6,7 @@ as pure-functional JAX with declarative sharding.
 """
 
 from deepspeed_tpu.models.adapters import flax_loss_fn
-from deepspeed_tpu.models.hf import config_from_hf, load_hf_llama
+from deepspeed_tpu.models.hf import config_from_hf, load_hf_llama, load_hf_model
 from deepspeed_tpu.models.transformer import (
     PRESETS,
     TransformerConfig,
@@ -26,6 +26,7 @@ __all__ = [
     "config_from_hf",
     "flax_loss_fn",
     "load_hf_llama",
+    "load_hf_model",
     "TransformerConfig",
     "decode_step",
     "flops_per_token",

@@ -1,18 +1,27 @@
-"""HF checkpoint import: llama/mistral-family → the native model family.
+"""HF checkpoint import: per-architecture loaders → the native model family.
 
-Analogue of the reference checkpoint-shard loading
-(``module_inject/load_checkpoint.py``, ``inference/engine.py:303`` meta-load
-path): a HF `LlamaForCausalLM` (or mistral — same layout) directory becomes a
-(:class:`TransformerConfig`, stacked-params pytree) pair that trains or
+Analogue of the reference checkpoint-shard loading + per-arch containers
+(``module_inject/load_checkpoint.py``, ``module_inject/containers/``,
+``inference/v2/model_implementations/{llama_v2,mistral,mixtral,qwen_v2,
+qwen_v2_moe,falcon,phi,phi3}``): a HF causal-LM checkpoint directory becomes
+a (:class:`TransformerConfig`, stacked-params pytree) pair that trains or
 serves through ``deepspeed_tpu.initialize`` / ``init_inference`` unchanged.
+
+Supported ``model_type``s: llama, mistral, qwen2, qwen2_moe, falcon, phi,
+phi3. Dispatch is by ``config.json``'s ``model_type`` (see
+:data:`ARCH_LOADERS`); the inference engine factory additionally dispatches
+on ``architectures[0]`` (engine_factory.py).
 
 Weight-layout notes (why each mapping is what it is):
   * HF Linear stores ``[out, in]``; this model family uses JAX's ``[in,
     out]`` → transpose every projection.
   * Layers here are STACKED along a leading ``[n_layers, ...]`` dim (the
     ``lax.scan`` layout), so per-layer tensors stack after transposing.
-  * RoPE: HF llama's ``rotate_half`` IS the half-split convention used by
-    ``transformer._rope`` — weights map 1:1, no permutation needed.
+  * RoPE: HF's ``rotate_half`` IS the half-split convention used by
+    ``transformer._rope`` — weights map 1:1, no permutation needed. Phi's
+    partial rotary maps to ``rope_frac``.
+  * Falcon fuses q/k/v into ``query_key_value`` with a per-kv-group
+    interleave under ``new_decoder_architecture`` — de-interleaved here.
   * ``torch`` is only used to read the checkpoint on host (CPU); arrays
     convert to numpy before entering JAX.
 """
@@ -20,7 +29,7 @@ Weight-layout notes (why each mapping is what it is):
 import dataclasses
 import json
 import os
-from typing import Any, Dict, Tuple
+from typing import Any, Callable, Dict, Tuple
 
 import numpy as np
 
@@ -36,36 +45,20 @@ def _to_np(t) -> np.ndarray:
     return np.asarray(t.cpu() if hasattr(t, "cpu") else t)
 
 
-def config_from_hf(hf_cfg) -> TransformerConfig:
-    """HF LlamaConfig/MistralConfig (object or dict) → TransformerConfig."""
-    get = (lambda k, d=None: hf_cfg.get(k, d)) if isinstance(hf_cfg, dict) else (
-        lambda k, d=None: getattr(hf_cfg, k, d)
-    )
-    head_dim = get("head_dim", None)
-    derived = get("hidden_size") // get("num_attention_heads")
-    if head_dim is not None and int(head_dim) != derived:
-        # mistral-nemo-style decoupled head_dim: the native family derives
-        # head_dim = hidden/n_heads, so the qkv shapes would not line up —
-        # fail at load time with the real reason, not a reshape error later
-        raise ValueError(
-            f"unsupported checkpoint: head_dim={head_dim} != hidden/num_heads={derived} "
-            "(decoupled head_dim is not representable in TransformerConfig yet)"
-        )
-    return TransformerConfig(
-        vocab_size=get("vocab_size"),
-        hidden_size=get("hidden_size"),
-        n_layers=get("num_hidden_layers"),
-        n_heads=get("num_attention_heads"),
-        n_kv_heads=get("num_key_value_heads", None),
-        ffn_hidden_size=get("intermediate_size"),
-        max_seq_len=get("max_position_embeddings", 2048),
-        norm="rmsnorm",
-        activation="swiglu",
-        position="rope",
-        rope_theta=float(get("rope_theta", 10000.0)),
-        norm_eps=float(get("rms_norm_eps", 1e-5)),
-        tie_embeddings=bool(get("tie_word_embeddings", False)),
-    )
+def _np_cast(a, dtype: str) -> np.ndarray:
+    """Host-only dtype cast (ml_dtypes carries bf16 in numpy — no device
+    round-trip for multi-GB checkpoints)."""
+    import ml_dtypes
+
+    a = _to_np(a)
+    if a.dtype == np.dtype("V2") or str(a.dtype) == "bfloat16":
+        a = a.view(ml_dtypes.bfloat16).astype(np.float32)
+    target = {"bfloat16": ml_dtypes.bfloat16, "float32": np.float32, "float16": np.float16}[dtype]
+    return a.astype(target)
+
+
+def dataclass_replace(cfg, **kw):
+    return dataclasses.replace(cfg, **kw)
 
 
 def _load_state_dict(path: str) -> Dict[str, Any]:
@@ -98,14 +91,337 @@ def _load_state_dict(path: str) -> Dict[str, Any]:
     return state
 
 
-def load_hf_llama(
+def _getter(hf_cfg) -> Callable:
+    return (lambda k, d=None: hf_cfg.get(k, d)) if isinstance(hf_cfg, dict) else (
+        lambda k, d=None: getattr(hf_cfg, k, d)
+    )
+
+
+# ---------------------------------------------------------------------------
+# per-arch config translation
+# ---------------------------------------------------------------------------
+def _check_rope_scaling(get):
+    """Fail fast on checkpoints whose RoPE is scaled (llama3 / longrope /
+    linear / yarn): silently building plain-theta RoPE would load without
+    error and produce wrong logits — even at short context for longrope's
+    short_factor."""
+    scaling = get("rope_scaling", None)
+    if not scaling:
+        return
+    kind = scaling.get("rope_type", scaling.get("type", "default")) if isinstance(scaling, dict) else scaling
+    if kind != "default":
+        raise ValueError(
+            f"unsupported checkpoint: rope_scaling={scaling!r} — scaled RoPE "
+            "(llama3/longrope/linear/yarn) is not implemented; logits would be wrong"
+        )
+
+
+def _llama_like_config(get, **extra) -> TransformerConfig:
+    _check_rope_scaling(get)
+    base = dict(
+        vocab_size=get("vocab_size"),
+        hidden_size=get("hidden_size"),
+        n_layers=get("num_hidden_layers"),
+        n_heads=get("num_attention_heads"),
+        n_kv_heads=get("num_key_value_heads", None),
+        ffn_hidden_size=get("intermediate_size"),
+        max_seq_len=get("max_position_embeddings", 2048),
+        norm="rmsnorm",
+        activation="swiglu",
+        position="rope",
+        rope_theta=float(get("rope_theta", 10000.0)),
+        norm_eps=float(get("rms_norm_eps", 1e-5)),
+        tie_embeddings=bool(get("tie_word_embeddings", False)),
+    )
+    base.update(extra)
+    return TransformerConfig(**base)
+
+
+def config_from_hf(hf_cfg) -> TransformerConfig:
+    """HF config (object or dict) → TransformerConfig; dispatches on
+    ``model_type`` (llama when absent)."""
+    get = _getter(hf_cfg)
+    mt = get("model_type", "llama")
+    if mt in ("llama", "mistral"):
+        head_dim = get("head_dim", None)
+        derived = get("hidden_size") // get("num_attention_heads")
+        override = int(head_dim) if head_dim is not None and int(head_dim) != derived else None
+        return _llama_like_config(get, head_dim_override=override)
+    if mt == "qwen2":
+        return _llama_like_config(get, attn_qkv_bias=True)
+    if mt == "qwen2_moe":
+        sparse_step = get("decoder_sparse_step", 1)
+        mlp_only = get("mlp_only_layers", []) or []
+        if sparse_step != 1 or mlp_only:
+            # the scan layout wants uniform layers; mixed dense/MoE stacks
+            # would need a per-layer dispatch — fail with the real reason
+            raise ValueError(
+                f"qwen2_moe: decoder_sparse_step={sparse_step}, mlp_only_layers="
+                f"{mlp_only} — only uniform MoE stacks are supported"
+            )
+        return _llama_like_config(
+            get,
+            attn_qkv_bias=True,
+            ffn_hidden_size=get("moe_intermediate_size"),
+            n_experts=get("num_experts"),
+            moe_top_k=get("num_experts_per_tok"),
+            moe_norm_topk_prob=bool(get("norm_topk_prob", False)),
+            # HF qwen2-moe never drops tokens. capacity = ceil(t·k·cf/E), and
+            # a token contributes at most ONE slot per expert, so cf = E/k
+            # gives capacity = t — the minimal drop-free bound (all tokens on
+            # one expert). Dense dispatch is still O(t·E·t) at this bound;
+            # lower cf (accepting drops) for long-sequence training runs.
+            moe_capacity_factor=float(get("num_experts")) / float(get("num_experts_per_tok")),
+            moe_shared_expert_dim=get("shared_expert_intermediate_size", 0) or 0,
+            moe_aux_loss_coef=float(get("router_aux_loss_coef", 0.001)),
+        )
+    if mt == "falcon":
+        if get("alibi", False):
+            raise ValueError("falcon: alibi position encoding is not supported (rope checkpoints only)")
+        _check_rope_scaling(get)
+        nh = get("num_attention_heads")
+        if get("new_decoder_architecture", False):
+            n_kv = get("num_kv_heads", nh)
+        else:
+            n_kv = 1 if get("multi_query", True) else nh
+        return TransformerConfig(
+            vocab_size=get("vocab_size"),
+            hidden_size=get("hidden_size"),
+            n_layers=get("num_hidden_layers"),
+            n_heads=nh,
+            n_kv_heads=n_kv,
+            ffn_hidden_size=get("ffn_hidden_size", None) or 4 * get("hidden_size"),
+            max_seq_len=get("max_position_embeddings", 2048),
+            norm="layernorm",
+            activation="gelu_exact",  # falcon's MLP is torch nn.GELU (erf)
+            position="rope",
+            rope_theta=float(get("rope_theta", 10000.0)),
+            norm_eps=float(get("layer_norm_epsilon", 1e-5)),
+            tie_embeddings=bool(get("tie_word_embeddings", True)),
+            parallel_block=bool(get("parallel_attn", True)),
+            attn_qkv_bias=bool(get("bias", False)),
+            attn_out_bias=bool(get("bias", False)),
+            mlp_bias=bool(get("bias", False)),
+        )
+    if mt == "phi":
+        if get("qk_layernorm", False):
+            raise ValueError("phi: qk_layernorm checkpoints are not supported")
+        _check_rope_scaling(get)
+        return TransformerConfig(
+            vocab_size=get("vocab_size"),
+            hidden_size=get("hidden_size"),
+            n_layers=get("num_hidden_layers"),
+            n_heads=get("num_attention_heads"),
+            n_kv_heads=get("num_key_value_heads", None),
+            ffn_hidden_size=get("intermediate_size"),
+            max_seq_len=get("max_position_embeddings", 2048),
+            norm="layernorm",
+            activation="gelu",
+            position="rope",
+            rope_theta=float(get("rope_theta", 10000.0)),
+            norm_eps=float(get("layer_norm_eps", 1e-5)),
+            tie_embeddings=bool(get("tie_word_embeddings", False)),
+            parallel_block=True,
+            attn_qkv_bias=True,
+            attn_out_bias=True,
+            mlp_bias=True,
+            lm_head_bias=True,
+            rope_frac=float(get("partial_rotary_factor", 0.5)),
+        )
+    if mt == "phi3":
+        return _llama_like_config(get)
+    raise ValueError(
+        f"unsupported model_type {mt!r}; supported: llama, mistral, qwen2, "
+        "qwen2_moe, falcon, phi, phi3"
+    )
+
+
+# ---------------------------------------------------------------------------
+# per-arch weight extraction
+# ---------------------------------------------------------------------------
+class _Taker:
+    """state-dict accessor with dtype cast + [out,in]→[in,out] transpose."""
+
+    def __init__(self, state: Dict[str, Any], dtype: str):
+        self.state = state
+        self.dtype = dtype
+
+    def __call__(self, name) -> np.ndarray:
+        return _np_cast(self.state.pop(name), self.dtype)
+
+    def linear(self, name) -> np.ndarray:
+        return self(name).T
+
+
+def _llama_layer(take: _Taker, cfg: TransformerConfig, p: str, layers: Dict[str, list]):
+    layers["attn_norm"].append(take(f"{p}.input_layernorm.weight"))
+    layers["wq"].append(take.linear(f"{p}.self_attn.q_proj.weight"))
+    layers["wk"].append(take.linear(f"{p}.self_attn.k_proj.weight"))
+    layers["wv"].append(take.linear(f"{p}.self_attn.v_proj.weight"))
+    layers["wo"].append(take.linear(f"{p}.self_attn.o_proj.weight"))
+    layers["mlp_norm"].append(take(f"{p}.post_attention_layernorm.weight"))
+    if cfg.attn_qkv_bias:
+        layers["wq_b"].append(take(f"{p}.self_attn.q_proj.bias"))
+        layers["wk_b"].append(take(f"{p}.self_attn.k_proj.bias"))
+        layers["wv_b"].append(take(f"{p}.self_attn.v_proj.bias"))
+    if cfg.n_experts > 0:
+        # qwen2-moe: router gate [E, h] + per-expert FFNs + shared expert
+        layers["router"].append(take.linear(f"{p}.mlp.gate.weight"))
+        for name, hf in (("w_gate", "gate_proj"), ("w_up", "up_proj"), ("w_down", "down_proj")):
+            layers[name].append(
+                np.stack([take.linear(f"{p}.mlp.experts.{e}.{hf}.weight") for e in range(cfg.n_experts)])
+            )
+        if cfg.moe_shared_expert_dim > 0:
+            layers["shared_gate"].append(take.linear(f"{p}.mlp.shared_expert.gate_proj.weight"))
+            layers["shared_up"].append(take.linear(f"{p}.mlp.shared_expert.up_proj.weight"))
+            layers["shared_down"].append(take.linear(f"{p}.mlp.shared_expert.down_proj.weight"))
+            layers["shared_gate_proj"].append(take.linear(f"{p}.mlp.shared_expert_gate.weight"))
+    else:
+        layers["w_gate"].append(take.linear(f"{p}.mlp.gate_proj.weight"))
+        layers["w_up"].append(take.linear(f"{p}.mlp.up_proj.weight"))
+        layers["w_down"].append(take.linear(f"{p}.mlp.down_proj.weight"))
+
+
+def _phi3_layer(take: _Taker, cfg: TransformerConfig, p: str, layers: Dict[str, list]):
+    # phi-3 fuses qkv_proj [q;k;v] and gate_up_proj [gate;up] — split rows
+    layers["attn_norm"].append(take(f"{p}.input_layernorm.weight"))
+    qkv = take(f"{p}.self_attn.qkv_proj.weight")  # [(nh+2*nkv)*d, h]
+    q_rows = cfg.n_heads * cfg.head_dim
+    kv_rows = cfg.kv_heads * cfg.head_dim
+    layers["wq"].append(qkv[:q_rows].T)
+    layers["wk"].append(qkv[q_rows : q_rows + kv_rows].T)
+    layers["wv"].append(qkv[q_rows + kv_rows :].T)
+    layers["wo"].append(take.linear(f"{p}.self_attn.o_proj.weight"))
+    layers["mlp_norm"].append(take(f"{p}.post_attention_layernorm.weight"))
+    gate_up = take(f"{p}.mlp.gate_up_proj.weight")  # [2*ffn, h]
+    ffn = gate_up.shape[0] // 2
+    layers["w_gate"].append(gate_up[:ffn].T)
+    layers["w_up"].append(gate_up[ffn:].T)
+    layers["w_down"].append(take.linear(f"{p}.mlp.down_proj.weight"))
+
+
+def _split_falcon_qkv(fused: np.ndarray, cfg: TransformerConfig) -> Tuple[np.ndarray, ...]:
+    """De-interleave falcon's fused query_key_value rows.
+
+    Every falcon layout is the per-kv-group interleave
+    [q·(nh/nkv), k, v] — HF's legacy ``_split_heads`` views are its
+    degenerate cases: MHA is group-of-3 per head (view ``(nh, 3, d)``) and
+    multi_query is nkv=1 (all q rows, then k, then v). fused: [rows, h]
+    (or [rows] for the bias). Returns (q, k, v) row-major.
+    """
+    d, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.kv_heads
+    group = nh // nkv + 2
+    blocks = fused.reshape(nkv, group, d, *fused.shape[1:])
+    q = blocks[:, :-2].reshape(nh * d, *fused.shape[1:])
+    k = blocks[:, -2].reshape(nkv * d, *fused.shape[1:])
+    v = blocks[:, -1].reshape(nkv * d, *fused.shape[1:])
+    return q, k, v
+
+
+def _falcon_layer(take: _Taker, cfg: TransformerConfig, p: str, layers: Dict[str, list]):
+    if f"{p}.ln_attn.weight" in take.state:  # new_decoder_architecture
+        layers["attn_norm"].append(take(f"{p}.ln_attn.weight"))
+        layers["attn_norm_b"].append(take(f"{p}.ln_attn.bias"))
+        layers["mlp_norm"].append(take(f"{p}.ln_mlp.weight"))
+        layers["mlp_norm_b"].append(take(f"{p}.ln_mlp.bias"))
+    else:
+        ln_w = take(f"{p}.input_layernorm.weight")
+        ln_b = take(f"{p}.input_layernorm.bias")
+        layers["attn_norm"].append(ln_w)
+        layers["attn_norm_b"].append(ln_b)
+        if cfg.parallel_block:
+            # falcon-7b shares one norm across both branches
+            layers["mlp_norm"].append(ln_w)
+            layers["mlp_norm_b"].append(ln_b)
+        else:
+            layers["mlp_norm"].append(take(f"{p}.post_attention_layernorm.weight"))
+            layers["mlp_norm_b"].append(take(f"{p}.post_attention_layernorm.bias"))
+    q, k, v = _split_falcon_qkv(take(f"{p}.self_attention.query_key_value.weight"), cfg)
+    layers["wq"].append(q.T)
+    layers["wk"].append(k.T)
+    layers["wv"].append(v.T)
+    layers["wo"].append(take.linear(f"{p}.self_attention.dense.weight"))
+    layers["w_up"].append(take.linear(f"{p}.mlp.dense_h_to_4h.weight"))
+    layers["w_down"].append(take.linear(f"{p}.mlp.dense_4h_to_h.weight"))
+    if cfg.attn_qkv_bias:
+        qb, kb, vb = _split_falcon_qkv(take(f"{p}.self_attention.query_key_value.bias"), cfg)
+        layers["wq_b"].append(qb)
+        layers["wk_b"].append(kb)
+        layers["wv_b"].append(vb)
+        layers["wo_b"].append(take(f"{p}.self_attention.dense.bias"))
+        layers["w_up_b"].append(take(f"{p}.mlp.dense_h_to_4h.bias"))
+        layers["w_down_b"].append(take(f"{p}.mlp.dense_4h_to_h.bias"))
+
+
+def _phi_layer(take: _Taker, cfg: TransformerConfig, p: str, layers: Dict[str, list]):
+    # phi: one shared input_layernorm feeds both parallel branches
+    ln_w = take(f"{p}.input_layernorm.weight")
+    ln_b = take(f"{p}.input_layernorm.bias")
+    layers["attn_norm"].append(ln_w)
+    layers["attn_norm_b"].append(ln_b)
+    layers["mlp_norm"].append(ln_w)
+    layers["mlp_norm_b"].append(ln_b)
+    for name, hf in (("wq", "q_proj"), ("wk", "k_proj"), ("wv", "v_proj")):
+        layers[name].append(take.linear(f"{p}.self_attn.{hf}.weight"))
+        layers[f"{name}_b"].append(take(f"{p}.self_attn.{hf}.bias"))
+    layers["wo"].append(take.linear(f"{p}.self_attn.dense.weight"))
+    layers["wo_b"].append(take(f"{p}.self_attn.dense.bias"))
+    layers["w_up"].append(take.linear(f"{p}.mlp.fc1.weight"))
+    layers["w_up_b"].append(take(f"{p}.mlp.fc1.bias"))
+    layers["w_down"].append(take.linear(f"{p}.mlp.fc2.weight"))
+    layers["w_down_b"].append(take(f"{p}.mlp.fc2.bias"))
+
+
+_LAYER_EXTRACTORS: Dict[str, Callable] = {
+    "llama": _llama_layer,
+    "mistral": _llama_layer,
+    "qwen2": _llama_layer,
+    "qwen2_moe": _llama_layer,
+    "falcon": _falcon_layer,
+    "phi": _phi_layer,
+    "phi3": _phi3_layer,
+}
+
+# per-arch (embed key, final-norm key, layer prefix)
+_TOPLEVEL_KEYS: Dict[str, Tuple[str, str, str]] = {
+    "llama": ("model.embed_tokens.weight", "model.norm", "model.layers"),
+    "mistral": ("model.embed_tokens.weight", "model.norm", "model.layers"),
+    "qwen2": ("model.embed_tokens.weight", "model.norm", "model.layers"),
+    "qwen2_moe": ("model.embed_tokens.weight", "model.norm", "model.layers"),
+    "phi3": ("model.embed_tokens.weight", "model.norm", "model.layers"),
+    "phi": ("model.embed_tokens.weight", "model.final_layernorm", "model.layers"),
+    "falcon": ("transformer.word_embeddings.weight", "transformer.ln_f", "transformer.h"),
+}
+
+
+def _expected_layer_keys(cfg: TransformerConfig) -> Dict[str, list]:
+    """Empty stacking lists for exactly the keys this config's params carry."""
+    keys = ["attn_norm", "wq", "wk", "wv", "wo", "mlp_norm", "w_up", "w_down"]
+    if cfg.activation == "swiglu":
+        keys.append("w_gate")
+    if cfg.norm == "layernorm":
+        keys += ["attn_norm_b", "mlp_norm_b"]
+    if cfg.attn_qkv_bias:
+        keys += ["wq_b", "wk_b", "wv_b"]
+    if cfg.attn_out_bias:
+        keys.append("wo_b")
+    if cfg.mlp_bias and cfg.n_experts == 0:
+        keys += ["w_up_b", "w_down_b"] + (["w_gate_b"] if cfg.activation == "swiglu" else [])
+    if cfg.n_experts > 0:
+        keys.append("router")
+        if cfg.moe_shared_expert_dim > 0:
+            keys += ["shared_gate", "shared_up", "shared_down", "shared_gate_proj"]
+    return {k: [] for k in keys}
+
+
+def load_hf_model(
     model_name_or_path: str,
     dtype: str = "bfloat16",
 ) -> Tuple[TransformerConfig, Dict[str, Any]]:
-    """Load a llama/mistral-family HF checkpoint directory into the native
-    family's stacked layout. Returns (config, params) — feed them to
+    """Load a supported HF checkpoint directory into the native family's
+    stacked layout. Returns (config, params) — feed them to
     ``make_loss_fn(config)`` + ``initialize(model_parameters=params)`` or the
-    inference engine."""
+    inference engines."""
     cfg_path = os.path.join(model_name_or_path, "config.json")
     if not os.path.isfile(cfg_path):
         raise FileNotFoundError(
@@ -113,40 +429,33 @@ def load_hf_llama(
             "download/snapshot the model first — there is no network access at load time"
         )
     hf_cfg = json.load(open(cfg_path))
+    mt = hf_cfg.get("model_type", "llama")
+    if mt not in _LAYER_EXTRACTORS:
+        raise ValueError(f"unsupported model_type {mt!r}; supported: {sorted(_LAYER_EXTRACTORS)}")
     cfg = dataclass_replace(config_from_hf(hf_cfg), dtype=dtype)
     state = _load_state_dict(model_name_or_path)
+    take = _Taker(state, dtype)
 
-    P = "model.layers.{i}.{name}"
-
-    def take(name) -> np.ndarray:
-        return _np_cast(state.pop(name), dtype)
-
-    def take_linear(name) -> np.ndarray:
-        return take(name).T  # [out, in] → [in, out]
-
-    layers: Dict[str, list] = {
-        "attn_norm": [], "wq": [], "wk": [], "wv": [], "wo": [],
-        "mlp_norm": [], "w_gate": [], "w_up": [], "w_down": [],
-    }
+    embed_key, norm_key, layer_prefix = _TOPLEVEL_KEYS[mt]
+    extract = _LAYER_EXTRACTORS[mt]
+    layers = _expected_layer_keys(cfg)
     for i in range(cfg.n_layers):
-        layers["attn_norm"].append(take(P.format(i=i, name="input_layernorm.weight")))
-        layers["wq"].append(take_linear(P.format(i=i, name="self_attn.q_proj.weight")))
-        layers["wk"].append(take_linear(P.format(i=i, name="self_attn.k_proj.weight")))
-        layers["wv"].append(take_linear(P.format(i=i, name="self_attn.v_proj.weight")))
-        layers["wo"].append(take_linear(P.format(i=i, name="self_attn.o_proj.weight")))
-        layers["mlp_norm"].append(take(P.format(i=i, name="post_attention_layernorm.weight")))
-        layers["w_gate"].append(take_linear(P.format(i=i, name="mlp.gate_proj.weight")))
-        layers["w_up"].append(take_linear(P.format(i=i, name="mlp.up_proj.weight")))
-        layers["w_down"].append(take_linear(P.format(i=i, name="mlp.down_proj.weight")))
+        extract(take, cfg, f"{layer_prefix}.{i}", layers)
 
     params: Dict[str, Any] = {
-        "embed": _np_cast(state.pop("model.embed_tokens.weight"), dtype),
-        "final_norm": take("model.norm.weight"),
+        "embed": take(embed_key),
+        "final_norm": take(f"{norm_key}.weight"),
         "layers": {k: np.stack(v) for k, v in layers.items()},
     }
+    if cfg.norm == "layernorm":
+        params["final_norm_b"] = take(f"{norm_key}.bias")
     if not cfg.tie_embeddings:
         if "lm_head.weight" in state:
-            params["lm_head"] = _np_cast(state.pop("lm_head.weight"), dtype).T
+            params["lm_head"] = take.linear("lm_head.weight")
+            if cfg.lm_head_bias:
+                params["lm_head_b"] = take("lm_head.bias")
+        elif cfg.lm_head_bias:
+            raise ValueError("checkpoint declares a biased lm_head but ships no lm_head.weight")
         else:
             logger.warning("no lm_head.weight in checkpoint; tying to embeddings")
             cfg = dataclass_replace(cfg, tie_embeddings=True)
@@ -158,17 +467,5 @@ def load_hf_llama(
     return cfg, params
 
 
-def _np_cast(a, dtype: str) -> np.ndarray:
-    """Host-only dtype cast (ml_dtypes carries bf16 in numpy — no device
-    round-trip for multi-GB checkpoints)."""
-    import ml_dtypes
-
-    a = _to_np(a)
-    if a.dtype == np.dtype("V2") or str(a.dtype) == "bfloat16":
-        a = a.view(ml_dtypes.bfloat16).astype(np.float32)
-    target = {"bfloat16": ml_dtypes.bfloat16, "float32": np.float32, "float16": np.float16}[dtype]
-    return a.astype(target)
-
-
-def dataclass_replace(cfg, **kw):
-    return dataclasses.replace(cfg, **kw)
+# legacy name (round-1 API); the registry now handles every supported arch
+load_hf_llama = load_hf_model

@@ -59,15 +59,30 @@ class TransformerConfig:
     hidden_size: int = 512
     n_layers: int = 4
     n_heads: int = 8
-    n_kv_heads: Optional[int] = None  # None → MHA; < n_heads → GQA
+    n_kv_heads: Optional[int] = None  # None → MHA; < n_heads → GQA; 1 → MQA
     ffn_hidden_size: Optional[int] = None  # None → 4x (gelu) / 8/3x rounded (swiglu)
     max_seq_len: int = 2048
     norm: str = "rmsnorm"  # rmsnorm | layernorm
-    activation: str = "swiglu"  # swiglu | gelu
+    activation: str = "swiglu"  # swiglu | gelu (tanh approx) | gelu_exact (erf)
     position: str = "rope"  # rope | learned
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     tie_embeddings: bool = False
+    # --- per-arch variations (reference module_inject/containers/ +
+    # inference/v2/model_implementations/ breadth) -------------------------
+    # decoupled head dim (mistral-nemo / qwen3 style): projections become
+    # [h, n_heads*head_dim] with head_dim != h/n_heads
+    head_dim_override: Optional[int] = None
+    attn_qkv_bias: bool = False  # qwen2-style bias on q/k/v projections
+    attn_out_bias: bool = False  # phi-style bias on the output projection
+    mlp_bias: bool = False  # phi-style bias on MLP projections
+    lm_head_bias: bool = False  # phi ships a biased lm_head
+    # falcon/phi parallel block: x + attn(norm1(x)) + mlp(norm2(x)) — one
+    # residual stream, attention and MLP branches computed from pre-attn
+    # state (falcon-7b/phi share one norm: import the same weights into both)
+    parallel_block: bool = False
+    # phi partial rotary: rope applies to the first rope_frac*head_dim dims
+    rope_frac: float = 1.0
     dtype: str = "bfloat16"
     remat: bool = True
     # remat policy knob (reference activation_checkpointing config; VERDICT
@@ -83,6 +98,16 @@ class TransformerConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_loss_coef: float = 0.01
+    # Residual-MoE (reference moe/layer.py:29 use_residual, arXiv 2201.05596):
+    # out = expert_out·coef₀ + dense_mlp(x)·coef₁ with coef = softmax of a
+    # learned [h, 2] projection per token
+    moe_residual: bool = False
+    # qwen2-moe shared expert: a dense expert of this ffn width runs on every
+    # token, added as sigmoid(shared_gate(x))·shared_mlp(x) (0 → none)
+    moe_shared_expert_dim: int = 0
+    # renormalize top-k combine weights over surviving experts (mixtral /
+    # qwen2 norm_topk_prob=True); False keeps raw softmax mass (qwen1.5-moe)
+    moe_norm_topk_prob: bool = True
     vocab_parallel: bool = True  # shard embedding/lm_head vocab dim on `model`
     # sequence-parallel attention: "ulysses" (all-to-all head scatter) or
     # "ring" (ppermute blockwise — O(s/N) per-device memory, unbounded SP
@@ -107,6 +132,8 @@ class TransformerConfig:
 
     @property
     def head_dim(self) -> int:
+        if self.head_dim_override is not None:
+            return self.head_dim_override
         assert self.hidden_size % self.n_heads == 0
         return self.hidden_size // self.n_heads
 
@@ -160,7 +187,7 @@ def init_params(config: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
     h, d, nh, nkv = c.hidden_size, c.head_dim, c.n_heads, c.kv_heads
     ffn = c.ffn_dim
     L = c.n_layers
-    keys = iter(jax.random.split(key, 16))
+    keys = iter(jax.random.split(key, 32))
 
     def dense(k, shape, fan_in):
         return (jax.random.normal(k, shape, jnp.float32) * (1.0 / math.sqrt(fan_in))).astype(dtype)
@@ -176,6 +203,12 @@ def init_params(config: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
     if c.norm == "layernorm":
         layers["attn_norm_b"] = jnp.zeros((L, h), dtype)
         layers["mlp_norm_b"] = jnp.zeros((L, h), dtype)
+    if c.attn_qkv_bias:
+        layers["wq_b"] = jnp.zeros((L, nh * d), dtype)
+        layers["wk_b"] = jnp.zeros((L, nkv * d), dtype)
+        layers["wv_b"] = jnp.zeros((L, nkv * d), dtype)
+    if c.attn_out_bias:
+        layers["wo_b"] = jnp.zeros((L, h), dtype)
     if c.n_experts > 0:
         E = c.n_experts
         layers["router"] = dense(next(keys), (L, h, E), h)
@@ -183,11 +216,30 @@ def init_params(config: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
         layers["w_down"] = dense(next(keys), (L, E, ffn, h), ffn)
         if c.activation == "swiglu":
             layers["w_gate"] = dense(next(keys), (L, E, h, ffn), h)
+        if c.moe_residual:
+            # dense residual expert + 2-way mixing coefficient (layer.py:47)
+            layers["res_up"] = dense(next(keys), (L, h, ffn), h)
+            layers["res_down"] = dense(next(keys), (L, ffn, h), ffn)
+            if c.activation == "swiglu":
+                layers["res_gate"] = dense(next(keys), (L, h, ffn), h)
+            layers["res_coef"] = dense(next(keys), (L, h, 2), h)
+        if c.moe_shared_expert_dim > 0:
+            sd = c.moe_shared_expert_dim
+            layers["shared_up"] = dense(next(keys), (L, h, sd), h)
+            layers["shared_down"] = dense(next(keys), (L, sd, h), sd)
+            if c.activation == "swiglu":
+                layers["shared_gate"] = dense(next(keys), (L, h, sd), h)
+            layers["shared_gate_proj"] = dense(next(keys), (L, h, 1), h)
     else:
         layers["w_up"] = dense(next(keys), (L, h, ffn), h)
         layers["w_down"] = dense(next(keys), (L, ffn, h), ffn)
         if c.activation == "swiglu":
             layers["w_gate"] = dense(next(keys), (L, h, ffn), h)
+    if c.mlp_bias and c.n_experts == 0:
+        layers["w_up_b"] = jnp.zeros((L, ffn), dtype)
+        layers["w_down_b"] = jnp.zeros((L, h), dtype)
+        if c.activation == "swiglu":
+            layers["w_gate_b"] = jnp.zeros((L, ffn), dtype)
 
     params: Dict[str, Any] = {
         "embed": (jax.random.normal(next(keys), (c.vocab_size, h), jnp.float32) * 0.02).astype(dtype),
@@ -202,6 +254,8 @@ def init_params(config: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
         ).astype(dtype)
     if not c.tie_embeddings:
         params["lm_head"] = dense(next(keys), (h, c.vocab_size), h)
+        if c.lm_head_bias:
+            params["lm_head_b"] = jnp.zeros((c.vocab_size,), dtype)
     return params
 
 
@@ -223,6 +277,13 @@ def param_partition_specs(config: TransformerConfig) -> Dict[str, Any]:
     if c.norm == "layernorm":
         layers["attn_norm_b"] = P(None, None)
         layers["mlp_norm_b"] = P(None, None)
+    if c.attn_qkv_bias:
+        # column-parallel biases shard with the output dim
+        layers["wq_b"] = P(None, m)
+        layers["wk_b"] = P(None, m)
+        layers["wv_b"] = P(None, m)
+    if c.attn_out_bias:
+        layers["wo_b"] = P(None, None)  # row-parallel bias: replicated
     if c.n_experts > 0:
         from deepspeed_tpu.parallel.topology import EXPERT_AXIS
 
@@ -232,11 +293,28 @@ def param_partition_specs(config: TransformerConfig) -> Dict[str, Any]:
         layers["w_down"] = P(None, e, m, None)
         if c.activation == "swiglu":
             layers["w_gate"] = P(None, e, None, m)
+        if c.moe_residual:
+            layers["res_up"] = P(None, None, m)
+            layers["res_down"] = P(None, m, None)
+            if c.activation == "swiglu":
+                layers["res_gate"] = P(None, None, m)
+            layers["res_coef"] = P(None, None, None)
+        if c.moe_shared_expert_dim > 0:
+            layers["shared_up"] = P(None, None, m)
+            layers["shared_down"] = P(None, m, None)
+            if c.activation == "swiglu":
+                layers["shared_gate"] = P(None, None, m)
+            layers["shared_gate_proj"] = P(None, None, None)
     else:
         layers["w_up"] = P(None, None, m)
         layers["w_down"] = P(None, m, None)
         if c.activation == "swiglu":
             layers["w_gate"] = P(None, None, m)
+    if c.mlp_bias and c.n_experts == 0:
+        layers["w_up_b"] = P(None, m)
+        layers["w_down_b"] = P(None, None)
+        if c.activation == "swiglu":
+            layers["w_gate_b"] = P(None, m)
 
     vocab_spec = P(m, None) if c.vocab_parallel else P(None, None)
     specs: Dict[str, Any] = {
@@ -250,6 +328,8 @@ def param_partition_specs(config: TransformerConfig) -> Dict[str, Any]:
         specs["pos_embed"] = P(None, None)
     if not c.tie_embeddings:
         specs["lm_head"] = P(None, m) if c.vocab_parallel else P(None, None)
+        if c.lm_head_bias:
+            specs["lm_head_b"] = P(m) if c.vocab_parallel else P(None)
     return specs
 
 
@@ -294,18 +374,26 @@ def _norm(x, w, b, kind, eps):
     return fused_layer_norm(x, w, b if b is not None else jnp.zeros_like(w), eps)
 
 
-def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
-    """Rotary embedding on [b, h, s, d] given positions [b, s] or [s]."""
+def _rope(x: jax.Array, positions: jax.Array, theta: float, frac: float = 1.0) -> jax.Array:
+    """Rotary embedding on [b, h, s, d] given positions [b, s] or [s].
+
+    frac < 1 (phi partial rotary, HF partial_rotary_factor): only the first
+    ``frac*d`` dims rotate; the tail passes through unrotated."""
     d = x.shape[-1]
-    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    rot = d if frac >= 1.0 else (int(d * frac) // 2) * 2
+    tail = None
+    if rot < d:
+        x, tail = x[..., :rot], x[..., rot:]
+    freqs = 1.0 / (theta ** (jnp.arange(0, rot, 2, dtype=jnp.float32) / rot))
     if positions.ndim == 1:
         positions = positions[None, :]
-    angles = positions[..., None].astype(jnp.float32) * freqs  # [b, s, d/2]
-    cos = jnp.cos(angles)[:, None]  # [b, 1, s, d/2]
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [b, s, rot/2]
+    cos = jnp.cos(angles)[:, None]  # [b, 1, s, rot/2]
     sin = jnp.sin(angles)[:, None]
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
-    return out.astype(x.dtype)
+    out = out.astype(tail.dtype if tail is not None else x.dtype)
+    return out if tail is None else jnp.concatenate([out, tail], axis=-1)
 
 
 def _act_constraint(x, seq_sharded=True):
@@ -319,12 +407,17 @@ def _attention_block(c: TransformerConfig, lp, x, positions, segment_ids, kv_cac
     """Self-attention for one layer. x: [b, s, h]."""
     b, s, h = x.shape
     nh, nkv, d = c.n_heads, c.kv_heads, c.head_dim
-    q = (x @ lp["wq"]).reshape(b, s, nh, d).transpose(0, 2, 1, 3)
-    k = (x @ lp["wk"]).reshape(b, s, nkv, d).transpose(0, 2, 1, 3)
-    v = (x @ lp["wv"]).reshape(b, s, nkv, d).transpose(0, 2, 1, 3)
+    q = x @ lp["wq"]
+    k = x @ lp["wk"]
+    v = x @ lp["wv"]
+    if c.attn_qkv_bias:
+        q, k, v = q + lp["wq_b"], k + lp["wk_b"], v + lp["wv_b"]
+    q = q.reshape(b, s, nh, d).transpose(0, 2, 1, 3)
+    k = k.reshape(b, s, nkv, d).transpose(0, 2, 1, 3)
+    v = v.reshape(b, s, nkv, d).transpose(0, 2, 1, 3)
     if c.position == "rope":
-        q = _rope(q, positions, c.rope_theta)
-        k = _rope(k, positions, c.rope_theta)
+        q = _rope(q, positions, c.rope_theta, c.rope_frac)
+        k = _rope(k, positions, c.rope_theta, c.rope_frac)
 
     new_cache = None
     if kv_cache is not None:
@@ -355,7 +448,10 @@ def _attention_block(c: TransformerConfig, lp, x, positions, segment_ids, kv_cac
         else:
             out = attention_op(q, k, v, causal=True, segment_ids=segment_ids)
     out = out.transpose(0, 2, 1, 3).reshape(b, s, nh * d)
-    return out @ lp["wo"], new_cache
+    out = out @ lp["wo"]
+    if c.attn_out_bias:
+        out = out + lp["wo_b"]
+    return out, new_cache
 
 
 def _mlp_block(c: TransformerConfig, lp, x):
@@ -364,11 +460,19 @@ def _mlp_block(c: TransformerConfig, lp, x):
 
         return moe_mlp(c, lp, x)
     up = x @ lp["w_up"]
+    if c.mlp_bias:
+        up = up + lp["w_up_b"]
     if c.activation == "swiglu":
-        act = jax.nn.silu(x @ lp["w_gate"]) * up
+        gate = x @ lp["w_gate"]
+        if c.mlp_bias:
+            gate = gate + lp["w_gate_b"]
+        act = jax.nn.silu(gate) * up
     else:
-        act = jax.nn.gelu(up)
-    return act @ lp["w_down"], jnp.float32(0.0)
+        act = jax.nn.gelu(up, approximate=c.activation != "gelu_exact")
+    out = act @ lp["w_down"]
+    if c.mlp_bias:
+        out = out + lp["w_down_b"]
+    return out, jnp.float32(0.0)
 
 
 def _dequant_tree(lp, dtype):
@@ -407,6 +511,12 @@ def _layer(c: TransformerConfig, lp, x, positions, segment_ids):
     )
     a = _norm(x, lp["attn_norm"], lp.get("attn_norm_b"), c.norm, c.norm_eps)
     attn_out, _ = _attention_block(c, lp, a, positions, segment_ids)
+    if c.parallel_block:
+        # falcon/phi: both branches from the pre-attention state, one residual
+        m = _norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"), c.norm, c.norm_eps)
+        mlp_out, aux_loss = _mlp_block(c, lp, m)
+        x = x + attn_out + mlp_out
+        return _act_constraint(x), aux_loss
     x = x + attn_out
     x = _act_constraint(x)
     m = _norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"), c.norm, c.norm_eps)
@@ -458,6 +568,13 @@ def _lm_head_matrix(params, config: TransformerConfig, dtype):
     return _dequant_tree(params["lm_head"], dtype)
 
 
+def _apply_lm_head(params, x, config: TransformerConfig):
+    logits = x @ _lm_head_matrix(params, config, x.dtype)
+    if config.lm_head_bias and not config.tie_embeddings:
+        logits = logits + params["lm_head_b"].astype(logits.dtype)
+    return logits
+
+
 def forward(
     params: Dict[str, Any],
     tokens: jax.Array,
@@ -467,8 +584,7 @@ def forward(
 ) -> Tuple[jax.Array, jax.Array]:
     """Full forward: tokens [b, s] int32 → (logits [b, s, vocab], aux_loss)."""
     x, aux = forward_hidden(params, tokens, config, positions, segment_ids)
-    logits = x @ _lm_head_matrix(params, config, x.dtype)
-    return logits, aux
+    return _apply_lm_head(params, x, config), aux
 
 
 def decode_step(params, tokens, config, kv_caches, positions):
@@ -490,6 +606,10 @@ def decode_step(params, tokens, config, kv_caches, positions):
         lp = _dequant_tree(lp, DTYPES[c.dtype])
         a = _norm(x, lp["attn_norm"], lp.get("attn_norm_b"), c.norm, c.norm_eps)
         attn_out, new_cache = _attention_block(c, lp, a, positions, None, kv_cache=cache)
+        if c.parallel_block:
+            m = _norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"), c.norm, c.norm_eps)
+            mlp_out, _ = _mlp_block(c, lp, m)
+            return x + attn_out + mlp_out, new_cache
         x = x + attn_out
         m = _norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"), c.norm, c.norm_eps)
         mlp_out, _ = _mlp_block(c, lp, m)
@@ -497,11 +617,7 @@ def decode_step(params, tokens, config, kv_caches, positions):
 
     x, new_caches = jax.lax.scan(scan_body, x, (params["layers"], kv_caches))
     x = _norm(x, params["final_norm"], params.get("final_norm_b"), c.norm, c.norm_eps)
-    if c.tie_embeddings:
-        logits = x @ params["embed"].astype(x.dtype).T
-    else:
-        logits = x @ _dequant_tree(params["lm_head"], x.dtype)
-    return logits, new_caches
+    return _apply_lm_head(params, x, c), new_caches
 
 
 def init_kv_cache(config: TransformerConfig, batch: int, max_len: int):
@@ -566,10 +682,7 @@ def lm_head_loss(params, x, labels, mask, config: TransformerConfig, aux=None):
     shared by the dense and pipelined paths."""
     c = config
     x = _norm(x, params["final_norm"], params.get("final_norm_b"), c.norm, c.norm_eps)
-    if c.tie_embeddings:
-        logits = x @ params["embed"].astype(x.dtype).T
-    else:
-        logits = x @ _dequant_tree(params["lm_head"], x.dtype)
+    logits = _apply_lm_head(params, x, c)
     loss = nll_loss(logits, labels, mask)
     if c.n_experts > 0 and aux is not None:
         loss = loss + c.moe_aux_loss_coef * aux
@@ -584,7 +697,14 @@ def make_loss_fn(config: TransformerConfig):
 
     def loss_fn(params, batch):
         inputs, labels, mask, positions, segment_ids = split_lm_batch(batch)
-        if config.fused_ce and jax.default_backend() == "tpu" and get_topology().world_size == 1:
+        # fused/tiled heads feed the bare head matrix to the kernel — a biased
+        # lm_head (phi) falls through to the dense path
+        if (
+            config.fused_ce
+            and not config.lm_head_bias
+            and jax.default_backend() == "tpu"
+            and get_topology().world_size == 1
+        ):
             # Pallas fused head+CE: logits never materialize in HBM
             # (ops/fused_ce.py). Single-device only: pallas_call is opaque to
             # GSPMD, and the head matmul wants the model-axis sharding on
@@ -609,7 +729,7 @@ def make_loss_fn(config: TransformerConfig):
                 flat_m = jnp.concatenate([flat_m, jnp.zeros((pad,), flat_m.dtype)])
             per_row = fused_ce_loss(flat_x, w, flat_l)
             loss = jnp.sum(per_row * flat_m) / jnp.maximum(jnp.sum(flat_m), 1.0)
-        elif config.loss_tiles > 1:
+        elif config.loss_tiles > 1 and not config.lm_head_bias:
             from deepspeed_tpu.parallel.sequence.tiled import tiled_logits_loss
 
             x, aux = forward_hidden(params, inputs, config, positions=positions, segment_ids=segment_ids)

@@ -12,6 +12,7 @@ buffers constrained to the ``expert`` mesh axis make GSPMD emit the
 all-to-all over ICI. Static capacity keeps every shape compile-time constant.
 """
 
+from deepspeed_tpu.parallel.moe.mappings import drop_tokens, gather_tokens
 from deepspeed_tpu.parallel.moe.sharded_moe import (
     MoE,
     TopKGate,
@@ -21,4 +22,13 @@ from deepspeed_tpu.parallel.moe.sharded_moe import (
     topkgating,
 )
 
-__all__ = ["MoE", "TopKGate", "moe_mlp", "top1gating", "top2gating", "topkgating"]
+__all__ = [
+    "MoE",
+    "TopKGate",
+    "drop_tokens",
+    "gather_tokens",
+    "moe_mlp",
+    "top1gating",
+    "top2gating",
+    "topkgating",
+]

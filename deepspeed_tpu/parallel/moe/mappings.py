@@ -1,0 +1,53 @@
+"""TP↔EP tensor remaps (reference ``deepspeed/moe/mappings.py``).
+
+The reference moves activations between tensor-parallel and expert-parallel
+layouts with explicit all-gather / drop autograd functions
+(``gather_tokens``/``drop_tokens``, moe/mappings.py): before an MoE block the
+sequence-partitioned hidden states of the TP group are gathered so the gate
+sees full sequences; after it each TP rank drops back to its slice.
+
+TPU-native form: both directions are *relayouts of the same logical array* —
+a ``with_sharding_constraint`` that moves the ``model`` mesh axis onto or off
+the token dimension. GSPMD inserts the all-gather (gather) or is free to keep
+only the local slice (drop); under ``jit`` the pair composes away entirely
+when a producer/consumer agrees on layout, which the reference's explicit
+collectives cannot do. Gradients follow automatically from the sharding
+(an all-gather's transpose is a reduce-scatter) — no hand-written autograd
+function needed.
+"""
+
+import jax
+
+from deepspeed_tpu.parallel.topology import MODEL_AXIS, constrain, get_topology
+
+
+def _axis_spec(x, dim: int, axis):
+    spec = [None] * x.ndim
+    spec[dim] = axis
+    return spec
+
+
+def gather_tokens(x: jax.Array, dim: int = 1) -> jax.Array:
+    """TP-sharded tokens → replicated over the ``model`` axis (reference
+    ``gather_tokens``, moe/mappings.py): every TP rank sees the full ``dim``.
+
+    Identity when no model axis is live (reference does the same for
+    tp_world_size == 1)."""
+    if get_topology().model_parallel_size <= 1:
+        return x
+    return constrain(x, *_axis_spec(x, dim, None))
+
+
+def drop_tokens(x: jax.Array, dim: int = 1) -> jax.Array:
+    """Replicated tokens → sharded over the ``model`` axis along ``dim``
+    (reference ``drop_tokens``): each TP rank keeps its 1/tp slice, so work
+    after the MoE block is not duplicated across the TP group."""
+    topo = get_topology()
+    if topo.model_parallel_size <= 1:
+        return x
+    if x.shape[dim] % topo.model_parallel_size != 0:
+        raise ValueError(
+            f"drop_tokens: dim {dim} of size {x.shape[dim]} is not divisible "
+            f"by the model-parallel degree {topo.model_parallel_size}"
+        )
+    return constrain(x, *_axis_spec(x, dim, MODEL_AXIS))

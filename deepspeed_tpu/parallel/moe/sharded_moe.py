@@ -116,6 +116,7 @@ def topkgating(
     min_capacity: int = 4,
     drop_tokens: bool = True,
     drop_policy: str = "probs",
+    normalize: bool = True,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Reference topkgating (sharded_moe.py:374): general top-k with
     normalized combine weights and per-expert capacity dropping.
@@ -180,7 +181,8 @@ def topkgating(
         w_j = topk_vals[:, j] * kept_j
         kept_total = kept_total + w_j
         combine = combine + w_j[:, None, None] * mask_j[:, :, None] * _one_hot(pos_j, c)[:, None, :]
-    combine = combine / jnp.maximum(kept_total, 1e-9)[:, None, None]
+    if normalize:
+        combine = combine / jnp.maximum(kept_total, 1e-9)[:, None, None]
     dispatch = combine > 0
     return l_aux, combine, dispatch, exp_counts
 
@@ -237,7 +239,10 @@ def moe_mlp(config, lp, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
     tokens = x.reshape(b * s, h)
     logits = tokens @ lp["router"]
     l_aux, combine, dispatch, _counts = topkgating(
-        logits, k=config.moe_top_k, capacity_factor=config.moe_capacity_factor
+        logits,
+        k=config.moe_top_k,
+        capacity_factor=config.moe_capacity_factor,
+        normalize=getattr(config, "moe_norm_topk_prob", True),
     )
     # dispatch: [t, e, c] bool; tokens: [t, h] → expert buffers [e, c, h]
     expert_in = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), tokens)
@@ -249,13 +254,33 @@ def moe_mlp(config, lp, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
         gate = jnp.einsum("ech,ehf->ecf", expert_in, lp["w_gate"])
         act = jax.nn.silu(gate) * up
     else:
-        act = jax.nn.gelu(up)
+        act = jax.nn.gelu(up, approximate=config.activation != "gelu_exact")
     act = _expert_sharded(act, P(EXPERT_AXIS, None, MODEL_AXIS))
     expert_out = jnp.einsum("ecf,efh->ech", act, lp["w_down"])
     expert_out = _expert_sharded(expert_out, P(EXPERT_AXIS, None, None))
 
     # combine back to tokens (reverse all-to-all via resharding)
     out = jnp.einsum("tec,ech->th", combine.astype(x.dtype), expert_out)
+
+    def _dense_mlp(prefix):
+        up = tokens @ lp[f"{prefix}_up"]
+        if config.activation == "swiglu":
+            act = jax.nn.silu(tokens @ lp[f"{prefix}_gate"]) * up
+        else:
+            act = jax.nn.gelu(up, approximate=config.activation != "gelu_exact")
+        return act @ lp[f"{prefix}_down"]
+
+    if getattr(config, "moe_residual", False) and "res_coef" in lp:
+        # Residual-MoE (reference moe/layer.py:29,47 — arXiv 2201.05596): a
+        # dense MLP runs on every token; a learned 2-way softmax coefficient
+        # mixes it with the (possibly dropped) expert output
+        coef = jax.nn.softmax((tokens @ lp["res_coef"]).astype(jnp.float32), axis=-1)
+        out = out * coef[:, 0:1].astype(out.dtype) + _dense_mlp("res") * coef[:, 1:2].astype(out.dtype)
+    if getattr(config, "moe_shared_expert_dim", 0) > 0 and "shared_up" in lp:
+        # qwen2-moe shared expert: always-on dense expert scaled by a
+        # sigmoid gate (HF Qwen2MoeSparseMoeBlock.shared_expert_gate)
+        gate = jax.nn.sigmoid((tokens @ lp["shared_gate_proj"]).astype(jnp.float32))
+        out = out + gate.astype(out.dtype) * _dense_mlp("shared")
     return out.reshape(b, s, h), l_aux
 
 

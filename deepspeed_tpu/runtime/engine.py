@@ -1468,6 +1468,12 @@ class DeepSpeedEngine:
         opt_payload = (
             self._host_opt.as_state_tree() if self._host_opt is not None else self.opt_state
         )
+        params_payload = self.params
+        canon = getattr(self.optimizer, "canonicalize_checkpoint_state", None)
+        if canon is not None and self._host_opt is None:
+            # 0/1 Adam phase-2: strip worker-0 drift so the checkpoint holds
+            # the last-sync canonical params (load re-localizes per worker)
+            params_payload, opt_payload = canon(params_payload, opt_payload)
         writer = self.config.checkpoint.writer
         if writer:
             # pluggable engine path (reference checkpoint_engine/): async
@@ -1479,7 +1485,7 @@ class DeepSpeedEngine:
             eng.create(tag)
             eng.save(
                 {
-                    "params": self.params,
+                    "params": params_payload,
                     "opt_state": opt_payload,
                     "scaler_state": self.scaler_state,
                     "__meta__": state,
@@ -1495,7 +1501,7 @@ class DeepSpeedEngine:
         _save(
             save_dir,
             tag,
-            params=self.params,
+            params=params_payload,
             opt_state=opt_payload,
             scaler_state=self.scaler_state,
             client_state=state,
@@ -1553,6 +1559,8 @@ class DeepSpeedEngine:
             if "scaler_state" in data:
                 self.scaler_state = self._restore_tree(self.scaler_state, data["scaler_state"])
             client_state = data.get("__meta__", {})
+            if load_optimizer_states and not load_module_only:
+                self._maybe_relocalize_params()
             self._restore_client_state(client_state, load_module_only, load_lr_scheduler_states)
             return os.path.join(load_dir, tag), client_state
         from deepspeed_tpu.checkpoint.engine import load_checkpoint as _load
@@ -1581,9 +1589,47 @@ class DeepSpeedEngine:
                 self.opt_state = out["opt_state"]
         if out.get("scaler_state") is not None:
             self.scaler_state = out["scaler_state"]
+        if want_opt and out.get("opt_state") is not None:
+            self._maybe_relocalize_params()
         client_state = out.get("client_state", {})
         self._restore_client_state(client_state, load_module_only, load_lr_scheduler_states)
         return out.get("load_path", load_dir), client_state
+
+    def _maybe_relocalize_params(self):
+        """Inverse of checkpoint canonicalization for 0/1 Adam: worker w's
+        params/master = canonical + u[w], rebuilt with one shard_map over the
+        data axis (out specs replicated + check_vma=False — the same
+        physically-divergent convention as the 1-bit train step)."""
+        canon = getattr(self.optimizer, "canonicalize_checkpoint_state", None)
+        if canon is None or self._host_opt is not None or not hasattr(self.opt_state, "inner"):
+            return
+        from jax.sharding import PartitionSpec as P
+
+        from deepspeed_tpu.parallel.topology import DATA_AXIS
+
+        mesh = self.topo.mesh
+        pspec = jax.tree.map(lambda _: P(), self.params)
+        mspec = jax.tree.map(lambda _: P(), self.opt_state.master)
+        u_specs = jax.tree.map(lambda _: P(DATA_AXIS), self.opt_state.inner.u)
+
+        def inner(params, master, u):
+            new_master = jax.tree.map(lambda m, uu: m + uu[0], master, u)
+            new_params = jax.tree.map(lambda p, m: m.astype(p.dtype), params, new_master)
+            return new_params, new_master
+
+        fn = jax.shard_map(
+            inner,
+            mesh=mesh,
+            in_specs=(pspec, mspec, u_specs),
+            out_specs=(pspec, mspec),
+            axis_names={DATA_AXIS},
+            check_vma=False,
+        )
+        new_params, new_master = jax.jit(fn)(
+            self.params, self.opt_state.master, self.opt_state.inner.u
+        )
+        self.params = new_params
+        self.opt_state = self.opt_state._replace(master=new_master)
 
     def _restore_client_state(self, client_state, load_module_only, load_lr_scheduler_states):
         """Counter + LR-schedule restore shared by the orbax and writer-engine

@@ -226,6 +226,30 @@ def onebit_adam_collective_transform(
     return optax.GradientTransformation(init, update)
 
 
+def zero_one_canonicalize_state(params, opt_state):
+    """Checkpoint-time canonicalization for 0/1 Adam (host-side).
+
+    During phase-2 local rounds params/master genuinely diverge per worker;
+    the engine's replicated fetch collapses them to device 0's copy, which
+    includes that worker's accumulated drift ``u[0]``. Subtracting it
+    recovers the last-sync canonical state — identical on every worker —
+    which is what the checkpoint must carry. The per-worker ``u``/``mu``
+    leaves are sharded over data ([W] leading dim) and serialize faithfully;
+    on load the engine re-localizes worker w's params as canonical + u[w]
+    (``DeepSpeedEngine._maybe_relocalize_params``), making mid-interval
+    save/resume exact."""
+    u0 = jax.tree.map(lambda x: np.asarray(x[0]), opt_state.inner.u)
+    new_master = jax.tree.map(
+        lambda m, u: (np.asarray(m, np.float32) - u).astype(np.asarray(m).dtype),
+        opt_state.master,
+        u0,
+    )
+    new_params = jax.tree.map(
+        lambda p, m: jnp.asarray(m).astype(p.dtype), params, new_master
+    )
+    return new_params, opt_state._replace(master=new_master)
+
+
 def onebit_state_partition_specs(state_shapes, data_axis: str):
     """PartitionSpec tree for an OptState(master, <1-bit family state>):
     everything replicated except the per-worker error buffers, which shard

@@ -61,6 +61,10 @@ class DeepSpeedOptimizer:
         # partitioning (per-worker error buffers shard over data).
         self.collective_grad_exchange = False
         self.state_partition_specs: Optional[Callable] = None
+        # set for optimizers whose params genuinely diverge per worker
+        # between sync rounds (0/1 Adam phase 2): checkpoint-time
+        # (params, opt_state) -> canonical (params, opt_state)
+        self.canonicalize_checkpoint_state: Optional[Callable] = None
 
     # imperative LR hook used by the reference-style schedulers
     def set_lr(self, lr):
@@ -277,6 +281,13 @@ def build_optimizer(
 
             opt.collective_grad_exchange = True
             opt.state_partition_specs = lambda shapes: _specs(shapes, _DA)
+            if name == ZERO_ONE_ADAM_OPTIMIZER:
+                # phase-2 local rounds make params/master per-worker; the
+                # engine canonicalizes checkpoints (drift u[0] subtracted)
+                # and re-localizes on load (see zero_one_canonicalize_state)
+                from deepspeed_tpu.runtime.fp16.onebit import zero_one_canonicalize_state
+
+                opt.canonicalize_checkpoint_state = zero_one_canonicalize_state
     return opt
 
 

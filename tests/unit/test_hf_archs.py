@@ -1,0 +1,247 @@
+"""Per-architecture HF import parity (VERDICT round-2 missing #1).
+
+Analogue of the reference's per-arch kernel-injection containers + v2
+model_implementations coverage (module_inject/containers/,
+inference/v2/model_implementations/{qwen_v2,qwen_v2_moe,falcon,phi,phi3}):
+each supported architecture gets a tiny random HF checkpoint written with
+``transformers`` and is checked for fp32 logits parity, a greedy decode, and
+a train step through ``deepspeed_tpu.initialize``.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models import load_hf_model, make_loss_fn
+from deepspeed_tpu.models.transformer import forward
+
+transformers = pytest.importorskip("transformers")
+import torch  # noqa: E402
+
+
+def _save_tiny(tmp_path_factory, name, cfg_cls, model_cls, **cfg_kw):
+    torch.manual_seed(0)
+    cfg = cfg_cls(**cfg_kw)
+    model = model_cls(cfg).eval()
+    path = tmp_path_factory.mktemp(name)
+    model.save_pretrained(path)
+    return model, str(path)
+
+
+@pytest.fixture(scope="module")
+def tiny_qwen2(tmp_path_factory):
+    return _save_tiny(
+        tmp_path_factory, "hf_qwen2",
+        transformers.Qwen2Config, transformers.Qwen2ForCausalLM,
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, tie_word_embeddings=False,
+    )
+
+
+@pytest.fixture(scope="module")
+def tiny_qwen2_moe(tmp_path_factory):
+    return _save_tiny(
+        tmp_path_factory, "hf_qwen2_moe",
+        transformers.Qwen2MoeConfig, transformers.Qwen2MoeForCausalLM,
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        moe_intermediate_size=48, shared_expert_intermediate_size=96,
+        num_experts=4, num_experts_per_tok=2, norm_topk_prob=False,
+        decoder_sparse_step=1, mlp_only_layers=[],
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, tie_word_embeddings=False,
+        output_router_logits=False,
+    )
+
+
+@pytest.fixture(scope="module")
+def tiny_falcon(tmp_path_factory):
+    # falcon-7b shape: multi-query, parallel block, single shared layernorm
+    return _save_tiny(
+        tmp_path_factory, "hf_falcon",
+        transformers.FalconConfig, transformers.FalconForCausalLM,
+        vocab_size=256, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, multi_query=True, parallel_attn=True,
+        new_decoder_architecture=False, bias=False, alibi=False,
+        max_position_embeddings=128,
+    )
+
+
+@pytest.fixture(scope="module")
+def tiny_falcon40b_style(tmp_path_factory):
+    # falcon-40b shape: GQA with interleaved fused qkv, dual layernorms
+    return _save_tiny(
+        tmp_path_factory, "hf_falcon40",
+        transformers.FalconConfig, transformers.FalconForCausalLM,
+        vocab_size=256, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_kv_heads=2, new_decoder_architecture=True,
+        bias=False, alibi=False, max_position_embeddings=128,
+    )
+
+
+@pytest.fixture(scope="module")
+def tiny_falcon_mha(tmp_path_factory):
+    # legacy MHA falcon (falcon-rw shape): per-head [q_i,k_i,v_i] interleave
+    return _save_tiny(
+        tmp_path_factory, "hf_falcon_mha",
+        transformers.FalconConfig, transformers.FalconForCausalLM,
+        vocab_size=256, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, multi_query=False, parallel_attn=False,
+        new_decoder_architecture=False, bias=True, alibi=False,
+        max_position_embeddings=128,
+    )
+
+
+@pytest.fixture(scope="module")
+def tiny_phi(tmp_path_factory):
+    return _save_tiny(
+        tmp_path_factory, "hf_phi",
+        transformers.PhiConfig, transformers.PhiForCausalLM,
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        partial_rotary_factor=0.5, max_position_embeddings=128,
+        tie_word_embeddings=False,
+    )
+
+
+@pytest.fixture(scope="module")
+def tiny_mistral_headdim(tmp_path_factory):
+    # mistral-nemo shape: head_dim decoupled from hidden/num_heads
+    return _save_tiny(
+        tmp_path_factory, "hf_mistral_hd",
+        transformers.MistralConfig, transformers.MistralForCausalLM,
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=24, max_position_embeddings=128, tie_word_embeddings=False,
+    )
+
+
+@pytest.fixture(scope="module")
+def tiny_phi3(tmp_path_factory):
+    return _save_tiny(
+        tmp_path_factory, "hf_phi3",
+        transformers.Phi3Config, transformers.Phi3ForCausalLM,
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, tie_word_embeddings=False,
+        pad_token_id=0, bos_token_id=1, eos_token_id=2,
+    )
+
+
+_FIXTURES = {
+    "qwen2": "tiny_qwen2",
+    "qwen2_moe": "tiny_qwen2_moe",
+    "falcon": "tiny_falcon",
+    "falcon40b": "tiny_falcon40b_style",
+    "falcon_mha": "tiny_falcon_mha",
+    "mistral_headdim": "tiny_mistral_headdim",
+    "phi": "tiny_phi",
+    "phi3": "tiny_phi3",
+}
+
+
+def _logits_parity(hf_model, path, atol=2e-3):
+    cfg, params = load_hf_model(path, dtype="float32")
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, 256, size=(2, 16)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    ours, _ = forward(params, jnp.asarray(tokens), cfg)
+    np.testing.assert_allclose(np.asarray(ours, np.float32), ref, atol=atol, rtol=2e-3)
+    return cfg, params
+
+
+@pytest.mark.parametrize("arch", sorted(_FIXTURES))
+def test_logits_parity(arch, request):
+    hf_model, path = request.getfixturevalue(_FIXTURES[arch])
+    cfg, _ = _logits_parity(hf_model, path)
+    if arch == "qwen2":
+        assert cfg.attn_qkv_bias and not cfg.parallel_block
+    elif arch == "qwen2_moe":
+        assert cfg.n_experts == 4 and cfg.moe_shared_expert_dim == 96
+        assert not cfg.moe_norm_topk_prob
+    elif arch == "falcon":
+        assert cfg.parallel_block and cfg.kv_heads == 1  # MQA
+    elif arch == "falcon40b":
+        assert cfg.kv_heads == 2  # GQA via interleaved fused qkv
+    elif arch == "falcon_mha":
+        # sequential block, biased projections, per-head qkv interleave
+        assert not cfg.parallel_block and cfg.kv_heads == 4 and cfg.attn_qkv_bias
+    elif arch == "phi":
+        assert cfg.parallel_block and cfg.rope_frac == 0.5 and cfg.lm_head_bias
+    elif arch == "phi3":
+        assert not cfg.attn_qkv_bias  # fused qkv_proj split cleanly
+    elif arch == "mistral_headdim":
+        assert cfg.head_dim_override == 24 and cfg.head_dim == 24  # != 64/4
+
+
+@pytest.mark.parametrize("arch", ["qwen2_moe", "falcon", "phi"])
+def test_greedy_decode_parity(arch, request):
+    hf_model, path = request.getfixturevalue(_FIXTURES[arch])
+    cfg, params = load_hf_model(path, dtype="float32")
+    prompt = np.array([[5, 17, 42, 7]], dtype=np.int32)
+    with torch.no_grad():
+        hf_out = hf_model.generate(
+            torch.tensor(prompt, dtype=torch.long), max_new_tokens=8, do_sample=False
+        ).numpy()[0]
+    toks = prompt.copy()
+    for _ in range(8):
+        logits, _ = forward(params, jnp.asarray(toks), cfg)
+        nxt = int(jnp.argmax(logits[0, -1]))
+        toks = np.concatenate([toks, [[nxt]]], axis=1)
+    np.testing.assert_array_equal(toks[0], hf_out)
+
+
+@pytest.mark.parametrize("arch", ["qwen2", "qwen2_moe", "falcon", "phi", "phi3"])
+def test_train_step_through_initialize(arch, request, devices8):
+    _, path = request.getfixturevalue(_FIXTURES[arch])
+    cfg, params = load_hf_model(path, dtype="float32")
+    mesh = {"data": 4, "expert": 2} if cfg.n_experts else {"data": 8}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=make_loss_fn(cfg),
+        model_parameters=params,
+        config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 2},
+            "mesh": mesh,
+            "steps_per_print": 1000,
+        },
+    )
+    toks = np.random.default_rng(0).integers(0, 256, size=(8, 33)).astype(np.int32)
+    losses = [float(engine.train_batch(batch={"input_ids": toks})) for _ in range(4)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
+
+
+@pytest.mark.parametrize("arch", ["qwen2", "phi"])
+def test_generate_through_inference_engine(arch, request):
+    """init_inference path: checkpoint dir → v1 engine → generate."""
+    _, path = request.getfixturevalue(_FIXTURES[arch])
+    from deepspeed_tpu.inference.v2.engine_factory import build_engine_v1
+
+    engine = build_engine_v1(path, {"dtype": "float32", "max_out_tokens": 16})
+    prompt = np.array([[5, 17, 42, 7]], dtype=np.int32)
+    out = engine.generate(prompt, max_new_tokens=6)
+    out = np.asarray(out)
+    assert out.shape[1] >= prompt.shape[1] + 6
+    assert (out[:, : prompt.shape[1]] == prompt).all()
+
+
+def test_engine_factory_dispatch(tiny_qwen2):
+    _, path = tiny_qwen2
+    arch = json.load(open(f"{path}/config.json"))["architectures"][0]
+    assert arch == "Qwen2ForCausalLM"
+    from deepspeed_tpu.inference.v2.engine_factory import load_model_implementation
+
+    cfg, params = load_model_implementation(path, dtype="float32")
+    assert cfg.attn_qkv_bias and params["layers"]["wq_b"].shape == (2, 64)
+
+
+def test_unsupported_arch_raises(tmp_path):
+    (tmp_path / "config.json").write_text(json.dumps({"model_type": "mamba", "architectures": ["MambaForCausalLM"]}))
+    with pytest.raises(ValueError, match="model_type"):
+        load_hf_model(str(tmp_path))
