@@ -118,6 +118,16 @@ class TransformerConfig:
     # ~b*s*vocab bytes of activations at the cost of recomputing the head
     # matmul in backward (~1pp MFU at 32k vocab); enable when memory-bound
     loss_tiles: int = 0
+    # ZeRO-Infinity weight streaming (reference partition_parameters.py
+    # remote_device + partitioned_param_coordinator prefetch): params rest in
+    # pinned_host; each scan iteration stages ONE layer's weights into HBM
+    # (XLA's latency-hiding scheduler overlaps the copy with compute — the
+    # reference's prefetch_bucket_size machinery for free), remat re-stages
+    # them in backward, and weight grads stream back to host via the staging
+    # vjp. HBM then holds one layer + activations, so models far larger than
+    # HBM train on one chip. Requires offload_param + a TPU backend; no-op
+    # elsewhere.
+    weight_stream: bool = False
 
     def __post_init__(self):
         if self.seq_impl not in ("ulysses", "ring"):
@@ -364,6 +374,52 @@ def remat_policy(name: str):
     return policies[name]
 
 
+# ---------------------------------------------------------------------------
+# weight streaming (ZeRO-Infinity tier)
+# ---------------------------------------------------------------------------
+@jax.custom_vjp
+def _stage_to_device(w):
+    """pinned_host → HBM copy whose cotangent flows back to host, so weight
+    gradients of streamed layers accumulate in host memory, never HBM."""
+    return jax.device_put(w, jax.memory.Space.Device)
+
+
+def _stage_fwd(w):
+    return _stage_to_device(w), None
+
+
+def _stage_bwd(_, g):
+    import os
+
+    if os.environ.get("DSTPU_STREAM_GRADS_DEVICE", "0") == "1":
+        # debug/bisect knob: leave weight grads in HBM (needs grads to fit)
+        return (g,)
+    return (jax.device_put(g, jax.memory.Space.Host),)
+
+
+_stage_to_device.defvjp(_stage_fwd, _stage_bwd)
+
+
+def _stream_active(c: TransformerConfig) -> bool:
+    return c.weight_stream and jax.default_backend() == "tpu"
+
+
+def _maybe_stage(w):
+    """Stage only leaves that actually live in host memory (the engine keeps
+    small leaves — norm vectors, biases — device-resident: their [1, h] scan
+    slices violate libtpu's >=8-sublane host-DUS requirement, and at a few
+    hundred KB they cost nothing in HBM)."""
+    try:
+        space = jax.typeof(w).memory_space
+    except Exception:
+        return _stage_to_device(w)
+    return _stage_to_device(w) if space == jax.memory.Space.Host else w
+
+
+def _stage_tree(tree):
+    return jax.tree.map(_maybe_stage, tree)
+
+
 def _norm(x, w, b, kind, eps):
     """Delegates to the ops layer (single definition; Pallas on TPU)."""
     from deepspeed_tpu.ops.normalization import fused_layer_norm, rms_norm
@@ -541,14 +597,22 @@ def forward_hidden(
     """
     c = config
     b, s = tokens.shape
+    stream = _stream_active(c)
     if positions is None:
         positions = jnp.arange(s, dtype=jnp.int32)
-    x = params["embed"].astype(DTYPES[c.dtype])[tokens]
+    embed = _maybe_stage(params["embed"]) if stream else params["embed"]
+    x = embed.astype(DTYPES[c.dtype])[tokens]
     if c.position == "learned":
-        x = x + params["pos_embed"][positions][None] if positions.ndim == 1 else x + params["pos_embed"][positions]
+        pe = _maybe_stage(params["pos_embed"]) if stream else params["pos_embed"]
+        x = x + pe[positions][None] if positions.ndim == 1 else x + pe[positions]
     x = _act_constraint(x)
 
     layer_fn = partial(_layer, c)
+    if stream:
+        # stage INSIDE the (remat'd) layer body: forward brings one layer's
+        # weights to HBM per scan step, backward re-stages them on recompute
+        inner_fn = layer_fn
+        layer_fn = lambda lp, *a: inner_fn(_stage_tree(lp), *a)  # noqa: E731
     if c.remat:
         layer_fn = jax.checkpoint(layer_fn, policy=remat_policy(c.remat_policy))
 
@@ -558,14 +622,21 @@ def forward_hidden(
         return x, aux
 
     x, aux_losses = jax.lax.scan(scan_body, x, params["layers"])
-    x = _norm(x, params["final_norm"], params.get("final_norm_b"), c.norm, c.norm_eps)
+    fn_w = _maybe_stage(params["final_norm"]) if stream else params["final_norm"]
+    fn_b = params.get("final_norm_b")
+    if stream and fn_b is not None:
+        fn_b = _maybe_stage(fn_b)
+    x = _norm(x, fn_w, fn_b, c.norm, c.norm_eps)
     return x, jnp.sum(aux_losses)
 
 
 def _lm_head_matrix(params, config: TransformerConfig, dtype):
+    stream = _stream_active(config)
     if config.tie_embeddings:
-        return params["embed"].astype(dtype).T
-    return _dequant_tree(params["lm_head"], dtype)
+        w = params["embed"]
+        return (_maybe_stage(w) if stream else w).astype(dtype).T
+    w = _dequant_tree(params["lm_head"], dtype)
+    return _maybe_stage(w) if stream else w
 
 
 def _apply_lm_head(params, x, config: TransformerConfig):
@@ -597,12 +668,17 @@ def decode_step(params, tokens, config, kv_caches, positions):
     """
     c = config
     b, t = tokens.shape
-    x = params["embed"].astype(DTYPES[c.dtype])[tokens]
+    stream = _stream_active(c)
+    embed = _maybe_stage(params["embed"]) if stream else params["embed"]
+    x = embed.astype(DTYPES[c.dtype])[tokens]
     if c.position == "learned":
-        x = x + params["pos_embed"][positions]
+        pe = _maybe_stage(params["pos_embed"]) if stream else params["pos_embed"]
+        x = x + pe[positions]
 
     def scan_body(x, inputs):
         lp, cache = inputs
+        if stream:
+            lp = _stage_tree(lp)
         lp = _dequant_tree(lp, DTYPES[c.dtype])
         a = _norm(x, lp["attn_norm"], lp.get("attn_norm_b"), c.norm, c.norm_eps)
         attn_out, new_cache = _attention_block(c, lp, a, positions, None, kv_cache=cache)
@@ -616,7 +692,11 @@ def decode_step(params, tokens, config, kv_caches, positions):
         return x + mlp_out, new_cache
 
     x, new_caches = jax.lax.scan(scan_body, x, (params["layers"], kv_caches))
-    x = _norm(x, params["final_norm"], params.get("final_norm_b"), c.norm, c.norm_eps)
+    fn_w = _maybe_stage(params["final_norm"]) if stream else params["final_norm"]
+    fn_b = params.get("final_norm_b")
+    if stream and fn_b is not None:
+        fn_b = _maybe_stage(fn_b)
+    x = _norm(x, fn_w, fn_b, c.norm, c.norm_eps)
     return _apply_lm_head(params, x, c), new_caches
 
 

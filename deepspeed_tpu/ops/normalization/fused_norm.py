@@ -64,9 +64,14 @@ def _rows_view(x):
     return x.reshape(-1, h), x.shape
 
 
-def _pick_rows(n_rows):
+def _pick_rows(n_rows, h=0):
+    # cap rows*h so the kernel's fp32 scratch stays under the ~16 MB scoped
+    # VMEM limit: 256 rows at h=4096 is 16.1 MB of stack and fails to compile
+    max_rows = 256
+    while h and max_rows > 1 and max_rows * h > (1 << 19):
+        max_rows //= 2
     for r in (256, 128, 64, 32, 16, 8, 4, 2, 1):
-        if n_rows % r == 0:
+        if r <= max_rows and n_rows % r == 0:
             return r
     return 1
 
@@ -78,7 +83,7 @@ def _rms_fwd(x, w, eps, interpret):
 
     x2, shape = _rows_view(x)
     n, h = x2.shape
-    rows = _pick_rows(n)
+    rows = _pick_rows(n, h)
     out = pl.pallas_call(
         functools.partial(_rms_fwd_kernel, eps=eps),
         grid=(n // rows,),
@@ -106,7 +111,7 @@ def _rms_bwd(eps, interpret, res, g):
     x2, shape = _rows_view(x)
     g2, _ = _rows_view(g)
     n, h = x2.shape
-    rows = _pick_rows(n)
+    rows = _pick_rows(n, h)
     dx = pl.pallas_call(
         functools.partial(_rms_bwd_kernel, eps=eps),
         grid=(n // rows,),

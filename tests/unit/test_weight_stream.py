@@ -1,0 +1,105 @@
+"""Weight-streaming tier tests (ZeRO-Infinity on one chip).
+
+The streaming path itself is TPU-only (pinned_host memory kinds +
+per-layer staging; proven on hardware — see PERF.md); the CPU suite checks
+the graceful degradation (weight_stream config trains normally on CPU) and
+the engine's compatibility guards, mirroring how the reference CI proves
+NVMe-offload plumbing without NVMe hardware."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models import TransformerConfig, get_config, init_params, make_loss_fn
+
+
+def _cfg(**kw):
+    return get_config("tiny", weight_stream=True, dtype="float32", **kw)
+
+
+def _ds_config(**over):
+    base = {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+        "zero_optimization": {
+            "stage": 3,
+            "offload_param": {"device": "cpu"},
+            "offload_optimizer": {"device": "cpu"},
+        },
+        "mesh": {"data": 8},
+        "steps_per_print": 1000,
+    }
+    base.update(over)
+    return base
+
+
+def test_weight_stream_cpu_fallback_trains():
+    """On non-TPU backends the stream staging is a no-op and the engine runs
+    the regular (eager-offload) path — training must still converge."""
+    cfg = _cfg()
+    params = init_params(cfg, jax.random.key(0))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=make_loss_fn(cfg), model_parameters=params, config=_ds_config()
+    )
+    assert not engine._weight_stream  # CPU: native offload unavailable
+    toks = np.random.default_rng(0).integers(0, cfg.vocab_size, size=(8, 33)).astype(np.int32)
+    losses = [float(engine.train_batch(batch={"input_ids": toks})) for _ in range(5)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
+
+
+def test_streamed_adamw_matches_adamw_math():
+    """The chunk-streamed AdamW must be plain AdamW when nothing is offloaded
+    (device leaves take the whole-leaf path on any backend)."""
+    import optax
+
+    from deepspeed_tpu.runtime.streamed_adam import StreamedAdamW
+
+    opt = StreamedAdamW(lr=1e-2, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.01)
+    params = {"a": jnp.ones((8, 16), jnp.float32), "b": jnp.full((4,), 2.0, jnp.float32)}
+    state = opt.init(params)
+    ref = optax.adamw(1e-2, b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.01)
+    ref_state = ref.init(params)
+    # the streamed step DONATES its state/param buffers — give it its own copies
+    p_s = jax.tree.map(jnp.copy, params)
+    p_r = params
+    key = jax.random.key(0)
+    for i in range(4):
+        key, k = jax.random.split(key)
+        grads = jax.tree.map(lambda p: jax.random.normal(k, p.shape), params)
+        p_s, state = opt.step(grads, state, p_s, jnp.float32(1e-2))
+        upd, ref_state = ref.update(grads, ref_state, p_r)
+        p_r = optax.apply_updates(p_r, upd)
+    for a, b in zip(jax.tree_util.tree_leaves(p_s), jax.tree_util.tree_leaves(p_r)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+class TestGuards:
+    """weight_stream incompatibility guards raise with actionable messages
+    (these run the TPU-only branch logic, so force the flag on)."""
+
+    def _engine(self, ds_over, cfg_over=None, monkeypatch=None):
+        cfg = _cfg(**(cfg_over or {}))
+        params = init_params(cfg, jax.random.key(0))
+        return deepspeed_tpu.initialize(
+            model=make_loss_fn(cfg), model_parameters=params, config=_ds_config(**ds_over)
+        )
+
+    def test_gas_guard(self, monkeypatch):
+        import deepspeed_tpu.runtime.engine as E
+
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        with pytest.raises(NotImplementedError, match="gradient_accumulation_steps"):
+            self._engine({"gradient_accumulation_steps": 2})
+
+    def test_clipping_guard(self, monkeypatch):
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        with pytest.raises(NotImplementedError, match="gradient_clipping"):
+            self._engine({"gradient_clipping": 1.0})
+
+    def test_optimizer_guard(self, monkeypatch):
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        with pytest.raises(NotImplementedError, match="Adam"):
+            self._engine({"optimizer": {"type": "Lamb", "params": {"lr": 1e-3}}})
