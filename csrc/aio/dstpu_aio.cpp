@@ -225,6 +225,13 @@ struct AioHandle {
     }
 };
 
+// OFFSET-WRITE SEMANTICS: writes are positional into an existing (or newly
+// created) file and deliberately do NOT truncate — the swap tiers rewrite
+// fixed-size leaves in place, and O_TRUNC would invalidate concurrent reads
+// of other regions. Consequence for other AioHandle users: rewriting a file
+// with a SHORTER payload leaves a stale tail, and dstpu_aio_file_size will
+// report the old length — unlink the file first (or write the full extent)
+// for whole-file replacement.
 int open_for(bool is_read, const char* path) {
     if (is_read) return open(path, O_RDONLY);
     return open(path, O_WRONLY | O_CREAT, 0644);

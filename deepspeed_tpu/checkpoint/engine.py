@@ -46,17 +46,22 @@ def save_checkpoint(save_dir, tag, params, opt_state, scaler_state, client_state
         if save_latest:
             with open(os.path.join(save_dir, "latest"), "w") as f:
                 f.write(str(tag))
-        # ship the standalone fp32 recovery script with the checkpoint
-        # (reference engine._copy_recovery_script :3991)
-        try:
-            import shutil
-
-            src = os.path.join(os.path.dirname(os.path.abspath(__file__)), "zero_to_fp32.py")
-            shutil.copy2(src, os.path.join(save_dir, "zero_to_fp32.py"))
-        except OSError as e:
-            logger.warning(f"could not copy zero_to_fp32.py into checkpoint dir: {e}")
+        copy_recovery_script(save_dir)
     log_dist(f"Saved checkpoint {path}", ranks=[0])
     return path
+
+
+def copy_recovery_script(save_dir: str):
+    """Ship the standalone fp32 recovery script with the checkpoint
+    (reference engine._copy_recovery_script :3991). Shared by the orbax path
+    and the pluggable writer engines."""
+    try:
+        import shutil
+
+        src = os.path.join(os.path.dirname(os.path.abspath(__file__)), "zero_to_fp32.py")
+        shutil.copy2(src, os.path.join(save_dir, "zero_to_fp32.py"))
+    except OSError as e:
+        logger.warning(f"could not copy zero_to_fp32.py into checkpoint dir: {e}")
 
 
 def _read_latest(load_dir):

@@ -1,5 +1,6 @@
 """Elastic training (reference deepspeed/elasticity/)."""
 
+from deepspeed_tpu.elasticity.elastic_agent import ElasticAgent
 from deepspeed_tpu.elasticity.elasticity import (
     ElasticityConfig,
     ElasticityConfigError,
@@ -13,6 +14,7 @@ from deepspeed_tpu.elasticity.elasticity import (
 )
 
 __all__ = [
+    "ElasticAgent",
     "ElasticityConfig",
     "ElasticityConfigError",
     "ElasticityError",

@@ -13,10 +13,30 @@ def main(argv=None) -> int:
     p.add_argument("-c", "--config", required=True, help="DeepSpeed config json")
     p.add_argument("-w", "--world-size", type=int, default=0,
                    help="report micro-batch/gas for this chip count")
+    p.add_argument("--watch", action="store_true",
+                   help="supervise CMD across membership changes (elastic agent)")
+    p.add_argument("--hostfile", default=None, help="watch: membership from hostfile slots")
+    p.add_argument("--world-file", default=None, help="watch: membership from an integer file")
+    p.add_argument("--poll-interval", type=float, default=5.0)
+    p.add_argument("--max-restarts", type=int, default=100)
+    p.add_argument("cmd", nargs=argparse.REMAINDER,
+                   help="watch: training command after '--' ({config}/{world_size} substituted)")
     args = p.parse_args(argv)
 
     with open(args.config) as f:
         ds_config = json.load(f)
+    if args.watch:
+        from deepspeed_tpu.elasticity.elastic_agent import ElasticAgent
+
+        cmd = args.cmd[1:] if args.cmd and args.cmd[0] == "--" else args.cmd
+        if not cmd:
+            p.error("--watch needs a training command after '--'")
+        agent = ElasticAgent(
+            cmd, ds_config,
+            hostfile=args.hostfile, world_file=args.world_file,
+            poll_interval=args.poll_interval, max_restarts=args.max_restarts,
+        )
+        return agent.run()
     if args.world_size:
         batch, valid, mbs = compute_elastic_config(
             ds_config, world_size=args.world_size, return_microbatch=True

@@ -1,0 +1,192 @@
+"""Elastic agent: membership watch + automatic relaunch into UCP resume.
+
+Reference analogue: ``DSElasticAgent(LocalElasticAgent)``
+(``deepspeed/elasticity/elastic_agent.py:32``) — the reference plugs into
+torch-elastic's rendezvous and restarts workers when membership changes.
+The TPU-native form is a supervisor daemon: it derives the current world
+size from a membership source (hostfile or a world-size file), solves the
+new (train_batch, micro, gas) decomposition with the elasticity solver
+(``elastic_resume_plan``), writes the patched config, and (re)launches the
+training command. The relaunched run resumes from the latest checkpoint;
+orbax reshard-on-load (the built-in universal checkpoint) absorbs the
+world-size change, so training continues where it left off.
+
+Membership sources:
+  * ``hostfile`` — re-parsed every poll; world = sum of ``slots`` entries
+    (the reference's rendezvous node set, file-driven).
+  * ``world_file`` — a file holding one integer (operator- or
+    orchestrator-driven; also what the integration test uses).
+
+The launched command may contain the placeholders ``{config}`` (path of the
+patched config JSON) and ``{world_size}``; the agent also exports
+``DSTPU_ELASTIC_CONFIG`` / ``DSTPU_WORLD_SIZE`` / ``DSTPU_ELASTIC_RESTARTS``.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Callable, List, Optional
+
+from deepspeed_tpu.elasticity.elasticity import ElasticityError, elastic_resume_plan
+from deepspeed_tpu.utils.logging import logger
+
+
+def _world_from_hostfile(path: str) -> int:
+    world = 0
+    with open(path) as f:
+        for line in f:
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            slots = 1
+            for tok in parts[1:]:
+                if tok.startswith("slots="):
+                    slots = int(tok.split("=", 1)[1])
+            world += slots
+    return world
+
+
+def _world_from_file(path: str) -> int:
+    with open(path) as f:
+        return int(f.read().strip())
+
+
+class ElasticAgent:
+    """Supervise a training command across membership changes.
+
+    cmd:        argv list; ``{config}``/``{world_size}`` placeholders are
+                substituted per launch.
+    ds_config:  base config dict (must contain an ``elasticity`` section).
+    """
+
+    def __init__(
+        self,
+        cmd: List[str],
+        ds_config: dict,
+        hostfile: Optional[str] = None,
+        world_file: Optional[str] = None,
+        world_fn: Optional[Callable[[], int]] = None,
+        poll_interval: float = 5.0,
+        max_restarts: int = 100,
+        workdir: Optional[str] = None,
+    ):
+        if sum(x is not None for x in (hostfile, world_file, world_fn)) != 1:
+            raise ValueError("pass exactly one membership source: hostfile, world_file or world_fn")
+        if "elasticity" not in ds_config:
+            raise ElasticityError("config has no 'elasticity' section")
+        self.cmd = list(cmd)
+        self.ds_config = ds_config
+        self.poll_interval = poll_interval
+        self.max_restarts = max_restarts
+        self.workdir = workdir or tempfile.mkdtemp(prefix="dstpu_elastic_")
+        os.makedirs(self.workdir, exist_ok=True)
+        if hostfile is not None:
+            self._world_fn = lambda: _world_from_hostfile(hostfile)
+        elif world_file is not None:
+            self._world_fn = lambda: _world_from_file(world_file)
+        else:
+            self._world_fn = world_fn
+        self.restarts = 0
+        self.launches: List[dict] = []  # (world, plan) per launch — observability/tests
+
+    # ------------------------------------------------------------------
+    def _patched_config_path(self, world: int) -> str:
+        plan = elastic_resume_plan(self.ds_config, world)
+        cfg = dict(self.ds_config)
+        cfg.update(plan)
+        path = os.path.join(self.workdir, f"elastic_config_w{world}_r{self.restarts}.json")
+        with open(path, "w") as f:
+            json.dump(cfg, f, indent=2)
+        self.launches.append({"world": world, "plan": plan, "config": path})
+        return path
+
+    def _launch(self, world: int) -> subprocess.Popen:
+        cfg_path = self._patched_config_path(world)
+        argv = [a.format(config=cfg_path, world_size=world) for a in self.cmd]
+        env = dict(os.environ)
+        env["DSTPU_ELASTIC_CONFIG"] = cfg_path
+        env["DSTPU_WORLD_SIZE"] = str(world)
+        env["DSTPU_ELASTIC_RESTARTS"] = str(self.restarts)
+        plan = self.launches[-1]["plan"]
+        logger.info(
+            f"elastic agent: launching world={world} micro="
+            f"{plan['train_micro_batch_size_per_gpu']} gas="
+            f"{plan['gradient_accumulation_steps']} (restart {self.restarts})"
+        )
+        # new process group so a membership change can kill the whole tree
+        # (reference launcher kills the proc tree on SIGTERM, launch.py:131)
+        return subprocess.Popen(argv, env=env, start_new_session=True)
+
+    @staticmethod
+    def _terminate(proc: subprocess.Popen, grace: float = 10.0):
+        if proc.poll() is not None:
+            return
+        try:
+            os.killpg(proc.pid, signal.SIGTERM)
+        except ProcessLookupError:
+            return
+        deadline = time.time() + grace
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                return
+            time.sleep(0.2)
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        proc.wait()
+
+    def _poll_world(self, last: int) -> int:
+        """Read membership, treating a transient failure (hostfile briefly
+        missing, world_file mid-rewrite → int('') ValueError) as 'membership
+        unchanged' — a failed poll must never take down a healthy run."""
+        try:
+            return self._world_fn()
+        except (OSError, ValueError) as e:
+            logger.warning(f"elastic agent: membership poll failed ({e}); keeping world={last}")
+            return last
+
+    # ------------------------------------------------------------------
+    def run(self) -> int:
+        """Supervise until the training command exits 0 (done), a config
+        becomes unsolvable, or max_restarts is exhausted. Returns the final
+        exit code."""
+        world = self._world_fn()
+        proc = self._launch(world)
+        try:
+            while True:
+                rc = proc.poll()
+                if rc is not None:
+                    if rc == 0:
+                        logger.info("elastic agent: training completed")
+                        return 0
+                    # crashed worker: relaunch at the CURRENT membership
+                    # (reference elastic agent restart-on-failure semantics)
+                    self.restarts += 1
+                    if self.restarts > self.max_restarts:
+                        logger.error(f"elastic agent: giving up after {self.max_restarts} restarts")
+                        return rc
+                    world = self._poll_world(world)
+                    logger.warning(f"elastic agent: worker exited rc={rc}; relaunching at world={world}")
+                    proc = self._launch(world)
+                    continue
+                new_world = self._poll_world(world)
+                if new_world != world:
+                    logger.warning(
+                        f"elastic agent: membership change {world} -> {new_world}; restarting into UCP resume"
+                    )
+                    self._terminate(proc)
+                    self.restarts += 1
+                    if self.restarts > self.max_restarts:
+                        return 1
+                    world = new_world
+                    proc = self._launch(world)
+                    continue
+                time.sleep(self.poll_interval)
+        finally:
+            self._terminate(proc)

@@ -34,11 +34,16 @@ def main(argv=None) -> int:
         return 0
 
     def run(host):
-        r = subprocess.run(
-            ["ssh", "-o", "StrictHostKeyChecking=no", "-p", str(args.ssh_port),
-             host, cmd],
-            capture_output=True, text=True, timeout=args.timeout,
-        )
+        try:
+            r = subprocess.run(
+                ["ssh", "-o", "StrictHostKeyChecking=no", "-p", str(args.ssh_port),
+                 host, cmd],
+                capture_output=True, text=True, timeout=args.timeout,
+            )
+        except subprocess.TimeoutExpired:
+            # one hung host must not abort the whole fan-out (reference
+            # ds_ssh keeps going); report it and continue
+            return host, 124, "", f"timeout after {args.timeout}s"
         return host, r.returncode, r.stdout, r.stderr
 
     rc = 0

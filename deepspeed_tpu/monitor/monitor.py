@@ -66,6 +66,43 @@ class WandbMonitor(Monitor):
             self._wandb.log({name: value}, step=step)
 
 
+class CometMonitor(Monitor):
+    """Reference monitor/comet.py: metrics to a Comet experiment. comet_ml
+    is not in the image — the writer degrades to disabled with a warning,
+    exactly like the W&B writer does without credentials."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self._experiment = None
+        if self.enabled:
+            try:
+                import comet_ml
+
+                kwargs = {
+                    k: getattr(config, k)
+                    for k in ("api_key", "project", "workspace", "experiment_key", "mode", "online")
+                    if getattr(config, k, None) is not None
+                }
+                if "project" in kwargs:
+                    kwargs["project_name"] = kwargs.pop("project")
+                self._experiment = comet_ml.start(**kwargs)
+                if getattr(config, "experiment_name", None):
+                    self._experiment.set_name(config.experiment_name)
+            except Exception as e:
+                logger.warning(f"Comet monitor unavailable: {e}")
+                self.enabled = False
+
+    @property
+    def experiment(self):
+        return self._experiment
+
+    def write_events(self, event_list):
+        if self._experiment is None:
+            return
+        for name, value, step in event_list:
+            self._experiment.__internal_api__log_metric__(name, value, step=step)
+
+
 class csvMonitor(Monitor):
     def __init__(self, config):
         super().__init__(config)
@@ -97,14 +134,18 @@ class MonitorMaster(Monitor):
         self.tb_monitor = TensorBoardMonitor(ds_config.tensorboard)
         self.wandb_monitor = WandbMonitor(ds_config.wandb)
         self.csv_monitor = csvMonitor(ds_config.csv_monitor)
+        self.comet_monitor = CometMonitor(ds_config.comet)
         self._rank0 = jax.process_index() == 0
         self.enabled = self._rank0 and (
-            self.tb_monitor.enabled or self.wandb_monitor.enabled or self.csv_monitor.enabled
+            self.tb_monitor.enabled
+            or self.wandb_monitor.enabled
+            or self.csv_monitor.enabled
+            or self.comet_monitor.enabled
         )
 
     def write_events(self, event_list):
         if not self.enabled:
             return
-        for m in (self.tb_monitor, self.wandb_monitor, self.csv_monitor):
+        for m in (self.tb_monitor, self.wandb_monitor, self.csv_monitor, self.comet_monitor):
             if m.enabled:
                 m.write_events(event_list)

@@ -135,8 +135,11 @@ class NativeOpBuilder(OpBuilder):
     def is_compatible(self, verbose=False):
         # Cheap capability probe (reference ds_report semantics): do NOT
         # compile as a side effect — a toolchain or an already-built artifact
-        # means the op can load.
-        return shutil.which("g++") is not None or self.NAME in self._lib_cache
+        # means the op can load. A cached None means a FAILED build (or the
+        # kill switch): report incompatible, not available.
+        if self.NAME in self._lib_cache:
+            return self._lib_cache[self.NAME] is not None
+        return shutil.which("g++") is not None
 
     @classmethod
     def lib(cls):

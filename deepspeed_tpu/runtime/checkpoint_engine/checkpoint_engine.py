@@ -16,9 +16,11 @@ reference's two-phase save/commit protocol (engine.py:3655).
 
 import json
 import os
+import queue
 import threading
+import zipfile
 from abc import ABC, abstractmethod
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -51,22 +53,54 @@ class CheckpointEngine(ABC):
         os.makedirs(path, exist_ok=exist_ok)
 
 
-def _to_host(tree):
-    """Materialize a pytree of (possibly sharded/donatable) arrays as host
-    numpy — the synchronous part of an async save."""
-
-    def leaf(x):
-        if not hasattr(x, "shape"):
-            return x
-        if hasattr(x, "is_fully_addressable") and not x.is_fully_addressable:
-            raise NotImplementedError(
-                "npz checkpoint writers materialize full arrays on each host; "
-                "this array spans non-addressable devices — use the default "
-                "orbax path (checkpoint.writer unset) for multi-host sharded saves"
-            )
+def _snapshot_leaf(x):
+    """Device → host copy of ONE leaf (the only part of a save that must
+    happen before the training step may donate the buffer)."""
+    if not hasattr(x, "shape"):
         return np.asarray(x)
+    if hasattr(x, "is_fully_addressable") and not x.is_fully_addressable:
+        raise NotImplementedError(
+            "npz checkpoint writers materialize full arrays on each host; "
+            "this array spans non-addressable devices — use the default "
+            "orbax path (checkpoint.writer unset) for multi-host sharded saves"
+        )
+    return np.asarray(x)
 
-    return jax.tree.map(leaf, tree)
+
+def _iter_named_leaves(state_dict: Dict[str, Any]) -> Iterator[Tuple[str, Any]]:
+    """Leaves in tree-flatten order under INDEX keys (``section::000042``):
+    restore zips them back into the live template's treedef, which is robust
+    for NamedTuple states whose field order is not alphabetical."""
+    for k, v in state_dict.items():
+        if k == "__meta__":
+            continue
+        for i, leaf in enumerate(jax.tree_util.tree_leaves(v)):
+            yield f"{k}::{i:06d}", leaf
+
+
+class _NpzStreamWriter:
+    """Incremental npz writer: one uncompressed zip entry per leaf, written
+    as it arrives — the archive matches ``np.savez`` layout (``np.load``
+    reads it back), but peak host memory is ONE leaf, not the tree (the
+    reference FastPersist ``fast_file_writer.py`` streams per-rank shards
+    for the same reason)."""
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        self._zf = zipfile.ZipFile(path, "w", zipfile.ZIP_STORED, allowZip64=True)
+
+    def write(self, name: str, arr: np.ndarray):
+        with self._zf.open(f"{name}.npy", "w", force_zip64=True) as f:
+            np.lib.format.write_array(f, np.asarray(arr), allow_pickle=False)
+
+    def close(self):
+        self._zf.close()
+
+
+def _write_meta(base: str, meta):
+    if meta is not None:
+        with open(base + ".meta.json", "w") as f:  # read side strips .npz too
+            json.dump(meta, f, default=_json_safe)
 
 
 def _json_safe(obj):
@@ -81,24 +115,17 @@ def _json_safe(obj):
     raise TypeError(f"client_state value of type {type(obj).__name__} is not JSON-serializable")
 
 
-def _write_npz(state_dict: Dict[str, Any], path: str):
-    """Leaves serialize in tree-flatten order under INDEX keys
-    (``section::000042``): restore zips them back into the live template's
-    treedef, which is robust for NamedTuple states whose field order is not
-    alphabetical (a name-keyed round trip through plain dicts would re-sort)."""
+def _write_npz_streaming(state_dict: Dict[str, Any], path: str):
+    """Synchronous bounded-memory save: snapshot → write → release, leaf at
+    a time."""
     base = path[: -len(".npz")] if path.endswith(".npz") else path
-    flat = {}
-    for k, v in state_dict.items():
-        if k == "__meta__":
-            continue
-        for i, leaf in enumerate(jax.tree_util.tree_leaves(v)):
-            flat[f"{k}::{i:06d}"] = np.asarray(leaf)
-    os.makedirs(os.path.dirname(base), exist_ok=True)
-    np.savez(base + ".npz", **flat)
-    meta = state_dict.get("__meta__")
-    if meta is not None:
-        with open(base + ".meta.json", "w") as f:  # read side strips .npz too
-            json.dump(meta, f, default=_json_safe)
+    w = _NpzStreamWriter(base + ".npz")
+    try:
+        for name, leaf in _iter_named_leaves(state_dict):
+            w.write(name, _snapshot_leaf(leaf))
+    finally:
+        w.close()
+    _write_meta(base, state_dict.get("__meta__"))
 
 
 def _read_npz(path: str) -> Dict[str, Any]:
@@ -121,10 +148,11 @@ def _read_npz(path: str) -> Dict[str, Any]:
 
 class TorchCheckpointEngine(CheckpointEngine):
     """Synchronous engine (reference torch_checkpoint_engine.py): save
-    blocks until the file is durable; commit just writes the marker."""
+    blocks until the file is durable; commit just writes the marker. Peak
+    host memory: one leaf (streamed)."""
 
     def save(self, state_dict, path):
-        _write_npz(_to_host(state_dict), path)
+        _write_npz_streaming(state_dict, path)
 
     def load(self, path, map_location=None):
         return _read_npz(path)
@@ -134,29 +162,65 @@ class TorchCheckpointEngine(CheckpointEngine):
 
 
 class AsyncCheckpointEngine(CheckpointEngine):
-    """Background-thread writer (reference FastCheckpointEngine /
-    AsyncTorchCheckpointEngine): ``save`` returns after the device→host
-    snapshot; serialization happens off-thread. ``commit`` joins all
-    outstanding writes for the tag — training never waits on the filesystem
-    between the two."""
+    """Pipelined background writer (reference FastCheckpointEngine +
+    FastPersist ``io/fast_file_writer.py``): ``save`` streams leaves through
+    a BOUNDED queue — snapshot of leaf i+1 overlaps the serialization of
+    leaf i, and host memory is capped at ``queue_depth`` leaves instead of
+    the whole tree. ``save`` returns once every leaf is SNAPSHOTTED (the
+    training step may then donate the device buffers); the final writes
+    drain off-thread and ``commit`` joins them — training never waits on
+    the filesystem between the two."""
+
+    QUEUE_DEPTH = 4
 
     def __init__(self, config_params=None):
         super().__init__(config_params)
         self._pending: List[threading.Thread] = []
         self._errors: List[BaseException] = []
+        self.max_buffered = 0  # observability: peak queued leaves (tests)
 
     def save(self, state_dict, path):
-        host_state = _to_host(state_dict)  # synchronous: buffers may be donated next step
+        base = path[: -len(".npz")] if path.endswith(".npz") else path
+        meta = state_dict.get("__meta__")
+        q: "queue.Queue" = queue.Queue(maxsize=self.QUEUE_DEPTH)
 
         def write():
+            sentinel_seen = False
             try:
-                _write_npz(host_state, path)
+                w = _NpzStreamWriter(base + ".npz")
+                try:
+                    while True:
+                        item = q.get()
+                        if item is None:
+                            sentinel_seen = True
+                            break
+                        w.write(*item)
+                finally:
+                    w.close()
+                _write_meta(base, meta)
             except BaseException as e:  # surfaced at commit
                 self._errors.append(e)
+                # unblock the producer — but ONLY until the sentinel; if the
+                # failure came after it (meta/close), the queue is already
+                # empty and a blocking drain would deadlock commit()
+                while not sentinel_seen:
+                    if q.get() is None:
+                        sentinel_seen = True
 
         t = threading.Thread(target=write, daemon=True)
         t.start()
         self._pending.append(t)
+        try:
+            for name, leaf in _iter_named_leaves(state_dict):
+                # put() blocks at queue_depth: bounded host buffering even
+                # when the filesystem is slower than the snapshots
+                q.put((name, _snapshot_leaf(leaf)))
+                self.max_buffered = max(self.max_buffered, q.qsize())
+        finally:
+            # ALWAYS release the writer (a snapshot error mid-loop would
+            # otherwise leave it blocked on q.get() and hang commit());
+            # the raised error aborts the save, so the tag never publishes
+            q.put(None)
 
     def load(self, path, map_location=None):
         return _read_npz(path)

@@ -120,6 +120,21 @@ class WandbConfig(DSConfigModel):
 
 
 @dataclass
+class CometConfig(DSConfigModel):
+    """Reference monitor/config.py CometConfig (comet.py writer)."""
+
+    enabled: bool = False
+    samples_log_interval: int = 100
+    project: Optional[str] = None
+    workspace: Optional[str] = None
+    api_key: Optional[str] = None
+    experiment_name: Optional[str] = None
+    experiment_key: Optional[str] = None
+    online: Optional[bool] = None
+    mode: Optional[str] = None
+
+
+@dataclass
 class CSVConfig(DSConfigModel):
     enabled: bool = False
     output_path: str = ""
@@ -229,6 +244,7 @@ class DeepSpeedConfig(DSConfigModel):
     tensorboard: TensorBoardConfig = submodel(TensorBoardConfig)
     wandb: WandbConfig = submodel(WandbConfig)
     csv_monitor: CSVConfig = submodel(CSVConfig)
+    comet: CometConfig = submodel(CometConfig)
     checkpoint: CheckpointConfig = submodel(CheckpointConfig)
     data_types: DataTypesConfig = submodel(DataTypesConfig)
     mesh: MeshConfig = submodel(MeshConfig)

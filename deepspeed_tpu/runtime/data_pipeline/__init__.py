@@ -3,6 +3,11 @@ curriculum learning, metric-indexed curriculum sampling, variable batch size
 + LR scaling, and random layer token drop."""
 
 from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import CurriculumScheduler
+from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import (
+    DistributedDataAnalyzer,
+    MMapIndexedDataset,
+    MMapIndexedDatasetBuilder,
+)
 from deepspeed_tpu.runtime.data_pipeline.data_sampler import (
     CurriculumDataSampler,
     DataAnalyzer,
@@ -22,6 +27,9 @@ __all__ = [
     "CurriculumDataSampler",
     "CurriculumScheduler",
     "DataAnalyzer",
+    "DistributedDataAnalyzer",
+    "MMapIndexedDataset",
+    "MMapIndexedDatasetBuilder",
     "RandomLTDScheduler",
     "VariableBatchSizeLR",
     "batch_by_seqlens",

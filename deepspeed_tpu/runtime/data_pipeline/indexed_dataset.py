@@ -1,0 +1,186 @@
+"""Memory-mapped indexed dataset + multi-worker data analyzer.
+
+Reference analogue: ``data_sampling/indexed_dataset.py`` (Megatron-style
+``MMapIndexedDataset``: a flat ``.bin`` of sample payloads + a ``.idx`` of
+dtype/lengths/offsets, read through ``np.memmap`` so a TB-scale corpus costs
+no RSS) and ``data_sampling/data_analyzer.py`` (``DataAnalyzer``/
+``DistributedDataAnalyzer``: shard the dataset over workers, compute
+per-sample metrics, write per-worker files, merge into the
+``metric_value → sample index`` map the curriculum sampler consumes).
+
+Format (little-endian):
+  .idx  magic ``DSTPIDX1`` | u8 dtype-code | u64 n_seqs
+        | u64 lengths[n_seqs] (elements per sample)
+        | u64 offsets[n_seqs] (element offset of each sample in .bin)
+  .bin  sample payloads, concatenated, no padding
+
+The analyzer's merged output is itself plain ``.npy`` arrays (one metric
+value per sample), loadable with ``mmap_mode="r"`` — exactly what
+:class:`~deepspeed_tpu.runtime.data_pipeline.data_sampler.CurriculumDataSampler`
+takes as ``metric_values``.
+"""
+
+import json
+import os
+import struct
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+_MAGIC = b"DSTPIDX1"
+_DTYPES = {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32, 5: np.int64,
+           6: np.float32, 7: np.float64, 8: np.uint16, 9: np.uint32}
+_DTYPE_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+class MMapIndexedDatasetBuilder:
+    """Append-only writer (reference ``MMapIndexedDatasetBuilder``)."""
+
+    def __init__(self, path_prefix: str, dtype=np.int32):
+        self.path_prefix = path_prefix
+        self.dtype = np.dtype(dtype)
+        if self.dtype not in _DTYPE_CODES:
+            raise ValueError(f"unsupported dtype {dtype}; one of {sorted(map(str, _DTYPE_CODES))}")
+        os.makedirs(os.path.dirname(os.path.abspath(path_prefix)), exist_ok=True)
+        self._bin = open(path_prefix + ".bin", "wb")
+        self._lengths: List[int] = []
+
+    def add_item(self, array) -> int:
+        a = np.ascontiguousarray(array, dtype=self.dtype)
+        self._bin.write(a.tobytes())
+        self._lengths.append(a.size)
+        return len(self._lengths) - 1
+
+    def merge_file(self, other_prefix: str):
+        """Concatenate another builder's output (the multi-worker merge path,
+        reference builder.merge_file_)."""
+        other = MMapIndexedDataset(other_prefix)
+        if other.dtype != self.dtype:
+            raise ValueError(f"dtype mismatch: {other.dtype} vs {self.dtype}")
+        with open(other_prefix + ".bin", "rb") as f:
+            while chunk := f.read(1 << 24):
+                self._bin.write(chunk)
+        self._lengths.extend(int(n) for n in other.lengths)
+
+    def finalize(self):
+        self._bin.close()
+        lengths = np.asarray(self._lengths, np.uint64)
+        offsets = np.zeros_like(lengths)
+        if len(lengths) > 1:
+            np.cumsum(lengths[:-1], out=offsets[1:])
+        with open(self.path_prefix + ".idx", "wb") as f:
+            f.write(_MAGIC)
+            f.write(struct.pack("<BQ", _DTYPE_CODES[self.dtype], len(lengths)))
+            f.write(lengths.tobytes())
+            f.write(offsets.tobytes())
+
+
+class MMapIndexedDataset:
+    """Zero-copy reader: ``ds[i]`` returns a memmap VIEW of sample i."""
+
+    def __init__(self, path_prefix: str):
+        self.path_prefix = path_prefix
+        with open(path_prefix + ".idx", "rb") as f:
+            magic = f.read(len(_MAGIC))
+            if magic != _MAGIC:
+                raise ValueError(f"{path_prefix}.idx: bad magic {magic!r}")
+            code, n = struct.unpack("<BQ", f.read(9))
+            self.dtype = np.dtype(_DTYPES[code])
+            header = f.tell()
+        self.lengths = np.memmap(path_prefix + ".idx", np.uint64, "r",
+                                 offset=header, shape=(n,))
+        self.offsets = np.memmap(path_prefix + ".idx", np.uint64, "r",
+                                 offset=header + 8 * n, shape=(n,))
+        self._data = np.memmap(path_prefix + ".bin", self.dtype, "r")
+
+    def __len__(self):
+        return len(self.lengths)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self)))]
+        off, n = int(self.offsets[i]), int(self.lengths[i])
+        return self._data[off : off + n]
+
+
+# ---------------------------------------------------------------------------
+# multi-worker analyzer
+# ---------------------------------------------------------------------------
+class DistributedDataAnalyzer:
+    """Shard-parallel metric computation (reference ``data_analyzer.py``
+    ``DistributedDataAnalyzer``): worker w computes metrics over its
+    contiguous shard and writes ``<out>/<metric>.worker<w>.npy``; the merge
+    step concatenates shards into one mmap-able ``<metric>.npy`` + a
+    ``<metric>.index.json`` with percentile boundaries for the curriculum.
+
+    Workers can be separate PROCESSES on separate hosts (each runs
+    ``run_worker(w)``); ``merge`` runs once anywhere with the shared fs.
+    """
+
+    def __init__(
+        self,
+        dataset,
+        metric_fns: Dict[str, Callable[[dict], float]],
+        output_dir: str,
+        num_workers: int = 1,
+    ):
+        self.dataset = dataset
+        self.metric_fns = metric_fns
+        self.output_dir = output_dir
+        self.num_workers = num_workers
+        os.makedirs(output_dir, exist_ok=True)
+
+    def _shard(self, worker: int):
+        n = len(self.dataset)
+        per = -(-n // self.num_workers)
+        return range(worker * per, min((worker + 1) * per, n))
+
+    def run_worker(self, worker: int):
+        idx = self._shard(worker)
+        out = {name: np.zeros(len(idx), np.float64) for name in self.metric_fns}
+        for j, i in enumerate(idx):
+            sample = self.dataset[i]
+            for name, fn in self.metric_fns.items():
+                out[name][j] = fn(sample)
+        for name, arr in out.items():
+            np.save(os.path.join(self.output_dir, f"{name}.worker{worker}.npy"), arr)
+
+    def run(self):
+        """Single-process convenience: all shards then merge."""
+        for w in range(self.num_workers):
+            self.run_worker(w)
+        return self.merge()
+
+    def merge(self) -> Dict[str, np.ndarray]:
+        merged = {}
+        for name in self.metric_fns:
+            parts = []
+            for w in range(self.num_workers):
+                path = os.path.join(self.output_dir, f"{name}.worker{w}.npy")
+                if not os.path.isfile(path):
+                    raise FileNotFoundError(
+                        f"{path} missing: worker {w} has not finished (run_worker({w}))"
+                    )
+                parts.append(np.load(path))
+            arr = np.concatenate(parts)
+            np.save(os.path.join(self.output_dir, f"{name}.npy"), arr)
+            with open(os.path.join(self.output_dir, f"{name}.index.json"), "w") as f:
+                json.dump(
+                    {
+                        "num_samples": int(arr.size),
+                        "percentiles": {
+                            str(p): float(np.percentile(arr, p))
+                            for p in (1, 5, 10, 25, 50, 75, 90, 95, 99)
+                        },
+                    },
+                    f,
+                    indent=2,
+                )
+            merged[name] = arr
+        return merged
+
+    @staticmethod
+    def load_metric(output_dir: str, name: str) -> np.ndarray:
+        """mmap the merged metric (feeds CurriculumDataSampler without
+        loading the corpus-scale array)."""
+        return np.load(os.path.join(output_dir, f"{name}.npy"), mmap_mode="r")

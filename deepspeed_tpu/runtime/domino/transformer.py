@@ -10,7 +10,13 @@ TPU-native form: the chunks are independent programs over the same weights;
 issuing them as separate computations inside one jit lets XLA's
 latency-hiding scheduler interleave chunk k's psum with chunk k+1's matmuls
 — no handle bookkeeping. The wrapper composes with ANY layer fn (the
-reference hardcodes its own attention/MLP pair)."""
+reference hardcodes its own attention/MLP pair).
+
+Measured (PERF.md "Domino chunking"): on the real chip at the bench layer
+shape, chunking itself costs +0.1% at n_chunks=2 and +2.0% at n_chunks=4
+with exact numerics — so the chunked form is essentially free where the
+overlap would pay. The overlap WIN itself needs a TP mesh to profile and
+rests on XLA's latency-hiding scheduler interleaving the chunk programs."""
 
 from typing import Callable
 

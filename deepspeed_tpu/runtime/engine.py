@@ -1033,6 +1033,13 @@ class DeepSpeedEngine:
         stream = self._weight_stream
 
         custom_vg = getattr(self.loss_fn, "custom_value_and_grad", None)
+        if stream and (custom_vg is not None or self._quantized_exchange_enabled()):
+            raise NotImplementedError(
+                "weight_stream is incompatible with custom-gradient loss functions "
+                "(1F1B pipeline) and quantized grad exchange: their micro_grads "
+                "constrain the full grad tree with kind-less specs, which would "
+                "drag host-resident streamed grads into HBM"
+            )
         if custom_vg is not None and self.fp16_enabled:
             raise NotImplementedError(
                 "fp16 dynamic loss scaling is incompatible with custom-gradient loss "
@@ -1349,10 +1356,28 @@ class DeepSpeedEngine:
         the reference's data post-process hook)."""
         self._curriculum_post = fn
 
+    _CURRICULUM_SHAPE_BUDGET = 16
+
     def _apply_curriculum(self, stacked):
         if self.curriculum_scheduler is None:
             return stacked
         difficulty = self.curriculum_scheduler.update_difficulty(self.global_steps + 1)
+        # enforcement for the compile-thrash hazard: every distinct difficulty
+        # is a distinct compiled train step. Track them and flag the schedule
+        # the moment it exceeds a sane budget, with the actionable fix.
+        seen = getattr(self, "_curriculum_difficulties", None)
+        if seen is None:
+            seen = self._curriculum_difficulties = set()
+        if difficulty not in seen:
+            seen.add(difficulty)
+            if len(seen) == self._CURRICULUM_SHAPE_BUDGET + 1:
+                logger.warning(
+                    f"curriculum produced {len(seen)} distinct difficulty values — "
+                    "each is a separate XLA compilation of the train step. Raise "
+                    "schedule.difficulty_step (coarser bins) to bound compile time; "
+                    "compiled programs are cached, but a fine-grained schedule can "
+                    "spend minutes per new shape."
+                )
         if self._curriculum_post is not None:
             return self._curriculum_post(stacked, difficulty)
         if self._curriculum_metric == "seqlen":
@@ -1668,6 +1693,12 @@ class DeepSpeedEngine:
             eng = self._checkpoint_writer()
             self.checkpoint_commit()
             eng.create(tag)
+            if jax.process_index() == 0:
+                # the writer branch must ship the recovery script too
+                # (the reference copies it on EVERY save, engine.py:3991)
+                from deepspeed_tpu.checkpoint.engine import copy_recovery_script
+
+                copy_recovery_script(save_dir)
             eng.save(
                 {
                     "params": params_payload,

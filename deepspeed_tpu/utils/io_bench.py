@@ -13,6 +13,8 @@ import time
 
 import numpy as np
 
+from deepspeed_tpu.utils.logging import logger
+
 
 def _bench_one(path, size_mb, block_size, parallelism, read):
     from deepspeed_tpu.ops.aio import AioHandle
@@ -22,6 +24,16 @@ def _bench_one(path, size_mb, block_size, parallelism, read):
     buf[:] = 1.0
     if read:
         h.sync_pwrite(buf, path)  # seed the file
+        # drop the freshly-written pages so the read measures the DEVICE,
+        # not the page cache (--tune would otherwise recommend AIO params
+        # from cache-bound numbers)
+        try:
+            fd = os.open(path, os.O_RDONLY)
+            os.fsync(fd)
+            os.posix_fadvise(fd, 0, 0, os.POSIX_FADV_DONTNEED)
+            os.close(fd)
+        except (OSError, AttributeError):
+            logger.warning("could not drop page cache; read bandwidth may be cache-bound")
     t0 = time.perf_counter()
     if read:
         h.sync_pread(buf, path)

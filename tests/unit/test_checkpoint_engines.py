@@ -61,13 +61,37 @@ class TestEngines:
         eng = AsyncCheckpointEngine()
         import deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine as ce
 
-        def boom(state, path):
+        def boom(self, name, arr):
             raise OSError("disk full")
 
-        monkeypatch.setattr(ce, "_write_npz", boom)
+        monkeypatch.setattr(ce._NpzStreamWriter, "write", boom)
         eng.save(_state(), str(tmp_path / "x" / "state"))
         with pytest.raises(RuntimeError, match="disk full"):
             eng.commit("t")
+
+    def test_async_buffering_is_bounded(self, tmp_path, monkeypatch):
+        """FastPersist semantics: a slow filesystem must NOT make the
+        writer buffer the whole tree — at most QUEUE_DEPTH leaves are live
+        (the round-2 writer materialized everything via _to_host)."""
+        import deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine as ce
+
+        orig = ce._NpzStreamWriter.write
+
+        def slow(self, name, arr):
+            time.sleep(0.01)
+            orig(self, name, arr)
+
+        monkeypatch.setattr(ce._NpzStreamWriter, "write", slow)
+        eng = AsyncCheckpointEngine()
+        state = {"params": {f"w{i}": np.full((64,), i, np.float32) for i in range(40)}}
+        state["__meta__"] = {"step": 1}
+        eng.save(state, str(tmp_path / "c" / "state"))
+        eng.commit("t")
+        assert eng.max_buffered <= eng.QUEUE_DEPTH, eng.max_buffered
+        out = eng.load(str(tmp_path / "c" / "state"))
+        leaves = out["params"]  # flatten (sorted-key) order
+        expect = [float(l[0]) for l in jax.tree_util.tree_leaves(state["params"])]
+        assert [float(l[0]) for l in leaves] == expect
 
     def test_decoupled_rank_suffix(self, tmp_path):
         eng = DecoupledCheckpointEngine()
@@ -131,13 +155,17 @@ class TestEngineIntegration:
         the writer and assert save_checkpoint is fast while commit waits."""
         import deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine as ce
 
-        orig = ce._write_npz
+        orig = ce._write_meta
 
-        def slow(state, path):
+        # the tail of the serialization (meta flush) runs off-thread: save
+        # must have returned long before it lands; commit is where the wait
+        # lives. (Per-leaf writes can back-pressure save by design now —
+        # bounded buffering — so the slow part sits after the last leaf.)
+        def slow(base, meta):
             time.sleep(0.5)
-            orig(state, path)
+            orig(base, meta)
 
-        monkeypatch.setattr(ce, "_write_npz", slow)
+        monkeypatch.setattr(ce, "_write_meta", slow)
         dataset = random_dataset(n=64)
         params = make_mlp_params(jax.random.key(0))
         engine, _, _, _ = deepspeed_tpu.initialize(

@@ -248,3 +248,99 @@ def test_engine_curriculum_seqlen_truncation(devices8):
     losses = [float(engine.train_batch(batch={"input_ids": toks})) for _ in range(5)]
     assert np.isfinite(losses).all()
     assert engine.curriculum_scheduler.get_current_difficulty() == 32
+
+
+class TestIndexedDataset:
+    """mmap indexed dataset + multi-worker analyzer (reference
+    data_sampling/indexed_dataset.py + data_analyzer.py)."""
+
+    def _build(self, tmp_path, n=50, seed=0):
+        from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import (
+            MMapIndexedDatasetBuilder,
+        )
+
+        rng = np.random.default_rng(seed)
+        prefix = str(tmp_path / "corpus")
+        b = MMapIndexedDatasetBuilder(prefix, dtype=np.int32)
+        samples = [rng.integers(0, 1000, size=rng.integers(3, 40)).astype(np.int32) for _ in range(n)]
+        for s in samples:
+            b.add_item(s)
+        b.finalize()
+        return prefix, samples
+
+    def test_roundtrip_zero_copy(self, tmp_path):
+        from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import MMapIndexedDataset
+
+        prefix, samples = self._build(tmp_path)
+        ds = MMapIndexedDataset(prefix)
+        assert len(ds) == len(samples)
+        for i in (0, 7, len(samples) - 1):
+            np.testing.assert_array_equal(np.asarray(ds[i]), samples[i])
+        # reads are memmap views, not copies
+        assert isinstance(ds[0], np.memmap)
+
+    def test_merge_files(self, tmp_path):
+        from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import (
+            MMapIndexedDataset,
+            MMapIndexedDatasetBuilder,
+        )
+
+        p1, s1 = self._build(tmp_path / "a", n=5, seed=1)
+        p2, s2 = self._build(tmp_path / "b", n=7, seed=2)
+        merged = str(tmp_path / "merged")
+        b = MMapIndexedDatasetBuilder(merged, dtype=np.int32)
+        b.merge_file(p1)
+        b.merge_file(p2)
+        b.finalize()
+        ds = MMapIndexedDataset(merged)
+        assert len(ds) == 12
+        np.testing.assert_array_equal(np.asarray(ds[0]), s1[0])
+        np.testing.assert_array_equal(np.asarray(ds[5]), s2[0])
+
+    def test_distributed_analyzer_feeds_sampler(self, tmp_path):
+        """Worker-sharded metrics merge into the mmap array the curriculum
+        sampler consumes; per-worker execution matches single-shot."""
+        from deepspeed_tpu.runtime.data_pipeline.data_sampler import CurriculumDataSampler
+        from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import (
+            DistributedDataAnalyzer,
+            MMapIndexedDataset,
+        )
+
+        prefix, samples = self._build(tmp_path, n=40)
+        ds = MMapIndexedDataset(prefix)
+        out = str(tmp_path / "metrics")
+        ana = DistributedDataAnalyzer(
+            ds, {"seqlen": lambda s: float(len(s))}, out, num_workers=4
+        )
+        # workers run independently (different processes in production)
+        for w in range(4):
+            ana.run_worker(w)
+        merged = ana.merge()
+        expect = np.array([len(s) for s in samples], np.float64)
+        np.testing.assert_array_equal(merged["seqlen"], expect)
+        # index sidecar with percentile boundaries
+        import json as _json
+
+        idx = _json.load(open(f"{out}/seqlen.index.json"))
+        assert idx["num_samples"] == 40 and "50" in idx["percentiles"]
+
+        metric = DistributedDataAnalyzer.load_metric(out, "seqlen")
+        assert isinstance(metric, np.memmap)
+        sampler = CurriculumDataSampler(metric, batch_size=4, difficulty_type="percentile")
+        sampler.set_difficulty(25.0)
+        batch = next(iter(sampler))
+        assert np.all(expect[batch] <= np.percentile(expect, 30))
+
+    def test_unfinished_worker_fails_fast(self, tmp_path):
+        from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import (
+            DistributedDataAnalyzer,
+            MMapIndexedDataset,
+        )
+
+        prefix, _ = self._build(tmp_path, n=8)
+        ana = DistributedDataAnalyzer(
+            MMapIndexedDataset(prefix), {"m": len}, str(tmp_path / "out"), num_workers=2
+        )
+        ana.run_worker(0)
+        with pytest.raises(FileNotFoundError, match="worker 1"):
+            ana.merge()
