@@ -164,6 +164,7 @@ def main():
             dtype="bfloat16",
             remat_policy=os.environ.get("DSTPU_REMAT_POLICY", "flash"),
             fused_ce=os.environ.get("DSTPU_FUSED_CE", "0") == "1",
+            matmul_precision=os.environ.get("DSTPU_MATMUL_PRECISION", "default"),
         )
         bsz, seq, steps, warmup = int(os.environ.get("DSTPU_BENCH_BSZ", 6)), 2048, 10, 4
     else:  # smoke-test path for CPU dev boxes

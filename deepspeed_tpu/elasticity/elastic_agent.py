@@ -107,7 +107,12 @@ class ElasticAgent:
 
     def _launch(self, world: int) -> subprocess.Popen:
         cfg_path = self._patched_config_path(world)
-        argv = [a.format(config=cfg_path, world_size=world) for a in self.cmd]
+        # literal replace, NOT str.format: training commands legitimately
+        # contain braces (shell/awk/JSON) that format() would choke on
+        argv = [
+            a.replace("{config}", cfg_path).replace("{world_size}", str(world))
+            for a in self.cmd
+        ]
         env = dict(os.environ)
         env["DSTPU_ELASTIC_CONFIG"] = cfg_path
         env["DSTPU_WORLD_SIZE"] = str(world)
@@ -143,13 +148,20 @@ class ElasticAgent:
 
     def _poll_world(self, last: int) -> int:
         """Read membership, treating a transient failure (hostfile briefly
-        missing, world_file mid-rewrite → int('') ValueError) as 'membership
-        unchanged' — a failed poll must never take down a healthy run."""
+        missing or empty, world_file mid-rewrite → int('') ValueError) as
+        'membership unchanged' — a failed poll must never take down a
+        healthy run."""
         try:
-            return self._world_fn()
+            world = self._world_fn()
         except (OSError, ValueError) as e:
             logger.warning(f"elastic agent: membership poll failed ({e}); keeping world={last}")
             return last
+        if world <= 0:
+            # an empty-but-readable hostfile parses to 0 — that is a
+            # mid-rewrite artifact, not a real zero-node cluster
+            logger.warning(f"elastic agent: membership poll read world={world}; keeping world={last}")
+            return last
+        return world
 
     # ------------------------------------------------------------------
     def run(self) -> int:
@@ -177,13 +189,21 @@ class ElasticAgent:
                     continue
                 new_world = self._poll_world(world)
                 if new_world != world:
+                    # budget check BEFORE terminating: never kill a healthy
+                    # run the agent is not allowed to replace
+                    if self.restarts + 1 > self.max_restarts:
+                        logger.error(
+                            f"elastic agent: membership change {world} -> {new_world} ignored — "
+                            f"restart budget ({self.max_restarts}) exhausted; current run continues"
+                        )
+                        world = new_world  # don't re-trigger every poll
+                        time.sleep(self.poll_interval)
+                        continue
                     logger.warning(
                         f"elastic agent: membership change {world} -> {new_world}; restarting into UCP resume"
                     )
                     self._terminate(proc)
                     self.restarts += 1
-                    if self.restarts > self.max_restarts:
-                        return 1
                     world = new_world
                     proc = self._launch(world)
                     continue

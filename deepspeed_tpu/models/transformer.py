@@ -83,6 +83,11 @@ class TransformerConfig:
     parallel_block: bool = False
     # phi partial rotary: rope applies to the first rope_frac*head_dim dims
     rope_frac: float = 1.0
+    # layer-projection matmul precision (VERDICT fp8 lever; ops/qmatmul.py):
+    # "default" = model dtype; "fp8" = e4m3 tensor-scaled forward operands;
+    # "int8" = symmetric int8 forward (native 2x MXU rate on v5e). Backward
+    # stays full precision (straight-through vjp). Head/embed stay dense.
+    matmul_precision: str = "default"
     dtype: str = "bfloat16"
     remat: bool = True
     # remat policy knob (reference activation_checkpointing config; VERDICT
@@ -134,6 +139,11 @@ class TransformerConfig:
             raise ValueError(
                 f"seq_impl={self.seq_impl!r}: expected 'ulysses' or 'ring' "
                 "(a typo would silently fall back to the wrong parallelism)"
+            )
+        if self.matmul_precision not in ("default", "fp8", "int8"):
+            raise ValueError(
+                f"matmul_precision={self.matmul_precision!r}: expected "
+                "'default', 'fp8' or 'int8'"
             )
 
     @property
@@ -459,13 +469,23 @@ def _act_constraint(x, seq_sharded=True):
     return constrain(x, BATCH_AXES, seq, None)
 
 
+def _proj(c: TransformerConfig, x, w):
+    """Layer projection honoring matmul_precision (quantized forward,
+    full-precision backward — ops/qmatmul.py)."""
+    if c.matmul_precision == "default":
+        return x @ w
+    from deepspeed_tpu.ops.qmatmul import qmatmul
+
+    return qmatmul(x, w, c.matmul_precision)
+
+
 def _attention_block(c: TransformerConfig, lp, x, positions, segment_ids, kv_cache=None):
     """Self-attention for one layer. x: [b, s, h]."""
     b, s, h = x.shape
     nh, nkv, d = c.n_heads, c.kv_heads, c.head_dim
-    q = x @ lp["wq"]
-    k = x @ lp["wk"]
-    v = x @ lp["wv"]
+    q = _proj(c, x, lp["wq"])
+    k = _proj(c, x, lp["wk"])
+    v = _proj(c, x, lp["wv"])
     if c.attn_qkv_bias:
         q, k, v = q + lp["wq_b"], k + lp["wk_b"], v + lp["wv_b"]
     q = q.reshape(b, s, nh, d).transpose(0, 2, 1, 3)
@@ -504,7 +524,7 @@ def _attention_block(c: TransformerConfig, lp, x, positions, segment_ids, kv_cac
         else:
             out = attention_op(q, k, v, causal=True, segment_ids=segment_ids)
     out = out.transpose(0, 2, 1, 3).reshape(b, s, nh * d)
-    out = out @ lp["wo"]
+    out = _proj(c, out, lp["wo"])
     if c.attn_out_bias:
         out = out + lp["wo_b"]
     return out, new_cache
@@ -515,17 +535,17 @@ def _mlp_block(c: TransformerConfig, lp, x):
         from deepspeed_tpu.parallel.moe import moe_mlp
 
         return moe_mlp(c, lp, x)
-    up = x @ lp["w_up"]
+    up = _proj(c, x, lp["w_up"])
     if c.mlp_bias:
         up = up + lp["w_up_b"]
     if c.activation == "swiglu":
-        gate = x @ lp["w_gate"]
+        gate = _proj(c, x, lp["w_gate"])
         if c.mlp_bias:
             gate = gate + lp["w_gate_b"]
         act = jax.nn.silu(gate) * up
     else:
         act = jax.nn.gelu(up, approximate=c.activation != "gelu_exact")
-    out = act @ lp["w_down"]
+    out = _proj(c, act, lp["w_down"])
     if c.mlp_bias:
         out = out + lp["w_down_b"]
     return out, jnp.float32(0.0)

@@ -28,6 +28,11 @@ import numpy as np
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 
+# queue marker: the producer aborted mid-save; the writer must discard the
+# partial archive instead of finalizing it (AsyncCheckpointEngine.save)
+_ABORT = object()
+
+
 class CheckpointEngine(ABC):
     """Reference ABC (checkpoint_engine.py:21): create/save/load/commit."""
 
@@ -186,6 +191,7 @@ class AsyncCheckpointEngine(CheckpointEngine):
 
         def write():
             sentinel_seen = False
+            aborted = False
             try:
                 w = _NpzStreamWriter(base + ".npz")
                 try:
@@ -194,9 +200,19 @@ class AsyncCheckpointEngine(CheckpointEngine):
                         if item is None:
                             sentinel_seen = True
                             break
+                        if item is _ABORT:
+                            sentinel_seen = True
+                            aborted = True
+                            break
                         w.write(*item)
                 finally:
                     w.close()
+                if aborted:
+                    # producer died mid-tree: a truncated archive with a
+                    # complete-looking meta sidecar would masquerade as a
+                    # valid checkpoint — remove it and record the abort
+                    os.unlink(base + ".npz")
+                    raise RuntimeError("save aborted: snapshot failed mid-tree")
                 _write_meta(base, meta)
             except BaseException as e:  # surfaced at commit
                 self._errors.append(e)
@@ -204,23 +220,25 @@ class AsyncCheckpointEngine(CheckpointEngine):
                 # failure came after it (meta/close), the queue is already
                 # empty and a blocking drain would deadlock commit()
                 while not sentinel_seen:
-                    if q.get() is None:
+                    if q.get() in (None, _ABORT):
                         sentinel_seen = True
 
         t = threading.Thread(target=write, daemon=True)
         t.start()
         self._pending.append(t)
+        ok = False
         try:
             for name, leaf in _iter_named_leaves(state_dict):
                 # put() blocks at queue_depth: bounded host buffering even
                 # when the filesystem is slower than the snapshots
                 q.put((name, _snapshot_leaf(leaf)))
                 self.max_buffered = max(self.max_buffered, q.qsize())
+            ok = True
         finally:
             # ALWAYS release the writer (a snapshot error mid-loop would
             # otherwise leave it blocked on q.get() and hang commit());
-            # the raised error aborts the save, so the tag never publishes
-            q.put(None)
+            # the abort marker makes it discard the partial archive
+            q.put(None if ok else _ABORT)
 
     def load(self, path, map_location=None):
         return _read_npz(path)

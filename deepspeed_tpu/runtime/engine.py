@@ -1695,7 +1695,9 @@ class DeepSpeedEngine:
             eng.create(tag)
             if jax.process_index() == 0:
                 # the writer branch must ship the recovery script too
-                # (the reference copies it on EVERY save, engine.py:3991)
+                # (the reference copies it on EVERY save, engine.py:3991);
+                # the writers only create directories later, off-thread
+                os.makedirs(save_dir, exist_ok=True)
                 from deepspeed_tpu.checkpoint.engine import copy_recovery_script
 
                 copy_recovery_script(save_dir)

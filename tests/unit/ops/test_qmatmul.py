@@ -1,0 +1,74 @@
+"""Quantized-forward matmul tests (fp8/int8 lever, VERDICT round-2 next #9)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.qmatmul import qmatmul
+
+
+@pytest.mark.parametrize("mode", ["fp8", "int8"])
+def test_forward_close_to_dense(mode):
+    rng = jax.random.key(0)
+    x = jax.random.normal(rng, (4, 64, 128), jnp.float32)
+    w = jax.random.normal(jax.random.key(1), (128, 96), jnp.float32) * 0.1
+    dense = x @ w
+    q = qmatmul(x, w, mode)
+    rel = float(jnp.linalg.norm(q - dense) / jnp.linalg.norm(dense))
+    assert rel < 0.05, rel  # per-tensor-scaled 8-bit ops stay within ~5%
+
+
+@pytest.mark.parametrize("mode", ["fp8", "int8"])
+def test_backward_is_exact_dense_vjp(mode):
+    """Straight-through recipe: grads must equal the DENSE matmul's grads."""
+    x = jax.random.normal(jax.random.key(0), (8, 32), jnp.float32)
+    w = jax.random.normal(jax.random.key(1), (32, 16), jnp.float32)
+
+    # linear readout: both paths then see the same cotangent
+    gq = jax.grad(lambda x, w: jnp.sum(qmatmul(x, w, mode)), argnums=(0, 1))(x, w)
+    gd = jax.grad(lambda x, w: jnp.sum(x @ w), argnums=(0, 1))(x, w)
+    for a, b in zip(gq, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
+
+
+def test_bad_mode_rejected():
+    x = jnp.ones((2, 4))
+    w = jnp.ones((4, 4))
+    with pytest.raises(ValueError, match="mode"):
+        qmatmul(x, w, "int4")
+
+
+@pytest.mark.parametrize("mode", ["fp8", "int8"])
+def test_model_loss_parity_and_training(mode, devices8):
+    """The quantized model trains and its loss trajectory stays within
+    tolerance of the dense model (the VERDICT's loss-parity criterion)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import get_config, init_params, make_loss_fn
+
+    losses = {}
+    for prec in ("default", mode):
+        from deepspeed_tpu.parallel.topology import reset_topology
+
+        reset_topology()
+        cfg = get_config("tiny", dtype="float32", matmul_precision=prec)
+        params = init_params(cfg, jax.random.key(0))
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=make_loss_fn(cfg), model_parameters=params,
+            config={
+                "train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+                "zero_optimization": {"stage": 2},
+                "mesh": {"data": 8},
+                "steps_per_print": 1000,
+            },
+        )
+        toks = np.random.default_rng(0).integers(0, cfg.vocab_size, size=(8, 33)).astype(np.int32)
+        losses[prec] = [float(engine.train_batch(batch={"input_ids": toks})) for _ in range(6)]
+    dense, quant = losses["default"], losses[mode]
+    assert quant[-1] < quant[0], quant  # trains
+    # trajectory parity: within 5% relative (or 0.05 absolute once the
+    # loss is near zero) at every step
+    for d, q in zip(dense, quant):
+        assert abs(d - q) < max(0.05 * d, 0.05), (dense, quant)
