@@ -228,6 +228,13 @@ class DeepSpeedConfig(DSConfigModel):
     dump_state: bool = False
     wall_clock_breakdown: bool = False
     memory_breakdown: bool = False
+    # None = auto. check_grad_overflow (reference engine.py:1774 bf16 knob):
+    # the isfinite scan + functional skip-step — auto runs it for fp16 only
+    # (bf16/fp32 training has no loss scale to protect; the pass costs a full
+    # fp32-grad read per step). monitor_grad_norm: the global-norm reduction
+    # — auto computes it when clipping or a monitor consumes it.
+    check_grad_overflow: Optional[bool] = None
+    monitor_grad_norm: Optional[bool] = None
     zero_allow_untested_optimizer: bool = True
     zero_force_ds_cpu_optimizer: bool = False  # [compat] no CPU-only optimizer binary on TPU
     graph_harvesting: bool = False  # [compat] jit covers CUDA-graph capture

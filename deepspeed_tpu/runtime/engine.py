@@ -1015,6 +1015,26 @@ class DeepSpeedEngine:
 
         return micro_grads
 
+    def _grad_epilogue_flags(self):
+        """Resolve check_grad_overflow / monitor_grad_norm (None = auto):
+        both cost a full fp32-grad pass per step — auto runs the overflow
+        scan for fp16 only (reference bf16 engines skip it) and the norm
+        reduction only when a monitor consumes it. Shared by the fused and
+        imperative step builders; the 1-bit path keeps its own overflow
+        handling (load-bearing for the compressed-state skip-step)."""
+        cfg = self.config
+        check_overflow = (
+            cfg.check_grad_overflow
+            if cfg.check_grad_overflow is not None
+            else self.fp16_enabled
+        )
+        monitor_norm = (
+            cfg.monitor_grad_norm
+            if cfg.monitor_grad_norm is not None
+            else bool(getattr(self.monitor, "enabled", False)) or cfg.wall_clock_breakdown
+        )
+        return check_overflow, monitor_norm
+
     def _build_train_step(self, grads_only=False):
         if getattr(self.optimizer, "collective_grad_exchange", False):
             if getattr(self.loss_fn, "custom_value_and_grad", None) is not None:
@@ -1031,6 +1051,7 @@ class DeepSpeedEngine:
         mesh = self.topo.mesh
         accum_dtype = self.grad_accum_dtype
         stream = self._weight_stream
+        check_overflow, monitor_norm = self._grad_epilogue_flags()
 
         custom_vg = getattr(self.loss_fn, "custom_value_and_grad", None)
         if stream and (custom_vg is not None or self._quantized_exchange_enabled()):
@@ -1112,14 +1133,25 @@ class DeepSpeedEngine:
             def grad_epilogue(grads):
                 inv = 1.0 / (gas * scale)
                 grads = jax.tree.map(lambda g: (g.astype(jnp.float32) * inv), grads)
-                overflow = ls.has_overflow(grads)
-                safe_grads = jax.tree.map(
-                    lambda g: jnp.where(jnp.isfinite(g), g, jnp.zeros_like(g)), grads
-                )
+                # the overflow scan + NaN-zeroing cost a full fp32-grad pass:
+                # auto mode runs them for fp16 only (reference bf16 engines
+                # skip them too; config.check_grad_overflow forces either way)
+                if check_overflow:
+                    overflow = ls.has_overflow(grads)
+                    safe_grads = jax.tree.map(
+                        lambda g: jnp.where(jnp.isfinite(g), g, jnp.zeros_like(g)), grads
+                    )
+                else:
+                    overflow = jnp.zeros((), jnp.bool_)
+                    safe_grads = grads
                 if clip > 0:
                     safe_grads, grad_norm = clip_by_global_norm(safe_grads, clip)
-                else:
+                elif monitor_norm:
                     grad_norm = global_grad_norm(safe_grads)
+                else:
+                    # norm reduction skipped (another full grad read): report
+                    # NaN so a consumer can tell "not computed" from 0
+                    grad_norm = jnp.full((), jnp.nan, jnp.float32)
                 return safe_grads, overflow, grad_norm
 
             if stream:
@@ -1313,18 +1345,27 @@ class DeepSpeedEngine:
         clip = self.config.gradient_clipping
         scaler_cfg = self.scaler_cfg
         gas = self.config.gradient_accumulation_steps
+        check_overflow, monitor_norm = self._grad_epilogue_flags()
 
         def apply_step(params, opt_state, scaler_state, acc_grads, lr):
             params = self._stage_params(params)
             scale = scaler_state.scale
             inv = 1.0 / (gas * scale)
             grads = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, acc_grads)
-            overflow = ls.has_overflow(grads)
-            safe_grads = jax.tree.map(lambda g: jnp.where(jnp.isfinite(g), g, jnp.zeros_like(g)), grads)
+            if check_overflow:
+                overflow = ls.has_overflow(grads)
+                safe_grads = jax.tree.map(
+                    lambda g: jnp.where(jnp.isfinite(g), g, jnp.zeros_like(g)), grads
+                )
+            else:
+                overflow = jnp.zeros((), jnp.bool_)
+                safe_grads = grads
             if clip > 0:
                 safe_grads, grad_norm = clip_by_global_norm(safe_grads, clip)
-            else:
+            elif monitor_norm:
                 grad_norm = global_grad_norm(safe_grads)
+            else:
+                grad_norm = jnp.full((), jnp.nan, jnp.float32)
             new_params, new_opt_state = self._opt_apply(safe_grads, opt_state, params, lr, overflow)
             new_scaler = ls.update_state(scaler_cfg, scaler_state, overflow)
             return new_params, new_opt_state, new_scaler, grad_norm, overflow
@@ -1586,9 +1627,12 @@ class DeepSpeedEngine:
             if overflow_f:
                 self.skipped_steps += 1
             loss_f = float(loss) if loss is not None else float("nan")
+            gn = float(grad_norm) if grad_norm is not None else float("nan")
+            # NaN is the "not computed" sentinel (monitor_grad_norm auto-off)
+            gn_s = f"{gn:.3f}" if gn == gn else "n/a (set monitor_grad_norm)"
             log_dist(
                 f"step={self.global_steps} loss={loss_f:.4f} lr={self._current_lr():.3e} "
-                f"grad_norm={float(grad_norm):.3f} scale={float(self.scaler_state.scale):.1f}"
+                f"grad_norm={gn_s} scale={float(self.scaler_state.scale):.1f}"
                 + (" OVERFLOW-SKIPPED" if overflow_f else ""),
                 ranks=[0],
             )
