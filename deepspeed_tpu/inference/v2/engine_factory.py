@@ -41,6 +41,8 @@ def _register_builtins():
         "FalconForCausalLM",
         "PhiForCausalLM",
         "Phi3ForCausalLM",
+        "GPT2LMHeadModel",
+        "OPTForCausalLM",
     ):
         POLICY_REGISTRY.setdefault(arch, load_hf_model)
 

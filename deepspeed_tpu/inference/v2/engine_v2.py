@@ -100,12 +100,15 @@ class InferenceEngineV2:
                 a = T._norm(x, lp["attn_norm"], lp.get("attn_norm_b"), c.norm, c.norm_eps)
                 b_, t_, h = a.shape
                 nh, nkv, d = c.n_heads, c.kv_heads, c.head_dim
-                q = (a @ lp["wq"]).reshape(1, t_, nh, d).transpose(0, 2, 1, 3)
-                k = (a @ lp["wk"]).reshape(1, t_, nkv, d).transpose(0, 2, 1, 3)
-                v = (a @ lp["wv"]).reshape(1, t_, nkv, d).transpose(0, 2, 1, 3)
+                q, k, v = a @ lp["wq"], a @ lp["wk"], a @ lp["wv"]
+                if c.attn_qkv_bias:
+                    q, k, v = q + lp["wq_b"], k + lp["wk_b"], v + lp["wv_b"]
+                q = q.reshape(1, t_, nh, d).transpose(0, 2, 1, 3)
+                k = k.reshape(1, t_, nkv, d).transpose(0, 2, 1, 3)
+                v = v.reshape(1, t_, nkv, d).transpose(0, 2, 1, 3)
                 if c.position == "rope":
-                    q = T._rope(q, positions[None], c.rope_theta)
-                    k = T._rope(k, positions[None], c.rope_theta)
+                    q = T._rope(q, positions[None], c.rope_theta, c.rope_frac)
+                    k = T._rope(k, positions[None], c.rope_theta, c.rope_frac)
                 # scatter new K/V into the paged cache (mask invalid rows to
                 # a scratch block write at their own position — clip keeps
                 # them inside the table; n_valid < t only pads the tail,
@@ -122,7 +125,15 @@ class InferenceEngineV2:
 
                 out = mha_reference(q, k_ctx, v_ctx, causal=False, bias=bias)
                 out = out.transpose(0, 2, 1, 3).reshape(1, t_, nh * d)
-                x = x + out @ lp["wo"]
+                attn_out = out @ lp["wo"]
+                if c.attn_out_bias:
+                    attn_out = attn_out + lp["wo_b"]
+                if c.parallel_block:
+                    # falcon/phi: both branches read the pre-attention state
+                    m = T._norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"), c.norm, c.norm_eps)
+                    mlp_out, _ = T._mlp_block(c, lp, m)
+                    return x + attn_out + mlp_out, (kc_l, vc_l)
+                x = x + attn_out
                 m = T._norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"), c.norm, c.norm_eps)
                 mlp_out, _ = T._mlp_block(c, lp, m)
                 return x + mlp_out, (kc_l, vc_l)
@@ -130,10 +141,7 @@ class InferenceEngineV2:
             x, (k_new, v_new) = jax.lax.scan(layer_step, x, (params["layers"], k_cache, v_cache))
             x = T._norm(x, params["final_norm"], params.get("final_norm_b"), c.norm, c.norm_eps)
             last = jnp.take_along_axis(x, jnp.clip(n_valid - 1, 0, t - 1)[None, None, None], axis=1)[:, 0]
-            if c.tie_embeddings:
-                logits = last @ params["embed"].astype(last.dtype).T
-            else:
-                logits = last @ T._dequant_tree(params["lm_head"], last.dtype)
+            logits = T._apply_lm_head(params, last, c)
             return logits[0].astype(jnp.float32), k_new, v_new
 
         return jax.jit(row_step, donate_argnums=(5, 6))
@@ -175,16 +183,26 @@ class InferenceEngineV2:
                 lp, kc_l, vc_l = inputs
                 lp = T._dequant_tree(lp, dtype)
                 a = T._norm(x, lp["attn_norm"], lp.get("attn_norm_b"), c.norm, c.norm_eps)
-                q = (a[0] @ lp["wq"]).reshape(t, nh, d)
-                k = (a[0] @ lp["wk"]).reshape(t, nkv, d)
-                v = (a[0] @ lp["wv"]).reshape(t, nkv, d)
+                q, k, v = a[0] @ lp["wq"], a[0] @ lp["wk"], a[0] @ lp["wv"]
+                if c.attn_qkv_bias:
+                    q, k, v = q + lp["wq_b"], k + lp["wk_b"], v + lp["wv_b"]
+                q = q.reshape(t, nh, d)
+                k = k.reshape(t, nkv, d)
+                v = v.reshape(t, nkv, d)
                 if c.position == "rope":
-                    q = T._rope(q.transpose(1, 0, 2)[None], positions[None], c.rope_theta)[0].transpose(1, 0, 2)
-                    k = T._rope(k.transpose(1, 0, 2)[None], positions[None], c.rope_theta)[0].transpose(1, 0, 2)
+                    q = T._rope(q.transpose(1, 0, 2)[None], positions[None], c.rope_theta, c.rope_frac)[0].transpose(1, 0, 2)
+                    k = T._rope(k.transpose(1, 0, 2)[None], positions[None], c.rope_theta, c.rope_frac)[0].transpose(1, 0, 2)
                 kc_l = kc_l.at[blk, row].set(k)
                 vc_l = vc_l.at[blk, row].set(v)
                 out = paged_attention(q, kc_l, vc_l, tok_tables, positions, trash)
-                x = x + (out.reshape(t, nh * d) @ lp["wo"])[None]
+                attn_out = (out.reshape(t, nh * d) @ lp["wo"])[None]
+                if c.attn_out_bias:
+                    attn_out = attn_out + lp["wo_b"]
+                if c.parallel_block:
+                    m = T._norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"), c.norm, c.norm_eps)
+                    mlp_out, _ = T._mlp_block(c, lp, m)
+                    return x + attn_out + mlp_out, (kc_l, vc_l)
+                x = x + attn_out
                 m = T._norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"), c.norm, c.norm_eps)
                 mlp_out, _ = T._mlp_block(c, lp, m)
                 return x + mlp_out, (kc_l, vc_l)
@@ -192,10 +210,7 @@ class InferenceEngineV2:
             x, (k_new, v_new) = jax.lax.scan(layer_step, x, (params["layers"], k_cache, v_cache))
             x = T._norm(x, params["final_norm"], params.get("final_norm_b"), c.norm, c.norm_eps)
             last = x[0, jnp.clip(last_idx, 0, t - 1)]  # [R, h]
-            if c.tie_embeddings:
-                logits = last @ params["embed"].astype(last.dtype).T
-            else:
-                logits = last @ T._dequant_tree(params["lm_head"], last.dtype)
+            logits = T._apply_lm_head(params, last, c)
             return logits.astype(jnp.float32), k_new, v_new
 
         return jax.jit(step, donate_argnums=(6, 7))

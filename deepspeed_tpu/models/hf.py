@@ -8,7 +8,7 @@ a (:class:`TransformerConfig`, stacked-params pytree) pair that trains or
 serves through ``deepspeed_tpu.initialize`` / ``init_inference`` unchanged.
 
 Supported ``model_type``s: llama, mistral, qwen2, qwen2_moe, falcon, phi,
-phi3. Dispatch is by ``config.json``'s ``model_type`` (see
+phi3, gpt2, opt. Dispatch is by ``config.json``'s ``model_type`` (see
 :data:`ARCH_LOADERS`); the inference engine factory additionally dispatches
 on ``architectures[0]`` (engine_factory.py).
 
@@ -29,7 +29,7 @@ Weight-layout notes (why each mapping is what it is):
 import dataclasses
 import json
 import os
-from typing import Any, Callable, Dict, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -230,9 +230,62 @@ def config_from_hf(hf_cfg) -> TransformerConfig:
         )
     if mt == "phi3":
         return _llama_like_config(get)
+    if mt == "gpt2":
+        h = get("n_embd")
+        act = get("activation_function", "gelu_new")
+        if act not in ("gelu_new", "gelu_pytorch_tanh"):
+            raise ValueError(f"gpt2: activation_function={act!r} is not supported (gelu_new only)")
+        return TransformerConfig(
+            vocab_size=get("vocab_size"),
+            hidden_size=h,
+            n_layers=get("n_layer"),
+            n_heads=get("n_head"),
+            ffn_hidden_size=get("n_inner", None) or 4 * h,
+            max_seq_len=get("n_positions", 1024),
+            norm="layernorm",
+            activation="gelu",  # gpt2 gelu_new = tanh approx
+            position="learned",
+            norm_eps=float(get("layer_norm_epsilon", 1e-5)),
+            tie_embeddings=True,
+            attn_qkv_bias=True,
+            attn_out_bias=True,
+            mlp_bias=True,
+        )
+    if mt == "opt":
+        h = get("hidden_size")
+        if get("word_embed_proj_dim", h) != h:
+            raise ValueError(
+                "opt: word_embed_proj_dim != hidden_size (opt-350m-style "
+                "embedding projection) is not supported"
+            )
+        if not get("do_layer_norm_before", True):
+            raise ValueError("opt: post-layernorm (do_layer_norm_before=False) is not supported")
+        # model_type "opt" covers relu (OPT) and gelu (Galactica) variants —
+        # read the config instead of assuming, or gelu checkpoints would
+        # silently run through relu
+        act_map = {"relu": "relu", "gelu": "gelu_exact", "gelu_new": "gelu"}
+        act = get("activation_function", "relu")
+        if act not in act_map:
+            raise ValueError(f"opt: activation_function={act!r} is not supported")
+        return TransformerConfig(
+            vocab_size=get("vocab_size"),
+            hidden_size=h,
+            n_layers=get("num_hidden_layers"),
+            n_heads=get("num_attention_heads"),
+            ffn_hidden_size=get("ffn_dim"),
+            max_seq_len=get("max_position_embeddings", 2048),
+            norm="layernorm",
+            activation=act_map[act],
+            position="learned",
+            norm_eps=1e-5,
+            tie_embeddings=bool(get("tie_word_embeddings", True)),
+            attn_qkv_bias=True,
+            attn_out_bias=True,
+            mlp_bias=True,
+        )
     raise ValueError(
         f"unsupported model_type {mt!r}; supported: llama, mistral, qwen2, "
-        "qwen2_moe, falcon, phi, phi3"
+        "qwen2_moe, falcon, phi, phi3, gpt2, opt"
     )
 
 
@@ -372,6 +425,45 @@ def _phi_layer(take: _Taker, cfg: TransformerConfig, p: str, layers: Dict[str, l
     layers["w_down_b"].append(take(f"{p}.mlp.fc2.bias"))
 
 
+def _gpt2_layer(take: _Taker, cfg: TransformerConfig, p: str, layers: Dict[str, list]):
+    # GPT-2 Conv1D stores [in, out] — NO transpose; c_attn fuses qkv columns
+    layers["attn_norm"].append(take(f"{p}.ln_1.weight"))
+    layers["attn_norm_b"].append(take(f"{p}.ln_1.bias"))
+    h = cfg.hidden_size
+    w = take(f"{p}.attn.c_attn.weight")  # [h, 3h]
+    b = take(f"{p}.attn.c_attn.bias")  # [3h]
+    layers["wq"].append(w[:, :h])
+    layers["wk"].append(w[:, h : 2 * h])
+    layers["wv"].append(w[:, 2 * h :])
+    layers["wq_b"].append(b[:h])
+    layers["wk_b"].append(b[h : 2 * h])
+    layers["wv_b"].append(b[2 * h :])
+    layers["wo"].append(take(f"{p}.attn.c_proj.weight"))
+    layers["wo_b"].append(take(f"{p}.attn.c_proj.bias"))
+    layers["mlp_norm"].append(take(f"{p}.ln_2.weight"))
+    layers["mlp_norm_b"].append(take(f"{p}.ln_2.bias"))
+    layers["w_up"].append(take(f"{p}.mlp.c_fc.weight"))
+    layers["w_up_b"].append(take(f"{p}.mlp.c_fc.bias"))
+    layers["w_down"].append(take(f"{p}.mlp.c_proj.weight"))
+    layers["w_down_b"].append(take(f"{p}.mlp.c_proj.bias"))
+
+
+def _opt_layer(take: _Taker, cfg: TransformerConfig, p: str, layers: Dict[str, list]):
+    layers["attn_norm"].append(take(f"{p}.self_attn_layer_norm.weight"))
+    layers["attn_norm_b"].append(take(f"{p}.self_attn_layer_norm.bias"))
+    for name, hf in (("wq", "q_proj"), ("wk", "k_proj"), ("wv", "v_proj")):
+        layers[name].append(take.linear(f"{p}.self_attn.{hf}.weight"))
+        layers[f"{name}_b"].append(take(f"{p}.self_attn.{hf}.bias"))
+    layers["wo"].append(take.linear(f"{p}.self_attn.out_proj.weight"))
+    layers["wo_b"].append(take(f"{p}.self_attn.out_proj.bias"))
+    layers["mlp_norm"].append(take(f"{p}.final_layer_norm.weight"))
+    layers["mlp_norm_b"].append(take(f"{p}.final_layer_norm.bias"))
+    layers["w_up"].append(take.linear(f"{p}.fc1.weight"))
+    layers["w_up_b"].append(take(f"{p}.fc1.bias"))
+    layers["w_down"].append(take.linear(f"{p}.fc2.weight"))
+    layers["w_down_b"].append(take(f"{p}.fc2.bias"))
+
+
 _LAYER_EXTRACTORS: Dict[str, Callable] = {
     "llama": _llama_layer,
     "mistral": _llama_layer,
@@ -380,17 +472,26 @@ _LAYER_EXTRACTORS: Dict[str, Callable] = {
     "falcon": _falcon_layer,
     "phi": _phi_layer,
     "phi3": _phi3_layer,
+    "gpt2": _gpt2_layer,
+    "opt": _opt_layer,
 }
 
-# per-arch (embed key, final-norm key, layer prefix)
-_TOPLEVEL_KEYS: Dict[str, Tuple[str, str, str]] = {
-    "llama": ("model.embed_tokens.weight", "model.norm", "model.layers"),
-    "mistral": ("model.embed_tokens.weight", "model.norm", "model.layers"),
-    "qwen2": ("model.embed_tokens.weight", "model.norm", "model.layers"),
-    "qwen2_moe": ("model.embed_tokens.weight", "model.norm", "model.layers"),
-    "phi3": ("model.embed_tokens.weight", "model.norm", "model.layers"),
-    "phi": ("model.embed_tokens.weight", "model.final_layernorm", "model.layers"),
-    "falcon": ("transformer.word_embeddings.weight", "transformer.ln_f", "transformer.h"),
+# per-arch (embed key, final-norm key, layer prefix, pos-embed key or None)
+_TOPLEVEL_KEYS: Dict[str, Tuple[str, str, str, Optional[str]]] = {
+    "llama": ("model.embed_tokens.weight", "model.norm", "model.layers", None),
+    "mistral": ("model.embed_tokens.weight", "model.norm", "model.layers", None),
+    "qwen2": ("model.embed_tokens.weight", "model.norm", "model.layers", None),
+    "qwen2_moe": ("model.embed_tokens.weight", "model.norm", "model.layers", None),
+    "phi3": ("model.embed_tokens.weight", "model.norm", "model.layers", None),
+    "phi": ("model.embed_tokens.weight", "model.final_layernorm", "model.layers", None),
+    "falcon": ("transformer.word_embeddings.weight", "transformer.ln_f", "transformer.h", None),
+    "gpt2": ("transformer.wte.weight", "transformer.ln_f", "transformer.h", "transformer.wpe.weight"),
+    "opt": (
+        "model.decoder.embed_tokens.weight",
+        "model.decoder.final_layer_norm",
+        "model.decoder.layers",
+        "model.decoder.embed_positions.weight",
+    ),
 }
 
 
@@ -436,7 +537,7 @@ def load_hf_model(
     state = _load_state_dict(model_name_or_path)
     take = _Taker(state, dtype)
 
-    embed_key, norm_key, layer_prefix = _TOPLEVEL_KEYS[mt]
+    embed_key, norm_key, layer_prefix, pos_key = _TOPLEVEL_KEYS[mt]
     extract = _LAYER_EXTRACTORS[mt]
     layers = _expected_layer_keys(cfg)
     for i in range(cfg.n_layers):
@@ -449,6 +550,11 @@ def load_hf_model(
     }
     if cfg.norm == "layernorm":
         params["final_norm_b"] = take(f"{norm_key}.bias")
+    if cfg.position == "learned":
+        pe = take(pos_key)
+        if mt == "opt":
+            pe = pe[2:]  # OPT offsets learned positions by 2
+        params["pos_embed"] = pe
     if not cfg.tie_embeddings:
         if "lm_head.weight" in state:
             params["lm_head"] = take.linear("lm_head.weight")

@@ -63,7 +63,7 @@ class TransformerConfig:
     ffn_hidden_size: Optional[int] = None  # None → 4x (gelu) / 8/3x rounded (swiglu)
     max_seq_len: int = 2048
     norm: str = "rmsnorm"  # rmsnorm | layernorm
-    activation: str = "swiglu"  # swiglu | gelu (tanh approx) | gelu_exact (erf)
+    activation: str = "swiglu"  # swiglu | gelu (tanh approx) | gelu_exact (erf) | relu
     position: str = "rope"  # rope | learned
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
@@ -543,6 +543,8 @@ def _mlp_block(c: TransformerConfig, lp, x):
         if c.mlp_bias:
             gate = gate + lp["w_gate_b"]
         act = jax.nn.silu(gate) * up
+    elif c.activation == "relu":
+        act = jax.nn.relu(up)
     else:
         act = jax.nn.gelu(up, approximate=c.activation != "gelu_exact")
     out = _proj(c, act, lp["w_down"])

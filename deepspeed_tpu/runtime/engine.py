@@ -555,7 +555,15 @@ class DeepSpeedEngine:
         return [self._current_lr()]
 
     def get_global_grad_norm(self):
-        return self._last_grad_norm
+        """Last step's global grad norm, or None when the norm reduction was
+        skipped (monitor_grad_norm auto-off) — a numeric consumer must see an
+        explicit None, not a NaN that silently fails every comparison. Set
+        config monitor_grad_norm=True to always compute it."""
+        n = self._last_grad_norm
+        if n is None:
+            return None
+        f = float(n)
+        return None if f != f else f
 
     @property
     def loss_scale(self):
@@ -1136,13 +1144,15 @@ class DeepSpeedEngine:
                 # the overflow scan + NaN-zeroing cost a full fp32-grad pass:
                 # auto mode runs them for fp16 only (reference bf16 engines
                 # skip them too; config.check_grad_overflow forces either way)
-                if check_overflow:
-                    overflow = ls.has_overflow(grads)
+                overflow = ls.has_overflow(grads) if check_overflow else jnp.zeros((), jnp.bool_)
+                if check_overflow or clip > 0:
+                    # clipping must see sanitized grads even in bf16 mode: one
+                    # non-finite leaf would NaN the global norm and the clip
+                    # scale would poison EVERY parameter in a single step
                     safe_grads = jax.tree.map(
                         lambda g: jnp.where(jnp.isfinite(g), g, jnp.zeros_like(g)), grads
                     )
                 else:
-                    overflow = jnp.zeros((), jnp.bool_)
                     safe_grads = grads
                 if clip > 0:
                     safe_grads, grad_norm = clip_by_global_norm(safe_grads, clip)
@@ -1352,13 +1362,13 @@ class DeepSpeedEngine:
             scale = scaler_state.scale
             inv = 1.0 / (gas * scale)
             grads = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, acc_grads)
-            if check_overflow:
-                overflow = ls.has_overflow(grads)
+            overflow = ls.has_overflow(grads) if check_overflow else jnp.zeros((), jnp.bool_)
+            if check_overflow or clip > 0:
+                # see grad_epilogue: clip needs sanitized grads in bf16 too
                 safe_grads = jax.tree.map(
                     lambda g: jnp.where(jnp.isfinite(g), g, jnp.zeros_like(g)), grads
                 )
             else:
-                overflow = jnp.zeros((), jnp.bool_)
                 safe_grads = grads
             if clip > 0:
                 safe_grads, grad_norm = clip_by_global_norm(safe_grads, clip)

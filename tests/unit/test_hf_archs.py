@@ -132,6 +132,27 @@ def tiny_phi3(tmp_path_factory):
     )
 
 
+@pytest.fixture(scope="module")
+def tiny_gpt2(tmp_path_factory):
+    return _save_tiny(
+        tmp_path_factory, "hf_gpt2",
+        transformers.GPT2Config, transformers.GPT2LMHeadModel,
+        vocab_size=256, n_embd=64, n_layer=2, n_head=4, n_positions=128,
+    )
+
+
+@pytest.fixture(scope="module")
+def tiny_opt(tmp_path_factory):
+    return _save_tiny(
+        tmp_path_factory, "hf_opt",
+        transformers.OPTConfig, transformers.OPTForCausalLM,
+        vocab_size=256, hidden_size=64, ffn_dim=128, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=128,
+        do_layer_norm_before=True, word_embed_proj_dim=64,
+        pad_token_id=0, bos_token_id=1, eos_token_id=2,
+    )
+
+
 _FIXTURES = {
     "qwen2": "tiny_qwen2",
     "qwen2_moe": "tiny_qwen2_moe",
@@ -139,6 +160,8 @@ _FIXTURES = {
     "falcon40b": "tiny_falcon40b_style",
     "falcon_mha": "tiny_falcon_mha",
     "mistral_headdim": "tiny_mistral_headdim",
+    "gpt2": "tiny_gpt2",
+    "opt": "tiny_opt",
     "phi": "tiny_phi",
     "phi3": "tiny_phi3",
 }
@@ -177,6 +200,11 @@ def test_logits_parity(arch, request):
         assert not cfg.attn_qkv_bias  # fused qkv_proj split cleanly
     elif arch == "mistral_headdim":
         assert cfg.head_dim_override == 24 and cfg.head_dim == 24  # != 64/4
+    elif arch == "gpt2":
+        # Conv1D fused qkv split, learned positions, tied embeddings
+        assert cfg.position == "learned" and cfg.tie_embeddings
+    elif arch == "opt":
+        assert cfg.activation == "relu" and cfg.position == "learned"
 
 
 @pytest.mark.parametrize("arch", ["qwen2_moe", "falcon", "phi"])
@@ -245,3 +273,30 @@ def test_unsupported_arch_raises(tmp_path):
     (tmp_path / "config.json").write_text(json.dumps({"model_type": "mamba", "architectures": ["MambaForCausalLM"]}))
     with pytest.raises(ValueError, match="model_type"):
         load_hf_model(str(tmp_path))
+
+
+@pytest.mark.parametrize("arch", ["gpt2", "phi"])
+def test_v2_engine_serves_biased_archs(arch, request):
+    """The v2 paged engine must honor attention biases, partial rotary, the
+    parallel block, and learned positions — its layer_step is a separate
+    implementation from the training forward, so parity is asserted against
+    the HF greedy decode through the FULL continuous-batching path."""
+    hf_model, path = request.getfixturevalue(_FIXTURES[arch])
+    from deepspeed_tpu.inference.config import RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+    from deepspeed_tpu.models import load_hf_model
+
+    cfg, params = load_hf_model(path, dtype="float32")
+    rc = RaggedInferenceEngineConfig.from_dict({
+        "dtype": "float32",
+        "kv_cache": {"block_size": 16, "num_blocks": 64, "max_blocks_per_seq": 8},
+        "state_manager": {"max_ragged_batch_size": 64, "max_ragged_sequence_count": 4},
+    })
+    engine = InferenceEngineV2(cfg, params, rc)
+    prompt = np.array([5, 17, 42, 7], dtype=np.int32)
+    with torch.no_grad():
+        ref = hf_model.generate(
+            torch.tensor(prompt[None], dtype=torch.long), max_new_tokens=6, do_sample=False
+        ).numpy()[0]
+    out = engine.generate([prompt], max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(out[0]), ref)
