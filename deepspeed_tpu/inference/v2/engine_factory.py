@@ -43,6 +43,7 @@ def _register_builtins():
         "Phi3ForCausalLM",
         "GPT2LMHeadModel",
         "OPTForCausalLM",
+        "GemmaForCausalLM",
     ):
         POLICY_REGISTRY.setdefault(arch, load_hf_model)
 

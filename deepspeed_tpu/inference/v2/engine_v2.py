@@ -82,7 +82,7 @@ class InferenceEngineV2:
             k_cache, v_cache)."""
             t = tokens.shape[1]
             positions = start + jnp.arange(t, dtype=jnp.int32)
-            x = params["embed"].astype(T.DTYPES[c.dtype])[tokens]
+            x = T._scale_embed(params["embed"].astype(T.DTYPES[c.dtype])[tokens], c, T.DTYPES[c.dtype])
             if c.position == "learned":
                 x = x + params["pos_embed"][jnp.clip(positions, 0, c.max_seq_len - 1)][None]
 
@@ -169,7 +169,7 @@ class InferenceEngineV2:
             (row R all-trash for padding); last_idx: [R] flat index of each
             row's last valid token. Returns (logits [R, vocab], caches)."""
             t = tokens.shape[0]
-            x = params["embed"].astype(dtype)[tokens][None]  # [1, T, h]
+            x = T._scale_embed(params["embed"].astype(dtype)[tokens][None], c, dtype)  # [1, T, h]
             if c.position == "learned":
                 x = x + params["pos_embed"][jnp.clip(positions, 0, c.max_seq_len - 1)][None]
             tok_tables = tables[seq_idx]  # [T, B]

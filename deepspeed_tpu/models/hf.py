@@ -8,7 +8,7 @@ a (:class:`TransformerConfig`, stacked-params pytree) pair that trains or
 serves through ``deepspeed_tpu.initialize`` / ``init_inference`` unchanged.
 
 Supported ``model_type``s: llama, mistral, qwen2, qwen2_moe, falcon, phi,
-phi3, gpt2, opt. Dispatch is by ``config.json``'s ``model_type`` (see
+phi3, gpt2, opt, gemma. Dispatch is by ``config.json``'s ``model_type`` (see
 :data:`ARCH_LOADERS`); the inference engine factory additionally dispatches
 on ``architectures[0]`` (engine_factory.py).
 
@@ -283,9 +283,25 @@ def config_from_hf(hf_cfg) -> TransformerConfig:
             attn_out_bias=True,
             mlp_bias=True,
         )
+    if mt == "gemma":
+        act = get("hidden_activation", None) or get("hidden_act", "gelu_pytorch_tanh")
+        if act != "gelu_pytorch_tanh":
+            # "gelu" would mean HF's EXACT erf GELU; geglu here is tanh —
+            # reject rather than silently diverge (gpt2 loader does the same)
+            raise ValueError(f"gemma: hidden_activation={act!r} is not supported (gelu_pytorch_tanh only)")
+        head_dim = get("head_dim", 256)
+        derived = get("hidden_size") // get("num_attention_heads")
+        return _llama_like_config(
+            get,
+            norm="rmsnorm_1p",  # zero-centered (1 + w) weights
+            activation="geglu",  # gelu-gated MLP
+            embed_scale=True,  # sqrt(h) embedding normalizer
+            tie_embeddings=True,  # gemma always ties
+            head_dim_override=int(head_dim) if int(head_dim) != derived else None,
+        )
     raise ValueError(
         f"unsupported model_type {mt!r}; supported: llama, mistral, qwen2, "
-        "qwen2_moe, falcon, phi, phi3, gpt2, opt"
+        "qwen2_moe, falcon, phi, phi3, gpt2, opt, gemma"
     )
 
 
@@ -474,6 +490,7 @@ _LAYER_EXTRACTORS: Dict[str, Callable] = {
     "phi3": _phi3_layer,
     "gpt2": _gpt2_layer,
     "opt": _opt_layer,
+    "gemma": _llama_layer,  # same checkpoint layout as llama
 }
 
 # per-arch (embed key, final-norm key, layer prefix, pos-embed key or None)
@@ -492,13 +509,14 @@ _TOPLEVEL_KEYS: Dict[str, Tuple[str, str, str, Optional[str]]] = {
         "model.decoder.layers",
         "model.decoder.embed_positions.weight",
     ),
+    "gemma": ("model.embed_tokens.weight", "model.norm", "model.layers", None),
 }
 
 
 def _expected_layer_keys(cfg: TransformerConfig) -> Dict[str, list]:
     """Empty stacking lists for exactly the keys this config's params carry."""
     keys = ["attn_norm", "wq", "wk", "wv", "wo", "mlp_norm", "w_up", "w_down"]
-    if cfg.activation == "swiglu":
+    if cfg.activation in ("swiglu", "geglu"):
         keys.append("w_gate")
     if cfg.norm == "layernorm":
         keys += ["attn_norm_b", "mlp_norm_b"]
@@ -507,7 +525,7 @@ def _expected_layer_keys(cfg: TransformerConfig) -> Dict[str, list]:
     if cfg.attn_out_bias:
         keys.append("wo_b")
     if cfg.mlp_bias and cfg.n_experts == 0:
-        keys += ["w_up_b", "w_down_b"] + (["w_gate_b"] if cfg.activation == "swiglu" else [])
+        keys += ["w_up_b", "w_down_b"] + (["w_gate_b"] if cfg.activation in ("swiglu", "geglu") else [])
     if cfg.n_experts > 0:
         keys.append("router")
         if cfg.moe_shared_expert_dim > 0:

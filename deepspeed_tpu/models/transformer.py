@@ -62,8 +62,8 @@ class TransformerConfig:
     n_kv_heads: Optional[int] = None  # None → MHA; < n_heads → GQA; 1 → MQA
     ffn_hidden_size: Optional[int] = None  # None → 4x (gelu) / 8/3x rounded (swiglu)
     max_seq_len: int = 2048
-    norm: str = "rmsnorm"  # rmsnorm | layernorm
-    activation: str = "swiglu"  # swiglu | gelu (tanh approx) | gelu_exact (erf) | relu
+    norm: str = "rmsnorm"  # rmsnorm | rmsnorm_1p (gemma zero-centered) | layernorm
+    activation: str = "swiglu"  # swiglu | geglu (gemma) | gelu (tanh) | gelu_exact (erf) | relu
     position: str = "rope"  # rope | learned
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
@@ -83,6 +83,8 @@ class TransformerConfig:
     parallel_block: bool = False
     # phi partial rotary: rope applies to the first rope_frac*head_dim dims
     rope_frac: float = 1.0
+    # gemma scales embeddings by sqrt(hidden_size) after lookup
+    embed_scale: bool = False
     # layer-projection matmul precision (VERDICT fp8 lever; ops/qmatmul.py):
     # "default" = model dtype; "fp8" = e4m3 tensor-scaled forward operands;
     # "int8" = symmetric int8 forward (native 2x MXU rate on v5e). Backward
@@ -161,7 +163,7 @@ class TransformerConfig:
     def ffn_dim(self) -> int:
         if self.ffn_hidden_size:
             return self.ffn_hidden_size
-        if self.activation == "swiglu":
+        if self.activation in ("swiglu", "geglu"):
             # llama-style 2/3 * 4h rounded up to a multiple of 256
             d = int(8 * self.hidden_size / 3)
             return ((d + 255) // 256) * 256
@@ -212,13 +214,15 @@ def init_params(config: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
     def dense(k, shape, fan_in):
         return (jax.random.normal(k, shape, jnp.float32) * (1.0 / math.sqrt(fan_in))).astype(dtype)
 
+    # rmsnorm_1p's effective scale is (1 + w): identity init is ZEROS there
+    norm_one = jnp.zeros if c.norm == "rmsnorm_1p" else jnp.ones
     layers: Dict[str, Any] = {
-        "attn_norm": jnp.ones((L, h), dtype),
+        "attn_norm": norm_one((L, h), dtype),
         "wq": dense(next(keys), (L, h, nh * d), h),
         "wk": dense(next(keys), (L, h, nkv * d), h),
         "wv": dense(next(keys), (L, h, nkv * d), h),
         "wo": dense(next(keys), (L, nh * d, h), nh * d),
-        "mlp_norm": jnp.ones((L, h), dtype),
+        "mlp_norm": norm_one((L, h), dtype),
     }
     if c.norm == "layernorm":
         layers["attn_norm_b"] = jnp.zeros((L, h), dtype)
@@ -234,36 +238,36 @@ def init_params(config: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
         layers["router"] = dense(next(keys), (L, h, E), h)
         layers["w_up"] = dense(next(keys), (L, E, h, ffn), h)
         layers["w_down"] = dense(next(keys), (L, E, ffn, h), ffn)
-        if c.activation == "swiglu":
+        if c.activation in ("swiglu", "geglu"):
             layers["w_gate"] = dense(next(keys), (L, E, h, ffn), h)
         if c.moe_residual:
             # dense residual expert + 2-way mixing coefficient (layer.py:47)
             layers["res_up"] = dense(next(keys), (L, h, ffn), h)
             layers["res_down"] = dense(next(keys), (L, ffn, h), ffn)
-            if c.activation == "swiglu":
+            if c.activation in ("swiglu", "geglu"):
                 layers["res_gate"] = dense(next(keys), (L, h, ffn), h)
             layers["res_coef"] = dense(next(keys), (L, h, 2), h)
         if c.moe_shared_expert_dim > 0:
             sd = c.moe_shared_expert_dim
             layers["shared_up"] = dense(next(keys), (L, h, sd), h)
             layers["shared_down"] = dense(next(keys), (L, sd, h), sd)
-            if c.activation == "swiglu":
+            if c.activation in ("swiglu", "geglu"):
                 layers["shared_gate"] = dense(next(keys), (L, h, sd), h)
             layers["shared_gate_proj"] = dense(next(keys), (L, h, 1), h)
     else:
         layers["w_up"] = dense(next(keys), (L, h, ffn), h)
         layers["w_down"] = dense(next(keys), (L, ffn, h), ffn)
-        if c.activation == "swiglu":
+        if c.activation in ("swiglu", "geglu"):
             layers["w_gate"] = dense(next(keys), (L, h, ffn), h)
     if c.mlp_bias and c.n_experts == 0:
         layers["w_up_b"] = jnp.zeros((L, ffn), dtype)
         layers["w_down_b"] = jnp.zeros((L, h), dtype)
-        if c.activation == "swiglu":
+        if c.activation in ("swiglu", "geglu"):
             layers["w_gate_b"] = jnp.zeros((L, ffn), dtype)
 
     params: Dict[str, Any] = {
         "embed": (jax.random.normal(next(keys), (c.vocab_size, h), jnp.float32) * 0.02).astype(dtype),
-        "final_norm": jnp.ones((h,), dtype),
+        "final_norm": norm_one((h,), dtype),
         "layers": layers,
     }
     if c.norm == "layernorm":
@@ -311,29 +315,29 @@ def param_partition_specs(config: TransformerConfig) -> Dict[str, Any]:
         layers["router"] = P(None, None, None)
         layers["w_up"] = P(None, e, None, m)
         layers["w_down"] = P(None, e, m, None)
-        if c.activation == "swiglu":
+        if c.activation in ("swiglu", "geglu"):
             layers["w_gate"] = P(None, e, None, m)
         if c.moe_residual:
             layers["res_up"] = P(None, None, m)
             layers["res_down"] = P(None, m, None)
-            if c.activation == "swiglu":
+            if c.activation in ("swiglu", "geglu"):
                 layers["res_gate"] = P(None, None, m)
             layers["res_coef"] = P(None, None, None)
         if c.moe_shared_expert_dim > 0:
             layers["shared_up"] = P(None, None, m)
             layers["shared_down"] = P(None, m, None)
-            if c.activation == "swiglu":
+            if c.activation in ("swiglu", "geglu"):
                 layers["shared_gate"] = P(None, None, m)
             layers["shared_gate_proj"] = P(None, None, None)
     else:
         layers["w_up"] = P(None, None, m)
         layers["w_down"] = P(None, m, None)
-        if c.activation == "swiglu":
+        if c.activation in ("swiglu", "geglu"):
             layers["w_gate"] = P(None, None, m)
     if c.mlp_bias and c.n_experts == 0:
         layers["w_up_b"] = P(None, m)
         layers["w_down_b"] = P(None, None)
-        if c.activation == "swiglu":
+        if c.activation in ("swiglu", "geglu"):
             layers["w_gate_b"] = P(None, m)
 
     vocab_spec = P(m, None) if c.vocab_parallel else P(None, None)
@@ -437,6 +441,11 @@ def _norm(x, w, b, kind, eps):
     if kind == "rmsnorm":
         y = rms_norm(x, w, eps)
         return y + b if b is not None else y
+    if kind == "rmsnorm_1p":
+        # gemma zero-centered weight: y = rms(x) * (1 + w), with the add in
+        # fp32 (HF casts to float for it); the kernel accepts an fp32 weight
+        y = rms_norm(x, 1.0 + w.astype(jnp.float32), eps)
+        return y + b if b is not None else y
     return fused_layer_norm(x, w, b if b is not None else jnp.zeros_like(w), eps)
 
 
@@ -460,6 +469,15 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float, frac: float = 1.0) -
     out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
     out = out.astype(tail.dtype if tail is not None else x.dtype)
     return out if tail is None else jnp.concatenate([out, tail], axis=-1)
+
+
+def _scale_embed(x, c: TransformerConfig, dtype):
+    """gemma sqrt(h) embedding normalizer — HF rounds it to the model dtype
+    BEFORE the multiply, so match that exactly (one definition for every
+    embed-lookup site)."""
+    if not c.embed_scale:
+        return x
+    return x * jnp.asarray(math.sqrt(c.hidden_size), dtype)
 
 
 def _act_constraint(x, seq_sharded=True):
@@ -538,11 +556,11 @@ def _mlp_block(c: TransformerConfig, lp, x):
     up = _proj(c, x, lp["w_up"])
     if c.mlp_bias:
         up = up + lp["w_up_b"]
-    if c.activation == "swiglu":
+    if c.activation in ("swiglu", "geglu"):
         gate = _proj(c, x, lp["w_gate"])
         if c.mlp_bias:
             gate = gate + lp["w_gate_b"]
-        act = jax.nn.silu(gate) * up
+        act = (jax.nn.gelu(gate) if c.activation == "geglu" else jax.nn.silu(gate)) * up
     elif c.activation == "relu":
         act = jax.nn.relu(up)
     else:
@@ -623,7 +641,7 @@ def forward_hidden(
     if positions is None:
         positions = jnp.arange(s, dtype=jnp.int32)
     embed = _maybe_stage(params["embed"]) if stream else params["embed"]
-    x = embed.astype(DTYPES[c.dtype])[tokens]
+    x = _scale_embed(embed.astype(DTYPES[c.dtype])[tokens], c, DTYPES[c.dtype])
     if c.position == "learned":
         pe = _maybe_stage(params["pos_embed"]) if stream else params["pos_embed"]
         x = x + pe[positions][None] if positions.ndim == 1 else x + pe[positions]
@@ -692,7 +710,7 @@ def decode_step(params, tokens, config, kv_caches, positions):
     b, t = tokens.shape
     stream = _stream_active(c)
     embed = _maybe_stage(params["embed"]) if stream else params["embed"]
-    x = embed.astype(DTYPES[c.dtype])[tokens]
+    x = _scale_embed(embed.astype(DTYPES[c.dtype])[tokens], c, DTYPES[c.dtype])
     if c.position == "learned":
         pe = _maybe_stage(params["pos_embed"]) if stream else params["pos_embed"]
         x = x + pe[positions]
@@ -756,7 +774,7 @@ def split_lm_batch(batch):
 def embed_tokens(params, tokens, positions, config: TransformerConfig):
     """Embedding (+ learned positions) — the model's stem, shared by the
     dense and pipelined paths."""
-    x = params["embed"].astype(DTYPES[config.dtype])[tokens]
+    x = _scale_embed(params["embed"].astype(DTYPES[config.dtype])[tokens], config, DTYPES[config.dtype])
     if config.position == "learned":
         pe = params["pos_embed"][positions]
         x = x + (pe[None] if positions.ndim == 1 else pe)
@@ -872,7 +890,7 @@ def flops_per_token(config: TransformerConfig, seq_len: Optional[int] = None) ->
     n_dense = (
         c.hidden_size * (c.n_heads + 2 * c.kv_heads) * c.head_dim  # qkv
         + c.n_heads * c.head_dim * c.hidden_size  # out proj
-        + c.hidden_size * c.ffn_dim * (3 if c.activation == "swiglu" else 2)
+        + c.hidden_size * c.ffn_dim * (3 if c.activation in ("swiglu", "geglu") else 2)
     ) * c.n_layers + c.vocab_size * c.hidden_size
     attn = 2 * c.n_layers * s * c.hidden_size
     return 6.0 * (n_dense + attn / 2)

@@ -250,9 +250,10 @@ def moe_mlp(config, lp, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
 
     # per-expert FFN, e sharded over the expert axis, f over model axis
     up = jnp.einsum("ech,ehf->ecf", expert_in, lp["w_up"])
-    if config.activation == "swiglu":
+    if config.activation in ("swiglu", "geglu"):
         gate = jnp.einsum("ech,ehf->ecf", expert_in, lp["w_gate"])
-        act = jax.nn.silu(gate) * up
+        g = jax.nn.gelu(gate) if config.activation == "geglu" else jax.nn.silu(gate)
+        act = g * up
     else:
         act = jax.nn.gelu(up, approximate=config.activation != "gelu_exact")
     act = _expert_sharded(act, P(EXPERT_AXIS, None, MODEL_AXIS))
@@ -264,8 +265,9 @@ def moe_mlp(config, lp, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
 
     def _dense_mlp(prefix):
         up = tokens @ lp[f"{prefix}_up"]
-        if config.activation == "swiglu":
-            act = jax.nn.silu(tokens @ lp[f"{prefix}_gate"]) * up
+        if config.activation in ("swiglu", "geglu"):
+            gate = tokens @ lp[f"{prefix}_gate"]
+            act = (jax.nn.gelu(gate) if config.activation == "geglu" else jax.nn.silu(gate)) * up
         else:
             act = jax.nn.gelu(up, approximate=config.activation != "gelu_exact")
         return act @ lp[f"{prefix}_down"]

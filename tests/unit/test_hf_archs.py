@@ -153,6 +153,20 @@ def tiny_opt(tmp_path_factory):
     )
 
 
+@pytest.fixture(scope="module")
+def tiny_gemma(tmp_path_factory):
+    # zero-centered rmsnorm, geglu MLP, sqrt(h) embed scaling, decoupled
+    # head_dim, tied embeddings
+    return _save_tiny(
+        tmp_path_factory, "hf_gemma",
+        transformers.GemmaConfig, transformers.GemmaForCausalLM,
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=24, max_position_embeddings=128,
+        pad_token_id=0, bos_token_id=1, eos_token_id=2,
+    )
+
+
 _FIXTURES = {
     "qwen2": "tiny_qwen2",
     "qwen2_moe": "tiny_qwen2_moe",
@@ -161,6 +175,7 @@ _FIXTURES = {
     "falcon_mha": "tiny_falcon_mha",
     "mistral_headdim": "tiny_mistral_headdim",
     "gpt2": "tiny_gpt2",
+    "gemma": "tiny_gemma",
     "opt": "tiny_opt",
     "phi": "tiny_phi",
     "phi3": "tiny_phi3",
@@ -200,6 +215,9 @@ def test_logits_parity(arch, request):
         assert not cfg.attn_qkv_bias  # fused qkv_proj split cleanly
     elif arch == "mistral_headdim":
         assert cfg.head_dim_override == 24 and cfg.head_dim == 24  # != 64/4
+    elif arch == "gemma":
+        assert cfg.norm == "rmsnorm_1p" and cfg.activation == "geglu"
+        assert cfg.embed_scale and cfg.tie_embeddings and cfg.head_dim == 24
     elif arch == "gpt2":
         # Conv1D fused qkv split, learned positions, tied embeddings
         assert cfg.position == "learned" and cfg.tie_embeddings
@@ -207,7 +225,7 @@ def test_logits_parity(arch, request):
         assert cfg.activation == "relu" and cfg.position == "learned"
 
 
-@pytest.mark.parametrize("arch", ["qwen2_moe", "falcon", "phi"])
+@pytest.mark.parametrize("arch", ["qwen2_moe", "falcon", "phi", "gemma"])
 def test_greedy_decode_parity(arch, request):
     hf_model, path = request.getfixturevalue(_FIXTURES[arch])
     cfg, params = load_hf_model(path, dtype="float32")
