@@ -185,6 +185,13 @@ PRESETS: Dict[str, Dict[str, Any]] = {
         vocab_size=32000, hidden_size=2048, n_layers=16, n_heads=16, n_kv_heads=8,
         max_seq_len=4096, ffn_hidden_size=5632,
     ),
+    # the round-3 bench flagship: best measured MFU shape on one v5e chip
+    # (55.4% — PERF.md width sweep); d=128 heads, 3:1 GQA, 3x ffn
+    "bench-767m": dict(
+        vocab_size=32000, hidden_size=2304, n_layers=10, n_heads=18,
+        n_kv_heads=6, ffn_hidden_size=6912, max_seq_len=2048,
+        remat_policy="flash",
+    ),
     "mixtral-tiny": dict(
         vocab_size=1024, hidden_size=256, n_layers=2, n_heads=4, n_kv_heads=2,
         max_seq_len=512, n_experts=4, moe_top_k=2,
