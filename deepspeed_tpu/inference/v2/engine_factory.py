@@ -44,6 +44,9 @@ def _register_builtins():
         "GPT2LMHeadModel",
         "OPTForCausalLM",
         "GemmaForCausalLM",
+        "BloomForCausalLM",
+        "GPTJForCausalLM",
+        "GPTNeoXForCausalLM",
     ):
         POLICY_REGISTRY.setdefault(arch, load_hf_model)
 

@@ -38,6 +38,11 @@ class InferenceEngineV2:
     def __init__(self, model_config: T.TransformerConfig, params, config: Optional[RaggedInferenceEngineConfig] = None):
         self.config = config or RaggedInferenceEngineConfig()
         self._mc = model_config
+        if model_config.position == "alibi":
+            raise NotImplementedError(
+                "v2 paged engine: alibi (bloom) is not supported — the paged "
+                "attention kernel takes no bias; serve bloom through the v1 engine"
+            )
         dtype = T.DTYPES.get(self.config.dtype, jnp.bfloat16)
         params = jax.tree.map(
             lambda p: p.astype(dtype) if jnp.issubdtype(p.dtype, jnp.floating) else p, params
@@ -85,6 +90,8 @@ class InferenceEngineV2:
             x = T._scale_embed(params["embed"].astype(T.DTYPES[c.dtype])[tokens], c, T.DTYPES[c.dtype])
             if c.position == "learned":
                 x = x + params["pos_embed"][jnp.clip(positions, 0, c.max_seq_len - 1)][None]
+            if c.embed_norm:
+                x = T._embed_norm(params, c, x, stream=False)
 
             glob = positions  # [t] global positions of the new tokens
             blk = block_table[jnp.clip(glob // bs, 0, B - 1)]  # [t] physical block
@@ -107,8 +114,9 @@ class InferenceEngineV2:
                 k = k.reshape(1, t_, nkv, d).transpose(0, 2, 1, 3)
                 v = v.reshape(1, t_, nkv, d).transpose(0, 2, 1, 3)
                 if c.position == "rope":
-                    q = T._rope(q, positions[None], c.rope_theta, c.rope_frac)
-                    k = T._rope(k, positions[None], c.rope_theta, c.rope_frac)
+                    # live length (HF max(position_ids)+1): longrope/dynamic switch
+                    q = T._rope(q, positions[None], c, jnp.max(positions) + 1)
+                    k = T._rope(k, positions[None], c, jnp.max(positions) + 1)
                 # scatter new K/V into the paged cache (mask invalid rows to
                 # a scratch block write at their own position — clip keeps
                 # them inside the table; n_valid < t only pads the tail,
@@ -172,6 +180,8 @@ class InferenceEngineV2:
             x = T._scale_embed(params["embed"].astype(dtype)[tokens][None], c, dtype)  # [1, T, h]
             if c.position == "learned":
                 x = x + params["pos_embed"][jnp.clip(positions, 0, c.max_seq_len - 1)][None]
+            if c.embed_norm:
+                x = T._embed_norm(params, c, x, stream=False)
             tok_tables = tables[seq_idx]  # [T, B]
             blk = jnp.take_along_axis(
                 tok_tables, jnp.clip(positions // bs, 0, B - 1)[:, None], axis=1
@@ -190,8 +200,11 @@ class InferenceEngineV2:
                 k = k.reshape(t, nkv, d)
                 v = v.reshape(t, nkv, d)
                 if c.position == "rope":
-                    q = T._rope(q.transpose(1, 0, 2)[None], positions[None], c.rope_theta, c.rope_frac)[0].transpose(1, 0, 2)
-                    k = T._rope(k.transpose(1, 0, 2)[None], positions[None], c.rope_theta, c.rope_frac)[0].transpose(1, 0, 2)
+                    # live length (HF max(position_ids)+1): longrope/dynamic
+                    # switch — batch-global, exactly like HF's packed update
+                    live = jnp.max(positions) + 1
+                    q = T._rope(q.transpose(1, 0, 2)[None], positions[None], c, live)[0].transpose(1, 0, 2)
+                    k = T._rope(k.transpose(1, 0, 2)[None], positions[None], c, live)[0].transpose(1, 0, 2)
                 kc_l = kc_l.at[blk, row].set(k)
                 vc_l = vc_l.at[blk, row].set(v)
                 out = paged_attention(q, kc_l, vc_l, tok_tables, positions, trash)

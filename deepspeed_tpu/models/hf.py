@@ -8,7 +8,9 @@ a (:class:`TransformerConfig`, stacked-params pytree) pair that trains or
 serves through ``deepspeed_tpu.initialize`` / ``init_inference`` unchanged.
 
 Supported ``model_type``s: llama, mistral, qwen2, qwen2_moe, falcon, phi,
-phi3, gpt2, opt, gemma. Dispatch is by ``config.json``'s ``model_type`` (see
+phi3, gpt2, opt, gemma, bloom, gptj, gpt_neox (scaled-RoPE checkpoints —
+llama3/yarn/longrope/linear/dynamic — import via ``rope_scaling``).
+Dispatch is by ``config.json``'s ``model_type`` (see
 :data:`ARCH_LOADERS`); the inference engine factory additionally dispatches
 on ``architectures[0]`` (engine_factory.py).
 
@@ -100,25 +102,21 @@ def _getter(hf_cfg) -> Callable:
 # ---------------------------------------------------------------------------
 # per-arch config translation
 # ---------------------------------------------------------------------------
-def _check_rope_scaling(get):
-    """Fail fast on checkpoints whose RoPE is scaled (llama3 / longrope /
-    linear / yarn): silently building plain-theta RoPE would load without
-    error and produce wrong logits — even at short context for longrope's
-    short_factor."""
-    scaling = get("rope_scaling", None)
-    if not scaling:
-        return
-    kind = scaling.get("rope_type", scaling.get("type", "default")) if isinstance(scaling, dict) else scaling
-    if kind != "default":
-        raise ValueError(
-            f"unsupported checkpoint: rope_scaling={scaling!r} — scaled RoPE "
-            "(llama3/longrope/linear/yarn) is not implemented; logits would be wrong"
-        )
+def _parse_rope_scaling(get):
+    """HF rope_scaling → the canonical hashable config form (llama3 / yarn /
+    longrope / linear / dynamic — transformer.rope_params implements the
+    math). Unknown types still fail fast: silently building plain-theta RoPE
+    would load without error and produce wrong logits."""
+    from deepspeed_tpu.models.transformer import rope_scaling_from_hf
+
+    return rope_scaling_from_hf(
+        get("rope_scaling", None), get("original_max_position_embeddings", None)
+    )
 
 
 def _llama_like_config(get, **extra) -> TransformerConfig:
-    _check_rope_scaling(get)
     base = dict(
+        rope_scaling=_parse_rope_scaling(get),
         vocab_size=get("vocab_size"),
         hidden_size=get("hidden_size"),
         n_layers=get("num_hidden_layers"),
@@ -178,7 +176,6 @@ def config_from_hf(hf_cfg) -> TransformerConfig:
     if mt == "falcon":
         if get("alibi", False):
             raise ValueError("falcon: alibi position encoding is not supported (rope checkpoints only)")
-        _check_rope_scaling(get)
         nh = get("num_attention_heads")
         if get("new_decoder_architecture", False):
             n_kv = get("num_kv_heads", nh)
@@ -196,6 +193,7 @@ def config_from_hf(hf_cfg) -> TransformerConfig:
             activation="gelu_exact",  # falcon's MLP is torch nn.GELU (erf)
             position="rope",
             rope_theta=float(get("rope_theta", 10000.0)),
+            rope_scaling=_parse_rope_scaling(get),
             norm_eps=float(get("layer_norm_epsilon", 1e-5)),
             tie_embeddings=bool(get("tie_word_embeddings", True)),
             parallel_block=bool(get("parallel_attn", True)),
@@ -206,7 +204,6 @@ def config_from_hf(hf_cfg) -> TransformerConfig:
     if mt == "phi":
         if get("qk_layernorm", False):
             raise ValueError("phi: qk_layernorm checkpoints are not supported")
-        _check_rope_scaling(get)
         return TransformerConfig(
             vocab_size=get("vocab_size"),
             hidden_size=get("hidden_size"),
@@ -219,6 +216,7 @@ def config_from_hf(hf_cfg) -> TransformerConfig:
             activation="gelu",
             position="rope",
             rope_theta=float(get("rope_theta", 10000.0)),
+            rope_scaling=_parse_rope_scaling(get),
             norm_eps=float(get("layer_norm_eps", 1e-5)),
             tie_embeddings=bool(get("tie_word_embeddings", False)),
             parallel_block=True,
@@ -299,9 +297,86 @@ def config_from_hf(hf_cfg) -> TransformerConfig:
             tie_embeddings=True,  # gemma always ties
             head_dim_override=int(head_dim) if int(head_dim) != derived else None,
         )
+    if mt == "bloom":
+        h = get("hidden_size") or get("n_embed")
+        return TransformerConfig(
+            vocab_size=get("vocab_size"),
+            hidden_size=h,
+            n_layers=get("n_layer") or get("num_hidden_layers"),
+            n_heads=get("n_head") or get("num_attention_heads"),
+            ffn_hidden_size=4 * h,
+            max_seq_len=get("seq_length", 2048) or 2048,
+            norm="layernorm",
+            activation="gelu",  # BloomGelu is the tanh approximation
+            position="alibi",
+            norm_eps=float(get("layer_norm_epsilon", 1e-5)),
+            tie_embeddings=True,  # bloom always ties lm_head to embeddings
+            embed_norm=True,  # word_embeddings_layernorm
+            attn_qkv_bias=True,
+            attn_out_bias=True,
+            mlp_bias=True,
+        )
+    if mt == "gptj":
+        h = get("n_embd")
+        act = get("activation_function", "gelu_new")
+        if act not in ("gelu_new", "gelu_pytorch_tanh"):
+            raise ValueError(f"gptj: activation_function={act!r} is not supported (gelu_new only)")
+        d = h // get("n_head")
+        rotary_dim = get("rotary_dim", None) or d
+        return TransformerConfig(
+            vocab_size=get("vocab_size"),
+            hidden_size=h,
+            n_layers=get("n_layer"),
+            n_heads=get("n_head"),
+            ffn_hidden_size=get("n_inner", None) or 4 * h,
+            max_seq_len=get("n_positions", 2048),
+            norm="layernorm",
+            activation="gelu",
+            position="rope",
+            # gptj's interleaved (rotate_every_two) rotary becomes the native
+            # half-split convention via a load-time column permutation of
+            # wq/wk (_gptj_layer) — the score q·k is permutation-invariant
+            rope_frac=rotary_dim / d,
+            norm_eps=float(get("layer_norm_epsilon", 1e-5)),
+            tie_embeddings=False,
+            parallel_block=True,  # shared ln_1 feeds both branches
+            mlp_bias=True,
+            lm_head_bias=True,  # GPTJForCausalLM's lm_head carries a bias
+        )
+    if mt == "gpt_neox":
+        act = get("hidden_act", "gelu")
+        act_map = {
+            # HF ACT2FN: "gelu" is the ERF form; the others are tanh approx
+            "gelu": "gelu_exact",
+            "gelu_new": "gelu",
+            "gelu_fast": "gelu",
+            "gelu_pytorch_tanh": "gelu",
+        }
+        if act not in act_map:
+            raise ValueError(f"gpt_neox: hidden_act={act!r} is not supported")
+        return TransformerConfig(
+            vocab_size=get("vocab_size"),
+            hidden_size=get("hidden_size"),
+            n_layers=get("num_hidden_layers"),
+            n_heads=get("num_attention_heads"),
+            ffn_hidden_size=get("intermediate_size"),
+            max_seq_len=get("max_position_embeddings", 2048),
+            norm="layernorm",
+            activation=act_map[act],
+            position="rope",
+            rope_theta=float(get("rope_theta", None) or get("rotary_emb_base", 10000.0)),
+            rope_scaling=_parse_rope_scaling(get),
+            rope_frac=float(get("rotary_pct", 1.0)),
+            norm_eps=float(get("layer_norm_eps", 1e-5)),
+            tie_embeddings=bool(get("tie_word_embeddings", False)),
+            parallel_block=bool(get("use_parallel_residual", True)),
+            attn_qkv_bias=bool(get("attention_bias", True)),
+            attn_out_bias=bool(get("attention_bias", True)),
+            mlp_bias=True,
+        )
     raise ValueError(
         f"unsupported model_type {mt!r}; supported: llama, mistral, qwen2, "
-        "qwen2_moe, falcon, phi, phi3, gpt2, opt, gemma"
+        "qwen2_moe, falcon, phi, phi3, gpt2, opt, gemma, bloom, gptj, gpt_neox"
     )
 
 
@@ -480,6 +555,81 @@ def _opt_layer(take: _Taker, cfg: TransformerConfig, p: str, layers: Dict[str, l
     layers["w_down_b"].append(take(f"{p}.fc2.bias"))
 
 
+def _bloom_layer(take: _Taker, cfg: TransformerConfig, p: str, layers: Dict[str, list]):
+    # bloom: MHA with per-head [q,k,v] interleaved fused qkv — the falcon
+    # MHA degenerate case (group-of-3 per head) splits it
+    layers["attn_norm"].append(take(f"{p}.input_layernorm.weight"))
+    layers["attn_norm_b"].append(take(f"{p}.input_layernorm.bias"))
+    layers["mlp_norm"].append(take(f"{p}.post_attention_layernorm.weight"))
+    layers["mlp_norm_b"].append(take(f"{p}.post_attention_layernorm.bias"))
+    q, k, v = _split_falcon_qkv(take(f"{p}.self_attention.query_key_value.weight"), cfg)
+    layers["wq"].append(q.T)
+    layers["wk"].append(k.T)
+    layers["wv"].append(v.T)
+    qb, kb, vb = _split_falcon_qkv(take(f"{p}.self_attention.query_key_value.bias"), cfg)
+    layers["wq_b"].append(qb)
+    layers["wk_b"].append(kb)
+    layers["wv_b"].append(vb)
+    layers["wo"].append(take.linear(f"{p}.self_attention.dense.weight"))
+    layers["wo_b"].append(take(f"{p}.self_attention.dense.bias"))
+    layers["w_up"].append(take.linear(f"{p}.mlp.dense_h_to_4h.weight"))
+    layers["w_up_b"].append(take(f"{p}.mlp.dense_h_to_4h.bias"))
+    layers["w_down"].append(take.linear(f"{p}.mlp.dense_4h_to_h.weight"))
+    layers["w_down_b"].append(take(f"{p}.mlp.dense_4h_to_h.bias"))
+
+
+def _gptj_rope_perm(w: np.ndarray, cfg: TransformerConfig) -> np.ndarray:
+    """Permute a [h, nh*d] projection's per-head rotary columns from gptj's
+    interleaved (rotate_every_two) layout to the half-split layout: new
+    column i ← old 2i, new rot/2+i ← old 2i+1. Scores are invariant because
+    q and k get the SAME permutation."""
+    d = cfg.head_dim
+    rot = (int(d * cfg.rope_frac) // 2) * 2
+    perm = np.concatenate([np.arange(0, rot, 2), np.arange(1, rot, 2), np.arange(rot, d)])
+    cols = w.reshape(w.shape[0], cfg.n_heads, d)
+    return cols[:, :, perm].reshape(w.shape)
+
+
+def _gptj_layer(take: _Taker, cfg: TransformerConfig, p: str, layers: Dict[str, list]):
+    ln_w = take(f"{p}.ln_1.weight")
+    ln_b = take(f"{p}.ln_1.bias")
+    layers["attn_norm"].append(ln_w)
+    layers["attn_norm_b"].append(ln_b)
+    layers["mlp_norm"].append(ln_w)  # shared norm feeds both parallel branches
+    layers["mlp_norm_b"].append(ln_b)
+    layers["wq"].append(_gptj_rope_perm(take.linear(f"{p}.attn.q_proj.weight"), cfg))
+    layers["wk"].append(_gptj_rope_perm(take.linear(f"{p}.attn.k_proj.weight"), cfg))
+    layers["wv"].append(take.linear(f"{p}.attn.v_proj.weight"))
+    layers["wo"].append(take.linear(f"{p}.attn.out_proj.weight"))
+    layers["w_up"].append(take.linear(f"{p}.mlp.fc_in.weight"))
+    layers["w_up_b"].append(take(f"{p}.mlp.fc_in.bias"))
+    layers["w_down"].append(take.linear(f"{p}.mlp.fc_out.weight"))
+    layers["w_down_b"].append(take(f"{p}.mlp.fc_out.bias"))
+
+
+def _gptneox_layer(take: _Taker, cfg: TransformerConfig, p: str, layers: Dict[str, list]):
+    layers["attn_norm"].append(take(f"{p}.input_layernorm.weight"))
+    layers["attn_norm_b"].append(take(f"{p}.input_layernorm.bias"))
+    layers["mlp_norm"].append(take(f"{p}.post_attention_layernorm.weight"))
+    layers["mlp_norm_b"].append(take(f"{p}.post_attention_layernorm.bias"))
+    q, k, v = _split_falcon_qkv(take(f"{p}.attention.query_key_value.weight"), cfg)
+    layers["wq"].append(q.T)
+    layers["wk"].append(k.T)
+    layers["wv"].append(v.T)
+    if cfg.attn_qkv_bias:
+        qb, kb, vb = _split_falcon_qkv(take(f"{p}.attention.query_key_value.bias"), cfg)
+        layers["wq_b"].append(qb)
+        layers["wk_b"].append(kb)
+        layers["wv_b"].append(vb)
+    layers["wo"].append(take.linear(f"{p}.attention.dense.weight"))
+    if cfg.attn_out_bias:
+        layers["wo_b"].append(take(f"{p}.attention.dense.bias"))
+    layers["w_up"].append(take.linear(f"{p}.mlp.dense_h_to_4h.weight"))
+    layers["w_up_b"].append(take(f"{p}.mlp.dense_h_to_4h.bias"))
+    layers["w_down"].append(take.linear(f"{p}.mlp.dense_4h_to_h.weight"))
+    layers["w_down_b"].append(take(f"{p}.mlp.dense_4h_to_h.bias"))
+
+
 _LAYER_EXTRACTORS: Dict[str, Callable] = {
     "llama": _llama_layer,
     "mistral": _llama_layer,
@@ -491,6 +641,9 @@ _LAYER_EXTRACTORS: Dict[str, Callable] = {
     "gpt2": _gpt2_layer,
     "opt": _opt_layer,
     "gemma": _llama_layer,  # same checkpoint layout as llama
+    "bloom": _bloom_layer,
+    "gptj": _gptj_layer,
+    "gpt_neox": _gptneox_layer,
 }
 
 # per-arch (embed key, final-norm key, layer prefix, pos-embed key or None)
@@ -510,6 +663,9 @@ _TOPLEVEL_KEYS: Dict[str, Tuple[str, str, str, Optional[str]]] = {
         "model.decoder.embed_positions.weight",
     ),
     "gemma": ("model.embed_tokens.weight", "model.norm", "model.layers", None),
+    "bloom": ("transformer.word_embeddings.weight", "transformer.ln_f", "transformer.h", None),
+    "gptj": ("transformer.wte.weight", "transformer.ln_f", "transformer.h", None),
+    "gpt_neox": ("gpt_neox.embed_in.weight", "gpt_neox.final_layer_norm", "gpt_neox.layers", None),
 }
 
 
@@ -573,8 +729,13 @@ def load_hf_model(
         if mt == "opt":
             pe = pe[2:]  # OPT offsets learned positions by 2
         params["pos_embed"] = pe
+    if cfg.embed_norm:
+        params["embed_norm"] = take("transformer.word_embeddings_layernorm.weight")
+        params["embed_norm_b"] = take("transformer.word_embeddings_layernorm.bias")
     if not cfg.tie_embeddings:
-        if "lm_head.weight" in state:
+        if "embed_out.weight" in state:  # gpt_neox names its lm_head embed_out
+            params["lm_head"] = take.linear("embed_out.weight")
+        elif "lm_head.weight" in state:
             params["lm_head"] = take.linear("lm_head.weight")
             if cfg.lm_head_bias:
                 params["lm_head_b"] = take("lm_head.bias")

@@ -64,8 +64,15 @@ class TransformerConfig:
     max_seq_len: int = 2048
     norm: str = "rmsnorm"  # rmsnorm | rmsnorm_1p (gemma zero-centered) | layernorm
     activation: str = "swiglu"  # swiglu | geglu (gemma) | gelu (tanh) | gelu_exact (erf) | relu
-    position: str = "rope"  # rope | learned
+    position: str = "rope"  # rope | learned | alibi (bloom) | none
     rope_theta: float = 10000.0
+    # Scaled RoPE (HF rope_scaling; reference AutoTP serves these checkpoints
+    # via the wrapped HF module — module_inject/auto_tp.py:193 — so parity
+    # requires native support): canonical hashable form, a sorted tuple of
+    # (key, value) pairs with list values as tuples. Build it with
+    # ``rope_scaling_from_hf``. Supported rope_type: linear, dynamic, yarn,
+    # longrope, llama3. None → plain theta RoPE.
+    rope_scaling: Optional[Tuple[Tuple[str, Any], ...]] = None
     norm_eps: float = 1e-5
     tie_embeddings: bool = False
     # --- per-arch variations (reference module_inject/containers/ +
@@ -85,6 +92,9 @@ class TransformerConfig:
     rope_frac: float = 1.0
     # gemma scales embeddings by sqrt(hidden_size) after lookup
     embed_scale: bool = False
+    # bloom applies a LayerNorm to the embedding output
+    # (word_embeddings_layernorm); params carry embed_norm/embed_norm_b
+    embed_norm: bool = False
     # layer-projection matmul precision (VERDICT fp8 lever; ops/qmatmul.py):
     # "default" = model dtype; "fp8" = e4m3 tensor-scaled forward operands;
     # "int8" = symmetric int8 forward (native 2x MXU rate on v5e). Backward
@@ -283,6 +293,9 @@ def init_params(config: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
         params["pos_embed"] = (
             jax.random.normal(next(keys), (c.max_seq_len, h), jnp.float32) * 0.02
         ).astype(dtype)
+    if c.embed_norm:
+        params["embed_norm"] = jnp.ones((h,), dtype)
+        params["embed_norm_b"] = jnp.zeros((h,), dtype)
     if not c.tie_embeddings:
         params["lm_head"] = dense(next(keys), (h, c.vocab_size), h)
         if c.lm_head_bias:
@@ -357,6 +370,9 @@ def param_partition_specs(config: TransformerConfig) -> Dict[str, Any]:
         specs["final_norm_b"] = P(None)
     if c.position == "learned":
         specs["pos_embed"] = P(None, None)
+    if c.embed_norm:
+        specs["embed_norm"] = P(None)
+        specs["embed_norm_b"] = P(None)
     if not c.tie_embeddings:
         specs["lm_head"] = P(None, m) if c.vocab_parallel else P(None, None)
         if c.lm_head_bias:
@@ -456,22 +472,177 @@ def _norm(x, w, b, kind, eps):
     return fused_layer_norm(x, w, b if b is not None else jnp.zeros_like(w), eps)
 
 
-def _rope(x: jax.Array, positions: jax.Array, theta: float, frac: float = 1.0) -> jax.Array:
+def rope_scaling_from_hf(scaling, original_max_position_embeddings=None):
+    """HF ``rope_scaling`` dict → the canonical hashable config form.
+
+    Returns None for absent/default scaling. ``original_max_position_
+    embeddings`` is the TOP-LEVEL HF config field (phi3 longrope keeps the
+    pretraining length there, not in the dict — modeling_rope_utils reads
+    ``config.original_max_position_embeddings``); when given it is folded
+    into the canonical dict so one structure carries all parameters.
+    """
+    if not scaling:
+        return None
+    if not isinstance(scaling, dict):
+        raise ValueError(f"unsupported rope_scaling={scaling!r} (expected a dict)")
+    kind = scaling.get("rope_type", scaling.get("type", "default"))
+    if kind == "default":
+        return None
+    if kind not in ("linear", "dynamic", "yarn", "longrope", "llama3"):
+        raise ValueError(
+            f"unsupported rope_scaling type {kind!r}; supported: "
+            "linear, dynamic, yarn, longrope, llama3"
+        )
+    out = {"rope_type": kind}
+    for k, v in scaling.items():
+        if k in ("rope_type", "type"):
+            continue
+        out[k] = tuple(float(x) for x in v) if isinstance(v, (list, tuple)) else v
+    if kind == "longrope" and original_max_position_embeddings:
+        # the top-level field wins (HF ignores any in-dict copy here)
+        out["original_max_position_embeddings"] = int(original_max_position_embeddings)
+    return tuple(sorted(out.items()))
+
+
+def rope_params(c: "TransformerConfig", rot: int, seq_len: Optional[Any] = None):
+    """Inverse frequencies [rot//2] + cos/sin attention factor.
+
+    Faithful to HF ``modeling_rope_utils`` so scaled-RoPE checkpoints
+    (llama-3.x, yarn, longrope/phi3, linear, dynamic-NTK) produce identical
+    logits. ``seq_len`` plays HF's dynamic ``max(position_ids)+1`` role
+    (longrope long/short-factor switch, dynamic-NTK growth) and may be a
+    TRACED scalar — decode paths pass the live cache length, so the factor
+    choice tracks the actual sequence, not the cache capacity. Seq-dependent
+    kinds return a jnp inv_freq; the rest return static numpy (one HF
+    divergence, deliberate: HF's dynamic-NTK ratchets to the longest length
+    seen between resets, ours is per-call — identical on monotonic decode).
+    """
+    theta = float(c.rope_theta)
+    dims = np.arange(0, rot, 2, dtype=np.float32) / rot
+    inv_freq = 1.0 / (theta**dims)
+    sc = dict(c.rope_scaling) if c.rope_scaling else None
+    if not sc:
+        return inv_freq.astype(np.float32), 1.0
+    kind = sc["rope_type"]
+    factor = float(sc.get("factor", 1.0))
+    if kind == "linear":
+        return (inv_freq / factor).astype(np.float32), 1.0
+    if kind == "dynamic":
+        maxp = c.max_seq_len
+        seq = jnp.maximum(jnp.asarray(seq_len if seq_len is not None else maxp, jnp.float32), maxp)
+        base = theta * ((factor * seq / maxp) - (factor - 1)) ** (rot / (rot - 2))
+        return 1.0 / (base ** jnp.asarray(dims)), 1.0
+    if kind == "llama3":
+        old_len = float(sc["original_max_position_embeddings"])
+        low_wl = old_len / float(sc["low_freq_factor"])
+        high_wl = old_len / float(sc["high_freq_factor"])
+        wavelen = 2 * math.pi / inv_freq
+        scaled = np.where(wavelen > low_wl, inv_freq / factor, inv_freq)
+        smooth = (old_len / wavelen - float(sc["low_freq_factor"])) / (
+            float(sc["high_freq_factor"]) - float(sc["low_freq_factor"])
+        )
+        mid = (1 - smooth) * scaled / factor + smooth * scaled
+        is_mid = (wavelen >= high_wl) & (wavelen <= low_wl)
+        return np.where(is_mid, mid, scaled).astype(np.float32), 1.0
+    if kind == "yarn":
+        old_len = float(sc.get("original_max_position_embeddings") or c.max_seq_len)
+        attn = sc.get("attention_factor")
+        mscale, mscale_all = sc.get("mscale"), sc.get("mscale_all_dim")
+
+        def get_mscale(scale, m=1.0):
+            return 1.0 if scale <= 1 else 0.1 * m * math.log(scale) + 1.0
+
+        if attn is None:
+            attn = (
+                get_mscale(factor, mscale) / get_mscale(factor, mscale_all)
+                if mscale and mscale_all
+                else get_mscale(factor)
+            )
+        beta_fast = float(sc.get("beta_fast") or 32)
+        beta_slow = float(sc.get("beta_slow") or 1)
+
+        def corr_dim(n_rot):
+            return rot * math.log(old_len / (n_rot * 2 * math.pi)) / (2 * math.log(theta))
+
+        low, high = corr_dim(beta_fast), corr_dim(beta_slow)
+        if sc.get("truncate", True):
+            low, high = math.floor(low), math.ceil(high)
+        low, high = max(low, 0), min(high, rot - 1)
+        if low == high:
+            high += 0.001
+        ramp = np.clip((np.arange(rot // 2, dtype=np.float32) - low) / (high - low), 0, 1)
+        extrap = 1.0 - ramp  # 1 → keep base freq, 0 → interpolate by factor
+        return (
+            (inv_freq / factor * (1 - extrap) + inv_freq * extrap).astype(np.float32),
+            float(attn),
+        )
+    # longrope: per-dim factor lists, chosen by seq length vs pretrain length
+    old_len = int(sc.get("original_max_position_embeddings") or c.max_seq_len)
+    if factor == 1.0 and sc.get("original_max_position_embeddings"):
+        factor = c.max_seq_len / old_len
+    attn = sc.get("attention_factor")
+    if attn is None:
+        attn = 1.0 if factor <= 1.0 else math.sqrt(1 + math.log(factor) / math.log(old_len))
+    short = 1.0 / (np.asarray(sc["short_factor"], np.float32) * theta**dims)
+    long = 1.0 / (np.asarray(sc["long_factor"], np.float32) * theta**dims)
+    if seq_len is None or isinstance(seq_len, (int, float)):
+        return (long if (seq_len or 0) > old_len else short).astype(np.float32), float(attn)
+    return jnp.where(seq_len > old_len, jnp.asarray(long), jnp.asarray(short)), float(attn)
+
+
+def alibi_slopes(n_heads: int) -> np.ndarray:
+    """Per-head ALiBi slopes (HF ``build_alibi_tensor`` formula, incl. the
+    non-power-of-2 interpolation). Returns fp32 [n_heads]."""
+    closest = 2 ** math.floor(math.log2(n_heads))
+    base = 2.0 ** (-(2.0 ** -(math.log2(closest) - 3)))
+    slopes = base ** np.arange(1, closest + 1, dtype=np.float32)
+    if closest != n_heads:
+        extra_base = 2.0 ** (-(2.0 ** -(math.log2(2 * closest) - 3)))
+        extra = extra_base ** np.arange(1, 2 * (n_heads - closest) + 1, 2, dtype=np.float32)
+        slopes = np.concatenate([slopes, extra])
+    return slopes.astype(np.float32)
+
+
+def _alibi_bias(c: TransformerConfig, key_positions: jax.Array) -> jax.Array:
+    """ALiBi attention bias ``slope_h * key_position`` → [1|b, nh, 1, sk].
+
+    HF bloom biases by the ABSOLUTE key position (cumsum(mask)-1 == arange);
+    with a causal mask this equals the relative form up to a per-row constant
+    the softmax cancels — matching HF exactly keeps logits bit-comparable."""
+    slopes = jnp.asarray(alibi_slopes(c.n_heads))
+    if key_positions.ndim == 1:
+        key_positions = key_positions[None]
+    return slopes[None, :, None, None] * key_positions[:, None, None, :].astype(jnp.float32)
+
+
+def _rope(
+    x: jax.Array,
+    positions: jax.Array,
+    c: "TransformerConfig",
+    seq_len: Optional[Any] = None,
+) -> jax.Array:
     """Rotary embedding on [b, h, s, d] given positions [b, s] or [s].
 
-    frac < 1 (phi partial rotary, HF partial_rotary_factor): only the first
-    ``frac*d`` dims rotate; the tail passes through unrotated."""
+    rope_frac < 1 (phi partial rotary, HF partial_rotary_factor): only the
+    first ``frac*d`` dims rotate; the tail passes through unrotated.
+    Scaled RoPE (config.rope_scaling) adjusts the frequencies and cos/sin
+    magnitude per ``rope_params``; ``seq_len`` (static or traced) feeds its
+    longrope/dynamic-NTK length dependence."""
     d = x.shape[-1]
+    frac = c.rope_frac
     rot = d if frac >= 1.0 else (int(d * frac) // 2) * 2
     tail = None
     if rot < d:
         x, tail = x[..., :rot], x[..., rot:]
-    freqs = 1.0 / (theta ** (jnp.arange(0, rot, 2, dtype=jnp.float32) / rot))
+    inv_freq, attn_factor = rope_params(c, rot, seq_len)
+    freqs = jnp.asarray(inv_freq)
     if positions.ndim == 1:
         positions = positions[None, :]
     angles = positions[..., None].astype(jnp.float32) * freqs  # [b, s, rot/2]
-    cos = jnp.cos(angles)[:, None]  # [b, 1, s, rot/2]
-    sin = jnp.sin(angles)[:, None]
+    # attn_factor scales cos/sin directly (HF convention: yarn/longrope
+    # "attention_scaling" multiplies the embedding, hence scores by factor²)
+    cos = jnp.cos(angles)[:, None] * attn_factor  # [b, 1, s, rot/2]
+    sin = jnp.sin(angles)[:, None] * attn_factor
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
     out = out.astype(tail.dtype if tail is not None else x.dtype)
@@ -517,8 +688,11 @@ def _attention_block(c: TransformerConfig, lp, x, positions, segment_ids, kv_cac
     k = k.reshape(b, s, nkv, d).transpose(0, 2, 1, 3)
     v = v.reshape(b, s, nkv, d).transpose(0, 2, 1, 3)
     if c.position == "rope":
-        q = _rope(q, positions, c.rope_theta, c.rope_frac)
-        k = _rope(k, positions, c.rope_theta, c.rope_frac)
+        # seq len: the LIVE sequence length (HF's max(position_ids)+1) — in
+        # decode that is cache fill + this block, traced; else the static s
+        seq_len = kv_cache[2] + s if kv_cache is not None else s
+        q = _rope(q, positions, c, seq_len)
+        k = _rope(k, positions, c, seq_len)
 
     new_cache = None
     if kv_cache is not None:
@@ -534,10 +708,19 @@ def _attention_block(c: TransformerConfig, lp, x, positions, segment_ids, kv_cac
         q_glob = clen + jnp.arange(s)  # [s]
         kpos = jnp.arange(S)  # [S]
         mask_bias = jnp.where(kpos[None, :] <= q_glob[:, None], 0.0, -1e30).astype(jnp.float32)
-        out = attention_op(q, k, v, causal=False, bias=mask_bias[None, None])
+        bias = mask_bias[None, None]
+        if c.position == "alibi":
+            bias = bias + _alibi_bias(c, kpos)
+        out = attention_op(q, k, v, causal=False, bias=bias)
     else:
+        alibi = _alibi_bias(c, positions) if c.position == "alibi" else None
         topo = get_topology()
         if topo.sequence_parallel_size > 1:
+            if c.position == "alibi":
+                raise NotImplementedError(
+                    "alibi attention under sequence parallelism is not supported "
+                    "(the ring/ulysses kernels take no bias)"
+                )
             if c.seq_impl == "ring":
                 from deepspeed_tpu.parallel.sequence import ring_attention
 
@@ -547,7 +730,7 @@ def _attention_block(c: TransformerConfig, lp, x, positions, segment_ids, kv_cac
 
                 out = ulysses_attention(q, k, v, causal=True, segment_ids=segment_ids)
         else:
-            out = attention_op(q, k, v, causal=True, segment_ids=segment_ids)
+            out = attention_op(q, k, v, causal=True, segment_ids=segment_ids, bias=alibi)
     out = out.transpose(0, 2, 1, 3).reshape(b, s, nh * d)
     out = _proj(c, out, lp["wo"])
     if c.attn_out_bias:
@@ -652,6 +835,8 @@ def forward_hidden(
     if c.position == "learned":
         pe = _maybe_stage(params["pos_embed"]) if stream else params["pos_embed"]
         x = x + pe[positions][None] if positions.ndim == 1 else x + pe[positions]
+    if c.embed_norm:
+        x = _embed_norm(params, c, x, stream)
     x = _act_constraint(x)
 
     layer_fn = partial(_layer, c)
@@ -675,6 +860,13 @@ def forward_hidden(
         fn_b = _maybe_stage(fn_b)
     x = _norm(x, fn_w, fn_b, c.norm, c.norm_eps)
     return x, jnp.sum(aux_losses)
+
+
+def _embed_norm(params, c: TransformerConfig, x, stream: bool):
+    """bloom word_embeddings_layernorm applied to the embedding output."""
+    w = _maybe_stage(params["embed_norm"]) if stream else params["embed_norm"]
+    b = _maybe_stage(params["embed_norm_b"]) if stream else params["embed_norm_b"]
+    return _norm(x, w, b, "layernorm", c.norm_eps)
 
 
 def _lm_head_matrix(params, config: TransformerConfig, dtype):
@@ -721,6 +913,8 @@ def decode_step(params, tokens, config, kv_caches, positions):
     if c.position == "learned":
         pe = _maybe_stage(params["pos_embed"]) if stream else params["pos_embed"]
         x = x + pe[positions]
+    if c.embed_norm:
+        x = _embed_norm(params, c, x, stream)
 
     def scan_body(x, inputs):
         lp, cache = inputs
@@ -779,12 +973,14 @@ def split_lm_batch(batch):
 
 
 def embed_tokens(params, tokens, positions, config: TransformerConfig):
-    """Embedding (+ learned positions) — the model's stem, shared by the
-    dense and pipelined paths."""
+    """Embedding (+ learned positions, + bloom's embedding layernorm) — the
+    model's stem, shared by the dense and pipelined paths."""
     x = _scale_embed(params["embed"].astype(DTYPES[config.dtype])[tokens], config, DTYPES[config.dtype])
     if config.position == "learned":
         pe = params["pos_embed"][positions]
         x = x + (pe[None] if positions.ndim == 1 else pe)
+    if config.embed_norm:
+        x = _embed_norm(params, config, x, stream=False)
     return x
 
 
