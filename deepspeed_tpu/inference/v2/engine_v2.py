@@ -114,9 +114,12 @@ class InferenceEngineV2:
                 k = k.reshape(1, t_, nkv, d).transpose(0, 2, 1, 3)
                 v = v.reshape(1, t_, nkv, d).transpose(0, 2, 1, 3)
                 if c.position == "rope":
-                    # live length (HF max(position_ids)+1): longrope/dynamic switch
-                    q = T._rope(q, positions[None], c, jnp.max(positions) + 1)
-                    k = T._rope(k, positions[None], c, jnp.max(positions) + 1)
+                    # live length (HF max(position_ids)+1) from the VALID
+                    # tokens only — positions covers the padded bucket tail,
+                    # whose max would flip longrope's factor switch early
+                    live = start + n_valid
+                    q = T._rope(q, positions[None], c, live)
+                    k = T._rope(k, positions[None], c, live)
                 # scatter new K/V into the paged cache (mask invalid rows to
                 # a scratch block write at their own position — clip keeps
                 # them inside the table; n_valid < t only pads the tail,
@@ -201,8 +204,10 @@ class InferenceEngineV2:
                 v = v.reshape(t, nkv, d)
                 if c.position == "rope":
                     # live length (HF max(position_ids)+1): longrope/dynamic
-                    # switch — batch-global, exactly like HF's packed update
-                    live = jnp.max(positions) + 1
+                    # switch — batch-global like HF's packed update, taken
+                    # over each row's LAST VALID token (padding tail tokens
+                    # carry future positions that would flip the switch early)
+                    live = jnp.max(positions[last_idx]) + 1
                     q = T._rope(q.transpose(1, 0, 2)[None], positions[None], c, live)[0].transpose(1, 0, 2)
                     k = T._rope(k.transpose(1, 0, 2)[None], positions[None], c, live)[0].transpose(1, 0, 2)
                 kc_l = kc_l.at[blk, row].set(k)

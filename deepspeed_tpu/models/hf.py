@@ -157,6 +157,12 @@ def config_from_hf(hf_cfg) -> TransformerConfig:
                 f"qwen2_moe: decoder_sparse_step={sparse_step}, mlp_only_layers="
                 f"{mlp_only} — only uniform MoE stacks are supported"
             )
+        logger.warning(
+            "qwen2_moe import sets moe_capacity_factor=E/k (drop-free, HF "
+            "semantics): the dense dispatch/combine einsums are O(tokens² · "
+            "experts · hidden) at this bound — for long-sequence training "
+            "lower capacity_factor (accepting drops) or expect high memory"
+        )
         return _llama_like_config(
             get,
             attn_qkv_bias=True,
@@ -204,6 +210,10 @@ def config_from_hf(hf_cfg) -> TransformerConfig:
     if mt == "phi":
         if get("qk_layernorm", False):
             raise ValueError("phi: qk_layernorm checkpoints are not supported")
+        act = get("hidden_act", "gelu_new")
+        if act not in ("gelu_new", "gelu_pytorch_tanh"):
+            # a 'gelu' (erf) checkpoint would silently load with tanh GELU
+            raise ValueError(f"phi: hidden_act={act!r} is not supported (gelu_new only)")
         return TransformerConfig(
             vocab_size=get("vocab_size"),
             hidden_size=get("hidden_size"),
@@ -298,6 +308,12 @@ def config_from_hf(hf_cfg) -> TransformerConfig:
             head_dim_override=int(head_dim) if int(head_dim) != derived else None,
         )
     if mt == "bloom":
+        logger.warning(
+            "bloom/alibi attention runs the reference (non-flash) kernel: the "
+            "attention bias path materializes [b, heads, s, s] fp32 scores — "
+            "expect higher memory and lower throughput than rope models at "
+            "long sequence lengths"
+        )
         h = get("hidden_size") or get("n_embed")
         return TransformerConfig(
             vocab_size=get("vocab_size"),

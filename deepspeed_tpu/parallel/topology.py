@@ -144,11 +144,37 @@ class Topology:
         return f"Topology(world={self.world_size}, {live or 'single-device'})"
 
 
+def _manual_axis_names():
+    """Axis names of the enclosing ``shard_map`` manual region (empty when
+    tracing outside one). Inside a manual region those axes are already
+    per-device; a with_sharding_constraint naming them is invalid (the qgZ
+    exchange wraps the model forward in shard_map over ``data``)."""
+    try:
+        from jax._src import core
+
+        return set(core.get_axis_env().axis_sizes)
+    except Exception:  # private API moved — degrade to no stripping
+        return set()
+
+
 def constrain(x, *spec):
     """``with_sharding_constraint`` over the ambient topology's mesh, degrading
     to identity when the mesh cannot shard that way (e.g. axis missing under a
-    test mesh). Shared helper for model/MoE/sequence activation constraints."""
+    test mesh). Axes that are manual in an enclosing shard_map are stripped
+    from the spec. Shared helper for model/MoE/sequence activation
+    constraints."""
     topo = get_topology()
+    manual = _manual_axis_names()
+    if manual:
+
+        def strip(entry):
+            if entry is None:
+                return None
+            axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+            kept = tuple(a for a in axes if a not in manual)
+            return kept if len(kept) > 1 else (kept[0] if kept else None)
+
+        spec = tuple(strip(e) for e in spec)
     try:
         return jax.lax.with_sharding_constraint(
             x, NamedSharding(topo.mesh, PartitionSpec(*spec))

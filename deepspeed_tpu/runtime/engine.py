@@ -407,6 +407,19 @@ class DeepSpeedEngine:
         # grad accumulation buffer for the imperative path
         self._acc_grads = None
 
+        # ZeRO++ LoCo error-feedback buffers (threaded through every step
+        # jit); size-0 placeholders when LoCo is off so signatures stay
+        # uniform. _loco_enabled() also VALIDATES the knob: zeropp_loco_param
+        # without qgZ raises instead of being silently ignored.
+        if self._quantized_exchange_enabled() and self._loco_enabled():
+            self._loco_state = self._loco_init_state()
+        else:
+            if config.zero_optimization.zeropp_loco_param is not None:
+                self._loco_enabled()  # raises with the real reason
+            self._loco_state = jax.tree.map(
+                lambda _: jnp.zeros((0,), jnp.bfloat16), self.params
+            )
+
         # timers / throughput
         self.wall_clock_breakdown = config.wall_clock_breakdown
         self.timers = SynchronizedWallClockTimer() if self.wall_clock_breakdown else NoopTimer()
@@ -831,11 +844,12 @@ class DeepSpeedEngine:
         self._unpark_params()  # eager offload_param mode parks params host-side
         shardings = self._batch_shardings(stacked, leading_gas_dim=True)
         stacked = jax.device_put(stacked, shardings)
-        safe_grads, self.scaler_state, loss, grad_norm, overflow = self._host_step_jit(
+        safe_grads, self.scaler_state, loss, grad_norm, overflow, self._loco_state = self._host_step_jit(
             self.params,
             self.scaler_state,
             jnp.int32(self.global_steps),
             stacked,
+            self._loco_state,
         )
         if not bool(overflow):  # functional skip-step, decided on host here
             flat_grads = jax.tree_util.tree_leaves(safe_grads)
@@ -867,11 +881,12 @@ class DeepSpeedEngine:
         self.timers(STEP_GLOBAL_TIMER).start()
         shardings = self._batch_shardings(stacked, leading_gas_dim=True)
         stacked = jax.device_put(stacked, shardings)
-        safe_grads, self.scaler_state, loss, grad_norm, overflow = self._stream_grads_jit(
+        safe_grads, self.scaler_state, loss, grad_norm, overflow, self._loco_state = self._stream_grads_jit(
             self.params,
             self.scaler_state,
             jnp.int32(self.global_steps),
             stacked,
+            self._loco_state,
         )
         self.params, self.opt_state = self.optimizer.step(
             safe_grads, self.opt_state, self.params, jnp.float32(lr)
@@ -936,6 +951,46 @@ class DeepSpeedEngine:
         zcfg = self.config.zero_optimization
         return (zcfg.zero_quantized_gradients or zcfg.zero_quantized_weights) and self.topo.dp_world_size > 1
 
+    def _loco_enabled(self) -> bool:
+        """ZeRO++ LoCo (zeropp_loco_param): error-feedback on the qgZ
+        quantized gradient exchange (reference stage3.py:2084
+        _loco_err_buf_update + coalesced_collectives
+        all_to_all_loco_quant_reduce)."""
+        zcfg = self.config.zero_optimization
+        if zcfg.zeropp_loco_param is None:
+            return False
+        if not (zcfg.zero_quantized_gradients and self.topo.dp_world_size > 1):
+            raise ValueError(
+                "zeropp_loco_param requires zero_quantized_gradients with a "
+                "data-parallel world > 1: LoCo is error feedback ON the qgZ "
+                "exchange — without qgZ there is no quantization error to feed back"
+            )
+        return True
+
+    def _loco_init_state(self):
+        """Per-rank error buffers as a [W, ...]-leading pytree sharded over
+        the data axis (rank w owns err[w] — shard_map slices it to the local
+        buffer). Ineligible leaves (below QGZ_MIN_SIZE) carry size-0
+        placeholders. bf16 storage (reference requantizes to int8; bf16 is
+        more faithful at comparable footprint)."""
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from deepspeed_tpu.parallel.topology import DATA_AXIS
+
+        W = self.topo.dp_world_size
+        mesh = self.topo.mesh
+
+        def leaf(p):
+            if p.size >= self.QGZ_MIN_SIZE:
+                sh = NamedSharding(mesh, P(DATA_AXIS, *([None] * p.ndim)))
+                return jax.jit(
+                    lambda: jnp.zeros((W,) + p.shape, jnp.bfloat16), out_shardings=sh
+                )()
+            return jnp.zeros((0,), jnp.bfloat16)
+
+        return jax.tree.map(leaf, self.params)
+
     def _make_quantized_micro_grads(self, grad_specs, mesh):
         """ZeRO++ qgZ/qwZ gradient/weight exchange (reference engine.py:1088
         zero_quantized_gradients + stage3.py:1610 quantize_nontrainable_params,
@@ -949,6 +1004,8 @@ class DeepSpeedEngine:
         from jax.sharding import PartitionSpec as P
 
         from deepspeed_tpu.ops.quantizer.block_quant import (
+            loco_quantized_allreduce,
+            loco_quantized_reduce_scatter_along,
             quantized_all_gather_along,
             quantized_allreduce,
             quantized_reduce_scatter_along,
@@ -964,6 +1021,9 @@ class DeepSpeedEngine:
             )
         zcfg = self.config.zero_optimization
         qgz, qwz = zcfg.zero_quantized_gradients, zcfg.zero_quantized_weights
+        loco = self._loco_enabled()
+        loco_cfg = zcfg.zeropp_loco_param or {}
+        err_beta = float(loco_cfg.get("err_beta", 0.8))
         W = self.topo.dp_world_size
         param_specs = self.plan.param_specs
 
@@ -975,17 +1035,27 @@ class DeepSpeedEngine:
                 return quantized_all_gather_along(x, DATA_AXIS, k)
             return jax.lax.all_gather(x, DATA_AXIS, axis=k, tiled=True)
 
-        def reduce_leaf(g, spec):
+        def reduce_leaf(g, spec, err):
+            """Returns (reduced grad, new local err). err is this rank's
+            local buffer ([*g.shape] bf16) or a size-0 placeholder."""
             k = self._data_dim(spec)
             if qgz and g.size >= self.QGZ_MIN_SIZE:
+                if loco:
+                    if k is None:
+                        return loco_quantized_allreduce(g, err, DATA_AXIS, err_beta=err_beta)
+                    return loco_quantized_reduce_scatter_along(
+                        g, err, DATA_AXIS, k, err_beta=err_beta
+                    )
                 if k is None:
-                    return quantized_allreduce(g, DATA_AXIS)
-                return quantized_reduce_scatter_along(g, DATA_AXIS, k)
+                    return quantized_allreduce(g, DATA_AXIS), err
+                return quantized_reduce_scatter_along(g, DATA_AXIS, k), err
             if k is None:
-                return jax.lax.pmean(g, DATA_AXIS)
-            return (jax.lax.psum_scatter(g, DATA_AXIS, scatter_dimension=k, tiled=True) / W).astype(g.dtype)
+                return jax.lax.pmean(g, DATA_AXIS), err
+            return (
+                jax.lax.psum_scatter(g, DATA_AXIS, scatter_dimension=k, tiled=True) / W
+            ).astype(g.dtype), err
 
-        def inner(params, mb, rng, scale):
+        def inner(params, mb, rng, scale, loco_state):
             flat_p, treedef = jax.tree_util.tree_flatten(params)
             flat_ps = treedef.flatten_up_to(param_specs)
             full = jax.tree_util.tree_unflatten(
@@ -999,12 +1069,25 @@ class DeepSpeedEngine:
             loss_scaled, g_full = jax.value_and_grad(scaled_loss)(full)
             flat_g = treedef.flatten_up_to(g_full)
             flat_gs = treedef.flatten_up_to(grad_specs)
-            grads = jax.tree_util.tree_unflatten(
-                treedef, [reduce_leaf(g, s) for g, s in zip(flat_g, flat_gs)]
+            # local err slices arrive [1, ...] (P(DATA_AXIS) on dim 0)
+            flat_e = treedef.flatten_up_to(loco_state)
+            pairs = [
+                reduce_leaf(g, s, e[0] if e.size else e)
+                for g, s, e in zip(flat_g, flat_gs, flat_e)
+            ]
+            grads = jax.tree_util.tree_unflatten(treedef, [p[0] for p in pairs])
+            new_loco = jax.tree_util.tree_unflatten(
+                treedef,
+                [e2[None] if e2.size else e2 for e2 in (p[1] for p in pairs)],
             )
-            return jax.lax.pmean(loss_scaled, DATA_AXIS) / scale, grads
+            return jax.lax.pmean(loss_scaled, DATA_AXIS) / scale, grads, new_loco
 
-        def micro_grads(params, mb, rng, scale):
+        loco_specs = jax.tree.map(
+            lambda p: P(DATA_AXIS) if loco and p.size >= self.QGZ_MIN_SIZE else P(),
+            self.params,
+        )
+
+        def micro_grads(params, mb, rng, scale, loco_state):
             bspecs = jax.tree.map(
                 lambda x: P(DATA_AXIS)
                 if getattr(x, "ndim", 0) >= 1 and x.shape[0] % W == 0
@@ -1014,12 +1097,12 @@ class DeepSpeedEngine:
             fn = jax.shard_map(
                 inner,
                 mesh=mesh,
-                in_specs=(param_specs, bspecs, P(), P()),
-                out_specs=(P(), grad_specs),
+                in_specs=(param_specs, bspecs, P(), P(), loco_specs),
+                out_specs=(P(), grad_specs, loco_specs),
                 axis_names={DATA_AXIS},
                 check_vma=False,
             )
-            return fn(params, mb, rng, scale)
+            return fn(params, mb, rng, scale, loco_state)
 
         return micro_grads
 
@@ -1041,6 +1124,21 @@ class DeepSpeedEngine:
             if cfg.monitor_grad_norm is not None
             else bool(getattr(self.monitor, "enabled", False)) or cfg.wall_clock_breakdown
         )
+        if (
+            not check_overflow
+            and not cfg.gradient_clipping
+            and cfg.check_grad_overflow is None
+            and not getattr(self, "_warned_no_sanitize", False)
+        ):
+            # one-time notice (round-3 advisor): with auto-off overflow checks
+            # and no clipping, a non-finite grad leaf poisons params silently
+            self._warned_no_sanitize = True
+            log_dist(
+                "bf16 mode skips the per-step grad overflow scan and NaN "
+                "sanitization (matching reference bf16 engines); set "
+                '"check_grad_overflow": true to re-enable it',
+                ranks=[0],
+            )
         return check_overflow, monitor_norm
 
     def _build_train_step(self, grads_only=False):
@@ -1082,16 +1180,16 @@ class DeepSpeedEngine:
             )
         if custom_vg is not None:
             # loss fn drives its own backward (1F1B pipeline executor)
-            def micro_grads(params, mb, rng, scale):
+            def micro_grads(params, mb, rng, scale, loco):
                 loss, grads = custom_vg(params, mb)
                 grads = constrain_tree(grads, grad_specs, mesh)
-                return loss.astype(jnp.float32), grads
+                return loss.astype(jnp.float32), grads, loco
 
         elif self._quantized_exchange_enabled():
             micro_grads = self._make_quantized_micro_grads(grad_specs, mesh)
         else:
 
-            def micro_grads(params, mb, rng, scale):
+            def micro_grads(params, mb, rng, scale, loco):
                 def scaled_loss(p):
                     loss, _aux = self._call_loss(p, mb, rng)
                     return (loss * scale.astype(loss.dtype)).astype(jnp.float32)
@@ -1101,21 +1199,32 @@ class DeepSpeedEngine:
                     # stage>=2: reduce-scatter layout. Streamed grads are
                     # host-kind; a kind-less constraint would drag them to HBM
                     grads = constrain_tree(grads, grad_specs, mesh)
-                return loss_scaled / scale, grads
+                return loss_scaled / scale, grads, loco
 
-        def train_step(params, opt_state, scaler_state, step, lr, batch):
+        loco_on = self._quantized_exchange_enabled() and self._loco_enabled()
+        loco_reset_T = (
+            int((self.config.zero_optimization.zeropp_loco_param or {}).get("reset_T", 0))
+            if loco_on
+            else 0
+        )
+
+        def train_step(params, opt_state, scaler_state, step, lr, batch, loco):
             params = self._stage_params(params)
             scale = scaler_state.scale if scaler_cfg.dynamic or scaler_cfg.init_scale != 1.0 else jnp.float32(1.0)
             base_rng = jax.random.fold_in(self._rng_key, step)
+            if loco_reset_T:
+                # reference loco_idx > reset_T periodic error-buffer reset
+                reset = (step % loco_reset_T) == 0
+                loco = jax.tree.map(lambda e: jnp.where(reset, jnp.zeros_like(e), e), loco)
 
             def body(carry, xs):
-                acc, = carry
+                acc, lc = carry
                 i, mb = xs
                 rng = jax.random.fold_in(base_rng, i)
-                loss, grads = micro_grads(params, mb, rng, scale)
+                loss, grads, lc = micro_grads(params, mb, rng, scale, lc)
                 acc = jax.tree.map(lambda a, g: a + g.astype(accum_dtype), acc, grads)
                 acc = constrain_tree(acc, grad_specs, mesh)
-                return (acc,), loss
+                return (acc, lc), loss
 
             if stream:
                 # weight streaming (gas == 1 by construction): grads pass
@@ -1123,8 +1232,8 @@ class DeepSpeedEngine:
                 # the host optimizer — any jnp pass over the full grad tree
                 # would stage fp32 HBM temps for the HostExecute operands
                 mb = jax.tree.map(lambda x: x[0] if x.ndim >= 1 else x, batch)
-                loss0, grads = micro_grads(
-                    params, mb, jax.random.fold_in(base_rng, jnp.int32(0)), scale
+                loss0, grads, loco = micro_grads(
+                    params, mb, jax.random.fold_in(base_rng, jnp.int32(0)), scale, loco
                 )
                 losses = loss0[None]
             else:
@@ -1132,11 +1241,11 @@ class DeepSpeedEngine:
                 zeros = constrain_tree(zeros, grad_specs, mesh)
                 if gas == 1:
                     mb = jax.tree.map(lambda x: x[0] if x.ndim >= 1 else x, batch)
-                    (grads,), losses = body((zeros,), (jnp.int32(0), mb))
+                    (grads, loco), losses = body((zeros, loco), (jnp.int32(0), mb))
                     losses = losses[None]
                 else:
                     idx = jnp.arange(gas, dtype=jnp.int32)
-                    (grads,), losses = jax.lax.scan(body, (zeros,), (idx, batch))
+                    (grads, loco), losses = jax.lax.scan(body, (zeros, loco), (idx, batch))
 
             def grad_epilogue(grads):
                 inv = 1.0 / (gas * scale)
@@ -1171,32 +1280,41 @@ class DeepSpeedEngine:
                 # pass over full-model grads stages fp32 HBM temps)
                 safe_grads = grads
                 overflow = jnp.zeros((), jnp.bool_)
-                grad_norm = jnp.zeros((), jnp.float32)
+                grad_norm = jnp.full((), jnp.nan, jnp.float32)
             else:
                 safe_grads, overflow, grad_norm = grad_epilogue(grads)
+            if loco_on:
+                # reference _loco_err_buf_update: error buffers absorbed the
+                # non-finite residual of an overflow-skipped step — drop them
+                # (gated on loco itself, NOT reset_T: reset_T=0 means no
+                # periodic reset but overflow recovery must still happen)
+                loco = jax.tree.map(
+                    lambda e: jnp.where(overflow, jnp.zeros_like(e), e), loco
+                )
             new_scaler = ls.update_state(scaler_cfg, scaler_state, overflow)
             mean_loss = jnp.mean(losses)
             if grads_only:
                 # NVMe tier: the update happens on the host afterwards
-                return safe_grads, new_scaler, mean_loss, grad_norm, overflow
+                return safe_grads, new_scaler, mean_loss, grad_norm, overflow, loco
             # offload-aware update + functional skip-step on overflow
             # (reference step skipping, fp16)
             new_params, new_opt_state = self._opt_apply(safe_grads, opt_state, params, lr, overflow)
-            return new_params, new_opt_state, new_scaler, mean_loss, grad_norm, overflow
+            return new_params, new_opt_state, new_scaler, mean_loss, grad_norm, overflow, loco
 
         if grads_only:
-            def grads_step(params, scaler_state, step, batch):
-                return train_step(params, {}, scaler_state, step, None, batch)
+            def grads_step(params, scaler_state, step, batch, loco):
+                return train_step(params, {}, scaler_state, step, None, batch, loco)
 
-            return jax.jit(grads_step, donate_argnums=(1,))
+            return jax.jit(grads_step, donate_argnums=(1, 4))
 
         self._train_step_raw = train_step  # unjitted: profiler jaxpr walk
         return jax.jit(
             train_step,
-            donate_argnums=(0, 1, 2),
+            donate_argnums=(0, 1, 2, 6),
             out_shardings=(
                 self._jit_param_shardings(),
                 self._jit_state_shardings(),
+                None,
                 None,
                 None,
                 None,
@@ -1292,7 +1410,7 @@ class DeepSpeedEngine:
             mean_loss = jax.lax.pmean(jnp.mean(losses), DATA_AXIS) / scale
             return new_params, new_opt_state, new_scaler, mean_loss, grad_norm, overflow
 
-        def train_step(params, opt_state, scaler_state, step, lr, batch):
+        def train_step(params, opt_state, scaler_state, step, lr, batch, loco):
             bspecs = jax.tree.map(
                 lambda x: P(None, DATA_AXIS)
                 if getattr(x, "ndim", 0) >= 2 and x.shape[1] % W == 0
@@ -1307,7 +1425,9 @@ class DeepSpeedEngine:
                 axis_names={DATA_AXIS},
                 check_vma=False,
             )
-            return fn(params, opt_state, scaler_state, step, lr, batch)
+            # loco is a uniform-signature pass-through: the 1-bit exchange has
+            # its own error-feedback state inside the optimizer
+            return fn(params, opt_state, scaler_state, step, lr, batch) + (loco,)
 
         self._train_step_raw = train_step
         return jax.jit(train_step, donate_argnums=(0, 1, 2))
@@ -1327,13 +1447,13 @@ class DeepSpeedEngine:
             else None
         )
 
-        def fwd_bwd(params, scaler_state, step, batch):
+        def fwd_bwd(params, scaler_state, step, batch, loco):
             params = self._stage_params(params)
             scale = scaler_state.scale
             rng = jax.random.fold_in(self._rng_key, step)
             if quantized is not None:
-                # imperative path honors qgZ/qwZ too — same shard_map exchange
-                return quantized(params, batch, rng, scale)
+                # imperative path honors qgZ/qwZ/LoCo too — same shard_map exchange
+                return quantized(params, batch, rng, scale, loco)
 
             def scaled_loss(p):
                 loss, _ = self._call_loss(p, batch, rng)
@@ -1341,9 +1461,9 @@ class DeepSpeedEngine:
 
             loss_scaled, grads = jax.value_and_grad(scaled_loss)(params)
             grads = constrain_tree(grads, grad_specs, mesh)
-            return loss_scaled / scale, grads
+            return loss_scaled / scale, grads, loco
 
-        return jax.jit(fwd_bwd)
+        return jax.jit(fwd_bwd, donate_argnums=(4,))
 
     def _build_apply(self):
         if getattr(self.optimizer, "collective_grad_exchange", False):
@@ -1480,6 +1600,7 @@ class DeepSpeedEngine:
             loss,
             grad_norm,
             overflow,
+            self._loco_state,
         ) = self._train_step_jit(
             self.params,
             self.opt_state,
@@ -1487,6 +1608,7 @@ class DeepSpeedEngine:
             jnp.int32(self.global_steps),
             jnp.float32(lr),
             stacked,
+            self._loco_state,
         )
         if profiling:
             jax.block_until_ready(loss)
@@ -1517,6 +1639,7 @@ class DeepSpeedEngine:
         args = (
             self.params, self.opt_state, self.scaler_state,
             jnp.int32(self.global_steps), jnp.float32(self._current_lr()), stacked,
+            self._loco_state,
         )
         try:
             log_dist("flops profile: lowering step for cost analysis (one-time)", ranks=[0])
@@ -1560,8 +1683,8 @@ class DeepSpeedEngine:
         self._unpark_params()
         batch = self._apply_curriculum(batch)  # name-keyed: works un-stacked too
         batch = jax.device_put(batch, self._batch_shardings(batch))
-        loss, grads = self._fwd_bwd_jit(
-            self.params, self.scaler_state, jnp.int32(self.micro_steps), batch
+        loss, grads, self._loco_state = self._fwd_bwd_jit(
+            self.params, self.scaler_state, jnp.int32(self.micro_steps), batch, self._loco_state
         )
         self.timers(FORWARD_GLOBAL_TIMER).stop()
         self._pending_grads = grads
@@ -1736,8 +1859,12 @@ class DeepSpeedEngine:
         canon = getattr(self.optimizer, "canonicalize_checkpoint_state", None)
         if canon is not None and self._host_opt is None:
             # 0/1 Adam phase-2: strip worker-0 drift so the checkpoint holds
-            # the last-sync canonical params (load re-localizes per worker)
+            # the last-sync canonical params (load re-localizes per worker).
+            # The stamp lets load tell canonicalized checkpoints from older
+            # drifted ones — re-localizing the latter would ADD drift twice
+            # (round-3 advisor finding)
             params_payload, opt_payload = canon(params_payload, opt_payload)
+            state["canonicalized_onebit_state"] = True
         writer = self.config.checkpoint.writer
         if writer:
             # pluggable engine path (reference checkpoint_engine/): async
@@ -1831,7 +1958,15 @@ class DeepSpeedEngine:
             if "scaler_state" in data:
                 self.scaler_state = self._restore_tree(self.scaler_state, data["scaler_state"])
             client_state = data.get("__meta__", {})
-            if load_optimizer_states and not load_module_only:
+            # Missing stamp defaults True: every canonicalizing release saved
+            # canonical state before the stamp existed — skipping would break
+            # their resume. Only an explicit False (a future drifted-state
+            # writer) disables re-localization.
+            if (
+                load_optimizer_states
+                and not load_module_only
+                and client_state.get("canonicalized_onebit_state", True)
+            ):
                 self._maybe_relocalize_params()
             self._restore_client_state(client_state, load_module_only, load_lr_scheduler_states)
             return os.path.join(load_dir, tag), client_state
@@ -1861,9 +1996,14 @@ class DeepSpeedEngine:
                 self.opt_state = out["opt_state"]
         if out.get("scaler_state") is not None:
             self.scaler_state = out["scaler_state"]
-        if want_opt and out.get("opt_state") is not None:
-            self._maybe_relocalize_params()
         client_state = out.get("client_state", {})
+        # missing stamp defaults True — see the writer-branch comment above
+        if (
+            want_opt
+            and out.get("opt_state") is not None
+            and client_state.get("canonicalized_onebit_state", True)
+        ):
+            self._maybe_relocalize_params()
         self._restore_client_state(client_state, load_module_only, load_lr_scheduler_states)
         return out.get("load_path", load_dir), client_state
 

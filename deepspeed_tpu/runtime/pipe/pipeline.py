@@ -252,17 +252,23 @@ class Pipelined1F1BLoss:
     ``_aggregate_total_loss`` semantics); with non-uniform loss masks this
     differs from the dense path's global-mask normalization.
 
-    Restrictions: tie_embeddings unsupported (head cotangent would need to
-    reach the embedding table across stages); fp16 loss-scaling unsupported
-    (the engine applies scaling around autodiff, not custom grads).
+    Tied embeddings (gpt2/gemma-style): the embedding table joins the head's
+    vjp inputs on the last stage, and its two grad contributions — stage-0
+    embedding-gather vjp and last-stage head-matmul vjp — are summed after
+    their psums. That IS the reference's tied-weight reduce
+    (``ReduceTiedGrads``, runtime/pipe/engine.py:274 + the TiedLayerSpec
+    group all-reduce, pipe/module.py:77), collapsed to one add because this
+    SPMD formulation replicates embed/head params over the pipe axis rather
+    than owning them on single ranks.
+
+    Restrictions: fp16 loss-scaling unsupported (the engine applies scaling
+    around autodiff, not custom grads).
     """
 
     def __init__(self, config, micro_batches: int, topo: Topology = None):
         self.config = config
         self.micro_batches = micro_batches
         self.topo = topo or get_topology()
-        if config.tie_embeddings:
-            raise NotImplementedError("1F1B pipeline does not support tied embeddings")
         self._fwd_loss = make_pipelined_loss_fn(config, micro_batches, self.topo)
 
     def __call__(self, params, batch):
@@ -297,8 +303,16 @@ class Pipelined1F1BLoss:
         seg_m = segment_ids.reshape(n_micro, mb, s) if has_seg else jnp.zeros((n_micro, 1, 1), jnp.int32)
 
         stage_params = _stack_stages(params["layers"], S)
-        head_keys = [k for k in ("final_norm", "final_norm_b", "lm_head") if k in params]
-        embed_keys = [k for k in ("embed", "pos_embed") if k in params]
+        head_keys = [
+            k for k in ("final_norm", "final_norm_b", "lm_head", "lm_head_b") if k in params
+        ]
+        if c.tie_embeddings:
+            # tied head reads params["embed"]: the table must be a head-vjp
+            # input so the last stage produces its head-matmul gradient
+            head_keys.append("embed")
+        embed_keys = [
+            k for k in ("embed", "pos_embed", "embed_norm", "embed_norm_b") if k in params
+        ]
         head_params = {k: params[k] for k in head_keys}
         embed_params = {k: params[k] for k in embed_keys}
 
@@ -476,7 +490,10 @@ class Pipelined1F1BLoss:
 
         L = jax.tree_util.tree_leaves(params["layers"])[0].shape[0]
         grads = dict(eg)
-        grads.update(hg)
+        for k, g in hg.items():
+            # tied embeddings: "embed" appears in BOTH eg (stage-0 gather vjp)
+            # and hg (last-stage head vjp) — their sum is the tied-grad reduce
+            grads[k] = grads[k] + g if k in grads else g
         grads["layers"] = jax.tree.map(lambda l: l.reshape((L,) + l.shape[2:]), lg)
         return loss, grads
 

@@ -141,7 +141,11 @@ def test_zero_to_fp32_offline_converter(devices8, tmp_path):
     r = subprocess.run(
         [sys.executable, str(script), str(tmp_path), str(out)],
         capture_output=True, text=True, timeout=300,
-        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        # PALLAS_AXON_POOL_IPS must be cleared too: a TPU-tunnel sitecustomize
+        # (if present) dials the device relay at interpreter start, BEFORE
+        # JAX_PLATFORMS is consulted — a busy/stuck device then hangs this
+        # host-side converter subprocess forever
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""},
     )
     assert r.returncode == 0, r.stderr[-800:]
     sd = np.load(str(out) + ".npz")

@@ -71,6 +71,45 @@ def test_1f1b_grads_match_gpipe_autodiff(pp_setup):
         )
 
 
+def test_1f1b_tied_embeddings_grads_match(devices8):
+    """Tied embeddings under the TRUE 1F1B schedule (VERDICT round-3 #3: the
+    raise locked gpt2/gemma-class models out of the fast path). The embed
+    grad must carry BOTH contributions — stage-0 gather vjp and last-stage
+    head vjp — matching autodiff through the GPipe rotation exactly."""
+    from deepspeed_tpu.models import TransformerConfig, init_params
+
+    topo = _pp_topo()
+    try:
+        cfg = TransformerConfig(
+            vocab_size=128, hidden_size=64, n_layers=4, n_heads=4,
+            max_seq_len=64, dtype="float32", tie_embeddings=True,
+        )
+        params = init_params(cfg, jax.random.key(0))
+        assert "lm_head" not in params
+        toks = np.random.default_rng(0).integers(0, 128, size=(8, 33)).astype(np.int32)
+        batch = {"input_ids": toks}
+        n_micro = 4
+
+        gpipe = make_pipelined_loss_fn(cfg, micro_batches=n_micro, topo=topo)
+        loss_ref, grads_ref = jax.jit(jax.value_and_grad(gpipe))(params, batch)
+
+        f1b = make_1f1b_loss_fn(cfg, micro_batches=n_micro, topo=topo)
+        loss_new, grads_new = jax.jit(f1b.custom_value_and_grad)(params, batch)
+
+        np.testing.assert_allclose(float(loss_new), float(loss_ref), rtol=1e-5)
+        key = lambda kv: str(kv[0])
+        for (ka, a), (kb, b) in zip(
+            sorted(jax.tree_util.tree_leaves_with_path(grads_ref), key=key),
+            sorted(jax.tree_util.tree_leaves_with_path(grads_new), key=key),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(b), np.asarray(a), atol=2e-4, rtol=2e-3,
+                err_msg=f"grad mismatch at {ka}",
+            )
+    finally:
+        reset_topology()
+
+
 def test_1f1b_activation_memory_bounded(devices8):
     """Compiled temp memory of the 1F1B step must stay (near-)flat as
     n_micro grows, while the GPipe path's grows linearly — the property that
