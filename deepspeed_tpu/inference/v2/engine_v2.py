@@ -58,20 +58,110 @@ class InferenceEngineV2:
         self.state_manager = DSStateManager(self.config.state_manager, kv)
         self.scheduler = RaggedScheduler(self.config.state_manager, self.state_manager)
         c = model_config
+        # --- tensor parallelism (reference config_v2.py:16 tp_size / :33
+        # tensor_parallel): GSPMD shards the dense algebra from the param
+        # shardings below; the Pallas paged-attention call gets an explicit
+        # shard_map island over the model axis (_paged_attention_sharded) —
+        # kernels are opaque to GSPMD's auto-partitioner.
+        self._tp = int(getattr(self.config, "tp_size", 1) or 1)
+        self._mesh = None
+        if self._tp > 1:
+            from deepspeed_tpu.models import param_partition_specs
+            from deepspeed_tpu.parallel.topology import MODEL_AXIS, get_topology
+
+            if c.kv_heads % self._tp or c.n_heads % self._tp:
+                raise ValueError(
+                    f"tp_size={self._tp} must divide n_heads={c.n_heads} and "
+                    f"kv_heads={c.kv_heads} (contiguous head sharding keeps "
+                    "GQA groups rank-local)"
+                )
+            topo = get_topology()
+            if topo.axis_size(MODEL_AXIS) != self._tp:
+                raise ValueError(
+                    f"tp_size={self._tp} needs a topology whose '{MODEL_AXIS}' axis "
+                    f"is {self._tp} (got {topo.axis_size(MODEL_AXIS)}): set one up "
+                    "with set_topology(Topology(model=...)) before building the engine"
+                )
+            self._mesh = topo.mesh
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            specs = self._match_specs(self.params, param_partition_specs(c))
+            self.params = jax.tree.map(
+                lambda p, s: jax.device_put(p, NamedSharding(self._mesh, s)),
+                self.params,
+                specs,
+            )
+            self._kv_sharding = NamedSharding(
+                self._mesh, P(None, None, None, MODEL_AXIS, None)
+            )
         # +1 trash block: padded tail tokens of bucketed chunks scatter there
         # instead of corrupting block 0 (which belongs to a live sequence)
         shape = (c.n_layers, kv.num_blocks + 1, kv.block_size, c.kv_heads, c.head_dim)
-        self._k_cache = jnp.zeros(shape, dtype)
-        self._v_cache = jnp.zeros(shape, dtype)
+        if self._tp > 1:
+            zeros = jax.jit(lambda: jnp.zeros(shape, dtype), out_shardings=self._kv_sharding)
+            self._k_cache = zeros()
+            self._v_cache = zeros()
+        else:
+            self._k_cache = jnp.zeros(shape, dtype)
+            self._v_cache = jnp.zeros(shape, dtype)
         self._row_jit = {}
         self._batched_jit = None  # shape-polymorphic: jit specializes per bucket
         self.last_scheduled_tokens = 0
         self.last_capped = set()
         log_dist(
             f"InferenceEngineV2: {kv.num_blocks} KV blocks × {kv.block_size} tokens, "
-            f"budget {self.config.state_manager.max_ragged_batch_size} tok/step",
+            f"budget {self.config.state_manager.max_ragged_batch_size} tok/step"
+            + (f", tp={self._tp}" if self._tp > 1 else ""),
             ranks=[0],
         )
+
+    def _paged_attention_sharded(self, kernel, q, kc_l, vc_l, tok_tables, positions, trash):
+        """The paged-attention call, TP-aware. Under tensor parallelism the
+        kernel runs inside a shard_map manual region over the model axis —
+        each rank attends its local q/kv heads (contiguous head sharding
+        keeps every GQA group on one rank, so the kernel's h→h//G map is
+        rank-local). GSPMD cannot partition a Pallas call itself; this island
+        is the standard composition (auto mode outside, manual inside)."""
+        if self._tp <= 1:
+            return kernel(q, kc_l, vc_l, tok_tables, positions, trash)
+        from jax.sharding import PartitionSpec as P
+
+        from deepspeed_tpu.parallel.topology import MODEL_AXIS
+
+        def local(q_l, kc, vc, tt, pos):
+            return kernel(q_l, kc, vc, tt, pos, trash)
+
+        return jax.shard_map(
+            local,
+            mesh=self._mesh,
+            in_specs=(
+                P(None, MODEL_AXIS, None),
+                P(None, None, MODEL_AXIS, None),
+                P(None, None, MODEL_AXIS, None),
+                P(),
+                P(),
+            ),
+            out_specs=P(None, MODEL_AXIS, None),
+            check_vma=False,
+        )(q, kc_l, vc_l, tok_tables, positions)
+
+    @staticmethod
+    def _match_specs(params, specs):
+        """Align the spec tree to the (possibly quantized) param tree: leaves
+        absent from the spec tree (quantized payload/scale leaves) replicate."""
+        from jax.sharding import PartitionSpec as P
+
+        def pick(path, leaf):
+            node = specs
+            try:
+                for k in path:
+                    node = node[k.key if hasattr(k, "key") else k.idx]
+                return node if isinstance(node, P) else P()
+            except (KeyError, TypeError, IndexError):
+                return P()
+
+        return jax.tree_util.tree_map_with_path(pick, params)
 
     # ------------------------------------------------------------------
     def _build_row_step(self, t_bucket: int):
@@ -212,7 +302,9 @@ class InferenceEngineV2:
                     k = T._rope(k.transpose(1, 0, 2)[None], positions[None], c, live)[0].transpose(1, 0, 2)
                 kc_l = kc_l.at[blk, row].set(k)
                 vc_l = vc_l.at[blk, row].set(v)
-                out = paged_attention(q, kc_l, vc_l, tok_tables, positions, trash)
+                out = self._paged_attention_sharded(
+                    paged_attention, q, kc_l, vc_l, tok_tables, positions, trash
+                )
                 attn_out = (out.reshape(t, nh * d) @ lp["wo"])[None]
                 if c.attn_out_bias:
                     attn_out = attn_out + lp["wo_b"]

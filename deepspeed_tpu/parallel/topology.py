@@ -144,6 +144,18 @@ class Topology:
         return f"Topology(world={self.world_size}, {live or 'single-device'})"
 
 
+def filter_spec_entry(entry, predicate):
+    """Normalize one PartitionSpec entry keeping only axis names that satisfy
+    ``predicate`` (None passthrough, tuple/scalar handling, 0/1/n collapse).
+    Shared by constrain()'s manual-axis strip and the engine's pure-DP spec
+    sanitizer."""
+    if entry is None:
+        return None
+    axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+    kept = tuple(a for a in axes if predicate(a))
+    return kept if len(kept) > 1 else (kept[0] if kept else None)
+
+
 def _manual_axis_names():
     """Axis names of the enclosing ``shard_map`` manual region (empty when
     tracing outside one). Inside a manual region those axes are already
@@ -166,15 +178,12 @@ def constrain(x, *spec):
     topo = get_topology()
     manual = _manual_axis_names()
     if manual:
-
-        def strip(entry):
-            if entry is None:
-                return None
-            axes = entry if isinstance(entry, (tuple, list)) else (entry,)
-            kept = tuple(a for a in axes if a not in manual)
-            return kept if len(kept) > 1 else (kept[0] if kept else None)
-
-        spec = tuple(strip(e) for e in spec)
+        spec = tuple(filter_spec_entry(e, lambda a: a not in manual) for e in spec)
+        if all(e is None for e in spec):
+            # nothing left to constrain — emitting an empty-sharding
+            # custom-call inside a manual region has tripped XLA CPU
+            # partitioner bugs; identity is exactly equivalent
+            return x
     try:
         return jax.lax.with_sharding_constraint(
             x, NamedSharding(topo.mesh, PartitionSpec(*spec))

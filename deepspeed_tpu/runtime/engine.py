@@ -981,15 +981,24 @@ class DeepSpeedEngine:
         W = self.topo.dp_world_size
         mesh = self.topo.mesh
 
-        def leaf(p):
+        def shard_of(p):
             if p.size >= self.QGZ_MIN_SIZE:
-                sh = NamedSharding(mesh, P(DATA_AXIS, *([None] * p.ndim)))
-                return jax.jit(
-                    lambda: jnp.zeros((W,) + p.shape, jnp.bfloat16), out_shardings=sh
-                )()
-            return jnp.zeros((0,), jnp.bfloat16)
+                return NamedSharding(mesh, P(DATA_AXIS, *([None] * p.ndim)))
+            return NamedSharding(mesh, P())
 
-        return jax.tree.map(leaf, self.params)
+        shardings = jax.tree.map(shard_of, self.params)
+        # ONE compile for the whole zero pytree (per-leaf jits would pay one
+        # XLA compilation per parameter leaf)
+        return jax.jit(
+            lambda: jax.tree.map(
+                lambda p: jnp.zeros(
+                    (W,) + p.shape if p.size >= self.QGZ_MIN_SIZE else (0,),
+                    jnp.bfloat16,
+                ),
+                self.params,
+            ),
+            out_shardings=shardings,
+        )()
 
     def _make_quantized_micro_grads(self, grad_specs, mesh):
         """ZeRO++ qgZ/qwZ gradient/weight exchange (reference engine.py:1088
@@ -1025,7 +1034,24 @@ class DeepSpeedEngine:
         loco_cfg = zcfg.zeropp_loco_param or {}
         err_beta = float(loco_cfg.get("err_beta", 0.8))
         W = self.topo.dp_world_size
-        param_specs = self.plan.param_specs
+
+        def _data_only(spec):
+            """shard_map in_specs may only name MANUAL axes; _pure_dp()
+            guarantees every non-data axis is size 1, so stripping their
+            names (e.g. the transformer's 'model' TP entries) is layout-
+            preserving."""
+            from deepspeed_tpu.parallel.topology import filter_spec_entry
+
+            if spec is None or not isinstance(spec, P):
+                return spec
+            return P(*(filter_spec_entry(e, lambda a: a == DATA_AXIS) for e in tuple(spec)))
+
+        param_specs = jax.tree.map(
+            _data_only, self.plan.param_specs, is_leaf=lambda x: isinstance(x, P)
+        )
+        grad_specs = jax.tree.map(
+            _data_only, grad_specs, is_leaf=lambda x: isinstance(x, P)
+        )
 
         def gather_leaf(x, spec):
             k = self._data_dim(spec)
@@ -1742,6 +1768,13 @@ class DeepSpeedEngine:
         self.opt_state = self._park_state(self.opt_state)
         self.timers(STEP_GLOBAL_TIMER).stop()
         self._acc_grads = None
+        if bool(overflow) and any(
+            e.size for e in jax.tree_util.tree_leaves(self._loco_state)
+        ):
+            # mirror the fused step's overflow recovery: LoCo error buffers
+            # absorbed the non-finite residual during forward() and must be
+            # dropped, or every later compensated gradient stays non-finite
+            self._loco_state = jax.tree.map(jnp.zeros_like, self._loco_state)
         self._after_step(self._last_loss, grad_norm, overflow)
 
     def _lr_for_step(self):

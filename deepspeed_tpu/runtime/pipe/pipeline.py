@@ -266,9 +266,24 @@ class Pipelined1F1BLoss:
     """
 
     def __init__(self, config, micro_batches: int, topo: Topology = None):
+        from deepspeed_tpu.parallel.topology import MODEL_AXIS
+
         self.config = config
         self.micro_batches = micro_batches
         self.topo = topo or get_topology()
+        if (
+            config.tie_embeddings
+            and config.vocab_parallel
+            and self.topo.axis_size(MODEL_AXIS) > 1
+            and self.topo.pipe_parallel_size > 1
+        ):
+            raise ValueError(
+                "1F1B with tied embeddings does not support vocab_parallel=True "
+                "on a model axis > 1: the tied head's embed-table vjp runs inside "
+                "the pipe shard_map manual region, where a model-sharded vocab dim "
+                "trips an XLA spmd_partitioner group-assignment CHECK-crash — set "
+                "vocab_parallel=False on the model config (replicated embeddings)"
+            )
         self._fwd_loss = make_pipelined_loss_fn(config, micro_batches, self.topo)
 
     def __call__(self, params, batch):
