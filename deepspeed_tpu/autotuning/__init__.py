@@ -6,14 +6,19 @@ from deepspeed_tpu.autotuning.autotuner import (
     ModelInfo,
     TuningRecord,
     activation_memory_per_chip,
+    estimate_params,
     zero_memory_per_chip,
 )
+from deepspeed_tpu.autotuning.scheduler import SubprocessRunner, predicted_score
 
 __all__ = [
     "Autotuner",
     "AutotunerConfig",
     "ModelInfo",
     "TuningRecord",
+    "SubprocessRunner",
     "activation_memory_per_chip",
+    "estimate_params",
+    "predicted_score",
     "zero_memory_per_chip",
 ]

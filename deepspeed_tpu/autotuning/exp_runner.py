@@ -1,0 +1,101 @@
+"""Autotuning experiment subprocess (reference ``autotuning/scheduler.py``
+experiment jobs: every candidate config runs as its OWN process via the
+launcher, so an OOM/compile crash kills the experiment, not the tuner).
+
+Usage (spawned by :mod:`deepspeed_tpu.autotuning.scheduler`):
+
+    python -m deepspeed_tpu.autotuning.exp_runner '<json>'
+
+The JSON carries {"shape": {TransformerConfig kwargs}, "zero_stage",
+"micro_batch", "remat_policy", "flash_block", "seq", "steps", "warmup",
+"platform"}. Prints ONE JSON result line to stdout:
+{"ok": true, "tok_s": ..., "mfu_pct": ..., "loss": ...} — everything else
+goes to stderr. Exit code 0 even on handled failure (the line carries
+ok=false + the reason); hard crashes (OOM kill) surface as a nonzero exit
+the scheduler maps to None.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def run(exp: dict) -> dict:
+    # flash block must be in the env BEFORE the ops import chain
+    if exp.get("flash_block"):
+        os.environ["DSTPU_FLASH_BLOCK"] = str(exp["flash_block"])
+    if exp.get("platform") == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["PALLAS_AXON_POOL_IPS"] = ""  # never dial a TPU tunnel
+
+    import jax
+
+    if exp.get("platform") == "cpu":
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import (
+        TransformerConfig,
+        flops_per_token,
+        init_params,
+        make_loss_fn,
+    )
+
+    shape = dict(exp["shape"])
+    shape["remat_policy"] = exp.get("remat_policy") or shape.get("remat_policy", "flash")
+    cfg = TransformerConfig(**shape)
+    micro = int(exp.get("micro_batch", 1))
+    seq = int(exp.get("seq", min(cfg.max_seq_len, 2048)))
+    steps = int(exp.get("steps", 6))
+    warmup = int(exp.get("warmup", 2))
+
+    params = init_params(cfg, jax.random.key(0))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=make_loss_fn(cfg),
+        model_parameters=params,
+        config={
+            "train_batch_size": micro,
+            "bf16": {"enabled": jax.default_backend() == "tpu"},
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+            "zero_optimization": {"stage": int(exp.get("zero_stage", 0))},
+            "steps_per_print": 10**9,
+        },
+    )
+    toks = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=(micro, seq + 1)
+    ).astype(np.int32)
+    batch = {"input_ids": toks}
+    for _ in range(warmup):
+        float(engine.train_batch(batch=batch))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine.train_batch(batch=batch)
+    loss = float(loss)  # device sync
+    dt = (time.perf_counter() - t0) / steps
+    tok_s = micro * seq / dt
+    peak = 197e12 if jax.default_backend() == "tpu" else 1e12
+    return {
+        "ok": True,
+        "tok_s": round(tok_s, 1),
+        "s_per_step": round(dt, 4),
+        "mfu_pct": round(tok_s * flops_per_token(cfg, seq) / peak * 100, 2),
+        "loss": round(loss, 4),
+    }
+
+
+def main():
+    exp = json.loads(sys.argv[1])
+    try:
+        out = run(exp)
+    except Exception as e:  # handled failure: report, don't crash the tuner
+        out = {"ok": False, "error": f"{type(e).__name__}: {e}"[:300]}
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

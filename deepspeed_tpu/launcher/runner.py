@@ -57,9 +57,98 @@ def parse_args(argv=None):
     p.add_argument("--force_multi", action="store_true", help="multi-node path even for one host")
     p.add_argument("--module", action="store_true", help="run user_script with python -m")
     p.add_argument("--no_python", action="store_true", help="exec user_script directly")
-    p.add_argument("user_script", type=str, help="training script (or module with --module)")
+    p.add_argument(
+        "--autotuning", type=str, default="", choices=["", "tune", "dry"],
+        metavar="MODE",
+        help="run the autotuner instead of launching: 'tune' (subprocess "
+        "experiments over stage/micro/remat-policy/flash-block/shape, "
+        "cost-model ordered) or 'dry' (print the ranked candidate space)",
+    )
+    p.add_argument(
+        "--autotuning_preset", type=str, default="bench-767m",
+        help="model preset whose shape neighborhood the tuner searches",
+    )
+    p.add_argument(
+        "--autotuning_experiments", type=int, default=12,
+        help="experiment budget (each is a fresh subprocess)",
+    )
+    p.add_argument("user_script", type=str, nargs="?", default="", help="training script (or module with --module)")
     p.add_argument("user_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
+
+
+def run_autotuning(args) -> int:
+    """``dstpu --autotuning tune`` (reference ``deepspeed --autotuning`` +
+    autotuner.tune()): search the extended space around a preset with real
+    subprocess experiments; print the best config as one JSON line."""
+    import json
+
+    from deepspeed_tpu.autotuning import (
+        Autotuner,
+        AutotunerConfig,
+        ModelInfo,
+        SubprocessRunner,
+        estimate_params,
+    )
+    from deepspeed_tpu.models.transformer import PRESETS
+
+    base = dict(PRESETS[args.autotuning_preset])
+    hidden = base.get("hidden_size", 1024)
+    heads = base.get("n_heads", 8)
+    # shape neighborhood: the preset itself + width/GQA neighbors at a
+    # similar parameter budget (the knob family the round-3 MFU wins came
+    # from — hand-swept then, searched now)
+    head_dim = hidden // heads
+    shapes = [dict(base)]
+    for h_mult, head_mult in ((0.8, 1.0), (1.25, 1.0), (1.0, 0.5)):
+        s = dict(base)
+        # width neighbors keep the base HEAD DIM and rescale the head count
+        # with the width (hidden stays a multiple of n_heads by construction
+        # — naive rounding silently dropped every width candidate)
+        new_heads = max(1, int(round(heads * h_mult * head_mult)))
+        s["hidden_size"] = new_heads * head_dim
+        s["n_heads"] = new_heads
+        if s.get("n_kv_heads"):
+            s["n_kv_heads"] = max(1, min(s["n_kv_heads"], new_heads))
+        if s["hidden_size"] == hidden and new_heads == heads:
+            continue
+        shapes.append(s)
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
+    hbm = 16e9 if on_tpu else 64e9  # CPU smoke runs are unconstrained
+    mi = ModelInfo(
+        num_params=estimate_params(base),
+        hidden_size=hidden,
+        num_layers=base.get("n_layers", 4),
+        seq_len=base.get("max_seq_len", 2048),
+    )
+    cfg = AutotunerConfig(
+        enabled=True,
+        metric="throughput",
+        fast=True,
+        max_experiments=args.autotuning_experiments,
+        stages=(3,),
+        micro_batch_sizes=(2, 4, 6, 8),
+        remat_policies=("nothing", "flash", "dots_with_no_batch_dims"),
+        flash_blocks=(256, 512) if on_tpu else (512,),
+        shapes=tuple(shapes),
+    )
+    runner = SubprocessRunner(
+        metric="mfu_pct" if on_tpu else "tok_s",
+        platform=None if on_tpu else "cpu",
+        steps=6 if on_tpu else 2,
+        warmup=2 if on_tpu else 1,
+    )
+    tuner = Autotuner(mi, int(hbm), dp_world=1, runner=runner, config=cfg)
+    if args.autotuning == "dry":
+        for exp in tuner._space()[: args.autotuning_experiments]:
+            print(json.dumps(exp))
+        return 0
+    best, best_val = tuner.tune()
+    print(tuner.summary())
+    print(json.dumps({"best": best, "metric": best_val}))
+    return 0 if best is not None else 1
 
 
 def parse_hostfile(path: str) -> Dict[str, int]:
@@ -166,6 +255,11 @@ def run_local(args, env: Dict[str, str]) -> int:
 
 def main(argv=None) -> int:
     args = parse_args(argv)
+    if args.autotuning:
+        return run_autotuning(args)
+    if not args.user_script:
+        print("dstpu: user_script is required (or pass --autotuning tune)", file=sys.stderr)
+        return 2
     if args.tpu_name:
         # Cloud TPU: workers are addressed through gcloud + metadata; a
         # hostfile would conflate two addressing schemes, so it is ignored

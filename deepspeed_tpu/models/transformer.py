@@ -152,10 +152,11 @@ class TransformerConfig:
                 f"seq_impl={self.seq_impl!r}: expected 'ulysses' or 'ring' "
                 "(a typo would silently fall back to the wrong parallelism)"
             )
-        if self.matmul_precision not in ("default", "fp8", "int8"):
+        if self.matmul_precision not in ("default", "fp8", "int8", "int8_tensor"):
             raise ValueError(
                 f"matmul_precision={self.matmul_precision!r}: expected "
-                "'default', 'fp8' or 'int8'"
+                "'default', 'fp8', 'int8' (per-token/per-channel scales) or "
+                "'int8_tensor' (legacy per-tensor scales)"
             )
 
     @property

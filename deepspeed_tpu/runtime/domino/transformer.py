@@ -12,11 +12,15 @@ latency-hiding scheduler interleave chunk k's psum with chunk k+1's matmuls
 — no handle bookkeeping. The wrapper composes with ANY layer fn (the
 reference hardcodes its own attention/MLP pair).
 
-Measured (PERF.md "Domino chunking"): on the real chip at the bench layer
-shape, chunking itself costs +0.1% at n_chunks=2 and +2.0% at n_chunks=4
-with exact numerics — so the chunked form is essentially free where the
-overlap would pay. The overlap WIN itself needs a TP mesh to profile and
-rests on XLA's latency-hiding scheduler interleaving the chunk programs."""
+Measured (PERF.md "Domino chunking"): on every configuration reachable in
+this environment the chunking does NOT pay — single real TPU chip: +0.1%
+(n=2) / +2.0% (n=4) overhead, exact numerics; tp2 x dp4 on the 8-device CPU
+mesh: 0.90x (n=2) / 0.46x (n=4) of the unchunked throughput. The HLO does
+show the structural precondition the technique needs (2x independent
+half-size all-reduces per layer, no serializing dependency between chunk
+programs), but the CPU backend has no latency-hiding scheduler to exploit
+it, and one chip has no collectives to hide. Treat n_chunks>1 as
+UNVALIDATED until profiled on a real multi-chip TPU slice; default off."""
 
 from typing import Callable
 

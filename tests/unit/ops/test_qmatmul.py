@@ -9,7 +9,7 @@ import jax.numpy as jnp
 from deepspeed_tpu.ops.qmatmul import qmatmul
 
 
-@pytest.mark.parametrize("mode", ["fp8", "int8"])
+@pytest.mark.parametrize("mode", ["fp8", "int8", "int8_tensor"])
 def test_forward_close_to_dense(mode):
     rng = jax.random.key(0)
     x = jax.random.normal(rng, (4, 64, 128), jnp.float32)
@@ -17,10 +17,29 @@ def test_forward_close_to_dense(mode):
     dense = x @ w
     q = qmatmul(x, w, mode)
     rel = float(jnp.linalg.norm(q - dense) / jnp.linalg.norm(dense))
-    assert rel < 0.05, rel  # per-tensor-scaled 8-bit ops stay within ~5%
+    # Gaussian operands: per-channel ~= per-tensor (uniform channel norms);
+    # the per-channel WIN is asserted on outlier channels in the next test
+    bound = 0.015 if mode == "int8" else 0.05
+    assert rel < bound, rel
 
 
-@pytest.mark.parametrize("mode", ["fp8", "int8"])
+def test_per_channel_beats_per_tensor_on_outlier_channels():
+    """VERDICT round-3 #9: per-tensor int8 lets one hot output channel set
+    the scale for every other channel; per-channel scales are the fix. Build
+    a weight with a 50x outlier column and compare reconstruction error."""
+    x = jax.random.normal(jax.random.key(0), (16, 128), jnp.float32)
+    w = jax.random.normal(jax.random.key(1), (128, 64), jnp.float32) * 0.02
+    w = w.at[:, 0].mul(50.0)  # outlier channel dominates the tensor absmax
+    dense = x @ w
+    err = {
+        m: float(jnp.linalg.norm(qmatmul(x, w, m) - dense) / jnp.linalg.norm(dense))
+        for m in ("int8", "int8_tensor")
+    }
+    assert err["int8"] < 0.01, err
+    assert err["int8"] < err["int8_tensor"] / 5, err
+
+
+@pytest.mark.parametrize("mode", ["fp8", "int8", "int8_tensor"])
 def test_backward_is_exact_dense_vjp(mode):
     """Straight-through recipe: grads must equal the DENSE matmul's grads."""
     x = jax.random.normal(jax.random.key(0), (8, 32), jnp.float32)
@@ -68,7 +87,8 @@ def test_model_loss_parity_and_training(mode, devices8):
         losses[prec] = [float(engine.train_batch(batch={"input_ids": toks})) for _ in range(6)]
     dense, quant = losses["default"], losses[mode]
     assert quant[-1] < quant[0], quant  # trains
-    # trajectory parity: within 5% relative (or 0.05 absolute once the
-    # loss is near zero) at every step
+    # trajectory parity at every step: per-channel int8 is tighter than the
+    # per-tensor forms (VERDICT r3 #9 "loss-parity test tightened")
+    tol = 0.02 if mode == "int8" else 0.05
     for d, q in zip(dense, quant):
-        assert abs(d - q) < max(0.05 * d, 0.05), (dense, quant)
+        assert abs(d - q) < max(tol * d, tol), (dense, quant)
