@@ -84,8 +84,13 @@ def estimate_params(shape: Dict[str, Any]) -> int:
     nh = shape.get("n_heads", 8)
     nkv = shape.get("n_kv_heads") or nh
     d = shape.get("head_dim_override") or h // nh
-    ffn = shape.get("ffn_hidden_size") or 4 * h
     glu = shape.get("activation", "swiglu") in ("swiglu", "geglu")
+    # default ffn mirrors TransformerConfig.ffn_dim exactly: llama-style
+    # 8h/3 rounded up to 256 for GLU activations, 4h otherwise (a 4h GLU
+    # default overestimated MLP params ~1.5x and over-pruned candidates)
+    ffn = shape.get("ffn_hidden_size") or (
+        ((int(8 * h / 3) + 255) // 256) * 256 if glu else 4 * h
+    )
     attn = h * nh * d + 2 * h * nkv * d + nh * d * h
     mlp = (3 if glu else 2) * h * ffn
     embed = v * h * (1 if shape.get("tie_embeddings") else 2)
@@ -207,18 +212,25 @@ class Autotuner:
         for shape, stage, policy, block, micro in itertools.product(
             shapes, c.stages, policies, blocks, c.micro_batch_sizes
         ):
-            if not self._shape_feasible(shape, stage, micro, policy):
+            if shape:
+                feasible = self._shape_feasible(shape, stage, micro, policy)
+            else:
+                # no shape candidates: feasibility comes from ModelInfo (an
+                # empty dict through estimate_params would model a ~50M toy
+                # and disable the OOM prune entirely)
+                feasible = self.memory_feasible(stage, micro, policy != "everything")
+            if not feasible:
                 continue
-            exps.append(
-                {
-                    "zero_stage": stage,
-                    "micro_batch": micro,
-                    "remat": policy != "everything",
-                    "remat_policy": policy,
-                    "flash_block": block,
-                    "shape": dict(shape),
-                }
-            )
+            exp = {
+                "zero_stage": stage,
+                "micro_batch": micro,
+                "remat": policy != "everything",
+                "remat_policy": policy,
+                "flash_block": block,
+            }
+            if shape:
+                exp["shape"] = dict(shape)
+            exps.append(exp)
         exps.sort(key=predicted_score, reverse=True)
         return exps
 

@@ -99,17 +99,21 @@ def run_autotuning(args) -> int:
     # similar parameter budget (the knob family the round-3 MFU wins came
     # from — hand-swept then, searched now)
     head_dim = hidden // heads
+    base_kv = base.get("n_kv_heads") or heads
+    gqa_ratio = max(1, heads // base_kv)
     shapes = [dict(base)]
     for h_mult, head_mult in ((0.8, 1.0), (1.25, 1.0), (1.0, 0.5)):
         s = dict(base)
-        # width neighbors keep the base HEAD DIM and rescale the head count
-        # with the width (hidden stays a multiple of n_heads by construction
-        # — naive rounding silently dropped every width candidate)
-        new_heads = max(1, int(round(heads * h_mult * head_mult)))
+        # width neighbors keep the base HEAD DIM and GQA RATIO: the head
+        # count snaps to a multiple of the kv count so n_heads % n_kv_heads
+        # holds (naive rounding produced only invalid candidates before)
+        want = max(1, int(round(heads * h_mult * head_mult)))
+        new_kv = max(1, want // gqa_ratio)
+        new_heads = new_kv * gqa_ratio
         s["hidden_size"] = new_heads * head_dim
         s["n_heads"] = new_heads
-        if s.get("n_kv_heads"):
-            s["n_kv_heads"] = max(1, min(s["n_kv_heads"], new_heads))
+        if base.get("n_kv_heads"):
+            s["n_kv_heads"] = new_kv
         if s["hidden_size"] == hidden and new_heads == heads:
             continue
         shapes.append(s)

@@ -1,17 +1,21 @@
 """Pluggable checkpoint engines (reference runtime/checkpoint_engine/)."""
 
 from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import (
+    ENGINES,
     AsyncCheckpointEngine,
     CheckpointEngine,
     DecoupledCheckpointEngine,
     TorchCheckpointEngine,
     create_checkpoint_engine,
+    register_checkpoint_engine,
 )
 
 __all__ = [
+    "ENGINES",
     "AsyncCheckpointEngine",
     "CheckpointEngine",
     "DecoupledCheckpointEngine",
     "TorchCheckpointEngine",
     "create_checkpoint_engine",
+    "register_checkpoint_engine",
 ]

@@ -4,11 +4,12 @@ Measures: wall-clock fwd+bwd for a 4-layer TP stack with n_chunks in {1,2,4},
 plus HLO schedule evidence — whether the chunked form produces independent
 per-chunk all-reduces that a latency-hiding scheduler can interleave.
 """
+import os
 import re
 import sys
 import time
 
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 
 jax.config.update("jax_platforms", "cpu")
