@@ -46,7 +46,7 @@ def run(exp: dict) -> dict:
         make_loss_fn,
     )
 
-    shape = dict(exp["shape"])
+    shape = dict(exp.get("shape") or {})  # no-shape searches benchmark the default config
     shape["remat_policy"] = exp.get("remat_policy") or shape.get("remat_policy", "flash")
     cfg = TransformerConfig(**shape)
     micro = int(exp.get("micro_batch", 1))

@@ -47,6 +47,9 @@ def _register_builtins():
         "BloomForCausalLM",
         "GPTJForCausalLM",
         "GPTNeoXForCausalLM",
+        "MixtralForCausalLM",
+        "StableLmForCausalLM",
+        "Starcoder2ForCausalLM",
     ):
         POLICY_REGISTRY.setdefault(arch, load_hf_model)
 

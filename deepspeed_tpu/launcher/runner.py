@@ -108,8 +108,13 @@ def run_autotuning(args) -> int:
         # count snaps to a multiple of the kv count so n_heads % n_kv_heads
         # holds (naive rounding produced only invalid candidates before)
         want = max(1, int(round(heads * h_mult * head_mult)))
-        new_kv = max(1, want // gqa_ratio)
-        new_heads = new_kv * gqa_ratio
+        if base_kv == 1:
+            # MQA: any head count divides kv=1 — snapping through the ratio
+            # would collapse every neighbor back onto the base shape
+            new_kv, new_heads = 1, want
+        else:
+            new_kv = max(1, want // gqa_ratio)
+            new_heads = new_kv * gqa_ratio
         s["hidden_size"] = new_heads * head_dim
         s["n_heads"] = new_heads
         if base.get("n_kv_heads"):

@@ -7,10 +7,11 @@ qwen_v2_moe,falcon,phi,phi3}``): a HF causal-LM checkpoint directory becomes
 a (:class:`TransformerConfig`, stacked-params pytree) pair that trains or
 serves through ``deepspeed_tpu.initialize`` / ``init_inference`` unchanged.
 
-Supported ``model_type``s: llama, mistral, qwen2, qwen2_moe, falcon, phi,
-phi3, gpt2, opt, gemma, bloom, gptj, gpt_neox (scaled-RoPE checkpoints —
-llama3/yarn/longrope/linear/dynamic — import via ``rope_scaling``).
-Dispatch is by ``config.json``'s ``model_type`` (see
+Supported ``model_type``s: llama, mistral, qwen2, qwen2_moe, mixtral,
+falcon, phi, phi3, gpt2, opt, gemma, bloom, gptj, gpt_neox, stablelm,
+starcoder2 (scaled-RoPE checkpoints — llama3/yarn/longrope/linear/dynamic —
+import via ``rope_scaling``). Dispatch is by ``config.json``'s
+``model_type`` (see
 :data:`ARCH_LOADERS`); the inference engine factory additionally dispatches
 on ``architectures[0]`` (engine_factory.py).
 
@@ -178,6 +179,81 @@ def config_from_hf(hf_cfg) -> TransformerConfig:
             moe_capacity_factor=float(get("num_experts")) / float(get("num_experts_per_tok")),
             moe_shared_expert_dim=get("shared_expert_intermediate_size", 0) or 0,
             moe_aux_loss_coef=float(get("router_aux_loss_coef", 0.001)),
+        )
+    if mt == "mixtral":
+        return _llama_like_config(
+            get,
+            ffn_hidden_size=get("intermediate_size"),
+            n_experts=get("num_local_experts"),
+            moe_top_k=get("num_experts_per_tok"),
+            # HF mixtral ALWAYS renormalizes the top-k routing weights
+            moe_norm_topk_prob=True,
+            # dropless (HF never drops): cf = E/k gives capacity = tokens,
+            # the minimal drop-free bound — same stance as qwen2_moe above
+            moe_capacity_factor=float(get("num_local_experts")) / float(get("num_experts_per_tok")),
+            moe_aux_loss_coef=float(get("router_aux_loss_coef", 0.001)),
+        )
+    if mt == "stablelm":
+        if get("qk_layernorm", False):
+            # stablelm-2-12b class: per-head q/k norms change the math —
+            # silently dropping the weights would return wrong logits
+            raise ValueError("stablelm: qk_layernorm checkpoints are not supported")
+        return TransformerConfig(
+            vocab_size=get("vocab_size"),
+            hidden_size=get("hidden_size"),
+            n_layers=get("num_hidden_layers"),
+            n_heads=get("num_attention_heads"),
+            n_kv_heads=get("num_key_value_heads", None),
+            ffn_hidden_size=get("intermediate_size"),
+            max_seq_len=get("max_position_embeddings", 4096),
+            norm="layernorm",
+            activation="swiglu",  # silu-gated MLP under LayerNorm
+            position="rope",
+            rope_theta=float(get("rope_theta", 10000.0)),
+            rope_scaling=_parse_rope_scaling(get),
+            rope_frac=float(get("partial_rotary_factor", 0.25)),
+            norm_eps=float(get("layer_norm_eps", 1e-5)),
+            tie_embeddings=bool(get("tie_word_embeddings", False)),
+            attn_qkv_bias=bool(get("use_qkv_bias", False)),
+            # parallel residual shares input_layernorm across both branches
+            parallel_block=bool(get("use_parallel_residual", False)),
+        )
+    if mt == "starcoder2":
+        act = get("hidden_act", "gelu_pytorch_tanh")
+        act_map = {"gelu_pytorch_tanh": "gelu", "gelu_new": "gelu", "gelu": "gelu_exact"}
+        if act not in act_map:
+            raise ValueError(f"starcoder2: hidden_act={act!r} is not supported")
+        bias = bool(get("use_bias", True))
+        max_seq = get("max_position_embeddings", 4096)
+        window = get("sliding_window", None)
+        if window and window < max_seq:
+            # every released starcoder2 sets sliding_window=4096 with a 16k
+            # position range; full causal attention matches HF only INSIDE
+            # the window — clamp rather than silently diverge past it
+            logger.warning(
+                f"starcoder2: sliding-window attention (window={window}) is "
+                f"not implemented; clamping max_seq_len {max_seq} -> {window} "
+                "(logits match HF within the window, full-causal == windowed)"
+            )
+            max_seq = window
+        return TransformerConfig(
+            vocab_size=get("vocab_size"),
+            hidden_size=get("hidden_size"),
+            n_layers=get("num_hidden_layers"),
+            n_heads=get("num_attention_heads"),
+            n_kv_heads=get("num_key_value_heads", None),
+            ffn_hidden_size=get("intermediate_size"),
+            max_seq_len=max_seq,
+            norm="layernorm",
+            activation=act_map[act],
+            position="rope",
+            rope_theta=float(get("rope_theta", 10000.0)),
+            rope_scaling=_parse_rope_scaling(get),
+            norm_eps=float(get("norm_epsilon", 1e-5)),
+            tie_embeddings=bool(get("tie_word_embeddings", True)),
+            attn_qkv_bias=bias,
+            attn_out_bias=bias,
+            mlp_bias=bias,
         )
     if mt == "falcon":
         if get("alibi", False):
@@ -392,7 +468,8 @@ def config_from_hf(hf_cfg) -> TransformerConfig:
         )
     raise ValueError(
         f"unsupported model_type {mt!r}; supported: llama, mistral, qwen2, "
-        "qwen2_moe, falcon, phi, phi3, gpt2, opt, gemma, bloom, gptj, gpt_neox"
+        "qwen2_moe, mixtral, falcon, phi, phi3, gpt2, opt, gemma, bloom, "
+        "gptj, gpt_neox, stablelm, starcoder2"
     )
 
 
@@ -571,6 +648,65 @@ def _opt_layer(take: _Taker, cfg: TransformerConfig, p: str, layers: Dict[str, l
     layers["w_down_b"].append(take(f"{p}.fc2.bias"))
 
 
+def _mixtral_layer(take: _Taker, cfg: TransformerConfig, p: str, layers: Dict[str, list]):
+    # llama attention + block-sparse MoE: w1=gate, w3=up, w2=down
+    layers["attn_norm"].append(take(f"{p}.input_layernorm.weight"))
+    layers["wq"].append(take.linear(f"{p}.self_attn.q_proj.weight"))
+    layers["wk"].append(take.linear(f"{p}.self_attn.k_proj.weight"))
+    layers["wv"].append(take.linear(f"{p}.self_attn.v_proj.weight"))
+    layers["wo"].append(take.linear(f"{p}.self_attn.o_proj.weight"))
+    layers["mlp_norm"].append(take(f"{p}.post_attention_layernorm.weight"))
+    layers["router"].append(take.linear(f"{p}.block_sparse_moe.gate.weight"))
+    for name, hf in (("w_gate", "w1"), ("w_up", "w3"), ("w_down", "w2")):
+        layers[name].append(
+            np.stack([
+                take.linear(f"{p}.block_sparse_moe.experts.{e}.{hf}.weight")
+                for e in range(cfg.n_experts)
+            ])
+        )
+
+
+def _stablelm_layer(take: _Taker, cfg: TransformerConfig, p: str, layers: Dict[str, list]):
+    ln_w = take(f"{p}.input_layernorm.weight")
+    ln_b = take(f"{p}.input_layernorm.bias")
+    layers["attn_norm"].append(ln_w)
+    layers["attn_norm_b"].append(ln_b)
+    if cfg.parallel_block:
+        # parallel residual shares input_layernorm (gpt-j-style)
+        layers["mlp_norm"].append(ln_w)
+        layers["mlp_norm_b"].append(ln_b)
+    else:
+        layers["mlp_norm"].append(take(f"{p}.post_attention_layernorm.weight"))
+        layers["mlp_norm_b"].append(take(f"{p}.post_attention_layernorm.bias"))
+    for name, hf in (("wq", "q_proj"), ("wk", "k_proj"), ("wv", "v_proj")):
+        layers[name].append(take.linear(f"{p}.self_attn.{hf}.weight"))
+        if cfg.attn_qkv_bias:
+            layers[f"{name}_b"].append(take(f"{p}.self_attn.{hf}.bias"))
+    layers["wo"].append(take.linear(f"{p}.self_attn.o_proj.weight"))
+    layers["w_gate"].append(take.linear(f"{p}.mlp.gate_proj.weight"))
+    layers["w_up"].append(take.linear(f"{p}.mlp.up_proj.weight"))
+    layers["w_down"].append(take.linear(f"{p}.mlp.down_proj.weight"))
+
+
+def _starcoder2_layer(take: _Taker, cfg: TransformerConfig, p: str, layers: Dict[str, list]):
+    layers["attn_norm"].append(take(f"{p}.input_layernorm.weight"))
+    layers["attn_norm_b"].append(take(f"{p}.input_layernorm.bias"))
+    layers["mlp_norm"].append(take(f"{p}.post_attention_layernorm.weight"))
+    layers["mlp_norm_b"].append(take(f"{p}.post_attention_layernorm.bias"))
+    for name, hf in (("wq", "q_proj"), ("wk", "k_proj"), ("wv", "v_proj")):
+        layers[name].append(take.linear(f"{p}.self_attn.{hf}.weight"))
+        if cfg.attn_qkv_bias:  # use_bias=False checkpoints ship no biases
+            layers[f"{name}_b"].append(take(f"{p}.self_attn.{hf}.bias"))
+    layers["wo"].append(take.linear(f"{p}.self_attn.o_proj.weight"))
+    if cfg.attn_out_bias:
+        layers["wo_b"].append(take(f"{p}.self_attn.o_proj.bias"))
+    layers["w_up"].append(take.linear(f"{p}.mlp.c_fc.weight"))
+    layers["w_down"].append(take.linear(f"{p}.mlp.c_proj.weight"))
+    if cfg.mlp_bias:
+        layers["w_up_b"].append(take(f"{p}.mlp.c_fc.bias"))
+        layers["w_down_b"].append(take(f"{p}.mlp.c_proj.bias"))
+
+
 def _bloom_layer(take: _Taker, cfg: TransformerConfig, p: str, layers: Dict[str, list]):
     # bloom: MHA with per-head [q,k,v] interleaved fused qkv — the falcon
     # MHA degenerate case (group-of-3 per head) splits it
@@ -660,6 +796,9 @@ _LAYER_EXTRACTORS: Dict[str, Callable] = {
     "bloom": _bloom_layer,
     "gptj": _gptj_layer,
     "gpt_neox": _gptneox_layer,
+    "mixtral": _mixtral_layer,
+    "stablelm": _stablelm_layer,
+    "starcoder2": _starcoder2_layer,
 }
 
 # per-arch (embed key, final-norm key, layer prefix, pos-embed key or None)
@@ -682,6 +821,9 @@ _TOPLEVEL_KEYS: Dict[str, Tuple[str, str, str, Optional[str]]] = {
     "bloom": ("transformer.word_embeddings.weight", "transformer.ln_f", "transformer.h", None),
     "gptj": ("transformer.wte.weight", "transformer.ln_f", "transformer.h", None),
     "gpt_neox": ("gpt_neox.embed_in.weight", "gpt_neox.final_layer_norm", "gpt_neox.layers", None),
+    "mixtral": ("model.embed_tokens.weight", "model.norm", "model.layers", None),
+    "stablelm": ("model.embed_tokens.weight", "model.norm", "model.layers", None),
+    "starcoder2": ("model.embed_tokens.weight", "model.norm", "model.layers", None),
 }
 
 

@@ -223,6 +223,60 @@ def tiny_gptneox_seq(tmp_path_factory):
 
 
 @pytest.fixture(scope="module")
+def tiny_mixtral(tmp_path_factory):
+    # block-sparse MoE: w1/w3/w2 experts, renormalized top-2 routing
+    return _save_tiny(
+        tmp_path_factory, "hf_mixtral",
+        transformers.MixtralConfig, transformers.MixtralForCausalLM,
+        vocab_size=256, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=128, tie_word_embeddings=False,
+        output_router_logits=False,
+    )
+
+
+@pytest.fixture(scope="module")
+def tiny_stablelm(tmp_path_factory):
+    # LayerNorm + silu-GLU MLP + 0.25 partial rotary + qkv bias
+    return _save_tiny(
+        tmp_path_factory, "hf_stablelm",
+        transformers.StableLmConfig, transformers.StableLmForCausalLM,
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        partial_rotary_factor=0.25, use_qkv_bias=True,
+        use_parallel_residual=False, max_position_embeddings=128,
+        tie_word_embeddings=False,
+    )
+
+
+@pytest.fixture(scope="module")
+def tiny_stablelm_parallel(tmp_path_factory):
+    # parallel-residual variant: shared input_layernorm feeds both branches
+    return _save_tiny(
+        tmp_path_factory, "hf_stablelm_par",
+        transformers.StableLmConfig, transformers.StableLmForCausalLM,
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        partial_rotary_factor=0.25, use_qkv_bias=False,
+        use_parallel_residual=True, max_position_embeddings=128,
+        tie_word_embeddings=False,
+    )
+
+
+@pytest.fixture(scope="module")
+def tiny_starcoder2(tmp_path_factory):
+    # biased everything, non-GLU gelu MLP (c_fc/c_proj), tied embeddings
+    return _save_tiny(
+        tmp_path_factory, "hf_starcoder2",
+        transformers.Starcoder2Config, transformers.Starcoder2ForCausalLM,
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        use_bias=True, max_position_embeddings=128, tie_word_embeddings=True,
+    )
+
+
+@pytest.fixture(scope="module")
 def tiny_llama3_rope(tmp_path_factory):
     # llama-3.1-style frequency-banded rope scaling
     return _save_tiny(
@@ -304,6 +358,10 @@ _FIXTURES = {
     "gptj": "tiny_gptj",
     "gptneox": "tiny_gptneox",
     "gptneox_seq": "tiny_gptneox_seq",
+    "mixtral": "tiny_mixtral",
+    "stablelm": "tiny_stablelm",
+    "stablelm_par": "tiny_stablelm_parallel",
+    "starcoder2": "tiny_starcoder2",
 }
 
 
@@ -406,9 +464,22 @@ def test_logits_parity(arch, request):
         assert cfg.parallel_block and cfg.rope_frac == 0.5 and cfg.attn_qkv_bias
     elif arch == "gptneox_seq":
         assert not cfg.parallel_block and cfg.rope_frac == 1.0
+    elif arch == "mixtral":
+        assert cfg.n_experts == 4 and cfg.moe_top_k == 2 and cfg.moe_norm_topk_prob
+    elif arch == "stablelm":
+        assert cfg.norm == "layernorm" and cfg.activation == "swiglu"
+        assert cfg.rope_frac == 0.25 and cfg.attn_qkv_bias
+    elif arch == "stablelm_par":
+        assert cfg.parallel_block and not cfg.attn_qkv_bias
+    elif arch == "starcoder2":
+        assert cfg.attn_out_bias and cfg.mlp_bias and cfg.tie_embeddings
+        assert cfg.activation == "gelu"
 
 
-@pytest.mark.parametrize("arch", ["qwen2_moe", "falcon", "phi", "gemma", "bloom", "gptj", "gptneox"])
+@pytest.mark.parametrize(
+    "arch",
+    ["qwen2_moe", "falcon", "phi", "gemma", "bloom", "gptj", "gptneox", "mixtral", "stablelm"],
+)
 def test_greedy_decode_parity(arch, request):
     hf_model, path = request.getfixturevalue(_FIXTURES[arch])
     cfg, params = load_hf_model(path, dtype="float32")
