@@ -384,12 +384,6 @@ def config_from_hf(hf_cfg) -> TransformerConfig:
             head_dim_override=int(head_dim) if int(head_dim) != derived else None,
         )
     if mt == "bloom":
-        logger.warning(
-            "bloom/alibi attention runs the reference (non-flash) kernel: the "
-            "attention bias path materializes [b, heads, s, s] fp32 scores — "
-            "expect higher memory and lower throughput than rope models at "
-            "long sequence lengths"
-        )
         h = get("hidden_size") or get("n_embed")
         return TransformerConfig(
             vocab_size=get("vocab_size"),

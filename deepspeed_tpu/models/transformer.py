@@ -714,7 +714,6 @@ def _attention_block(c: TransformerConfig, lp, x, positions, segment_ids, kv_cac
             bias = bias + _alibi_bias(c, kpos)
         out = attention_op(q, k, v, causal=False, bias=bias)
     else:
-        alibi = _alibi_bias(c, positions) if c.position == "alibi" else None
         topo = get_topology()
         if topo.sequence_parallel_size > 1:
             if c.position == "alibi":
@@ -730,8 +729,16 @@ def _attention_block(c: TransformerConfig, lp, x, positions, segment_ids, kv_cac
                 from deepspeed_tpu.parallel.sequence import ulysses_attention
 
                 out = ulysses_attention(q, k, v, causal=True, segment_ids=segment_ids)
+        elif c.position == "alibi":
+            # rank-1 form rides the flash kernel (slope * key_position added
+            # in-kernel) — the dense [s, s] bias never materializes
+            out = attention_op(
+                q, k, v, causal=True, segment_ids=segment_ids,
+                alibi_slopes=jnp.asarray(alibi_slopes(nh)),
+                alibi_positions=positions,
+            )
         else:
-            out = attention_op(q, k, v, causal=True, segment_ids=segment_ids, bias=alibi)
+            out = attention_op(q, k, v, causal=True, segment_ids=segment_ids)
     out = out.transpose(0, 2, 1, 3).reshape(b, s, nh * d)
     out = _proj(c, out, lp["wo"])
     if c.attn_out_bias:

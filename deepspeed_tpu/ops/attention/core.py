@@ -37,10 +37,14 @@ def mha_reference(
     segment_ids: Optional[jax.Array] = None,
     bias: Optional[jax.Array] = None,
     scale: Optional[float] = None,
+    alibi_slopes: Optional[jax.Array] = None,
+    alibi_positions: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Numerically-stable reference attention in jnp (fp32 softmax).
 
     q: [b, h, sq, d]; k, v: [b, h_kv, sk, d]. Returns [b, h, sq, d].
+    ``alibi_slopes`` ([h]): adds ``slope_h * key_position`` to the logits
+    (bloom's absolute-position ALiBi; positions default to arange(sk)).
     """
     b, h, sq, d = q.shape
     h_kv = k.shape[1]
@@ -50,6 +54,16 @@ def mha_reference(
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
     if bias is not None:
         logits = logits + bias.astype(jnp.float32)
+    if alibi_slopes is not None:
+        kp = (
+            jnp.arange(k.shape[2], dtype=jnp.float32)[None]
+            if alibi_positions is None
+            else jnp.asarray(alibi_positions, jnp.float32)
+        )
+        if kp.ndim == 1:
+            kp = kp[None]
+        slopes = jnp.asarray(alibi_slopes, jnp.float32)
+        logits = logits + slopes[None, :, None, None] * kp[:, None, None, :]
     sk = k.shape[2]
     if causal:
         # offset so the last q position attends to all sk keys (decode-friendly)
@@ -65,6 +79,9 @@ def mha_reference(
     return jnp.einsum("bhqk,bhkd->bhqd", weights.astype(v.dtype), v)
 
 
+_warned_alibi_fallback = False
+
+
 @functools.lru_cache(maxsize=1)
 def _flash_available() -> bool:
     if jax.default_backend() != "tpu":
@@ -77,7 +94,7 @@ def _flash_available() -> bool:
         return False
 
 
-def _flash_sharded(q, k, v, causal, segment_ids, scale):
+def _flash_sharded(q, k, v, causal, segment_ids, scale, alibi_slopes=None, alibi_positions=None):
     """Run the Pallas flash kernel under a multi-device mesh.
 
     pallas_call is opaque to the GSPMD partitioner — invoked bare inside jit
@@ -99,7 +116,27 @@ def _flash_sharded(q, k, v, causal, segment_ids, scale):
 
     topo = get_topology()
     if topo.world_size == 1:
-        return flash_attention(q, k, v, causal=causal, segment_ids=segment_ids, scale=scale)
+        return flash_attention(
+            q, k, v, causal=causal, segment_ids=segment_ids, scale=scale,
+            alibi_slopes=alibi_slopes, alibi_positions=alibi_positions,
+        )
+    if alibi_slopes is not None:
+        # multi-device alibi would need the slope plane sharded with the
+        # head axes inside the manual region — not wired yet; the caller
+        # falls back to the reference einsum (GSPMD partitions that, but it
+        # materializes [b, h, s, s] fp32 scores — warn once, loudly)
+        global _warned_alibi_fallback
+        if not _warned_alibi_fallback:
+            _warned_alibi_fallback = True
+            from deepspeed_tpu.utils.logging import logger
+
+            logger.warning(
+                "alibi attention on a multi-device mesh falls back to the "
+                "dense reference path (O(seq²) HBM for scores) — the flash "
+                "kernel's in-kernel alibi is single-device only for now; "
+                "expect much higher memory at long sequence lengths"
+            )
+        return None
 
     b, h, s, d = q.shape
     h_kv = k.shape[1]
@@ -149,8 +186,12 @@ def attention(
     bias: Optional[jax.Array] = None,
     scale: Optional[float] = None,
     impl: Optional[str] = None,
+    alibi_slopes: Optional[jax.Array] = None,
+    alibi_positions: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """Dispatching attention entry point. ``impl`` forces 'flash' or 'reference'."""
+    """Dispatching attention entry point. ``impl`` forces 'flash' or
+    'reference'. ALiBi rides the flash path (rank-1 in-kernel bias); a dense
+    ``bias`` forces the reference path."""
     d = q.shape[-1]
     sq, sk = q.shape[2], k.shape[2]
     use_flash = impl == "flash" or (
@@ -163,7 +204,10 @@ def attention(
         and sq == sk  # self-attention training path; decode uses reference
     )
     if use_flash:
-        out = _flash_sharded(q, k, v, causal, segment_ids, scale)
+        out = _flash_sharded(q, k, v, causal, segment_ids, scale, alibi_slopes, alibi_positions)
         if out is not None:
             return out
-    return mha_reference(q, k, v, causal=causal, segment_ids=segment_ids, bias=bias, scale=scale)
+    return mha_reference(
+        q, k, v, causal=causal, segment_ids=segment_ids, bias=bias, scale=scale,
+        alibi_slopes=alibi_slopes, alibi_positions=alibi_positions,
+    )

@@ -44,8 +44,17 @@ NEG_INF = -1e30
 LANES = 128
 
 
+def _alibi_term(alibi_ref, kpos_ref):
+    """ALiBi additive logits term for one block: ``slope_h * key_position``
+    (HF bloom's absolute-position convention — softmax-equivalent to the
+    relative form under causal masking). alibi_ref: [1, LANES] slope plane
+    for this head; kpos_ref: [bk] int32 key positions."""
+    return alibi_ref[0, 0] * kpos_ref[:].astype(jnp.float32)[None, :]
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
-                scale, causal, bq, bk, nk, seg_q_ref=None, seg_k_ref=None):
+                scale, causal, bq, bk, nk, seg_q_ref=None, seg_k_ref=None,
+                alibi_ref=None, kpos_ref=None):
     # q_ref: [bq, d]; k_ref/v_ref: [bk, d] (one streamed block);
     # o_ref: [bq, d]; lse_ref: [bq, LANES]; scratch m/l: [bq, LANES] f32,
     # acc: [bq, d] f32 — carried across the minor (kv) grid dimension.
@@ -69,6 +78,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
         logits = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [bq, bk] fp32
+        if alibi_ref is not None:
+            logits = logits + _alibi_term(alibi_ref, kpos_ref)
         if causal:
             q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -101,7 +112,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
                    delta_ref, dq_acc_ref, *, scale, causal, bq, bk, nk,
-                   seg_q_ref=None, seg_k_ref=None):
+                   seg_q_ref=None, seg_k_ref=None, alibi_ref=None, kpos_ref=None):
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -126,6 +137,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
         logits = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
+        if alibi_ref is not None:
+            logits = logits + _alibi_term(alibi_ref, kpos_ref)
         if causal:
             q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -151,7 +164,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref,
                     dv_ref, dk_acc_ref, dv_acc_ref, *, scale, causal, bq, bk,
-                    nq, seg_q_ref=None, seg_k_ref=None):
+                    nq, seg_q_ref=None, seg_k_ref=None, alibi_ref=None,
+                    kpos_ref=None):
     ki = pl.program_id(2)
     qj = pl.program_id(3)
 
@@ -180,6 +194,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref,
         logits = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [bq, bk]
+        if alibi_ref is not None:
+            logits = logits + _alibi_term(alibi_ref, kpos_ref)
         if causal:
             q_pos = qj * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -236,18 +252,40 @@ def flash_attention(
     segment_ids=None,
     scale: Optional[float] = None,
     interpret: bool = False,
+    alibi_slopes=None,
+    alibi_positions=None,
 ) -> jax.Array:
     """Flash attention. q: [b, h, s, d]; k, v: [b, h_kv, s, d] → [b, h, s, d].
 
     ``segment_ids``: optional [b, s] int32 — packed-sequence masking happens
     IN the kernel (tokens attend only within their own segment), so packed
-    pretraining keeps the flash path."""
-    return _flash_core(q, k, v, segment_ids, causal, scale, interpret)
+    pretraining keeps the flash path.
+
+    ``alibi_slopes``: optional [h] fp32 — bloom-style ALiBi folds into the
+    kernel as ``slope_h * key_position`` added to the logits (rank-1, so the
+    [s, s] bias never materializes; the review of round 4 found alibi
+    silently dropping to the O(s²)-HBM reference path). ``alibi_positions``
+    ([b, s] or [s] int32) supplies the key positions; defaults to arange.
+    Slopes are constants (non-learned) — no cotangent."""
+    alibi = None
+    if alibi_slopes is not None:
+        b, _, s, _ = q.shape
+        slopes = jnp.asarray(alibi_slopes, jnp.float32)
+        pos = (
+            jnp.arange(s, dtype=jnp.int32)
+            if alibi_positions is None
+            else jnp.asarray(alibi_positions, jnp.int32)
+        )
+        if pos.ndim == 1:
+            pos = jnp.broadcast_to(pos[None], (b, s))
+        # lane-broadcast plane per head: the kernel reads [1, LANES] blocks
+        alibi = (jnp.broadcast_to(slopes[:, None], (slopes.shape[0], LANES)), pos)
+    return _flash_core(q, k, v, segment_ids, alibi, causal, scale, interpret)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flash_core(q, k, v, segment_ids, causal, scale, interpret):
-    out, _ = _flash_fwd(q, k, v, segment_ids, causal, scale, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _flash_core(q, k, v, segment_ids, alibi, causal, scale, interpret):
+    out, _ = _flash_fwd(q, k, v, segment_ids, alibi, causal, scale, interpret)
     return out
 
 
@@ -280,7 +318,19 @@ def _seg_specs(segment_ids, q_block, q_map, k_block, k_map):
     ]
 
 
-def _flash_call(q, k, v, segment_ids, causal, scale, interpret):
+def _alibi_specs(alibi, k_block, k_map):
+    """(extra operands, extra in_specs) for ALiBi: the per-head slope plane
+    [h, LANES] plus the [b, s] key-position plane (k-side blocks only)."""
+    if alibi is None:
+        return [], []
+    slopes_lane, kpos = alibi
+    return [slopes_lane, kpos], [
+        pl.BlockSpec((1, LANES), lambda b_, h_, i, j: (h_, 0)),
+        pl.BlockSpec((1, k_block), lambda b_, h_, i, j: (b_, k_map(i, j))),
+    ]
+
+
+def _flash_call(q, k, v, segment_ids, alibi, causal, scale, interpret):
     b, h, s, d = q.shape
     h_kv = k.shape[1]
     group = h // h_kv
@@ -295,17 +345,20 @@ def _flash_call(q, k, v, segment_ids, causal, scale, interpret):
     )
 
     seg_ops, seg_specs = _seg_specs(segment_ids, bq, lambda i, j: i, bk, jc)
+    alibi_ops, alibi_specs = _alibi_specs(alibi, bk, jc)
 
     def entry(qr, kr, vr, *rest):
+        rest = list(rest)
+        kw = {}
         if seg_ops:
-            sq_r, sk_r, orf, lr, mref, lref, aref = rest
-            kernel(qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], orf.at[0, 0],
-                   lr.at[0, 0], mref, lref, aref,
-                   seg_q_ref=sq_r.at[0], seg_k_ref=sk_r.at[0])
-        else:
-            orf, lr, mref, lref, aref = rest
-            kernel(qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], orf.at[0, 0],
-                   lr.at[0, 0], mref, lref, aref)
+            kw["seg_q_ref"] = rest.pop(0).at[0]
+            kw["seg_k_ref"] = rest.pop(0).at[0]
+        if alibi_ops:
+            kw["alibi_ref"] = rest.pop(0)
+            kw["kpos_ref"] = rest.pop(0).at[0]
+        orf, lr, mref, lref, aref = rest
+        kernel(qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], orf.at[0, 0],
+               lr.at[0, 0], mref, lref, aref, **kw)
 
     out, lse = pl.pallas_call(
         # refs arrive with the leading (1, 1) block dims squeezed via .at
@@ -317,7 +370,7 @@ def _flash_call(q, k, v, segment_ids, causal, scale, interpret):
                          lambda b_, h_, i, j: (b_, h_ // group, jc(i, j), 0)),
             pl.BlockSpec((1, 1, bk, d),
                          lambda b_, h_, i, j: (b_, h_ // group, jc(i, j), 0)),
-        ] + seg_specs,
+        ] + seg_specs + alibi_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
             pl.BlockSpec((1, 1, bq, LANES), lambda b_, h_, i, j: (b_, h_, i, 0)),
@@ -332,12 +385,12 @@ def _flash_call(q, k, v, segment_ids, causal, scale, interpret):
             pltpu.VMEM((bq, d), jnp.float32),      # output accumulator
         ],
         interpret=interpret,
-    )(q, k, v, *seg_ops)
+    )(q, k, v, *seg_ops, *alibi_ops)
     return out, lse
 
 
-def _flash_fwd(q, k, v, segment_ids, causal, scale, interpret):
-    out, lse = _flash_call(q, k, v, segment_ids, causal, scale, interpret)
+def _flash_fwd(q, k, v, segment_ids, alibi, causal, scale, interpret):
+    out, lse = _flash_call(q, k, v, segment_ids, alibi, causal, scale, interpret)
     # Residual LSE is narrowed to one lane (it is lane-broadcast) so saving it
     # costs b·h·s·4 bytes, not ×LANES; the backward re-broadcasts. The names
     # feed the "flash" remat policy (models.transformer.remat_policy): saving
@@ -351,11 +404,11 @@ def _flash_fwd(q, k, v, segment_ids, causal, scale, interpret):
     q = checkpoint_name(q, "flash_qkv")
     k = checkpoint_name(k, "flash_qkv")
     v = checkpoint_name(v, "flash_qkv")
-    return out, (q, k, v, segment_ids, out, lse1)
+    return out, (q, k, v, segment_ids, alibi, out, lse1)
 
 
 def _flash_bwd(causal, scale, interpret, res, g):
-    q, k, v, segment_ids, out, lse = res
+    q, k, v, segment_ids, alibi, out, lse = res
     lse = jnp.broadcast_to(lse, lse.shape[:-1] + (LANES,))
     b, h, s, d = q.shape
     h_kv = k.shape[1]
@@ -372,17 +425,20 @@ def _flash_bwd(causal, scale, interpret, res, g):
     )
 
     seg_ops, seg_specs = _seg_specs(segment_ids, bq, lambda i, j: i, bk, jc)
+    alibi_ops, alibi_specs = _alibi_specs(alibi, bk, jc)
 
     def dq_entry(qr, kr, vr, orf, dor, lr, *rest):
+        rest = list(rest)
+        kw = {}
         if seg_ops:
-            sq_r, sk_r, dqr, dref, aref = rest
-            dq_kernel(qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], orf.at[0, 0],
-                      dor.at[0, 0], lr.at[0, 0], dqr.at[0, 0], dref, aref,
-                      seg_q_ref=sq_r.at[0], seg_k_ref=sk_r.at[0])
-        else:
-            dqr, dref, aref = rest
-            dq_kernel(qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], orf.at[0, 0],
-                      dor.at[0, 0], lr.at[0, 0], dqr.at[0, 0], dref, aref)
+            kw["seg_q_ref"] = rest.pop(0).at[0]
+            kw["seg_k_ref"] = rest.pop(0).at[0]
+        if alibi_ops:
+            kw["alibi_ref"] = rest.pop(0)
+            kw["kpos_ref"] = rest.pop(0).at[0]
+        dqr, dref, aref = rest
+        dq_kernel(qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], orf.at[0, 0],
+                  dor.at[0, 0], lr.at[0, 0], dqr.at[0, 0], dref, aref, **kw)
 
     dq = pl.pallas_call(
         dq_entry,
@@ -396,7 +452,7 @@ def _flash_bwd(causal, scale, interpret, res, g):
             pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
             pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
             pl.BlockSpec((1, 1, bq, LANES), lambda b_, h_, i, j: (b_, h_, i, 0)),
-        ] + seg_specs,
+        ] + seg_specs + alibi_specs,
         out_specs=pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[
@@ -404,7 +460,7 @@ def _flash_bwd(causal, scale, interpret, res, g):
             pltpu.VMEM((bq, d), jnp.float32),      # dq accumulator
         ],
         interpret=interpret,
-    )(q, k, v, out, g, lse, *seg_ops)
+    )(q, k, v, out, g, lse, *seg_ops, *alibi_ops)
 
     # dk/dv computed per q-head (reduced over the GQA group after), with the
     # q/do/o/lse stream minor so one [bk, d] kv block stays resident.
@@ -412,18 +468,22 @@ def _flash_bwd(causal, scale, interpret, res, g):
         _bwd_dkv_kernel, scale=scale_v, causal=causal, bq=bq, bk=bk, nq=nq
     )
     dkv_seg_ops, dkv_seg_specs = _seg_specs(segment_ids, bq, qc, bk, lambda i, j: i)
+    # dk/dv grid is kv-major: the key-position block follows the kv index i
+    dkv_alibi_ops, dkv_alibi_specs = _alibi_specs(alibi, bk, lambda i, j: i)
 
     def dkv_entry(qr, kr, vr, orf, dor, lr, *rest):
+        rest = list(rest)
+        kw = {}
         if dkv_seg_ops:
-            sq_r, sk_r, dkr, dvr, dka, dva = rest
-            dkv_kernel(qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], orf.at[0, 0],
-                       dor.at[0, 0], lr.at[0, 0], dkr.at[0, 0], dvr.at[0, 0],
-                       dka, dva, seg_q_ref=sq_r.at[0], seg_k_ref=sk_r.at[0])
-        else:
-            dkr, dvr, dka, dva = rest
-            dkv_kernel(qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], orf.at[0, 0],
-                       dor.at[0, 0], lr.at[0, 0], dkr.at[0, 0], dvr.at[0, 0],
-                       dka, dva)
+            kw["seg_q_ref"] = rest.pop(0).at[0]
+            kw["seg_k_ref"] = rest.pop(0).at[0]
+        if dkv_alibi_ops:
+            kw["alibi_ref"] = rest.pop(0)
+            kw["kpos_ref"] = rest.pop(0).at[0]
+        dkr, dvr, dka, dva = rest
+        dkv_kernel(qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], orf.at[0, 0],
+                   dor.at[0, 0], lr.at[0, 0], dkr.at[0, 0], dvr.at[0, 0],
+                   dka, dva, **kw)
 
     dk_h, dv_h = pl.pallas_call(
         dkv_entry,
@@ -436,7 +496,7 @@ def _flash_bwd(causal, scale, interpret, res, g):
             pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, qc(i, j), 0)),
             pl.BlockSpec((1, 1, bq, LANES),
                          lambda b_, h_, i, j: (b_, h_, qc(i, j), 0)),
-        ] + dkv_seg_specs,
+        ] + dkv_seg_specs + dkv_alibi_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bk, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
             pl.BlockSpec((1, 1, bk, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
@@ -450,14 +510,14 @@ def _flash_bwd(causal, scale, interpret, res, g):
             pltpu.VMEM((bk, d), jnp.float32),  # dv accumulator
         ],
         interpret=interpret,
-    )(q, k, v, out, g, lse, *dkv_seg_ops)
+    )(q, k, v, out, g, lse, *dkv_seg_ops, *dkv_alibi_ops)
 
     if group > 1:
         dk = jnp.sum(dk_h.reshape(b, h_kv, group, s, d).astype(jnp.float32), axis=2).astype(k.dtype)
         dv = jnp.sum(dv_h.reshape(b, h_kv, group, s, d).astype(jnp.float32), axis=2).astype(v.dtype)
     else:
         dk, dv = dk_h, dv_h
-    return dq, dk, dv, None  # no cotangent for segment_ids
+    return dq, dk, dv, None, None  # no cotangent for segment_ids / alibi
 
 
 _flash_core.defvjp(_flash_fwd, _flash_bwd)
