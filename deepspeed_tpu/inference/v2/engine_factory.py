@@ -42,6 +42,8 @@ def _register_builtins():
         "PhiForCausalLM",
         "Phi3ForCausalLM",
         "GPT2LMHeadModel",
+        "GPTNeoForCausalLM",
+        "InternLMForCausalLM",
         "OPTForCausalLM",
         "GemmaForCausalLM",
         "BloomForCausalLM",

@@ -43,6 +43,17 @@ class InferenceEngineV2:
                 "v2 paged engine: alibi (bloom) is not supported — the paged "
                 "attention kernel takes no bias; serve bloom through the v1 engine"
             )
+        if model_config.sliding_window > 0 or model_config.attn_scale is not None:
+            raise NotImplementedError(
+                "v2 paged engine: sliding-window / scale-override attention "
+                "(mistral-v0.1, starcoder2, gpt_neo) is not supported — the "
+                "paged kernel has no banded mask; serve through the v1 engine"
+            )
+        if not model_config.attn_causal:
+            raise ValueError(
+                "v2 paged engine: encoder models (attn_causal=False) do not "
+                "autoregressively generate — run models.transformer.forward()"
+            )
         dtype = T.DTYPES.get(self.config.dtype, jnp.bfloat16)
         params = jax.tree.map(
             lambda p: p.astype(dtype) if jnp.issubdtype(p.dtype, jnp.floating) else p, params

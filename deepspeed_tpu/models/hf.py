@@ -8,9 +8,12 @@ a (:class:`TransformerConfig`, stacked-params pytree) pair that trains or
 serves through ``deepspeed_tpu.initialize`` / ``init_inference`` unchanged.
 
 Supported ``model_type``s: llama, mistral, qwen2, qwen2_moe, mixtral,
-falcon, phi, phi3, gpt2, opt, gemma, bloom, gptj, gpt_neox, stablelm,
-starcoder2 (scaled-RoPE checkpoints — llama3/yarn/longrope/linear/dynamic —
-import via ``rope_scaling``). Dispatch is by ``config.json``'s
+falcon, phi, phi3, gpt2, gpt_neo, opt, gemma, bloom, gptj, gpt_neox,
+internlm, stablelm, starcoder2, plus the bert/distilbert encoder family
+(post-LN bidirectional stack + masked-LM head) (scaled-RoPE checkpoints —
+llama3/yarn/longrope/linear/dynamic — import via ``rope_scaling``;
+sliding-window checkpoints — mistral/starcoder2/gpt_neo local — import via
+``sliding_window``/``attn_layer_pattern``). Dispatch is by ``config.json``'s
 ``model_type`` (see
 :data:`ARCH_LOADERS`); the inference engine factory additionally dispatches
 on ``architectures[0]`` (engine_factory.py).
@@ -145,7 +148,22 @@ def config_from_hf(hf_cfg) -> TransformerConfig:
         head_dim = get("head_dim", None)
         derived = get("hidden_size") // get("num_attention_heads")
         override = int(head_dim) if head_dim is not None and int(head_dim) != derived else None
-        return _llama_like_config(get, head_dim_override=override)
+        bias = bool(get("attention_bias", False))
+        # mistral sliding_window (None on v0.2+ checkpoints → full attention)
+        window = int(get("sliding_window", None) or 0) if mt == "mistral" else 0
+        if window >= get("max_position_embeddings", 2048):
+            window = 0  # window beyond the position range is full attention
+        return _llama_like_config(
+            get, head_dim_override=override, attn_qkv_bias=bias,
+            attn_out_bias=bias, sliding_window=window,
+        )
+    if mt == "internlm":
+        # InternLM is llama + biased attention projections (reference
+        # module_inject/containers/internlm.py). `bias` covers q/k/v AND o
+        # (HF InternLM passes one flag to all four Linears). internlm2's
+        # fused-wqkv export is not supported.
+        bias = bool(get("bias", True))
+        return _llama_like_config(get, attn_qkv_bias=bias, attn_out_bias=bias)
     if mt == "qwen2":
         return _llama_like_config(get, attn_qkv_bias=True)
     if mt == "qwen2_moe":
@@ -225,17 +243,11 @@ def config_from_hf(hf_cfg) -> TransformerConfig:
             raise ValueError(f"starcoder2: hidden_act={act!r} is not supported")
         bias = bool(get("use_bias", True))
         max_seq = get("max_position_embeddings", 4096)
-        window = get("sliding_window", None)
-        if window and window < max_seq:
-            # every released starcoder2 sets sliding_window=4096 with a 16k
-            # position range; full causal attention matches HF only INSIDE
-            # the window — clamp rather than silently diverge past it
-            logger.warning(
-                f"starcoder2: sliding-window attention (window={window}) is "
-                f"not implemented; clamping max_seq_len {max_seq} -> {window} "
-                "(logits match HF within the window, full-causal == windowed)"
-            )
-            max_seq = window
+        # released starcoder2 sets sliding_window=4096 with a 16k position
+        # range — native banded masking (sliding_window) keeps the full range
+        window = int(get("sliding_window", None) or 0)
+        if window >= max_seq:
+            window = 0
         return TransformerConfig(
             vocab_size=get("vocab_size"),
             hidden_size=get("hidden_size"),
@@ -254,6 +266,103 @@ def config_from_hf(hf_cfg) -> TransformerConfig:
             attn_qkv_bias=bias,
             attn_out_bias=bias,
             mlp_bias=bias,
+            sliding_window=window,
+        )
+    if mt == "gpt_neo":
+        h = get("hidden_size")
+        act = get("activation_function", "gelu_new")
+        if act not in ("gelu_new", "gelu_pytorch_tanh"):
+            raise ValueError(f"gpt_neo: activation_function={act!r} is not supported (gelu_new only)")
+        n_layers = get("num_layers")
+        # expand attention_types ([[types, repeat], ...]) the way
+        # GPTNeoConfig.expand_attention_types_params does
+        pattern: list = []
+        for types, rep in get("attention_types", [[["global"], n_layers]]):
+            for _ in range(rep):
+                pattern.extend(types)
+        if len(pattern) != n_layers:
+            raise ValueError(
+                f"gpt_neo: attention_types expands to {len(pattern)} layers, "
+                f"config has {n_layers}"
+            )
+        bad = sorted(set(pattern) - {"global", "local"})
+        if bad:
+            raise ValueError(f"gpt_neo: unknown attention type(s) {bad}")
+        any_local = "local" in pattern
+        return TransformerConfig(
+            vocab_size=get("vocab_size"),
+            hidden_size=h,
+            n_layers=n_layers,
+            n_heads=get("num_heads"),
+            ffn_hidden_size=get("intermediate_size", None) or 4 * h,
+            max_seq_len=get("max_position_embeddings", 2048),
+            norm="layernorm",
+            activation="gelu",  # gelu_new = tanh approx
+            position="learned",
+            norm_eps=float(get("layer_norm_epsilon", 1e-5)),
+            tie_embeddings=True,
+            # q/k/v Linears carry no bias; out_proj and the MLP do
+            attn_out_bias=True,
+            mlp_bias=True,
+            # GPTNeoSelfAttention never scales the logits by 1/sqrt(d)
+            attn_scale=1.0,
+            sliding_window=int(get("window_size", 256)) if any_local else 0,
+            attn_layer_pattern=tuple(int(t == "local") for t in pattern) if any_local else None,
+        )
+    if mt == "bert":
+        act_map = {"gelu": "gelu_exact", "gelu_new": "gelu", "gelu_pytorch_tanh": "gelu", "relu": "relu"}
+        act = get("hidden_act", "gelu")
+        if act not in act_map:
+            raise ValueError(f"bert: hidden_act={act!r} is not supported")
+        return TransformerConfig(
+            vocab_size=get("vocab_size"),
+            hidden_size=get("hidden_size"),
+            n_layers=get("num_hidden_layers"),
+            n_heads=get("num_attention_heads"),
+            ffn_hidden_size=get("intermediate_size"),
+            max_seq_len=get("max_position_embeddings", 512),
+            norm="layernorm",
+            activation=act_map[act],
+            position="learned",
+            norm_eps=float(get("layer_norm_eps", 1e-12)),
+            tie_embeddings=True,  # cls.predictions.decoder ties to embeddings
+            attn_qkv_bias=True,
+            attn_out_bias=True,
+            mlp_bias=True,
+            attn_causal=False,
+            norm_scheme="post",
+            embed_norm=True,  # embeddings.LayerNorm after word+pos+type sum
+            type_vocab_size=get("type_vocab_size", 2),
+            final_norm=False,
+            mlm_head=True,
+        )
+    if mt == "distilbert":
+        if get("sinusoidal_pos_embds", False):
+            raise ValueError("distilbert: sinusoidal_pos_embds is not supported (learned only)")
+        act_map = {"gelu": "gelu_exact", "relu": "relu"}
+        act = get("activation", "gelu")
+        if act not in act_map:
+            raise ValueError(f"distilbert: activation={act!r} is not supported")
+        return TransformerConfig(
+            vocab_size=get("vocab_size"),
+            hidden_size=get("dim"),
+            n_layers=get("n_layers"),
+            n_heads=get("n_heads"),
+            ffn_hidden_size=get("hidden_dim"),
+            max_seq_len=get("max_position_embeddings", 512),
+            norm="layernorm",
+            activation=act_map[act],
+            position="learned",
+            norm_eps=1e-12,  # hardcoded in HF modeling_distilbert
+            tie_embeddings=True,  # vocab_projector ties to embeddings
+            attn_qkv_bias=True,
+            attn_out_bias=True,
+            mlp_bias=True,
+            attn_causal=False,
+            norm_scheme="post",
+            embed_norm=True,
+            final_norm=False,
+            mlm_head=True,
         )
     if mt == "falcon":
         if get("alibi", False):
@@ -462,8 +571,9 @@ def config_from_hf(hf_cfg) -> TransformerConfig:
         )
     raise ValueError(
         f"unsupported model_type {mt!r}; supported: llama, mistral, qwen2, "
-        "qwen2_moe, mixtral, falcon, phi, phi3, gpt2, opt, gemma, bloom, "
-        "gptj, gpt_neox, stablelm, starcoder2"
+        "qwen2_moe, mixtral, falcon, phi, phi3, gpt2, gpt_neo, opt, gemma, "
+        "bloom, gptj, gpt_neox, internlm, stablelm, starcoder2, bert, "
+        "distilbert"
     )
 
 
@@ -495,6 +605,8 @@ def _llama_layer(take: _Taker, cfg: TransformerConfig, p: str, layers: Dict[str,
         layers["wq_b"].append(take(f"{p}.self_attn.q_proj.bias"))
         layers["wk_b"].append(take(f"{p}.self_attn.k_proj.bias"))
         layers["wv_b"].append(take(f"{p}.self_attn.v_proj.bias"))
+    if cfg.attn_out_bias:
+        layers["wo_b"].append(take(f"{p}.self_attn.o_proj.bias"))
     if cfg.n_experts > 0:
         # qwen2-moe: router gate [E, h] + per-expert FFNs + shared expert
         layers["router"].append(take.linear(f"{p}.mlp.gate.weight"))
@@ -753,6 +865,57 @@ def _gptj_layer(take: _Taker, cfg: TransformerConfig, p: str, layers: Dict[str, 
     layers["w_down_b"].append(take(f"{p}.mlp.fc_out.bias"))
 
 
+def _bert_layer(take: _Taker, cfg: TransformerConfig, p: str, layers: Dict[str, list]):
+    # post-LN encoder: attention.output.LayerNorm normalizes x + attn(x)
+    # (→ attn_norm), output.LayerNorm normalizes + mlp (→ mlp_norm)
+    for name, hf in (("wq", "query"), ("wk", "key"), ("wv", "value")):
+        layers[name].append(take.linear(f"{p}.attention.self.{hf}.weight"))
+        layers[f"{name}_b"].append(take(f"{p}.attention.self.{hf}.bias"))
+    layers["wo"].append(take.linear(f"{p}.attention.output.dense.weight"))
+    layers["wo_b"].append(take(f"{p}.attention.output.dense.bias"))
+    layers["attn_norm"].append(take(f"{p}.attention.output.LayerNorm.weight"))
+    layers["attn_norm_b"].append(take(f"{p}.attention.output.LayerNorm.bias"))
+    layers["w_up"].append(take.linear(f"{p}.intermediate.dense.weight"))
+    layers["w_up_b"].append(take(f"{p}.intermediate.dense.bias"))
+    layers["w_down"].append(take.linear(f"{p}.output.dense.weight"))
+    layers["w_down_b"].append(take(f"{p}.output.dense.bias"))
+    layers["mlp_norm"].append(take(f"{p}.output.LayerNorm.weight"))
+    layers["mlp_norm_b"].append(take(f"{p}.output.LayerNorm.bias"))
+
+
+def _distilbert_layer(take: _Taker, cfg: TransformerConfig, p: str, layers: Dict[str, list]):
+    for name, hf in (("wq", "q_lin"), ("wk", "k_lin"), ("wv", "v_lin")):
+        layers[name].append(take.linear(f"{p}.attention.{hf}.weight"))
+        layers[f"{name}_b"].append(take(f"{p}.attention.{hf}.bias"))
+    layers["wo"].append(take.linear(f"{p}.attention.out_lin.weight"))
+    layers["wo_b"].append(take(f"{p}.attention.out_lin.bias"))
+    layers["attn_norm"].append(take(f"{p}.sa_layer_norm.weight"))
+    layers["attn_norm_b"].append(take(f"{p}.sa_layer_norm.bias"))
+    layers["w_up"].append(take.linear(f"{p}.ffn.lin1.weight"))
+    layers["w_up_b"].append(take(f"{p}.ffn.lin1.bias"))
+    layers["w_down"].append(take.linear(f"{p}.ffn.lin2.weight"))
+    layers["w_down_b"].append(take(f"{p}.ffn.lin2.bias"))
+    layers["mlp_norm"].append(take(f"{p}.output_layer_norm.weight"))
+    layers["mlp_norm_b"].append(take(f"{p}.output_layer_norm.bias"))
+
+
+def _gptneo_layer(take: _Taker, cfg: TransformerConfig, p: str, layers: Dict[str, list]):
+    # GPT-Neo uses plain Linears ([out, in] — transpose), unlike gpt2's
+    # Conv1D; q/k/v carry NO bias, out_proj and the MLP do
+    layers["attn_norm"].append(take(f"{p}.ln_1.weight"))
+    layers["attn_norm_b"].append(take(f"{p}.ln_1.bias"))
+    for name, hf in (("wq", "q_proj"), ("wk", "k_proj"), ("wv", "v_proj")):
+        layers[name].append(take.linear(f"{p}.attn.attention.{hf}.weight"))
+    layers["wo"].append(take.linear(f"{p}.attn.attention.out_proj.weight"))
+    layers["wo_b"].append(take(f"{p}.attn.attention.out_proj.bias"))
+    layers["mlp_norm"].append(take(f"{p}.ln_2.weight"))
+    layers["mlp_norm_b"].append(take(f"{p}.ln_2.bias"))
+    layers["w_up"].append(take.linear(f"{p}.mlp.c_fc.weight"))
+    layers["w_up_b"].append(take(f"{p}.mlp.c_fc.bias"))
+    layers["w_down"].append(take.linear(f"{p}.mlp.c_proj.weight"))
+    layers["w_down_b"].append(take(f"{p}.mlp.c_proj.bias"))
+
+
 def _gptneox_layer(take: _Taker, cfg: TransformerConfig, p: str, layers: Dict[str, list]):
     layers["attn_norm"].append(take(f"{p}.input_layernorm.weight"))
     layers["attn_norm_b"].append(take(f"{p}.input_layernorm.bias"))
@@ -784,7 +947,11 @@ _LAYER_EXTRACTORS: Dict[str, Callable] = {
     "falcon": _falcon_layer,
     "phi": _phi_layer,
     "phi3": _phi3_layer,
+    "bert": _bert_layer,
+    "distilbert": _distilbert_layer,
     "gpt2": _gpt2_layer,
+    "gpt_neo": _gptneo_layer,
+    "internlm": _llama_layer,
     "opt": _opt_layer,
     "gemma": _llama_layer,  # same checkpoint layout as llama
     "bloom": _bloom_layer,
@@ -805,6 +972,8 @@ _TOPLEVEL_KEYS: Dict[str, Tuple[str, str, str, Optional[str]]] = {
     "phi": ("model.embed_tokens.weight", "model.final_layernorm", "model.layers", None),
     "falcon": ("transformer.word_embeddings.weight", "transformer.ln_f", "transformer.h", None),
     "gpt2": ("transformer.wte.weight", "transformer.ln_f", "transformer.h", "transformer.wpe.weight"),
+    "gpt_neo": ("transformer.wte.weight", "transformer.ln_f", "transformer.h", "transformer.wpe.weight"),
+    "internlm": ("model.embed_tokens.weight", "model.norm", "model.layers", None),
     "opt": (
         "model.decoder.embed_tokens.weight",
         "model.decoder.final_layer_norm",
@@ -841,6 +1010,57 @@ def _expected_layer_keys(cfg: TransformerConfig) -> Dict[str, list]:
     return {k: [] for k in keys}
 
 
+def _load_encoder(mt: str, cfg: TransformerConfig, take: _Taker, state: Dict[str, Any]):
+    """bert / distilbert (reference module_inject/containers/{bert,
+    distil_bert}.py): post-LN encoder stack + masked-LM head. A bare
+    BertModel checkpoint (no cls.predictions / vocab_transform) loads with
+    mlm_head=False and returns the final hidden states from forward_hidden."""
+    # BertForMaskedLM prefixes the backbone with "bert." / "distilbert.";
+    # a bare BertModel/DistilBertModel checkpoint saves root-level keys
+    base = "" if "embeddings.word_embeddings.weight" in state else f"{mt}."
+    stem = f"{base}embeddings"
+    prefix = f"{base}encoder.layer" if mt == "bert" else f"{base}transformer.layer"
+    head_probe = "cls.predictions.transform.dense.weight" if mt == "bert" else "vocab_transform.weight"
+    if head_probe not in state:
+        cfg = dataclasses.replace(cfg, mlm_head=False)
+    layers = _expected_layer_keys(cfg)
+    extract = _LAYER_EXTRACTORS[mt]
+    for i in range(cfg.n_layers):
+        extract(take, cfg, f"{prefix}.{i}", layers)
+    params: Dict[str, Any] = {
+        "embed": take(f"{stem}.word_embeddings.weight"),
+        "pos_embed": take(f"{stem}.position_embeddings.weight"),
+        "embed_norm": take(f"{stem}.LayerNorm.weight"),
+        "embed_norm_b": take(f"{stem}.LayerNorm.bias"),
+        "layers": {k: np.stack(v) for k, v in layers.items()},
+    }
+    if cfg.type_vocab_size > 0:
+        params["type_embed"] = take(f"{stem}.token_type_embeddings.weight")
+    if cfg.mlm_head:
+        if mt == "bert":
+            params["mlm_dense"] = take.linear("cls.predictions.transform.dense.weight")
+            params["mlm_dense_b"] = take("cls.predictions.transform.dense.bias")
+            params["mlm_norm"] = take("cls.predictions.transform.LayerNorm.weight")
+            params["mlm_norm_b"] = take("cls.predictions.transform.LayerNorm.bias")
+            params["mlm_bias"] = take("cls.predictions.bias")
+            state.pop("cls.predictions.decoder.weight", None)  # tied alias
+            state.pop("cls.predictions.decoder.bias", None)  # alias of cls.predictions.bias
+        else:
+            params["mlm_dense"] = take.linear("vocab_transform.weight")
+            params["mlm_dense_b"] = take("vocab_transform.bias")
+            params["mlm_norm"] = take("vocab_layer_norm.weight")
+            params["mlm_norm_b"] = take("vocab_layer_norm.bias")
+            params["mlm_bias"] = take("vocab_projector.bias")
+            state.pop("vocab_projector.weight", None)  # tied alias
+    leftover = [
+        k for k in state
+        if not k.endswith("position_ids")  # non-persistent HF buffer
+    ]
+    if leftover:
+        logger.warning(f"unmapped HF weights ignored: {leftover[:8]}{'...' if len(leftover) > 8 else ''}")
+    return cfg, params
+
+
 def load_hf_model(
     model_name_or_path: str,
     dtype: str = "bfloat16",
@@ -862,6 +1082,9 @@ def load_hf_model(
     cfg = dataclass_replace(config_from_hf(hf_cfg), dtype=dtype)
     state = _load_state_dict(model_name_or_path)
     take = _Taker(state, dtype)
+
+    if mt in ("bert", "distilbert"):
+        return _load_encoder(mt, cfg, take, state)
 
     embed_key, norm_key, layer_prefix, pos_key = _TOPLEVEL_KEYS[mt]
     extract = _LAYER_EXTRACTORS[mt]

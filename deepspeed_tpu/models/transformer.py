@@ -90,6 +90,33 @@ class TransformerConfig:
     parallel_block: bool = False
     # phi partial rotary: rope applies to the first rope_frac*head_dim dims
     rope_frac: float = 1.0
+    # softmax scale override: None → 1/sqrt(head_dim); gpt_neo uses 1.0
+    # (HF GPTNeoSelfAttention never divides the logits)
+    attn_scale: Optional[float] = None
+    # --- encoder family (bert/distilbert — reference module_inject/
+    # containers/{bert,distil_bert}.py serve these through kernel injection;
+    # the training transformer kernel, csrc/transformer/, is BERT-shaped) ---
+    # False → bidirectional self-attention (encoder)
+    attn_causal: bool = True
+    # "pre" (GPT/llama: norm before each block) | "post" (BERT: norm AFTER
+    # each residual add — x = LN(x + attn(x)); x = LN(x + mlp(x)))
+    norm_scheme: str = "pre"
+    # > 0: token-type (segment) embeddings added into the stem (BERT);
+    # forward takes token_type_ids (defaults to all-zeros)
+    type_vocab_size: int = 0
+    # BERT has no final norm (the post-LN layers end normalized already)
+    final_norm: bool = True
+    # masked-LM head: dense[h,h] + activation + LN before the tied decoder
+    # (+ per-vocab bias) — BertForMaskedLM's cls.predictions transform
+    mlm_head: bool = False
+    # sliding-window attention (mistral/starcoder2 sliding_window, gpt_neo
+    # local attention): query i sees keys in (i - window, i]. 0 = full
+    # causal. Applies to every layer unless attn_layer_pattern says which.
+    sliding_window: int = 0
+    # per-layer window flags for alternating local/global stacks (gpt_neo
+    # attention_types): tuple of n_layers ints, 1 = windowed, 0 = global.
+    # None with sliding_window > 0 → all layers windowed.
+    attn_layer_pattern: Optional[Tuple[int, ...]] = None
     # gemma scales embeddings by sqrt(hidden_size) after lookup
     embed_scale: bool = False
     # bloom applies a LayerNorm to the embedding output
@@ -147,11 +174,24 @@ class TransformerConfig:
     weight_stream: bool = False
 
     def __post_init__(self):
+        if self.norm_scheme not in ("pre", "post"):
+            raise ValueError(f"norm_scheme={self.norm_scheme!r}: expected 'pre' or 'post'")
         if self.seq_impl not in ("ulysses", "ring"):
             raise ValueError(
                 f"seq_impl={self.seq_impl!r}: expected 'ulysses' or 'ring' "
                 "(a typo would silently fall back to the wrong parallelism)"
             )
+        if self.attn_layer_pattern is not None:
+            if self.sliding_window <= 0:
+                raise ValueError(
+                    "attn_layer_pattern set without sliding_window — the "
+                    "pattern flags which layers use the window"
+                )
+            if len(self.attn_layer_pattern) != self.n_layers:
+                raise ValueError(
+                    f"attn_layer_pattern has {len(self.attn_layer_pattern)} "
+                    f"entries for {self.n_layers} layers"
+                )
         if self.matmul_precision not in ("default", "fp8", "int8", "int8_tensor"):
             raise ValueError(
                 f"matmul_precision={self.matmul_precision!r}: expected "
@@ -285,18 +325,29 @@ def init_params(config: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
 
     params: Dict[str, Any] = {
         "embed": (jax.random.normal(next(keys), (c.vocab_size, h), jnp.float32) * 0.02).astype(dtype),
-        "final_norm": norm_one((h,), dtype),
         "layers": layers,
     }
-    if c.norm == "layernorm":
-        params["final_norm_b"] = jnp.zeros((h,), dtype)
+    if c.final_norm:
+        params["final_norm"] = norm_one((h,), dtype)
+        if c.norm == "layernorm":
+            params["final_norm_b"] = jnp.zeros((h,), dtype)
     if c.position == "learned":
         params["pos_embed"] = (
             jax.random.normal(next(keys), (c.max_seq_len, h), jnp.float32) * 0.02
         ).astype(dtype)
+    if c.type_vocab_size > 0:
+        params["type_embed"] = (
+            jax.random.normal(next(keys), (c.type_vocab_size, h), jnp.float32) * 0.02
+        ).astype(dtype)
     if c.embed_norm:
         params["embed_norm"] = jnp.ones((h,), dtype)
         params["embed_norm_b"] = jnp.zeros((h,), dtype)
+    if c.mlm_head:
+        params["mlm_dense"] = dense(next(keys), (h, h), h)
+        params["mlm_dense_b"] = jnp.zeros((h,), dtype)
+        params["mlm_norm"] = jnp.ones((h,), dtype)
+        params["mlm_norm_b"] = jnp.zeros((h,), dtype)
+        params["mlm_bias"] = jnp.zeros((c.vocab_size,), dtype)
     if not c.tie_embeddings:
         params["lm_head"] = dense(next(keys), (h, c.vocab_size), h)
         if c.lm_head_bias:
@@ -364,16 +415,25 @@ def param_partition_specs(config: TransformerConfig) -> Dict[str, Any]:
     vocab_spec = P(m, None) if c.vocab_parallel else P(None, None)
     specs: Dict[str, Any] = {
         "embed": vocab_spec,
-        "final_norm": P(None),
         "layers": layers,
     }
-    if c.norm == "layernorm":
-        specs["final_norm_b"] = P(None)
+    if c.final_norm:
+        specs["final_norm"] = P(None)
+        if c.norm == "layernorm":
+            specs["final_norm_b"] = P(None)
     if c.position == "learned":
         specs["pos_embed"] = P(None, None)
+    if c.type_vocab_size > 0:
+        specs["type_embed"] = P(None, None)
     if c.embed_norm:
         specs["embed_norm"] = P(None)
         specs["embed_norm_b"] = P(None)
+    if c.mlm_head:
+        specs["mlm_dense"] = P(None, None)
+        specs["mlm_dense_b"] = P(None)
+        specs["mlm_norm"] = P(None)
+        specs["mlm_norm_b"] = P(None)
+        specs["mlm_bias"] = P(m) if c.vocab_parallel else P(None)
     if not c.tie_embeddings:
         specs["lm_head"] = P(None, m) if c.vocab_parallel else P(None, None)
         if c.lm_head_bias:
@@ -676,7 +736,40 @@ def _proj(c: TransformerConfig, x, w):
     return qmatmul(x, w, c.matmul_precision)
 
 
-def _attention_block(c: TransformerConfig, lp, x, positions, segment_ids, kv_cache=None):
+_warned_window_fallback = False
+
+
+def _warn_window_fallback(c: TransformerConfig, s: int):
+    """The flash kernel has no banded mask, so windowed training attention
+    takes the dense-bias reference path — O(s²) fp32 scores in HBM. Warn
+    once, loudly, at trace time (starcoder2's 16k position range would
+    materialize ~1 GiB per head per batch element)."""
+    global _warned_window_fallback
+    if _warned_window_fallback:
+        return
+    _warned_window_fallback = True
+    from deepspeed_tpu.utils.logging import logger
+
+    logger.warning(
+        f"sliding-window attention (window={c.sliding_window}) runs on the "
+        f"dense reference path — [b, h, {s}, {s}] fp32 scores materialize in "
+        "HBM; expect much higher memory than flash at long sequence lengths"
+    )
+
+
+def _window_bias(c: TransformerConfig, q_glob, k_pos, local_flag):
+    """[sq, sk] fp32 additive bias masking keys ≥ sliding_window behind the
+    query. ``local_flag`` (traced 0/1 scalar from attn_layer_pattern, or
+    None) switches the window off for global layers inside the layer scan —
+    the scan stays uniform while layers alternate (gpt_neo)."""
+    far = (q_glob[:, None] - k_pos[None, :]) >= c.sliding_window
+    if local_flag is not None:
+        far = jnp.logical_and(far, local_flag > 0)
+    return jnp.where(far, jnp.float32(-1e30), jnp.float32(0.0))
+
+
+def _attention_block(c: TransformerConfig, lp, x, positions, segment_ids, kv_cache=None,
+                     local_flag=None):
     """Self-attention for one layer. x: [b, s, h]."""
     b, s, h = x.shape
     nh, nkv, d = c.n_heads, c.kv_heads, c.head_dim
@@ -709,10 +802,12 @@ def _attention_block(c: TransformerConfig, lp, x, positions, segment_ids, kv_cac
         q_glob = clen + jnp.arange(s)  # [s]
         kpos = jnp.arange(S)  # [S]
         mask_bias = jnp.where(kpos[None, :] <= q_glob[:, None], 0.0, -1e30).astype(jnp.float32)
+        if c.sliding_window > 0:
+            mask_bias = mask_bias + _window_bias(c, q_glob, kpos, local_flag)
         bias = mask_bias[None, None]
         if c.position == "alibi":
             bias = bias + _alibi_bias(c, kpos)
-        out = attention_op(q, k, v, causal=False, bias=bias)
+        out = attention_op(q, k, v, causal=False, bias=bias, scale=c.attn_scale)
     else:
         topo = get_topology()
         if topo.sequence_parallel_size > 1:
@@ -720,6 +815,12 @@ def _attention_block(c: TransformerConfig, lp, x, positions, segment_ids, kv_cac
                 raise NotImplementedError(
                     "alibi attention under sequence parallelism is not supported "
                     "(the ring/ulysses kernels take no bias)"
+                )
+            if c.sliding_window > 0 or c.attn_scale is not None or not c.attn_causal:
+                raise NotImplementedError(
+                    "sliding-window / scaled / bidirectional attention under "
+                    "sequence parallelism is not supported (the ring/ulysses "
+                    "kernels are causal and take no bias or scale override)"
                 )
             if c.seq_impl == "ring":
                 from deepspeed_tpu.parallel.sequence import ring_attention
@@ -737,8 +838,20 @@ def _attention_block(c: TransformerConfig, lp, x, positions, segment_ids, kv_cac
                 alibi_slopes=jnp.asarray(alibi_slopes(nh)),
                 alibi_positions=positions,
             )
+        elif c.sliding_window > 0:
+            # windowed layers take the dense-bias reference path (the flash
+            # kernel has no banded mask yet); window distance is the token
+            # index — packing composes via segment_ids
+            _warn_window_fallback(c, s)
+            pos = jnp.arange(s, dtype=jnp.int32)
+            bias = _window_bias(c, pos, pos, local_flag)[None, None]
+            out = attention_op(
+                q, k, v, causal=c.attn_causal, segment_ids=segment_ids,
+                bias=bias, scale=c.attn_scale,
+            )
         else:
-            out = attention_op(q, k, v, causal=True, segment_ids=segment_ids)
+            out = attention_op(q, k, v, causal=c.attn_causal,
+                               segment_ids=segment_ids, scale=c.attn_scale)
     out = out.transpose(0, 2, 1, 3).reshape(b, s, nh * d)
     out = _proj(c, out, lp["wo"])
     if c.attn_out_bias:
@@ -790,7 +903,7 @@ def _dequant_tree(lp, dtype):
     )
 
 
-def _layer(c: TransformerConfig, lp, x, positions, segment_ids):
+def _layer(c: TransformerConfig, lp, x, positions, segment_ids, local_flag=None):
     lp = _dequant_tree(lp, DTYPES[c.dtype])
     # Autocast: run the layer at the model's configured compute dtype even
     # when the engine hands in wider params (e.g. fp32 masters with no bf16
@@ -803,8 +916,16 @@ def _layer(c: TransformerConfig, lp, x, positions, segment_ids):
         else w,
         lp,
     )
+    if c.norm_scheme == "post":
+        # BERT: norm AFTER each residual add; attention reads the raw stream
+        attn_out, _ = _attention_block(c, lp, x, positions, segment_ids, local_flag=local_flag)
+        x = _norm(x + attn_out, lp["attn_norm"], lp.get("attn_norm_b"), c.norm, c.norm_eps)
+        x = _act_constraint(x)
+        mlp_out, aux_loss = _mlp_block(c, lp, x)
+        x = _norm(x + mlp_out, lp["mlp_norm"], lp.get("mlp_norm_b"), c.norm, c.norm_eps)
+        return _act_constraint(x), aux_loss
     a = _norm(x, lp["attn_norm"], lp.get("attn_norm_b"), c.norm, c.norm_eps)
-    attn_out, _ = _attention_block(c, lp, a, positions, segment_ids)
+    attn_out, _ = _attention_block(c, lp, a, positions, segment_ids, local_flag=local_flag)
     if c.parallel_block:
         # falcon/phi: both branches from the pre-attention state, one residual
         m = _norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"), c.norm, c.norm_eps)
@@ -826,6 +947,7 @@ def forward_hidden(
     config: TransformerConfig,
     positions: Optional[jax.Array] = None,
     segment_ids: Optional[jax.Array] = None,
+    token_type_ids: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Body forward: tokens [b, s] → (final-norm'd hidden [b, s, h], aux_loss).
 
@@ -843,6 +965,11 @@ def forward_hidden(
     if c.position == "learned":
         pe = _maybe_stage(params["pos_embed"]) if stream else params["pos_embed"]
         x = x + pe[positions][None] if positions.ndim == 1 else x + pe[positions]
+    if c.type_vocab_size > 0:
+        te = _maybe_stage(params["type_embed"]) if stream else params["type_embed"]
+        te = te.astype(x.dtype)
+        # default token type 0 (HF convention when token_type_ids is omitted)
+        x = x + (te[0] if token_type_ids is None else te[token_type_ids])
     if c.embed_norm:
         x = _embed_norm(params, c, x, stream)
     x = _act_constraint(x)
@@ -856,17 +983,29 @@ def forward_hidden(
     if c.remat:
         layer_fn = jax.checkpoint(layer_fn, policy=remat_policy(c.remat_policy))
 
-    def scan_body(carry, lp):
-        x = carry
-        x, aux = layer_fn(lp, x, positions, segment_ids)
-        return x, aux
+    if c.attn_layer_pattern is not None:
+        flags = jnp.asarray(c.attn_layer_pattern, jnp.int32)
 
-    x, aux_losses = jax.lax.scan(scan_body, x, params["layers"])
-    fn_w = _maybe_stage(params["final_norm"]) if stream else params["final_norm"]
-    fn_b = params.get("final_norm_b")
-    if stream and fn_b is not None:
-        fn_b = _maybe_stage(fn_b)
-    x = _norm(x, fn_w, fn_b, c.norm, c.norm_eps)
+        def scan_body(carry, xs):
+            lp, flag = xs
+            y, aux = layer_fn(lp, carry, positions, segment_ids, flag)
+            return y, aux
+
+        x, aux_losses = jax.lax.scan(scan_body, x, (params["layers"], flags))
+    else:
+
+        def scan_body(carry, lp):
+            x = carry
+            x, aux = layer_fn(lp, x, positions, segment_ids)
+            return x, aux
+
+        x, aux_losses = jax.lax.scan(scan_body, x, params["layers"])
+    if c.final_norm:
+        fn_w = _maybe_stage(params["final_norm"]) if stream else params["final_norm"]
+        fn_b = params.get("final_norm_b")
+        if stream and fn_b is not None:
+            fn_b = _maybe_stage(fn_b)
+        x = _norm(x, fn_w, fn_b, c.norm, c.norm_eps)
     return x, jnp.sum(aux_losses)
 
 
@@ -899,9 +1038,22 @@ def forward(
     config: TransformerConfig,
     positions: Optional[jax.Array] = None,
     segment_ids: Optional[jax.Array] = None,
+    token_type_ids: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Full forward: tokens [b, s] int32 → (logits [b, s, vocab], aux_loss)."""
-    x, aux = forward_hidden(params, tokens, config, positions, segment_ids)
+    x, aux = forward_hidden(params, tokens, config, positions, segment_ids, token_type_ids)
+    if config.mlm_head:
+        # BertForMaskedLM cls.predictions: transform (dense + act + LN), then
+        # the tied decoder with its standalone vocab bias
+        c = config
+        t = x @ params["mlm_dense"].astype(x.dtype) + params["mlm_dense_b"].astype(x.dtype)
+        # the transform uses the config's hidden activation (HF ACT2FN)
+        if c.activation == "relu":
+            t = jax.nn.relu(t)
+        else:
+            t = jax.nn.gelu(t, approximate=c.activation != "gelu_exact")
+        t = _norm(t, params["mlm_norm"], params["mlm_norm_b"], "layernorm", c.norm_eps)
+        return _apply_lm_head(params, t, c) + params["mlm_bias"].astype(x.dtype), aux
     return _apply_lm_head(params, x, config), aux
 
 
@@ -914,6 +1066,11 @@ def decode_step(params, tokens, config, kv_caches, positions):
     stacking anyway, which we do — caches are stacked [L, ...]).
     """
     c = config
+    if not c.attn_causal:
+        raise ValueError(
+            "decode_step: bidirectional encoder models (attn_causal=False) "
+            "do not autoregressively decode — call forward() instead"
+        )
     b, t = tokens.shape
     stream = _stream_active(c)
     embed = _maybe_stage(params["embed"]) if stream else params["embed"]
@@ -925,12 +1082,14 @@ def decode_step(params, tokens, config, kv_caches, positions):
         x = _embed_norm(params, c, x, stream)
 
     def scan_body(x, inputs):
-        lp, cache = inputs
+        lp, cache, local_flag = inputs
         if stream:
             lp = _stage_tree(lp)
         lp = _dequant_tree(lp, DTYPES[c.dtype])
         a = _norm(x, lp["attn_norm"], lp.get("attn_norm_b"), c.norm, c.norm_eps)
-        attn_out, new_cache = _attention_block(c, lp, a, positions, None, kv_cache=cache)
+        attn_out, new_cache = _attention_block(
+            c, lp, a, positions, None, kv_cache=cache, local_flag=local_flag
+        )
         if c.parallel_block:
             m = _norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"), c.norm, c.norm_eps)
             mlp_out, _ = _mlp_block(c, lp, m)
@@ -940,7 +1099,11 @@ def decode_step(params, tokens, config, kv_caches, positions):
         mlp_out, _ = _mlp_block(c, lp, m)
         return x + mlp_out, new_cache
 
-    x, new_caches = jax.lax.scan(scan_body, x, (params["layers"], kv_caches))
+    flags = jnp.asarray(
+        c.attn_layer_pattern if c.attn_layer_pattern is not None else [1] * c.n_layers,
+        jnp.int32,
+    )
+    x, new_caches = jax.lax.scan(scan_body, x, (params["layers"], kv_caches, flags))
     fn_w = _maybe_stage(params["final_norm"]) if stream else params["final_norm"]
     fn_b = params.get("final_norm_b")
     if stream and fn_b is not None:
@@ -1038,6 +1201,7 @@ def make_loss_fn(config: TransformerConfig):
         if (
             config.fused_ce
             and not config.lm_head_bias
+            and not config.mlm_head  # the fused kernel has no MLM transform
             and jax.default_backend() == "tpu"
             and get_topology().world_size == 1
         ):
@@ -1065,7 +1229,7 @@ def make_loss_fn(config: TransformerConfig):
                 flat_m = jnp.concatenate([flat_m, jnp.zeros((pad,), flat_m.dtype)])
             per_row = fused_ce_loss(flat_x, w, flat_l)
             loss = jnp.sum(per_row * flat_m) / jnp.maximum(jnp.sum(flat_m), 1.0)
-        elif config.loss_tiles > 1 and not config.lm_head_bias:
+        elif config.loss_tiles > 1 and not config.lm_head_bias and not config.mlm_head:
             from deepspeed_tpu.parallel.sequence.tiled import tiled_logits_loss
 
             x, aux = forward_hidden(params, inputs, config, positions=positions, segment_ids=segment_ids)

@@ -44,20 +44,23 @@ def domino_layer(layer_fn: Callable, x: jax.Array, n_chunks: int = 2, batch_axis
     return jnp.concatenate(outs, axis=batch_axis)
 
 
-def domino_transformer_layer(config, lp, x, positions, segment_ids, n_chunks: int = 2):
+def domino_transformer_layer(config, lp, x, positions, segment_ids, n_chunks: int = 2,
+                             local_flag=None):
     """The model-family layer under Domino chunking (reference
-    DominoTransformerLayer): aux losses average over chunks."""
+    DominoTransformerLayer): aux losses average over chunks. ``local_flag``
+    must be threaded through — dropping it would apply the sliding window
+    to gpt_neo's GLOBAL layers."""
     from deepspeed_tpu.models import transformer as T
 
     b = x.shape[0]
     if n_chunks <= 1 or b % n_chunks:
-        return T._layer(config, lp, x, positions, segment_ids)
+        return T._layer(config, lp, x, positions, segment_ids, local_flag)
     outs, auxes = [], []
     for i, xc in enumerate(jnp.split(x, n_chunks, axis=0)):
         seg_c = None
         if segment_ids is not None:
             seg_c = jnp.split(segment_ids, n_chunks, axis=0)[i]
-        y, aux = T._layer(config, lp, xc, positions, seg_c)
+        y, aux = T._layer(config, lp, xc, positions, seg_c, local_flag)
         outs.append(y)
         auxes.append(aux)
     return jnp.concatenate(outs, axis=0), sum(auxes) / n_chunks

@@ -277,6 +277,91 @@ def tiny_starcoder2(tmp_path_factory):
 
 
 @pytest.fixture(scope="module")
+def tiny_gpt_neo(tmp_path_factory):
+    # alternating global/local attention (window 8 < the 16-token test seq,
+    # so the banded mask actually bites), unscaled logits (attn_scale=1.0),
+    # plain Linears (no Conv1D), tied embeddings
+    return _save_tiny(
+        tmp_path_factory, "hf_gpt_neo",
+        transformers.GPTNeoConfig, transformers.GPTNeoForCausalLM,
+        vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+        attention_types=[[["global", "local"], 1]], window_size=8,
+        max_position_embeddings=128,
+    )
+
+
+@pytest.fixture(scope="module")
+def tiny_internlm(tmp_path_factory):
+    # InternLM = llama + biased q/k/v/o. transformers ships no InternLM class
+    # (trust_remote_code upstream), but LlamaForCausalLM with
+    # attention_bias=True is the same math and the same state-dict naming —
+    # save that and stamp model_type=internlm the way the real checkpoints do.
+    model, path = _save_tiny(
+        tmp_path_factory, "hf_internlm",
+        transformers.LlamaConfig, transformers.LlamaForCausalLM,
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        attention_bias=True, max_position_embeddings=128,
+        tie_word_embeddings=False,
+    )
+    cfg_path = path + "/config.json"
+    cfg = json.load(open(cfg_path))
+    cfg["model_type"] = "internlm"
+    cfg["bias"] = True
+    json.dump(cfg, open(cfg_path, "w"))
+    return model, path
+
+
+@pytest.fixture(scope="module")
+def tiny_llama_bias(tmp_path_factory):
+    # llama's own attention_bias flag (no model_type patch)
+    return _save_tiny(
+        tmp_path_factory, "hf_llama_bias",
+        transformers.LlamaConfig, transformers.LlamaForCausalLM,
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        attention_bias=True, max_position_embeddings=128,
+        tie_word_embeddings=False,
+    )
+
+
+@pytest.fixture(scope="module")
+def tiny_mistral_window(tmp_path_factory):
+    # sliding_window=8 < the 16-token test seq: queries past position 8 must
+    # NOT see the earliest keys (round-3 VERDICT: starcoder2 clamped instead)
+    return _save_tiny(
+        tmp_path_factory, "hf_mistral_window",
+        transformers.MistralConfig, transformers.MistralForCausalLM,
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        sliding_window=8, max_position_embeddings=128,
+        tie_word_embeddings=False,
+    )
+
+
+@pytest.fixture(scope="module")
+def tiny_bert(tmp_path_factory):
+    # post-LN bidirectional encoder + token types + masked-LM head
+    return _save_tiny(
+        tmp_path_factory, "hf_bert",
+        transformers.BertConfig, transformers.BertForMaskedLM,
+        vocab_size=256, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=128, type_vocab_size=2,
+    )
+
+
+@pytest.fixture(scope="module")
+def tiny_distilbert(tmp_path_factory):
+    return _save_tiny(
+        tmp_path_factory, "hf_distilbert",
+        transformers.DistilBertConfig, transformers.DistilBertForMaskedLM,
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, hidden_dim=128,
+        max_position_embeddings=128,
+    )
+
+
+@pytest.fixture(scope="module")
 def tiny_llama3_rope(tmp_path_factory):
     # llama-3.1-style frequency-banded rope scaling
     return _save_tiny(
@@ -362,7 +447,19 @@ _FIXTURES = {
     "stablelm": "tiny_stablelm",
     "stablelm_par": "tiny_stablelm_parallel",
     "starcoder2": "tiny_starcoder2",
+    "gpt_neo": "tiny_gpt_neo",
+    "internlm": "tiny_internlm",
+    "llama_bias": "tiny_llama_bias",
+    "mistral_window": "tiny_mistral_window",
+    "bert": "tiny_bert",
+    "distilbert": "tiny_distilbert",
 }
+
+# gpt_neo's attn_scale=1.0 skips the 1/sqrt(d) shrink and bert's post-LN
+# renormalizes every residual add, so XLA:CPU's reduced-precision fp32
+# matmuls leave ~1.5x more absolute noise in the logits (exact-precision
+# parity is ~3e-6 / 2e-7 — verified while landing the arches)
+_ATOL_OVERRIDES = {"gpt_neo": 6e-3, "bert": 6e-3, "distilbert": 6e-3}
 
 
 def _logits_parity(hf_model, path, atol=2e-3):
@@ -425,10 +522,117 @@ def test_longrope_decode_crosses_boundary(request):
     np.testing.assert_array_equal(out[: len(ref)], ref)
 
 
+def test_bert_relu_mlm_parity(tmp_path_factory):
+    """The cls.predictions transform uses the config's hidden activation —
+    a relu checkpoint must not silently run gelu (code-review finding)."""
+    hf_model, path = _save_tiny(
+        tmp_path_factory, "hf_bert_relu",
+        transformers.BertConfig, transformers.BertForMaskedLM,
+        vocab_size=256, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128, hidden_act="relu",
+        max_position_embeddings=128, type_vocab_size=2,
+    )
+    _logits_parity(hf_model, path, atol=6e-3)
+
+
+def test_bare_bert_model_loads(tmp_path_factory):
+    """A bare BertModel checkpoint (root-level keys, no MLM head) loads with
+    mlm_head=False; forward_hidden returns its final hidden states."""
+    torch.manual_seed(0)
+    m = transformers.BertModel(
+        transformers.BertConfig(
+            vocab_size=256, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=128,
+            max_position_embeddings=128, type_vocab_size=2,
+        ),
+        add_pooling_layer=False,
+    ).eval()
+    path = str(tmp_path_factory.mktemp("hf_bert_bare"))
+    m.save_pretrained(path)
+    cfg, params = load_hf_model(path, dtype="float32")
+    assert not cfg.mlm_head
+    toks = np.random.default_rng(13).integers(0, 256, size=(2, 16)).astype(np.int32)
+    with torch.no_grad():
+        ref = m(torch.tensor(toks, dtype=torch.long)).last_hidden_state.numpy()
+    from deepspeed_tpu.models.transformer import forward_hidden
+
+    ours, _ = forward_hidden(params, jnp.asarray(toks), cfg)
+    np.testing.assert_allclose(np.asarray(ours, np.float32), ref, atol=6e-3, rtol=2e-3)
+
+
+def test_bert_token_type_parity(request):
+    """token_type_ids flow into the stem sum before embeddings.LayerNorm —
+    parity with HF on a mixed segment-A/segment-B batch."""
+    hf_model, path = request.getfixturevalue("tiny_bert")
+    cfg, params = load_hf_model(path, dtype="float32")
+    rng = np.random.default_rng(11)
+    toks = rng.integers(0, 256, size=(2, 16)).astype(np.int32)
+    tt = np.zeros((2, 16), np.int32)
+    tt[:, 8:] = 1
+    with torch.no_grad():
+        ref = hf_model(
+            torch.tensor(toks, dtype=torch.long),
+            token_type_ids=torch.tensor(tt, dtype=torch.long),
+        ).logits.numpy()
+    ours, _ = forward(params, jnp.asarray(toks), cfg, token_type_ids=jnp.asarray(tt))
+    np.testing.assert_allclose(np.asarray(ours, np.float32), ref, atol=6e-3, rtol=2e-3)
+
+
+def test_bert_mlm_train_step(request, devices8):
+    """Masked-LM training through deepspeed_tpu.initialize on the 8-device
+    mesh: explicit labels + loss_mask (split_lm_batch skips the causal shift
+    when labels are given), loss decreases and stays finite."""
+    _, path = request.getfixturevalue("tiny_bert")
+    cfg, params = load_hf_model(path, dtype="float32")
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=make_loss_fn(cfg),
+        model_parameters=params,
+        config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 2},
+            "mesh": {"data": 8},
+            "steps_per_print": 1000,
+        },
+    )
+    rng = np.random.default_rng(12)
+    toks = rng.integers(0, 256, size=(8, 16)).astype(np.int32)
+    labels = toks.copy()
+    masked = toks.copy()
+    mask = np.zeros((8, 16), np.float32)
+    mask[:, [3, 7, 12]] = 1.0
+    masked[:, [3, 7, 12]] = 103  # [MASK]-style corruption
+    batch = {
+        "input_ids": jnp.asarray(masked),
+        "labels": jnp.asarray(labels),
+        "loss_mask": jnp.asarray(mask),
+    }
+    losses = [float(engine.train_batch(batch)) for _ in range(5)]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_gpt_neo_windowed_decode(request):
+    """Greedy decode with the KV cache where generation runs past the local
+    window (8): the cached-path banded mask (q_glob vs cache positions) must
+    match HF, including on the global layers of the alternating pattern."""
+    hf_model, path = request.getfixturevalue("tiny_gpt_neo")
+    from deepspeed_tpu.inference.v2.engine_factory import build_engine_v1
+
+    engine = build_engine_v1(path, {"dtype": "float32", "max_out_tokens": 64})
+    prompt = np.random.default_rng(7).integers(0, 256, size=(1, 6)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf_model.generate(
+            torch.tensor(prompt, dtype=torch.long), max_new_tokens=10, do_sample=False
+        ).numpy()[0]
+    out = np.asarray(engine.generate(prompt, max_new_tokens=10))[0]
+    np.testing.assert_array_equal(out[: len(ref)], ref)
+
+
 @pytest.mark.parametrize("arch", sorted(_FIXTURES))
 def test_logits_parity(arch, request):
     hf_model, path = request.getfixturevalue(_FIXTURES[arch])
-    cfg, _ = _logits_parity(hf_model, path)
+    cfg, _ = _logits_parity(hf_model, path, atol=_ATOL_OVERRIDES.get(arch, 2e-3))
     if arch == "qwen2":
         assert cfg.attn_qkv_bias and not cfg.parallel_block
     elif arch == "qwen2_moe":
@@ -474,6 +678,19 @@ def test_logits_parity(arch, request):
     elif arch == "starcoder2":
         assert cfg.attn_out_bias and cfg.mlp_bias and cfg.tie_embeddings
         assert cfg.activation == "gelu"
+    elif arch == "gpt_neo":
+        # unscaled attention + alternating banded mask, window < test seq
+        assert cfg.attn_scale == 1.0 and cfg.sliding_window == 8
+        assert cfg.attn_layer_pattern == (0, 1)
+        assert not cfg.attn_qkv_bias and cfg.attn_out_bias
+    elif arch in ("internlm", "llama_bias"):
+        assert cfg.attn_qkv_bias and cfg.attn_out_bias and cfg.norm == "rmsnorm"
+    elif arch == "mistral_window":
+        assert cfg.sliding_window == 8 and cfg.attn_layer_pattern is None
+    elif arch in ("bert", "distilbert"):
+        assert not cfg.attn_causal and cfg.norm_scheme == "post"
+        assert cfg.mlm_head and not cfg.final_norm and cfg.embed_norm
+        assert cfg.type_vocab_size == (2 if arch == "bert" else 0)
 
 
 @pytest.mark.parametrize(
