@@ -9,8 +9,10 @@ serves through ``deepspeed_tpu.initialize`` / ``init_inference`` unchanged.
 
 Supported ``model_type``s: llama, mistral, qwen2, qwen2_moe, mixtral,
 falcon, phi, phi3, gpt2, gpt_neo, opt, gemma, bloom, gptj, gpt_neox,
-internlm, stablelm, starcoder2, plus the bert/distilbert encoder family
-(post-LN bidirectional stack + masked-LM head) (scaled-RoPE checkpoints —
+internlm, stablelm, starcoder2, megatron_gpt (Megatron-LM GPT state-dict
+naming, per-head-interleaved fused qkv), plus the bert/distilbert encoder
+family (post-LN bidirectional stack + masked-LM head) (scaled-RoPE
+checkpoints —
 llama3/yarn/longrope/linear/dynamic — import via ``rope_scaling``;
 sliding-window checkpoints — mistral/starcoder2/gpt_neo local — import via
 ``sliding_window``/``attn_layer_pattern``). Dispatch is by ``config.json``'s
@@ -364,6 +366,31 @@ def config_from_hf(hf_cfg) -> TransformerConfig:
             final_norm=False,
             mlm_head=True,
         )
+    if mt == "megatron_gpt":
+        # Megatron-LM GPT checkpoints (reference module_inject/containers/
+        # megatron_gpt.py): gpt2-architecture model, megatron state-dict
+        # naming with per-head-interleaved fused query_key_value
+        h = get("hidden_size") or get("n_embd")
+        act = get("activation_function", "gelu_new")
+        act_map = {"gelu_new": "gelu", "gelu_pytorch_tanh": "gelu", "gelu": "gelu_exact"}
+        if act not in act_map:
+            raise ValueError(f"megatron_gpt: activation_function={act!r} is not supported")
+        return TransformerConfig(
+            vocab_size=get("vocab_size"),
+            hidden_size=h,
+            n_layers=get("num_layers") or get("num_hidden_layers") or get("n_layer"),
+            n_heads=get("num_attention_heads") or get("n_head"),
+            ffn_hidden_size=get("ffn_hidden_size", None) or 4 * h,
+            max_seq_len=get("max_position_embeddings") or get("n_positions") or 1024,
+            norm="layernorm",
+            activation=act_map[act],
+            position="learned",
+            norm_eps=float(get("layernorm_epsilon", 1e-5)),
+            tie_embeddings=True,  # megatron GPT always ties the output head
+            attn_qkv_bias=True,
+            attn_out_bias=True,
+            mlp_bias=True,
+        )
     if mt == "falcon":
         if get("alibi", False):
             raise ValueError("falcon: alibi position encoding is not supported (rope checkpoints only)")
@@ -572,8 +599,8 @@ def config_from_hf(hf_cfg) -> TransformerConfig:
     raise ValueError(
         f"unsupported model_type {mt!r}; supported: llama, mistral, qwen2, "
         "qwen2_moe, mixtral, falcon, phi, phi3, gpt2, gpt_neo, opt, gemma, "
-        "bloom, gptj, gpt_neox, internlm, stablelm, starcoder2, bert, "
-        "distilbert"
+        "bloom, gptj, gpt_neox, internlm, stablelm, starcoder2, "
+        "megatron_gpt, bert, distilbert"
     )
 
 
@@ -916,6 +943,29 @@ def _gptneo_layer(take: _Taker, cfg: TransformerConfig, p: str, layers: Dict[str
     layers["w_down_b"].append(take(f"{p}.mlp.c_proj.bias"))
 
 
+def _megatron_gpt_layer(take: _Taker, cfg: TransformerConfig, p: str, layers: Dict[str, list]):
+    # megatron fuses qkv per head ([q_h, k_h, v_h] blocks) — the falcon MHA
+    # de-interleave (group-of-3 per head) recovers row-major q/k/v
+    layers["attn_norm"].append(take(f"{p}.input_layernorm.weight"))
+    layers["attn_norm_b"].append(take(f"{p}.input_layernorm.bias"))
+    q, k, v = _split_falcon_qkv(take(f"{p}.attention.query_key_value.weight"), cfg)
+    layers["wq"].append(q.T)
+    layers["wk"].append(k.T)
+    layers["wv"].append(v.T)
+    qb, kb, vb = _split_falcon_qkv(take(f"{p}.attention.query_key_value.bias"), cfg)
+    layers["wq_b"].append(qb)
+    layers["wk_b"].append(kb)
+    layers["wv_b"].append(vb)
+    layers["wo"].append(take.linear(f"{p}.attention.dense.weight"))
+    layers["wo_b"].append(take(f"{p}.attention.dense.bias"))
+    layers["mlp_norm"].append(take(f"{p}.post_attention_layernorm.weight"))
+    layers["mlp_norm_b"].append(take(f"{p}.post_attention_layernorm.bias"))
+    layers["w_up"].append(take.linear(f"{p}.mlp.dense_h_to_4h.weight"))
+    layers["w_up_b"].append(take(f"{p}.mlp.dense_h_to_4h.bias"))
+    layers["w_down"].append(take.linear(f"{p}.mlp.dense_4h_to_h.weight"))
+    layers["w_down_b"].append(take(f"{p}.mlp.dense_4h_to_h.bias"))
+
+
 def _gptneox_layer(take: _Taker, cfg: TransformerConfig, p: str, layers: Dict[str, list]):
     layers["attn_norm"].append(take(f"{p}.input_layernorm.weight"))
     layers["attn_norm_b"].append(take(f"{p}.input_layernorm.bias"))
@@ -957,6 +1007,7 @@ _LAYER_EXTRACTORS: Dict[str, Callable] = {
     "bloom": _bloom_layer,
     "gptj": _gptj_layer,
     "gpt_neox": _gptneox_layer,
+    "megatron_gpt": _megatron_gpt_layer,
     "mixtral": _mixtral_layer,
     "stablelm": _stablelm_layer,
     "starcoder2": _starcoder2_layer,
@@ -984,6 +1035,12 @@ _TOPLEVEL_KEYS: Dict[str, Tuple[str, str, str, Optional[str]]] = {
     "bloom": ("transformer.word_embeddings.weight", "transformer.ln_f", "transformer.h", None),
     "gptj": ("transformer.wte.weight", "transformer.ln_f", "transformer.h", None),
     "gpt_neox": ("gpt_neox.embed_in.weight", "gpt_neox.final_layer_norm", "gpt_neox.layers", None),
+    "megatron_gpt": (
+        "word_embeddings.weight",
+        "transformer.final_layernorm",
+        "transformer.layers",
+        "position_embeddings.weight",
+    ),
     "mixtral": ("model.embed_tokens.weight", "model.norm", "model.layers", None),
     "stablelm": ("model.embed_tokens.weight", "model.norm", "model.layers", None),
     "starcoder2": ("model.embed_tokens.weight", "model.norm", "model.layers", None),

@@ -736,27 +736,6 @@ def _proj(c: TransformerConfig, x, w):
     return qmatmul(x, w, c.matmul_precision)
 
 
-_warned_window_fallback = False
-
-
-def _warn_window_fallback(c: TransformerConfig, s: int):
-    """The flash kernel has no banded mask, so windowed training attention
-    takes the dense-bias reference path — O(s²) fp32 scores in HBM. Warn
-    once, loudly, at trace time (starcoder2's 16k position range would
-    materialize ~1 GiB per head per batch element)."""
-    global _warned_window_fallback
-    if _warned_window_fallback:
-        return
-    _warned_window_fallback = True
-    from deepspeed_tpu.utils.logging import logger
-
-    logger.warning(
-        f"sliding-window attention (window={c.sliding_window}) runs on the "
-        f"dense reference path — [b, h, {s}, {s}] fp32 scores materialize in "
-        "HBM; expect much higher memory than flash at long sequence lengths"
-    )
-
-
 def _window_bias(c: TransformerConfig, q_glob, k_pos, local_flag):
     """[sq, sk] fp32 additive bias masking keys ≥ sliding_window behind the
     query. ``local_flag`` (traced 0/1 scalar from attn_layer_pattern, or
@@ -838,20 +817,16 @@ def _attention_block(c: TransformerConfig, lp, x, positions, segment_ids, kv_cac
                 alibi_slopes=jnp.asarray(alibi_slopes(nh)),
                 alibi_positions=positions,
             )
-        elif c.sliding_window > 0:
-            # windowed layers take the dense-bias reference path (the flash
-            # kernel has no banded mask yet); window distance is the token
-            # index — packing composes via segment_ids
-            _warn_window_fallback(c, s)
-            pos = jnp.arange(s, dtype=jnp.int32)
-            bias = _window_bias(c, pos, pos, local_flag)[None, None]
+        else:
+            # sliding windows ride the flash kernel (in-kernel band mask;
+            # static windows — no attn_layer_pattern — additionally prune
+            # out-of-band kv blocks, O(s·window) compute); window distance is
+            # the token index, packing composes via segment_ids
             out = attention_op(
                 q, k, v, causal=c.attn_causal, segment_ids=segment_ids,
-                bias=bias, scale=c.attn_scale,
+                scale=c.attn_scale, window=c.sliding_window,
+                window_flag=local_flag,
             )
-        else:
-            out = attention_op(q, k, v, causal=c.attn_causal,
-                               segment_ids=segment_ids, scale=c.attn_scale)
     out = out.transpose(0, 2, 1, 3).reshape(b, s, nh * d)
     out = _proj(c, out, lp["wo"])
     if c.attn_out_bias:

@@ -39,13 +39,22 @@ def mha_reference(
     scale: Optional[float] = None,
     alibi_slopes: Optional[jax.Array] = None,
     alibi_positions: Optional[jax.Array] = None,
+    window: int = 0,
+    window_flag: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Numerically-stable reference attention in jnp (fp32 softmax).
 
     q: [b, h, sq, d]; k, v: [b, h_kv, sk, d]. Returns [b, h, sq, d].
     ``alibi_slopes`` ([h]): adds ``slope_h * key_position`` to the logits
     (bloom's absolute-position ALiBi; positions default to arange(sk)).
+    ``window``: sliding-window band (query i sees keys in (i - window, i],
+    requires causal); ``window_flag`` (traced 0/1 scalar) toggles the band
+    per layer for alternating local/global stacks.
     """
+    if window and not causal:
+        # fail-fast to match flash_attention — silently computing full
+        # bidirectional attention would be platform-dependent wrongness
+        raise ValueError("mha_reference: window > 0 requires causal=True")
     b, h, sq, d = q.shape
     h_kv = k.shape[1]
     k = _repeat_kv(k, h // h_kv)
@@ -70,6 +79,11 @@ def mha_reference(
         q_pos = jnp.arange(sq)[:, None] + (sk - sq)
         k_pos = jnp.arange(sk)[None, :]
         mask = q_pos >= k_pos
+        if window:
+            far = (q_pos - k_pos) >= window
+            if window_flag is not None:
+                far = jnp.logical_and(far, window_flag > 0)
+            mask = jnp.logical_and(mask, jnp.logical_not(far))
         logits = jnp.where(mask[None, None], logits, jnp.float32(-1e30))
     if segment_ids is not None:
         # segment_ids: [b, s] per position; requires sq == sk (training path)
@@ -80,6 +94,7 @@ def mha_reference(
 
 
 _warned_alibi_fallback = False
+_warned_window_fallback = False
 
 
 @functools.lru_cache(maxsize=1)
@@ -94,7 +109,8 @@ def _flash_available() -> bool:
         return False
 
 
-def _flash_sharded(q, k, v, causal, segment_ids, scale, alibi_slopes=None, alibi_positions=None):
+def _flash_sharded(q, k, v, causal, segment_ids, scale, alibi_slopes=None,
+                   alibi_positions=None, window=0, window_flag=None):
     """Run the Pallas flash kernel under a multi-device mesh.
 
     pallas_call is opaque to the GSPMD partitioner — invoked bare inside jit
@@ -119,6 +135,7 @@ def _flash_sharded(q, k, v, causal, segment_ids, scale, alibi_slopes=None, alibi
         return flash_attention(
             q, k, v, causal=causal, segment_ids=segment_ids, scale=scale,
             alibi_slopes=alibi_slopes, alibi_positions=alibi_positions,
+            window=window, window_flag=window_flag,
         )
     if alibi_slopes is not None:
         # multi-device alibi would need the slope plane sharded with the
@@ -151,30 +168,37 @@ def _flash_sharded(q, k, v, causal, segment_ids, scale, alibi_slopes=None, alibi
     sharding = jax.sharding.NamedSharding(topo.mesh, spec)
     q, k, v = (jax.lax.with_sharding_constraint(x, sharding) for x in (q, k, v))
 
-    if segment_ids is not None:
+    # optional extra operands: segment ids (batch-sharded plane) and the
+    # traced per-layer window flag (replicated scalar)
+    extra_ops, extra_specs, has_seg, has_wf = [], [], segment_ids is not None, None
+    if has_seg:
         seg_spec = P(BATCH_AXES, None)
         segment_ids = jax.lax.with_sharding_constraint(
             segment_ids, jax.sharding.NamedSharding(topo.mesh, seg_spec)
         )
-        fn = jax.shard_map(
-            lambda q_, k_, v_, s_: flash_attention(q_, k_, v_, causal=causal, segment_ids=s_, scale=scale),
-            mesh=topo.mesh,
-            in_specs=(spec, spec, spec, seg_spec),
-            out_specs=spec,
-            axis_names=set(topo.mesh.axis_names),
-            check_vma=False,
-        )
-        return fn(q, k, v, segment_ids)
+        extra_ops.append(segment_ids)
+        extra_specs.append(seg_spec)
+    has_wf = window > 0 and window_flag is not None
+    if has_wf:
+        extra_ops.append(jnp.asarray(window_flag, jnp.int32))
+        extra_specs.append(P())
+
+    def body(q_, k_, v_, *rest):
+        rest = list(rest)
+        seg = rest.pop(0) if has_seg else None
+        wf = rest.pop(0) if has_wf else None
+        return flash_attention(q_, k_, v_, causal=causal, segment_ids=seg,
+                               scale=scale, window=window, window_flag=wf)
 
     fn = jax.shard_map(
-        lambda q_, k_, v_: flash_attention(q_, k_, v_, causal=causal, segment_ids=None, scale=scale),
+        body,
         mesh=topo.mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=(spec, spec, spec, *extra_specs),
         out_specs=spec,
         axis_names=set(topo.mesh.axis_names),
         check_vma=False,
     )
-    return fn(q, k, v)
+    return fn(q, k, v, *extra_ops)
 
 
 def attention(
@@ -188,10 +212,13 @@ def attention(
     impl: Optional[str] = None,
     alibi_slopes: Optional[jax.Array] = None,
     alibi_positions: Optional[jax.Array] = None,
+    window: int = 0,
+    window_flag: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Dispatching attention entry point. ``impl`` forces 'flash' or
-    'reference'. ALiBi rides the flash path (rank-1 in-kernel bias); a dense
-    ``bias`` forces the reference path."""
+    'reference'. ALiBi and sliding windows ride the flash path (in-kernel
+    masking; a static window additionally prunes out-of-band kv blocks from
+    the grid); a dense ``bias`` forces the reference path."""
     d = q.shape[-1]
     sq, sk = q.shape[2], k.shape[2]
     use_flash = impl == "flash" or (
@@ -204,10 +231,23 @@ def attention(
         and sq == sk  # self-attention training path; decode uses reference
     )
     if use_flash:
-        out = _flash_sharded(q, k, v, causal, segment_ids, scale, alibi_slopes, alibi_positions)
+        out = _flash_sharded(q, k, v, causal, segment_ids, scale, alibi_slopes,
+                             alibi_positions, window, window_flag)
         if out is not None:
             return out
+    if window and sq == sk and sq >= 4096:
+        global _warned_window_fallback
+        if not _warned_window_fallback:
+            _warned_window_fallback = True
+            from deepspeed_tpu.utils.logging import logger
+
+            logger.warning(
+                f"sliding-window attention fell back to the dense reference "
+                f"path at seq={sq} (flash needs TPU, head_dim in 64/128/256, "
+                "seq % 128 == 0) — [b, h, s, s] fp32 scores materialize in HBM"
+            )
     return mha_reference(
         q, k, v, causal=causal, segment_ids=segment_ids, bias=bias, scale=scale,
         alibi_slopes=alibi_slopes, alibi_positions=alibi_positions,
+        window=window, window_flag=window_flag,
     )

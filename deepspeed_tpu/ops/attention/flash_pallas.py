@@ -52,9 +52,20 @@ def _alibi_term(alibi_ref, kpos_ref):
     return alibi_ref[0, 0] * kpos_ref[:].astype(jnp.float32)[None, :]
 
 
+def _apply_window(logits, window, wflag_ref, q_pos, k_pos):
+    """Sliding-window band mask: query sees keys in (q - window, q]. With a
+    ``wflag_ref`` ([1, LANES] int32 plane, traced per layer from
+    attn_layer_pattern) the band only applies when the flag is set — the
+    layer scan stays uniform while layers alternate local/global (gpt_neo)."""
+    far = (q_pos - k_pos) >= window
+    if wflag_ref is not None:
+        far = jnp.logical_and(far, wflag_ref[0, 0] > 0)
+    return jnp.where(far, NEG_INF, logits)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
-                scale, causal, bq, bk, nk, seg_q_ref=None, seg_k_ref=None,
-                alibi_ref=None, kpos_ref=None):
+                scale, causal, bq, bk, nk, window=0, seg_q_ref=None,
+                seg_k_ref=None, alibi_ref=None, kpos_ref=None, wflag_ref=None):
     # q_ref: [bq, d]; k_ref/v_ref: [bk, d] (one streamed block);
     # o_ref: [bq, d]; lse_ref: [bq, LANES]; scratch m/l: [bq, LANES] f32,
     # acc: [bq, d] f32 — carried across the minor (kv) grid dimension.
@@ -69,6 +80,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
 
     hi = (qi * bq + bq - 1) // bk  # last kv block a causal q block touches
     active = (ki <= hi) if causal else (ki >= 0)
+    if window and wflag_ref is None:
+        # static window (every layer banded): prune kv blocks fully behind it
+        active = jnp.logical_and(active, ki >= jnp.maximum(0, qi * bq - window + 1) // bk)
 
     @pl.when(active)
     def _step():
@@ -84,6 +98,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
             q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
+            if window:
+                logits = _apply_window(logits, window, wflag_ref, q_pos, k_pos)
         if seg_q_ref is not None:
             logits = jnp.where(
                 seg_q_ref[:][:, None] == seg_k_ref[:][None, :], logits, NEG_INF
@@ -112,7 +128,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
                    delta_ref, dq_acc_ref, *, scale, causal, bq, bk, nk,
-                   seg_q_ref=None, seg_k_ref=None, alibi_ref=None, kpos_ref=None):
+                   window=0, seg_q_ref=None, seg_k_ref=None, alibi_ref=None,
+                   kpos_ref=None, wflag_ref=None):
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -126,6 +143,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
 
     hi = (qi * bq + bq - 1) // bk
     active = (ki <= hi) if causal else (ki >= 0)
+    if window and wflag_ref is None:
+        active = jnp.logical_and(active, ki >= jnp.maximum(0, qi * bq - window + 1) // bk)
 
     @pl.when(active)
     def _step():
@@ -143,6 +162,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
             q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
+            if window:
+                logits = _apply_window(logits, window, wflag_ref, q_pos, k_pos)
         if seg_q_ref is not None:
             logits = jnp.where(
                 seg_q_ref[:][:, None] == seg_k_ref[:][None, :], logits, NEG_INF
@@ -164,8 +185,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref,
                     dv_ref, dk_acc_ref, dv_acc_ref, *, scale, causal, bq, bk,
-                    nq, seg_q_ref=None, seg_k_ref=None, alibi_ref=None,
-                    kpos_ref=None):
+                    nq, window=0, seg_q_ref=None, seg_k_ref=None,
+                    alibi_ref=None, kpos_ref=None, wflag_ref=None):
     ki = pl.program_id(2)
     qj = pl.program_id(3)
 
@@ -176,6 +197,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref,
 
     lo = (ki * bk) // bq  # first q block that sees this kv block
     active = (qj >= lo) if causal else (qj >= 0)
+    if window and wflag_ref is None:
+        # last q block inside the band for this kv block
+        active = jnp.logical_and(active, qj <= (ki * bk + bk - 1 + window - 1) // bq)
 
     @pl.when(active)
     def _step():
@@ -200,6 +224,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref,
             q_pos = qj * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
+            if window:
+                logits = _apply_window(logits, window, wflag_ref, q_pos, k_pos)
         if seg_q_ref is not None:
             logits = jnp.where(
                 seg_q_ref[:][:, None] == seg_k_ref[:][None, :], logits, NEG_INF
@@ -254,6 +280,8 @@ def flash_attention(
     interpret: bool = False,
     alibi_slopes=None,
     alibi_positions=None,
+    window: int = 0,
+    window_flag=None,
 ) -> jax.Array:
     """Flash attention. q: [b, h, s, d]; k, v: [b, h_kv, s, d] → [b, h, s, d].
 
@@ -266,7 +294,16 @@ def flash_attention(
     [s, s] bias never materializes; the review of round 4 found alibi
     silently dropping to the O(s²)-HBM reference path). ``alibi_positions``
     ([b, s] or [s] int32) supplies the key positions; defaults to arange.
-    Slopes are constants (non-learned) — no cotangent."""
+    Slopes are constants (non-learned) — no cotangent.
+
+    ``window``: static sliding-window size — query i sees keys in
+    (i - window, i] (mistral/starcoder2/gpt_neo). With ``window_flag`` None
+    every layer is banded and out-of-band kv BLOCKS are pruned from the grid
+    (compute and copies drop to O(s·window)); with ``window_flag`` (a traced
+    0/1 scalar from attn_layer_pattern) the band toggles per layer via
+    in-kernel masking (full causal grid, flash memory). Requires causal."""
+    if window and not causal:
+        raise ValueError("flash_attention: window > 0 requires causal=True")
     alibi = None
     if alibi_slopes is not None:
         b, _, s, _ = q.shape
@@ -280,28 +317,44 @@ def flash_attention(
             pos = jnp.broadcast_to(pos[None], (b, s))
         # lane-broadcast plane per head: the kernel reads [1, LANES] blocks
         alibi = (jnp.broadcast_to(slopes[:, None], (slopes.shape[0], LANES)), pos)
-    return _flash_core(q, k, v, segment_ids, alibi, causal, scale, interpret)
+    wflag = None
+    if window and window_flag is not None:
+        wflag = jnp.broadcast_to(
+            jnp.asarray(window_flag, jnp.int32).reshape(1, 1), (1, LANES)
+        )
+    return _flash_core(q, k, v, segment_ids, alibi, wflag, causal, scale,
+                       int(window), interpret)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
-def _flash_core(q, k, v, segment_ids, alibi, causal, scale, interpret):
-    out, _ = _flash_fwd(q, k, v, segment_ids, alibi, causal, scale, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9))
+def _flash_core(q, k, v, segment_ids, alibi, wflag, causal, scale, window, interpret):
+    out, _ = _flash_fwd(q, k, v, segment_ids, alibi, wflag, causal, scale, window, interpret)
     return out
 
 
-def _kv_clamp(causal, bq, bk):
+def _kv_clamp(causal, bq, bk, window=0, static_window=False):
     """kv-block index map value for grid point (i, j): masked points re-fetch
-    the last active block (Pallas elides the unchanged copy)."""
+    the nearest active block (Pallas elides the unchanged copy). A static
+    window additionally clamps from below — blocks fully behind the band are
+    never fetched."""
     if not causal:
         return lambda i, j: j
-    return lambda i, j: jnp.minimum(j, (i * bq + bq - 1) // bk)
+    hi = lambda i: (i * bq + bq - 1) // bk
+    if window and static_window:
+        return lambda i, j: jnp.clip(j, jnp.maximum(0, i * bq - window + 1) // bk, hi(i))
+    return lambda i, j: jnp.minimum(j, hi(i))
 
 
-def _q_clamp(causal, bq, bk):
+def _q_clamp(causal, bq, bk, window=0, static_window=False, nq=None):
     """q-block index map for the dk/dv grid (kv major, q minor)."""
     if not causal:
         return lambda i, j: j
-    return lambda i, j: jnp.maximum(j, (i * bk) // bq)
+    lo = lambda i: (i * bk) // bq
+    if window and static_window:
+        return lambda i, j: jnp.clip(
+            j, lo(i), jnp.minimum(nq - 1, (i * bk + bk - 1 + window - 1) // bq)
+        )
+    return lambda i, j: jnp.maximum(j, lo(i))
 
 
 def _seg_specs(segment_ids, q_block, q_map, k_block, k_map):
@@ -330,7 +383,14 @@ def _alibi_specs(alibi, k_block, k_map):
     ]
 
 
-def _flash_call(q, k, v, segment_ids, alibi, causal, scale, interpret):
+def _wflag_specs(wflag):
+    """(extra operands, extra in_specs) for the per-layer window flag plane."""
+    if wflag is None:
+        return [], []
+    return [wflag], [pl.BlockSpec((1, LANES), lambda b_, h_, i, j: (0, 0))]
+
+
+def _flash_call(q, k, v, segment_ids, alibi, wflag, causal, scale, window, interpret):
     b, h, s, d = q.shape
     h_kv = k.shape[1]
     group = h // h_kv
@@ -338,14 +398,15 @@ def _flash_call(q, k, v, segment_ids, alibi, causal, scale, interpret):
     bq = _pick_block(s)
     bk = _pick_block(s)
     nq, nk = s // bq, s // bk
-    jc = _kv_clamp(causal, bq, bk)
+    jc = _kv_clamp(causal, bq, bk, window, static_window=wflag is None)
 
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk
+        _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk, window=window
     )
 
     seg_ops, seg_specs = _seg_specs(segment_ids, bq, lambda i, j: i, bk, jc)
     alibi_ops, alibi_specs = _alibi_specs(alibi, bk, jc)
+    wf_ops, wf_specs = _wflag_specs(wflag)
 
     def entry(qr, kr, vr, *rest):
         rest = list(rest)
@@ -356,6 +417,8 @@ def _flash_call(q, k, v, segment_ids, alibi, causal, scale, interpret):
         if alibi_ops:
             kw["alibi_ref"] = rest.pop(0)
             kw["kpos_ref"] = rest.pop(0).at[0]
+        if wf_ops:
+            kw["wflag_ref"] = rest.pop(0)
         orf, lr, mref, lref, aref = rest
         kernel(qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], orf.at[0, 0],
                lr.at[0, 0], mref, lref, aref, **kw)
@@ -370,7 +433,7 @@ def _flash_call(q, k, v, segment_ids, alibi, causal, scale, interpret):
                          lambda b_, h_, i, j: (b_, h_ // group, jc(i, j), 0)),
             pl.BlockSpec((1, 1, bk, d),
                          lambda b_, h_, i, j: (b_, h_ // group, jc(i, j), 0)),
-        ] + seg_specs + alibi_specs,
+        ] + seg_specs + alibi_specs + wf_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
             pl.BlockSpec((1, 1, bq, LANES), lambda b_, h_, i, j: (b_, h_, i, 0)),
@@ -385,12 +448,12 @@ def _flash_call(q, k, v, segment_ids, alibi, causal, scale, interpret):
             pltpu.VMEM((bq, d), jnp.float32),      # output accumulator
         ],
         interpret=interpret,
-    )(q, k, v, *seg_ops, *alibi_ops)
+    )(q, k, v, *seg_ops, *alibi_ops, *wf_ops)
     return out, lse
 
 
-def _flash_fwd(q, k, v, segment_ids, alibi, causal, scale, interpret):
-    out, lse = _flash_call(q, k, v, segment_ids, alibi, causal, scale, interpret)
+def _flash_fwd(q, k, v, segment_ids, alibi, wflag, causal, scale, window, interpret):
+    out, lse = _flash_call(q, k, v, segment_ids, alibi, wflag, causal, scale, window, interpret)
     # Residual LSE is narrowed to one lane (it is lane-broadcast) so saving it
     # costs b·h·s·4 bytes, not ×LANES; the backward re-broadcasts. The names
     # feed the "flash" remat policy (models.transformer.remat_policy): saving
@@ -404,11 +467,11 @@ def _flash_fwd(q, k, v, segment_ids, alibi, causal, scale, interpret):
     q = checkpoint_name(q, "flash_qkv")
     k = checkpoint_name(k, "flash_qkv")
     v = checkpoint_name(v, "flash_qkv")
-    return out, (q, k, v, segment_ids, alibi, out, lse1)
+    return out, (q, k, v, segment_ids, alibi, wflag, out, lse1)
 
 
-def _flash_bwd(causal, scale, interpret, res, g):
-    q, k, v, segment_ids, alibi, out, lse = res
+def _flash_bwd(causal, scale, window, interpret, res, g):
+    q, k, v, segment_ids, alibi, wflag, out, lse = res
     lse = jnp.broadcast_to(lse, lse.shape[:-1] + (LANES,))
     b, h, s, d = q.shape
     h_kv = k.shape[1]
@@ -417,15 +480,18 @@ def _flash_bwd(causal, scale, interpret, res, g):
     bq = _pick_block(s)
     bk = _pick_block(s)
     nq, nk = s // bq, s // bk
-    jc = _kv_clamp(causal, bq, bk)
-    qc = _q_clamp(causal, bq, bk)
+    static_w = wflag is None
+    jc = _kv_clamp(causal, bq, bk, window, static_window=static_w)
+    qc = _q_clamp(causal, bq, bk, window, static_window=static_w, nq=nq)
 
     dq_kernel = functools.partial(
-        _bwd_dq_kernel, scale=scale_v, causal=causal, bq=bq, bk=bk, nk=nk
+        _bwd_dq_kernel, scale=scale_v, causal=causal, bq=bq, bk=bk, nk=nk,
+        window=window,
     )
 
     seg_ops, seg_specs = _seg_specs(segment_ids, bq, lambda i, j: i, bk, jc)
     alibi_ops, alibi_specs = _alibi_specs(alibi, bk, jc)
+    wf_ops, wf_specs = _wflag_specs(wflag)
 
     def dq_entry(qr, kr, vr, orf, dor, lr, *rest):
         rest = list(rest)
@@ -436,6 +502,8 @@ def _flash_bwd(causal, scale, interpret, res, g):
         if alibi_ops:
             kw["alibi_ref"] = rest.pop(0)
             kw["kpos_ref"] = rest.pop(0).at[0]
+        if wf_ops:
+            kw["wflag_ref"] = rest.pop(0)
         dqr, dref, aref = rest
         dq_kernel(qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], orf.at[0, 0],
                   dor.at[0, 0], lr.at[0, 0], dqr.at[0, 0], dref, aref, **kw)
@@ -452,7 +520,7 @@ def _flash_bwd(causal, scale, interpret, res, g):
             pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
             pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
             pl.BlockSpec((1, 1, bq, LANES), lambda b_, h_, i, j: (b_, h_, i, 0)),
-        ] + seg_specs + alibi_specs,
+        ] + seg_specs + alibi_specs + wf_specs,
         out_specs=pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[
@@ -460,12 +528,13 @@ def _flash_bwd(causal, scale, interpret, res, g):
             pltpu.VMEM((bq, d), jnp.float32),      # dq accumulator
         ],
         interpret=interpret,
-    )(q, k, v, out, g, lse, *seg_ops, *alibi_ops)
+    )(q, k, v, out, g, lse, *seg_ops, *alibi_ops, *wf_ops)
 
     # dk/dv computed per q-head (reduced over the GQA group after), with the
     # q/do/o/lse stream minor so one [bk, d] kv block stays resident.
     dkv_kernel = functools.partial(
-        _bwd_dkv_kernel, scale=scale_v, causal=causal, bq=bq, bk=bk, nq=nq
+        _bwd_dkv_kernel, scale=scale_v, causal=causal, bq=bq, bk=bk, nq=nq,
+        window=window,
     )
     dkv_seg_ops, dkv_seg_specs = _seg_specs(segment_ids, bq, qc, bk, lambda i, j: i)
     # dk/dv grid is kv-major: the key-position block follows the kv index i
@@ -480,6 +549,8 @@ def _flash_bwd(causal, scale, interpret, res, g):
         if dkv_alibi_ops:
             kw["alibi_ref"] = rest.pop(0)
             kw["kpos_ref"] = rest.pop(0).at[0]
+        if wf_ops:
+            kw["wflag_ref"] = rest.pop(0)
         dkr, dvr, dka, dva = rest
         dkv_kernel(qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], orf.at[0, 0],
                    dor.at[0, 0], lr.at[0, 0], dkr.at[0, 0], dvr.at[0, 0],
@@ -496,7 +567,7 @@ def _flash_bwd(causal, scale, interpret, res, g):
             pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, qc(i, j), 0)),
             pl.BlockSpec((1, 1, bq, LANES),
                          lambda b_, h_, i, j: (b_, h_, qc(i, j), 0)),
-        ] + dkv_seg_specs + dkv_alibi_specs,
+        ] + dkv_seg_specs + dkv_alibi_specs + wf_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bk, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
             pl.BlockSpec((1, 1, bk, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
@@ -510,14 +581,14 @@ def _flash_bwd(causal, scale, interpret, res, g):
             pltpu.VMEM((bk, d), jnp.float32),  # dv accumulator
         ],
         interpret=interpret,
-    )(q, k, v, out, g, lse, *dkv_seg_ops, *dkv_alibi_ops)
+    )(q, k, v, out, g, lse, *dkv_seg_ops, *dkv_alibi_ops, *wf_ops)
 
     if group > 1:
         dk = jnp.sum(dk_h.reshape(b, h_kv, group, s, d).astype(jnp.float32), axis=2).astype(k.dtype)
         dv = jnp.sum(dv_h.reshape(b, h_kv, group, s, d).astype(jnp.float32), axis=2).astype(v.dtype)
     else:
         dk, dv = dk_h, dv_h
-    return dq, dk, dv, None, None  # no cotangent for segment_ids / alibi
+    return dq, dk, dv, None, None, None  # no cotangent for segment_ids / alibi / wflag
 
 
 _flash_core.defvjp(_flash_fwd, _flash_bwd)

@@ -522,6 +522,65 @@ def test_longrope_decode_crosses_boundary(request):
     np.testing.assert_array_equal(out[: len(ref)], ref)
 
 
+def test_megatron_gpt_parity(tmp_path_factory, request):
+    """Megatron-LM GPT state-dict naming + per-head-interleaved fused qkv:
+    rewrite a tiny GPT-2's weights into the megatron layout and check the
+    de-interleaving importer reproduces the GPT-2 logits exactly."""
+    hf_model, _ = request.getfixturevalue("tiny_gpt2")
+    sd = hf_model.state_dict()
+    h = hf_model.config.n_embd
+    nh = hf_model.config.n_head
+    d = h // nh
+
+    def meg_qkv(w_cols):  # [h, 3h] conv1d cols [q|k|v] → [3h, h] per-head rows
+        q, k, v = (w_cols[:, i * h : (i + 1) * h].T for i in range(3))
+        return (
+            torch.stack([q.reshape(nh, d, h), k.reshape(nh, d, h), v.reshape(nh, d, h)], dim=1)
+            .reshape(3 * h, h)
+        )
+
+    def meg_qkv_b(b_cols):  # [3h] → per-head interleave
+        q, k, v = (b_cols[i * h : (i + 1) * h] for i in range(3))
+        return torch.stack([q.reshape(nh, d), k.reshape(nh, d), v.reshape(nh, d)], dim=1).reshape(-1)
+
+    meg = {
+        "word_embeddings.weight": sd["transformer.wte.weight"],
+        "position_embeddings.weight": sd["transformer.wpe.weight"],
+        "transformer.final_layernorm.weight": sd["transformer.ln_f.weight"],
+        "transformer.final_layernorm.bias": sd["transformer.ln_f.bias"],
+    }
+    for i in range(hf_model.config.n_layer):
+        g, p = f"transformer.h.{i}", f"transformer.layers.{i}"
+        meg[f"{p}.input_layernorm.weight"] = sd[f"{g}.ln_1.weight"]
+        meg[f"{p}.input_layernorm.bias"] = sd[f"{g}.ln_1.bias"]
+        meg[f"{p}.attention.query_key_value.weight"] = meg_qkv(sd[f"{g}.attn.c_attn.weight"])
+        meg[f"{p}.attention.query_key_value.bias"] = meg_qkv_b(sd[f"{g}.attn.c_attn.bias"])
+        meg[f"{p}.attention.dense.weight"] = sd[f"{g}.attn.c_proj.weight"].T.contiguous()
+        meg[f"{p}.attention.dense.bias"] = sd[f"{g}.attn.c_proj.bias"]
+        meg[f"{p}.post_attention_layernorm.weight"] = sd[f"{g}.ln_2.weight"]
+        meg[f"{p}.post_attention_layernorm.bias"] = sd[f"{g}.ln_2.bias"]
+        meg[f"{p}.mlp.dense_h_to_4h.weight"] = sd[f"{g}.mlp.c_fc.weight"].T.contiguous()
+        meg[f"{p}.mlp.dense_h_to_4h.bias"] = sd[f"{g}.mlp.c_fc.bias"]
+        meg[f"{p}.mlp.dense_4h_to_h.weight"] = sd[f"{g}.mlp.c_proj.weight"].T.contiguous()
+        meg[f"{p}.mlp.dense_4h_to_h.bias"] = sd[f"{g}.mlp.c_proj.bias"]
+    path = str(tmp_path_factory.mktemp("hf_megatron_gpt"))
+    torch.save(meg, path + "/pytorch_model.bin")
+    json.dump(
+        {
+            "model_type": "megatron_gpt",
+            "vocab_size": hf_model.config.vocab_size,
+            "hidden_size": h,
+            "num_layers": hf_model.config.n_layer,
+            "num_attention_heads": nh,
+            "max_position_embeddings": hf_model.config.n_positions,
+            "activation_function": "gelu_new",
+        },
+        open(path + "/config.json", "w"),
+    )
+    cfg, _ = _logits_parity(hf_model, path)
+    assert cfg.tie_embeddings and cfg.position == "learned" and cfg.attn_qkv_bias
+
+
 def test_bert_relu_mlm_parity(tmp_path_factory):
     """The cls.predictions transform uses the config's hidden activation —
     a relu checkpoint must not silently run gelu (code-review finding)."""
