@@ -48,6 +48,8 @@ def run(exp: dict) -> dict:
 
     shape = dict(exp.get("shape") or {})  # no-shape searches benchmark the default config
     shape["remat_policy"] = exp.get("remat_policy") or shape.get("remat_policy", "flash")
+    if exp.get("matmul_precision"):
+        shape["matmul_precision"] = exp["matmul_precision"]
     cfg = TransformerConfig(**shape)
     micro = int(exp.get("micro_batch", 1))
     seq = int(exp.get("seq", min(cfg.max_seq_len, 2048)))

@@ -45,7 +45,12 @@ def predicted_score(exp: Dict[str, Any]) -> float:
     # MXU sweet spot: log-ish growth in width, saturating past ~2048
     width_w = min(hidden, 2560) / 2560.0
     stage_w = 1.0 - 0.01 * exp.get("zero_stage", 0)  # stages add comm/plumbing
-    return micro * policy_w * block_w * (0.5 + 0.5 * width_w) * stage_w
+    # per-channel int8 rides the MXU's native 2x int8 rate (measured +4.3pp
+    # MFU at the bench shape, PERF.md round 4); fp8 measured a loss on v5e
+    prec_w = {"int8": 1.08, "int8_tensor": 1.05, "fp8": 0.9}.get(
+        exp.get("matmul_precision", "default"), 1.0
+    )
+    return micro * policy_w * block_w * (0.5 + 0.5 * width_w) * stage_w * prec_w
 
 
 @dataclass
