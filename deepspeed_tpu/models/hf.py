@@ -11,8 +11,9 @@ Supported ``model_type``s: llama, mistral, qwen2, qwen2_moe, mixtral,
 falcon, phi, phi3, gpt2, gpt_neo, opt, gemma, bloom, gptj, gpt_neox,
 internlm, stablelm, starcoder2, megatron_gpt (Megatron-LM GPT state-dict
 naming, per-head-interleaved fused qkv), plus the bert/distilbert encoder
-family (post-LN bidirectional stack + masked-LM head) (scaled-RoPE
-checkpoints —
+family (post-LN bidirectional stack + masked-LM head) and clip_text_model
+(the stable-diffusion text tower; unet/vae are N/A here — diffusers is not
+in the image) (scaled-RoPE checkpoints —
 llama3/yarn/longrope/linear/dynamic — import via ``rope_scaling``;
 sliding-window checkpoints — mistral/starcoder2/gpt_neo local — import via
 ``sliding_window``/``attn_layer_pattern``). Dispatch is by ``config.json``'s
@@ -311,6 +312,32 @@ def config_from_hf(hf_cfg) -> TransformerConfig:
             sliding_window=int(get("window_size", 256)) if any_local else 0,
             attn_layer_pattern=tuple(int(t == "local") for t in pattern) if any_local else None,
         )
+    if mt == "clip_text_model":
+        # CLIP's text encoder (reference module_inject/containers/clip.py —
+        # the stable-diffusion text tower): causal pre-LN encoder, learned
+        # positions, quick_gelu MLP, final LN, NO lm head (use
+        # forward_hidden for features; tie_embeddings avoids a head param).
+        act_map = {"quick_gelu": "quick_gelu", "gelu": "gelu_exact",
+                   "gelu_new": "gelu", "gelu_pytorch_tanh": "gelu"}
+        act = get("hidden_act", "quick_gelu")
+        if act not in act_map:
+            raise ValueError(f"clip_text_model: hidden_act={act!r} is not supported")
+        return TransformerConfig(
+            vocab_size=get("vocab_size"),
+            hidden_size=get("hidden_size"),
+            n_layers=get("num_hidden_layers"),
+            n_heads=get("num_attention_heads"),
+            ffn_hidden_size=get("intermediate_size"),
+            max_seq_len=get("max_position_embeddings", 77),
+            norm="layernorm",
+            activation=act_map[act],
+            position="learned",
+            norm_eps=float(get("layer_norm_eps", 1e-5)),
+            tie_embeddings=True,  # no lm head: features come from forward_hidden
+            attn_qkv_bias=True,
+            attn_out_bias=True,
+            mlp_bias=True,
+        )
     if mt == "bert":
         act_map = {"gelu": "gelu_exact", "gelu_new": "gelu", "gelu_pytorch_tanh": "gelu", "relu": "relu"}
         act = get("hidden_act", "gelu")
@@ -600,7 +627,7 @@ def config_from_hf(hf_cfg) -> TransformerConfig:
         f"unsupported model_type {mt!r}; supported: llama, mistral, qwen2, "
         "qwen2_moe, mixtral, falcon, phi, phi3, gpt2, gpt_neo, opt, gemma, "
         "bloom, gptj, gpt_neox, internlm, stablelm, starcoder2, "
-        "megatron_gpt, bert, distilbert"
+        "megatron_gpt, bert, distilbert, clip_text_model"
     )
 
 
@@ -892,6 +919,22 @@ def _gptj_layer(take: _Taker, cfg: TransformerConfig, p: str, layers: Dict[str, 
     layers["w_down_b"].append(take(f"{p}.mlp.fc_out.bias"))
 
 
+def _clip_text_layer(take: _Taker, cfg: TransformerConfig, p: str, layers: Dict[str, list]):
+    layers["attn_norm"].append(take(f"{p}.layer_norm1.weight"))
+    layers["attn_norm_b"].append(take(f"{p}.layer_norm1.bias"))
+    for name, hf in (("wq", "q_proj"), ("wk", "k_proj"), ("wv", "v_proj")):
+        layers[name].append(take.linear(f"{p}.self_attn.{hf}.weight"))
+        layers[f"{name}_b"].append(take(f"{p}.self_attn.{hf}.bias"))
+    layers["wo"].append(take.linear(f"{p}.self_attn.out_proj.weight"))
+    layers["wo_b"].append(take(f"{p}.self_attn.out_proj.bias"))
+    layers["mlp_norm"].append(take(f"{p}.layer_norm2.weight"))
+    layers["mlp_norm_b"].append(take(f"{p}.layer_norm2.bias"))
+    layers["w_up"].append(take.linear(f"{p}.mlp.fc1.weight"))
+    layers["w_up_b"].append(take(f"{p}.mlp.fc1.bias"))
+    layers["w_down"].append(take.linear(f"{p}.mlp.fc2.weight"))
+    layers["w_down_b"].append(take(f"{p}.mlp.fc2.bias"))
+
+
 def _bert_layer(take: _Taker, cfg: TransformerConfig, p: str, layers: Dict[str, list]):
     # post-LN encoder: attention.output.LayerNorm normalizes x + attn(x)
     # (→ attn_norm), output.LayerNorm normalizes + mlp (→ mlp_norm)
@@ -998,6 +1041,7 @@ _LAYER_EXTRACTORS: Dict[str, Callable] = {
     "phi": _phi_layer,
     "phi3": _phi3_layer,
     "bert": _bert_layer,
+    "clip_text_model": _clip_text_layer,
     "distilbert": _distilbert_layer,
     "gpt2": _gpt2_layer,
     "gpt_neo": _gptneo_layer,
@@ -1040,6 +1084,12 @@ _TOPLEVEL_KEYS: Dict[str, Tuple[str, str, str, Optional[str]]] = {
         "transformer.final_layernorm",
         "transformer.layers",
         "position_embeddings.weight",
+    ),
+    "clip_text_model": (
+        "text_model.embeddings.token_embedding.weight",
+        "text_model.final_layer_norm",
+        "text_model.encoder.layers",
+        "text_model.embeddings.position_embedding.weight",
     ),
     "mixtral": ("model.embed_tokens.weight", "model.norm", "model.layers", None),
     "stablelm": ("model.embed_tokens.weight", "model.norm", "model.layers", None),

@@ -63,7 +63,9 @@ class TransformerConfig:
     ffn_hidden_size: Optional[int] = None  # None → 4x (gelu) / 8/3x rounded (swiglu)
     max_seq_len: int = 2048
     norm: str = "rmsnorm"  # rmsnorm | rmsnorm_1p (gemma zero-centered) | layernorm
-    activation: str = "swiglu"  # swiglu | geglu (gemma) | gelu (tanh) | gelu_exact (erf) | relu
+    # swiglu | geglu (gemma) | gelu (tanh) | gelu_exact (erf) | relu |
+    # quick_gelu (CLIP: x * sigmoid(1.702 x))
+    activation: str = "swiglu"
     position: str = "rope"  # rope | learned | alibi (bloom) | none
     rope_theta: float = 10000.0
     # Scaled RoPE (HF rope_scaling; reference AutoTP serves these checkpoints
@@ -849,6 +851,8 @@ def _mlp_block(c: TransformerConfig, lp, x):
         act = (jax.nn.gelu(gate) if c.activation == "geglu" else jax.nn.silu(gate)) * up
     elif c.activation == "relu":
         act = jax.nn.relu(up)
+    elif c.activation == "quick_gelu":
+        act = up * jax.nn.sigmoid(1.702 * up)
     else:
         act = jax.nn.gelu(up, approximate=c.activation != "gelu_exact")
     out = _proj(c, act, lp["w_down"])

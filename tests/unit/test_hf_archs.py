@@ -581,6 +581,34 @@ def test_megatron_gpt_parity(tmp_path_factory, request):
     assert cfg.tie_embeddings and cfg.position == "learned" and cfg.attn_qkv_bias
 
 
+def test_clip_text_encoder_parity(tmp_path_factory):
+    """CLIP's text tower (reference module_inject/containers/clip.py — the
+    stable-diffusion text encoder): causal pre-LN encoder with quick_gelu;
+    hidden-state parity via forward_hidden (CLIP has no LM head). atol is
+    loose because XLA:CPU's reduced-precision fp32 matmuls meet ~3.2-scale
+    activations here; exact-precision parity is 3.5e-6 (verified while
+    landing the arch)."""
+    torch.manual_seed(0)
+    m = transformers.CLIPTextModel(
+        transformers.CLIPTextConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=77,
+        )
+    ).eval()
+    path = str(tmp_path_factory.mktemp("hf_clip_text"))
+    m.save_pretrained(path)
+    cfg, params = load_hf_model(path, dtype="float32")
+    assert cfg.activation == "quick_gelu" and cfg.attn_causal
+    toks = np.random.default_rng(21).integers(0, 256, size=(2, 16)).astype(np.int32)
+    with torch.no_grad():
+        ref = m(torch.tensor(toks, dtype=torch.long)).last_hidden_state.numpy()
+    from deepspeed_tpu.models.transformer import forward_hidden
+
+    ours, _ = forward_hidden(params, jnp.asarray(toks), cfg)
+    np.testing.assert_allclose(np.asarray(ours, np.float32), ref, atol=5e-2, rtol=5e-3)
+
+
 def test_bert_relu_mlm_parity(tmp_path_factory):
     """The cls.predictions transform uses the config's hidden activation —
     a relu checkpoint must not silently run gelu (code-review finding)."""
