@@ -173,8 +173,12 @@ class Autotuner:
         # candidates that waste subprocess budget at the head of the ranking
         saved = {
             "nothing": 2.0,
-            "flash": 4.0,
-            "flash_qkv": 5.0,
+            # calibrated against the bench config's measured residency
+            # (h=2304 micro 6 remat=flash ≈ 15.2 GB total → ~1.4 GB of
+            # activations → ~2.5 hidden-elements per token per layer; the
+            # old 4.0 pruned the measured-best config as infeasible)
+            "flash": 2.5,
+            "flash_qkv": 3.5,
             "everything": 34.0,
         }.get(policy, 12.0)
         need = zero_memory_per_chip(n_params, stage, self.dp) + activation_memory_per_chip(
@@ -185,7 +189,11 @@ class Autotuner:
             remat=True,
             saved_factor=saved,
         )
-        return need < self.hbm * 0.92
+        # 0.97 runway, looser than the in-process 0.92: shape candidates run
+        # as ISOLATED subprocesses where an OOM is a cheap data point, and
+        # the measured-best bench config (h=2304 micro 6, ~15.2/16 GB) sits
+        # exactly in the band the tighter cap pruned
+        return need < self.hbm * 0.97
 
     # -- space enumeration -------------------------------------------------
     def _space(self) -> List[Dict[str, Any]]:

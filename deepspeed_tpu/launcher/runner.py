@@ -140,7 +140,7 @@ def run_autotuning(args) -> int:
         stages=(3,),
         micro_batch_sizes=(2, 4, 6, 8),
         remat_policies=("nothing", "flash", "dots_with_no_batch_dims"),
-        flash_blocks=(256, 512) if on_tpu else (512,),
+        flash_blocks=(512, 1024) if on_tpu else (512,),
         # int8 only pays on hardware with a native int8 MXU rate — CPU smoke
         # searches skip it to keep the space small
         matmul_precisions=("default", "int8") if on_tpu else ("default",),

@@ -178,6 +178,14 @@ class TransformerConfig:
     def __post_init__(self):
         if self.norm_scheme not in ("pre", "post"):
             raise ValueError(f"norm_scheme={self.norm_scheme!r}: expected 'pre' or 'post'")
+        if self.position == "alibi" and (self.sliding_window > 0 or self.attn_scale is not None):
+            # the alibi training branch rides the flash kernel's rank-1 bias
+            # and takes no window/scale — silently ignoring them would train
+            # full-context and then DECODE windowed (train/serve mismatch)
+            raise ValueError(
+                "alibi attention does not compose with sliding_window or "
+                "attn_scale (no supported arch combines them)"
+            )
         if self.seq_impl not in ("ulysses", "ring"):
             raise ValueError(
                 f"seq_impl={self.seq_impl!r}: expected 'ulysses' or 'ring' "
@@ -740,12 +748,13 @@ def _proj(c: TransformerConfig, x, w):
 
 def _window_bias(c: TransformerConfig, q_glob, k_pos, local_flag):
     """[sq, sk] fp32 additive bias masking keys ≥ sliding_window behind the
-    query. ``local_flag`` (traced 0/1 scalar from attn_layer_pattern, or
-    None) switches the window off for global layers inside the layer scan —
-    the scan stays uniform while layers alternate (gpt_neo)."""
-    far = (q_glob[:, None] - k_pos[None, :]) >= c.sliding_window
-    if local_flag is not None:
-        far = jnp.logical_and(far, local_flag > 0)
+    query (band convention shared via ops.attention.core.window_too_far).
+    ``local_flag`` (traced 0/1 scalar from attn_layer_pattern, or None)
+    switches the window off for global layers inside the layer scan — the
+    scan stays uniform while layers alternate (gpt_neo)."""
+    from deepspeed_tpu.ops.attention.core import window_too_far
+
+    far = window_too_far(q_glob[:, None], k_pos[None, :], c.sliding_window, local_flag)
     return jnp.where(far, jnp.float32(-1e30), jnp.float32(0.0))
 
 
@@ -797,20 +806,28 @@ def _attention_block(c: TransformerConfig, lp, x, positions, segment_ids, kv_cac
                     "alibi attention under sequence parallelism is not supported "
                     "(the ring/ulysses kernels take no bias)"
                 )
-            if c.sliding_window > 0 or c.attn_scale is not None or not c.attn_causal:
+            if not c.attn_causal:
                 raise NotImplementedError(
-                    "sliding-window / scaled / bidirectional attention under "
-                    "sequence parallelism is not supported (the ring/ulysses "
-                    "kernels are causal and take no bias or scale override)"
+                    "bidirectional attention under sequence parallelism is "
+                    "not supported (the ring/ulysses paths are causal)"
                 )
             if c.seq_impl == "ring":
                 from deepspeed_tpu.parallel.sequence import ring_attention
 
-                out = ring_attention(q, k, v, causal=True, segment_ids=segment_ids)
+                # window masks over GLOBAL positions inside the ring loop
+                out = ring_attention(
+                    q, k, v, causal=True, segment_ids=segment_ids,
+                    scale=c.attn_scale, window=c.sliding_window,
+                    window_flag=local_flag,
+                )
             else:
                 from deepspeed_tpu.parallel.sequence import ulysses_attention
 
-                out = ulysses_attention(q, k, v, causal=True, segment_ids=segment_ids)
+                out = ulysses_attention(
+                    q, k, v, causal=True, segment_ids=segment_ids,
+                    scale=c.attn_scale, window=c.sliding_window,
+                    window_flag=local_flag,
+                )
         elif c.position == "alibi":
             # rank-1 form rides the flash kernel (slope * key_position added
             # in-kernel) — the dense [s, s] bias never materializes

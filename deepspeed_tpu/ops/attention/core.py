@@ -21,6 +21,20 @@ import jax
 import jax.numpy as jnp
 
 
+def window_too_far(q_pos, k_pos, window: int, window_flag=None):
+    """THE sliding-window band convention, shared by every implementation
+    (flash kernel, reference einsum, ring loop, decode mask) so the masks
+    cannot drift: key k is out of band for query q iff ``q - k >= window``
+    (query sees keys in ``(q - window, q]``). ``window_flag`` (traced 0/1
+    scalar from attn_layer_pattern) gates the band per layer — flag 0 means
+    the layer is global and nothing is masked. Returns a boolean array of
+    ``broadcast(q_pos, k_pos)`` shape, True = mask out."""
+    far = (q_pos - k_pos) >= window
+    if window_flag is not None:
+        far = jnp.logical_and(far, window_flag > 0)
+    return far
+
+
 def _repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
     """Expand kv heads for grouped-query attention: [b, h_kv, s, d] -> [b, h, s, d]."""
     if n_rep == 1:
@@ -80,10 +94,9 @@ def mha_reference(
         k_pos = jnp.arange(sk)[None, :]
         mask = q_pos >= k_pos
         if window:
-            far = (q_pos - k_pos) >= window
-            if window_flag is not None:
-                far = jnp.logical_and(far, window_flag > 0)
-            mask = jnp.logical_and(mask, jnp.logical_not(far))
+            mask = jnp.logical_and(
+                mask, jnp.logical_not(window_too_far(q_pos, k_pos, window, window_flag))
+            )
         logits = jnp.where(mask[None, None], logits, jnp.float32(-1e30))
     if segment_ids is not None:
         # segment_ids: [b, s] per position; requires sq == sk (training path)

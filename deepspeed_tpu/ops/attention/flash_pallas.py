@@ -56,10 +56,13 @@ def _apply_window(logits, window, wflag_ref, q_pos, k_pos):
     """Sliding-window band mask: query sees keys in (q - window, q]. With a
     ``wflag_ref`` ([1, LANES] int32 plane, traced per layer from
     attn_layer_pattern) the band only applies when the flag is set — the
-    layer scan stays uniform while layers alternate local/global (gpt_neo)."""
-    far = (q_pos - k_pos) >= window
-    if wflag_ref is not None:
-        far = jnp.logical_and(far, wflag_ref[0, 0] > 0)
+    layer scan stays uniform while layers alternate local/global (gpt_neo).
+    The band convention is the shared ``core.window_too_far``."""
+    from deepspeed_tpu.ops.attention.core import window_too_far
+
+    far = window_too_far(
+        q_pos, k_pos, window, wflag_ref[0, 0] if wflag_ref is not None else None
+    )
     return jnp.where(far, NEG_INF, logits)
 
 

@@ -71,9 +71,16 @@ def ring_attention_local(
     axis_name: str = SEQUENCE_AXIS,
     causal: bool = True,
     scale: Optional[float] = None,
+    window: int = 0,
+    window_flag: Optional[jax.Array] = None,
 ) -> jax.Array:
     """The ring loop — call INSIDE shard_map over ``axis_name`` with
-    sequence-sharded [b, h, s/N, d] blocks. Returns the local output block."""
+    sequence-sharded [b, h, s/N, d] blocks. Returns the local output block.
+
+    ``window``: sliding-window band over GLOBAL positions (device i's q block
+    starts at i·sq, the rotating k/v block at src·sq — the band mask is exact
+    across shard boundaries). ``window_flag`` (traced 0/1) toggles the band
+    per layer for alternating local/global stacks."""
     N = jax.lax.axis_size(axis_name)
     i = jax.lax.axis_index(axis_name)
     b, h, sq, d = q.shape
@@ -81,11 +88,25 @@ def ring_attention_local(
 
     q32 = q.astype(jnp.float32)
     diag_bias, zero_bias, full_mask = make_block_biases(sq)
+    lq = jnp.arange(sq)[:, None]
+    lk = jnp.arange(sq)[None, :]
 
     def step(carry, t):
         k_cur, v_cur, acc, m_run, l_run = carry
         src = (i - t) % N  # origin shard of the current k/v block
-        if causal:
+        if causal and window:
+            # global-position band: query i·sq+lq sees keys in (g - window, g]
+            # (band convention shared via ops.attention.core.window_too_far)
+            from deepspeed_tpu.ops.attention.core import window_too_far
+
+            q_glob = i * sq + lq
+            k_glob = src * sq + lk
+            mask = jnp.logical_and(
+                q_glob >= k_glob,
+                jnp.logical_not(window_too_far(q_glob, k_glob, window, window_flag)),
+            )
+            bias = jnp.where(mask, 0.0, NEG_INF).astype(jnp.float32)
+        elif causal:
             bias = block_causal_bias(sq, src, i, diag_bias, zero_bias, full_mask)
         else:
             bias = zero_bias
@@ -118,6 +139,8 @@ def ring_attention(
     causal: bool = True,
     segment_ids: Optional[jax.Array] = None,
     scale: Optional[float] = None,
+    window: int = 0,
+    window_flag: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Drop-in for ``ulysses_attention``: inputs logically [b, h, s, d] with
     s sharded over ``sequence``; output in the same layout. Falls back to the
@@ -127,23 +150,36 @@ def ring_attention(
     topo = get_topology()
     sp = topo.sequence_parallel_size
     if sp <= 1:
-        return attention_op(q, k, v, causal=causal, segment_ids=segment_ids, scale=scale)
+        return attention_op(q, k, v, causal=causal, segment_ids=segment_ids,
+                            scale=scale, window=window, window_flag=window_flag)
     if segment_ids is not None:
         # packed sequences span shard boundaries; the block mask would need
         # per-position segment exchange — use Ulysses for packed batches
         raise NotImplementedError("ring attention does not support segment_ids; use Ulysses")
+    if window and not causal:
+        raise ValueError("ring_attention: window > 0 requires causal=True")
     assert q.shape[2] % sp == 0, f"seq {q.shape[2]} not divisible by sequence axis {sp}"
 
     # manual over `sequence` only: specs may not reference auto axes — the
     # batch dim stays under GSPMD (data/expert sharding preserved around the
     # manual region)
     spec = P(None, None, SEQUENCE_AXIS, None)
+    wf_ops, wf_specs = (), ()
+    if window and window_flag is not None:
+        wf_ops = (jnp.asarray(window_flag, jnp.int32),)
+        wf_specs = (P(),)
+
+    def body(q_, k_, v_, *rest):
+        wf = rest[0] if rest else None
+        return ring_attention_local(q_, k_, v_, SEQUENCE_AXIS, causal, scale,
+                                    window, wf)
+
     fn = jax.shard_map(
-        lambda q_, k_, v_: ring_attention_local(q_, k_, v_, SEQUENCE_AXIS, causal, scale),
+        body,
         mesh=topo.mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=(spec, spec, spec, *wf_specs),
         out_specs=spec,
         axis_names={SEQUENCE_AXIS},
         check_vma=False,
     )
-    return fn(q, k, v)
+    return fn(q, k, v, *wf_ops)

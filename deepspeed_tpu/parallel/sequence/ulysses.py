@@ -32,6 +32,8 @@ def ulysses_attention(
     causal: bool = True,
     segment_ids: Optional[jax.Array] = None,
     scale: Optional[float] = None,
+    window: int = 0,
+    window_flag: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Attention with the Ulysses layout dance.
 
@@ -40,11 +42,14 @@ def ulysses_attention(
     constraint to head-sharded layout triggers the scatter-heads /
     gather-sequence all-to-all; attention then sees the FULL sequence for its
     h/SP local heads — exactly the reference semantics (sequence/layer.py:367).
+    Sliding windows compose for free: the local attention sees the full
+    sequence, so ``window``/``window_flag`` pass straight through.
     """
     topo = get_topology()
     sp = topo.sequence_parallel_size
     if sp <= 1:
-        return attention_op(q, k, v, causal=causal, segment_ids=segment_ids, scale=scale)
+        return attention_op(q, k, v, causal=causal, segment_ids=segment_ids,
+                            scale=scale, window=window, window_flag=window_flag)
 
     seq_layout = P(BATCH_AXES, None, SEQUENCE_AXIS, None)
     head_layout = P(BATCH_AXES, SEQUENCE_AXIS, None, None)
@@ -53,7 +58,8 @@ def ulysses_attention(
     q = _constrain(_constrain(q, seq_layout), head_layout)
     k = _constrain(_constrain(k, seq_layout), head_layout)
     v = _constrain(_constrain(v, seq_layout), head_layout)
-    out = attention_op(q, k, v, causal=causal, segment_ids=segment_ids, scale=scale)
+    out = attention_op(q, k, v, causal=causal, segment_ids=segment_ids,
+                       scale=scale, window=window, window_flag=window_flag)
     # post-attention inverse all-to-all back to sequence-sharded
     return _constrain(_constrain(out, head_layout), seq_layout)
 
