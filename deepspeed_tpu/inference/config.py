@@ -79,6 +79,13 @@ class RaggedInferenceEngineConfig(DSConfigModel):
 
     dtype: str = "bfloat16"
     tp_size: int = 1
+    # > 1: generate() fuses this many greedy decode iterations into ONE
+    # device program (argmax fed back in-device) once all prompts are
+    # prefilled — the per-token host round-trip (measured ~120 ms through a
+    # remote-tunnel device; sub-ms attached, but still the classic serving
+    # bottleneck) is paid once per decode_steps tokens. Trade-off: EOS hits
+    # mid-round waste the remaining iterations for that row.
+    decode_steps: int = 1
     quant: QuantConfig = submodel(QuantConfig)
     kv_cache: Optional[KVCacheConfig] = submodel(KVCacheConfig)
     state_manager: Optional[StateManagerConfig] = submodel(StateManagerConfig)

@@ -118,6 +118,8 @@ class InferenceEngineV2:
             self._v_cache = jnp.zeros(shape, dtype)
         self._row_jit = {}
         self._batched_jit = None  # shape-polymorphic: jit specializes per bucket
+        self._multistep_jit = None
+        self._multistep_n = 0
         self.last_scheduled_tokens = 0
         self.last_capped = set()
         log_dist(
@@ -259,6 +261,48 @@ class InferenceEngineV2:
         return jax.jit(row_step, donate_argnums=(5, 6))
 
     # ------------------------------------------------------------------
+    def _paged_layer(self, lp, x, blk, row, tok_tables, positions, live, kc_l, vc_l):
+        """One transformer layer over a packed token batch with paged KV —
+        THE decode layer body, shared by the batched SplitFuse step and the
+        fused multi-step decode so the two paths cannot drift. x: [1, T, h];
+        blk/row/positions: [T]; tok_tables: [T, B]; ``live`` is the traced
+        live sequence length for the rope-scaling switch. Returns
+        (x, kc_l, vc_l)."""
+        from deepspeed_tpu.ops.attention.paged_pallas import paged_attention
+
+        c = self._mc
+        dtype = T.DTYPES[c.dtype]
+        trash = self.config.kv_cache.num_blocks
+        nh, nkv, d = c.n_heads, c.kv_heads, c.head_dim
+        t = x.shape[1]
+        lp = T._dequant_tree(lp, dtype)
+        a = T._norm(x, lp["attn_norm"], lp.get("attn_norm_b"), c.norm, c.norm_eps)
+        q, k, v = a[0] @ lp["wq"], a[0] @ lp["wk"], a[0] @ lp["wv"]
+        if c.attn_qkv_bias:
+            q, k, v = q + lp["wq_b"], k + lp["wk_b"], v + lp["wv_b"]
+        q = q.reshape(t, nh, d)
+        k = k.reshape(t, nkv, d)
+        v = v.reshape(t, nkv, d)
+        if c.position == "rope":
+            q = T._rope(q.transpose(1, 0, 2)[None], positions[None], c, live)[0].transpose(1, 0, 2)
+            k = T._rope(k.transpose(1, 0, 2)[None], positions[None], c, live)[0].transpose(1, 0, 2)
+        kc_l = kc_l.at[blk, row].set(k)
+        vc_l = vc_l.at[blk, row].set(v)
+        out = self._paged_attention_sharded(
+            paged_attention, q, kc_l, vc_l, tok_tables, positions, trash
+        )
+        attn_out = (out.reshape(t, nh * d) @ lp["wo"])[None]
+        if c.attn_out_bias:
+            attn_out = attn_out + lp["wo_b"]
+        if c.parallel_block:
+            m = T._norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"), c.norm, c.norm_eps)
+            mlp_out, _ = T._mlp_block(c, lp, m)
+            return x + attn_out + mlp_out, kc_l, vc_l
+        x = x + attn_out
+        m = T._norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"), c.norm, c.norm_eps)
+        mlp_out, _ = T._mlp_block(c, lp, m)
+        return x + mlp_out, kc_l, vc_l
+
     def _build_batched_step(self):
         """ONE compiled step over the whole packed ragged batch (the actual
         SplitFuse execution: reference ragged_ops kernels run every scheduled
@@ -266,8 +310,6 @@ class InferenceEngineV2:
         only as ``_step_per_row`` for comparison). All sequences' new tokens
         are flattened to [T]; every matmul serves the fused batch; attention
         is the paged block-table kernel (ops/attention/paged_pallas)."""
-        from deepspeed_tpu.ops.attention.paged_pallas import paged_attention
-
         c = self._mc
         kv = self.config.kv_cache
         bs = kv.block_size
@@ -291,42 +333,18 @@ class InferenceEngineV2:
                 tok_tables, jnp.clip(positions // bs, 0, B - 1)[:, None], axis=1
             )[:, 0]
             row = positions % bs
-            nh, nkv, d = c.n_heads, c.kv_heads, c.head_dim
+            # live length (HF max(position_ids)+1): longrope/dynamic switch —
+            # batch-global like HF's packed update, taken over each row's
+            # LAST VALID token (padding tail tokens carry future positions
+            # that would flip the switch early)
+            live = jnp.max(positions[last_idx]) + 1
 
             def layer_step(x, inputs):
                 lp, kc_l, vc_l = inputs
-                lp = T._dequant_tree(lp, dtype)
-                a = T._norm(x, lp["attn_norm"], lp.get("attn_norm_b"), c.norm, c.norm_eps)
-                q, k, v = a[0] @ lp["wq"], a[0] @ lp["wk"], a[0] @ lp["wv"]
-                if c.attn_qkv_bias:
-                    q, k, v = q + lp["wq_b"], k + lp["wk_b"], v + lp["wv_b"]
-                q = q.reshape(t, nh, d)
-                k = k.reshape(t, nkv, d)
-                v = v.reshape(t, nkv, d)
-                if c.position == "rope":
-                    # live length (HF max(position_ids)+1): longrope/dynamic
-                    # switch — batch-global like HF's packed update, taken
-                    # over each row's LAST VALID token (padding tail tokens
-                    # carry future positions that would flip the switch early)
-                    live = jnp.max(positions[last_idx]) + 1
-                    q = T._rope(q.transpose(1, 0, 2)[None], positions[None], c, live)[0].transpose(1, 0, 2)
-                    k = T._rope(k.transpose(1, 0, 2)[None], positions[None], c, live)[0].transpose(1, 0, 2)
-                kc_l = kc_l.at[blk, row].set(k)
-                vc_l = vc_l.at[blk, row].set(v)
-                out = self._paged_attention_sharded(
-                    paged_attention, q, kc_l, vc_l, tok_tables, positions, trash
+                x, kc_l, vc_l = self._paged_layer(
+                    lp, x, blk, row, tok_tables, positions, live, kc_l, vc_l
                 )
-                attn_out = (out.reshape(t, nh * d) @ lp["wo"])[None]
-                if c.attn_out_bias:
-                    attn_out = attn_out + lp["wo_b"]
-                if c.parallel_block:
-                    m = T._norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"), c.norm, c.norm_eps)
-                    mlp_out, _ = T._mlp_block(c, lp, m)
-                    return x + attn_out + mlp_out, (kc_l, vc_l)
-                x = x + attn_out
-                m = T._norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"), c.norm, c.norm_eps)
-                mlp_out, _ = T._mlp_block(c, lp, m)
-                return x + mlp_out, (kc_l, vc_l)
+                return x, (kc_l, vc_l)
 
             x, (k_new, v_new) = jax.lax.scan(layer_step, x, (params["layers"], k_cache, v_cache))
             x = T._norm(x, params["final_norm"], params.get("final_norm_b"), c.norm, c.norm_eps)
@@ -335,6 +353,141 @@ class InferenceEngineV2:
             return logits.astype(jnp.float32), k_new, v_new
 
         return jax.jit(step, donate_argnums=(6, 7))
+
+    def _build_multistep_decode(self, n_steps: int):
+        """``n_steps`` greedy decode iterations in ONE device program, the
+        argmax fed back in-device (reference FastGen keeps sampling on-device
+        for the same reason): the per-token host round-trip — measured
+        ~120 ms through a remote-tunnel device, and the classic serving
+        bottleneck everywhere — is paid once per ``n_steps`` tokens.
+
+        Every row is one running sequence (R = max_ragged_sequence_count;
+        inactive rows carry an all-trash block table, so their KV writes land
+        in the trash block and the paged kernel masks their context reads).
+        Block capacity for ``n_steps`` tokens per row must be allocated by
+        the caller BEFORE the call (decode_round does)."""
+        c = self._mc
+        kv = self.config.kv_cache
+        bs = kv.block_size
+        B = kv.max_blocks_per_seq
+        trash = kv.num_blocks
+        R = self.config.state_manager.max_ragged_sequence_count
+        dtype = T.DTYPES[c.dtype]
+
+        def one_token(params, tokens, positions, tok_tables, active, k_cache, v_cache):
+            # tokens/positions/active: [R]; tok_tables: [R, B]
+            x = T._scale_embed(params["embed"].astype(dtype)[tokens][None], c, dtype)
+            if c.position == "learned":
+                x = x + params["pos_embed"][jnp.clip(positions, 0, c.max_seq_len - 1)][None]
+            if c.embed_norm:
+                x = T._embed_norm(params, c, x, stream=False)
+            blk = jnp.take_along_axis(
+                tok_tables, jnp.clip(positions // bs, 0, B - 1)[:, None], axis=1
+            )[:, 0]
+            row = positions % bs
+            # inactive rows carry position 0: exclude them from the rope
+            # live-length switch
+            live = jnp.max(jnp.where(active, positions, 0)) + 1
+
+            def layer_step(x, inputs):
+                lp, kc_l, vc_l = inputs
+                x, kc_l, vc_l = self._paged_layer(
+                    lp, x, blk, row, tok_tables, positions, live, kc_l, vc_l
+                )
+                return x, (kc_l, vc_l)
+
+            x, (k_new, v_new) = jax.lax.scan(layer_step, x, (params["layers"], k_cache, v_cache))
+            x = T._norm(x, params["final_norm"], params.get("final_norm_b"), c.norm, c.norm_eps)
+            logits = T._apply_lm_head(params, x[0], c)  # [R, vocab]
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), k_new, v_new
+
+        def fused(params, tokens, positions, tables, active, k_cache, v_cache):
+            tok_tables = jnp.where(active[:, None], tables, trash)
+
+            def step_fn(carry, _):
+                toks, pos, kc, vc = carry
+                nxt, kc, vc = one_token(params, toks, pos, tok_tables, active, kc, vc)
+                nxt = jnp.where(active, nxt, toks)  # inactive rows freeze
+                return (nxt, pos + active.astype(jnp.int32), kc, vc), nxt
+
+            (_, _, kc, vc), toks_out = jax.lax.scan(
+                step_fn, (tokens, positions, k_cache, v_cache), None, length=n_steps
+            )
+            return toks_out, kc, vc  # toks_out: [n_steps, R]
+
+        return jax.jit(fused, donate_argnums=(5, 6))
+
+    def decode_round(self, n_steps: Optional[int] = None) -> Dict[int, np.ndarray]:
+        """One fused decode round: ``n_steps`` greedy tokens for every
+        eligible RUNNING sequence in a single device call. Only legal when no
+        prompt chunks are pending (prefill through step()/put() first).
+        Returns {uid: [n_steps] generated tokens}; the caller truncates at
+        EOS and calls scheduler.finish for completed sequences.
+
+        Sequences that cannot take a FULL round — within ``n_steps`` of
+        max_context or the per-sequence block cap, or whose block extension
+        fails because the pool is momentarily exhausted — are simply left
+        untouched (still running): capping, max-context stops, and
+        memory-pressure waiting all stay the per-step scheduler's job
+        (generate() falls back to step() when a round serves nobody)."""
+        n = int(n_steps or self.config.decode_steps)
+        sched = self.scheduler
+        if sched._pending:
+            raise RuntimeError(
+                "decode_round: prompt chunks are still pending — drive step() "
+                "until prefill completes before fused decode"
+            )
+        max_context = self.config.state_manager.max_context
+        R = self.config.state_manager.max_ragged_sequence_count
+        uids = []
+        for uid in list(sched._running):
+            if len(uids) >= R:
+                break
+            seq = self.state_manager.get_sequence(uid)
+            if seq.seen_tokens + n > max_context:
+                continue  # near the context limit: per-step path stops it
+            if self.state_manager.seq_capped(seq, n):
+                continue  # near the block cap: per-step path caps it
+            if not self.state_manager.extend(seq, n):
+                continue  # pool momentarily exhausted: sequence waits
+            uids.append(uid)
+        if not uids:
+            return {}
+        kv = self.config.kv_cache
+        B = kv.max_blocks_per_seq
+        trash = kv.num_blocks
+        tokens = np.zeros(R, np.int32)
+        positions = np.zeros(R, np.int32)
+        tables = np.full((R, B), trash, np.int32)
+        active = np.zeros(R, bool)
+        for i, uid in enumerate(uids):
+            seq = self.state_manager.get_sequence(uid)
+            tokens[i] = sched._next_token[uid]
+            positions[i] = seq.seen_tokens
+            tables[i, : len(seq.block_table)] = seq.block_table
+            active[i] = True
+        if self._multistep_jit is None or self._multistep_n != n:
+            self._multistep_jit = self._build_multistep_decode(n)
+            self._multistep_n = n
+        toks_out, self._k_cache, self._v_cache = self._multistep_jit(
+            self.params,
+            jnp.asarray(tokens),
+            jnp.asarray(positions),
+            jnp.asarray(tables),
+            jnp.asarray(active),
+            self._k_cache,
+            self._v_cache,
+        )
+        toks_out = np.asarray(toks_out)  # [n, R]
+        results: Dict[int, np.ndarray] = {}
+        for i, uid in enumerate(uids):
+            seq = self.state_manager.get_sequence(uid)
+            gen = toks_out[:, i]
+            seq.tokens.extend(int(t) for t in gen)
+            seq.seen_tokens += n
+            sched._next_token[uid] = int(gen[-1])
+            results[uid] = gen
+        return results
 
     def put(self, batch_uids, batch_tokens) -> Dict[int, np.ndarray]:
         """Submit new sequences (reference put :107) and run ONE engine step.
@@ -448,7 +601,30 @@ class InferenceEngineV2:
         remaining = {uid: max_new_tokens for uid in uids}
         outputs = {uid: list(np.asarray(p, np.int32).reshape(-1)) for uid, p in zip(uids, prompts)}
         self.last_capped = set()
+        ds = int(getattr(self.config, "decode_steps", 1) or 1)
         while self.scheduler.has_work():
+            if ds > 1 and not self.scheduler._pending and self.scheduler._running:
+                # fused multi-token decode: full ds-rounds for every eligible
+                # sequence; a sequence that needs fewer tokens overshoots by
+                # < one round and the extras are truncated (its state is
+                # discarded at finish). Sequences decode_round skips (near a
+                # cap / max_context, or waiting on KV blocks) fall through to
+                # the per-step scheduler below, which owns stop/cap/wait
+                # policy, once no sequence is round-eligible.
+                res = self.decode_round(ds)
+                if res:
+                    for uid, gen in res.items():
+                        take = [int(t) for t in gen]
+                        if eos_token_id is not None and eos_token_id in take:
+                            take = take[: take.index(eos_token_id) + 1]
+                        take = take[: remaining[uid]]
+                        outputs[uid].extend(take)
+                        remaining[uid] -= len(take)
+                        if remaining[uid] <= 0 or (
+                            eos_token_id is not None and take and take[-1] == eos_token_id
+                        ):
+                            self.scheduler.finish(uid)
+                    continue
             results = self.step()
             # Liveness: if nothing was scheduled and work remains, no call we
             # make below can change scheduler state — fail loudly instead of

@@ -178,6 +178,62 @@ class TestInferenceV2:
         for o, r in zip(outs, refs):
             np.testing.assert_array_equal(o, r)
 
+    @pytest.mark.parametrize("ds", [4, 8])
+    def test_fused_multistep_decode_matches_per_step(self, tiny_model, ds):
+        """decode_steps > 1 fuses ds greedy iterations into one device
+        program (argmax fed back in-device) — token-exact vs per-step greedy,
+        including a round count that doesn't divide max_new_tokens."""
+        cfg, params = tiny_model
+
+        def engine(ds_):
+            rc = RaggedInferenceEngineConfig.from_dict(
+                {
+                    "dtype": "float32",
+                    "decode_steps": ds_,
+                    "kv_cache": {"block_size": 16, "num_blocks": 64, "max_blocks_per_seq": 8},
+                    "state_manager": {"max_ragged_batch_size": 64, "max_ragged_sequence_count": 4},
+                }
+            )
+            return InferenceEngineV2(cfg, params, rc)
+
+        prompts = [np.arange(1, 9), np.arange(21, 33), np.arange(5, 10)]
+        refs = engine(1).generate(prompts, max_new_tokens=13)
+        outs = engine(ds).generate(prompts, max_new_tokens=13)
+        for o, r in zip(outs, refs):
+            np.testing.assert_array_equal(o, r)
+
+    def test_fused_decode_eos_truncation(self, tiny_model):
+        """A sequence hitting EOS mid-round is truncated and finished; the
+        others keep generating — outputs match the per-step EOS path."""
+        cfg, params = tiny_model
+
+        def engine(ds_):
+            rc = RaggedInferenceEngineConfig.from_dict(
+                {
+                    "dtype": "float32",
+                    "decode_steps": ds_,
+                    "kv_cache": {"block_size": 16, "num_blocks": 64, "max_blocks_per_seq": 8},
+                    "state_manager": {"max_ragged_batch_size": 64, "max_ragged_sequence_count": 4},
+                }
+            )
+            return InferenceEngineV2(cfg, params, rc)
+
+        prompts = [np.arange(1, 9), np.arange(21, 33)]
+        base = engine(1).generate(prompts, max_new_tokens=9)
+        # choose the 3rd generated token of seq 0 as the EOS id
+        eos = int(base[0][len(prompts[0]) + 2])
+        refs = engine(1).generate(prompts, max_new_tokens=9, eos_token_id=eos)
+        outs = engine(4).generate(prompts, max_new_tokens=9, eos_token_id=eos)
+        for o, r in zip(outs, refs):
+            np.testing.assert_array_equal(o, r)
+
+    def test_fused_decode_requires_prefill_done(self, tiny_model):
+        cfg, params = tiny_model
+        engine = self._engine(cfg, params)
+        engine.scheduler.submit(0, np.arange(1, 9))
+        with pytest.raises(RuntimeError, match="prompt chunks are still pending"):
+            engine.decode_round(4)
+
     def test_prompt_splitting_across_steps(self, tiny_model):
         """Prompt longer than the per-step token budget is split (SplitFuse)."""
         cfg, params = tiny_model
