@@ -43,11 +43,11 @@ class InferenceEngineV2:
                 "v2 paged engine: alibi (bloom) is not supported — the paged "
                 "attention kernel takes no bias; serve bloom through the v1 engine"
             )
-        if model_config.sliding_window > 0 or model_config.attn_scale is not None:
+        if model_config.attn_layer_pattern is not None or model_config.attn_scale is not None:
             raise NotImplementedError(
-                "v2 paged engine: sliding-window / scale-override attention "
-                "(mistral-v0.1, starcoder2, gpt_neo) is not supported — the "
-                "paged kernel has no banded mask; serve through the v1 engine"
+                "v2 paged engine: alternating local/global layer patterns and "
+                "scale-override attention (gpt_neo) are not supported — serve "
+                "through the v1 engine (uniform sliding windows ARE supported)"
             )
         if not model_config.attn_causal:
             raise ValueError(
@@ -234,6 +234,15 @@ class InferenceEngineV2:
                 v_ctx = vc_l[block_table].reshape(S, nkv, d).transpose(1, 0, 2)[None]
                 kpos = jnp.arange(S, dtype=jnp.int32)
                 mask = kpos[None, :] <= glob[:, None]  # [t, S] causal vs global pos
+                if c.sliding_window:
+                    from deepspeed_tpu.ops.attention.core import window_too_far
+
+                    mask = jnp.logical_and(
+                        mask,
+                        jnp.logical_not(
+                            window_too_far(glob[:, None], kpos[None, :], c.sliding_window)
+                        ),
+                    )
                 bias = jnp.where(mask, 0.0, -1e30).astype(jnp.float32)[None, None]
                 from deepspeed_tpu.ops.attention import mha_reference
 
@@ -268,11 +277,18 @@ class InferenceEngineV2:
         blk/row/positions: [T]; tok_tables: [T, B]; ``live`` is the traced
         live sequence length for the rope-scaling switch. Returns
         (x, kc_l, vc_l)."""
+        import functools
+
         from deepspeed_tpu.ops.attention.paged_pallas import paged_attention
 
         c = self._mc
         dtype = T.DTYPES[c.dtype]
         trash = self.config.kv_cache.num_blocks
+        paged = (
+            functools.partial(paged_attention, window=c.sliding_window)
+            if c.sliding_window
+            else paged_attention
+        )
         nh, nkv, d = c.n_heads, c.kv_heads, c.head_dim
         t = x.shape[1]
         lp = T._dequant_tree(lp, dtype)
@@ -289,7 +305,7 @@ class InferenceEngineV2:
         kc_l = kc_l.at[blk, row].set(k)
         vc_l = vc_l.at[blk, row].set(v)
         out = self._paged_attention_sharded(
-            paged_attention, q, kc_l, vc_l, tok_tables, positions, trash
+            paged, q, kc_l, vc_l, tok_tables, positions, trash
         )
         attn_out = (out.reshape(t, nh * d) @ lp["wo"])[None]
         if c.attn_out_bias:

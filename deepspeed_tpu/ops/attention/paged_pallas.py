@@ -33,10 +33,13 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def paged_attention_reference(q, k_cache, v_cache, block_tables, q_pos, trash_block):
+def paged_attention_reference(q, k_cache, v_cache, block_tables, q_pos, trash_block,
+                              window: int = 0):
     """jnp reference: per-token context gather + masked softmax, mapped over
     tokens so peak memory is one context window ([S, nkv, d]) rather than T
-    of them. Shapes as module docstring; returns [T, nh, d]."""
+    of them. Shapes as module docstring; returns [T, nh, d]. ``window``:
+    static sliding-window band over sequence positions (mistral/starcoder2;
+    band convention shared via core.window_too_far)."""
     T, nh, d = q.shape
     NB, bs, nkv, _ = k_cache.shape
     B = block_tables.shape[1]
@@ -50,6 +53,10 @@ def paged_attention_reference(q, k_cache, v_cache, block_tables, q_pos, trash_bl
         v_ctx = v_cache[bt].reshape(S, nkv, d).astype(jnp.float32)
         blk_valid = jnp.repeat(bt != trash_block, bs)
         mask = (kpos <= pos) & blk_valid  # [S]
+        if window:
+            from deepspeed_tpu.ops.attention.core import window_too_far
+
+            mask = mask & jnp.logical_not(window_too_far(pos, kpos, window))
         qg = qt.reshape(nkv, group, d).astype(jnp.float32)
         scores = jnp.einsum("ngd,snd->ngs", qg, k_ctx) * (d**-0.5)
         scores = jnp.where(mask[None, None], scores, NEG_INF)
@@ -64,7 +71,8 @@ def paged_attention_reference(q, k_cache, v_cache, block_tables, q_pos, trash_bl
 
 
 def _paged_kernel(
-    bt_ref, qpos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, bs, nh, nkv, d, trash
+    bt_ref, qpos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, bs, nh, nkv, d,
+    trash, window=0
 ):
     t = pl.program_id(0)
     j = pl.program_id(1)
@@ -83,6 +91,10 @@ def _paged_kernel(
     base = j * bs
     kpos = base + jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1)  # [1, bs]
     valid = (kpos <= qpos) & (blk != trash)  # [1, bs]
+    if window:
+        from deepspeed_tpu.ops.attention.core import window_too_far
+
+        valid = valid & jnp.logical_not(window_too_far(qpos, kpos, window))
 
     q = q_ref[0].astype(jnp.float32) * scale  # [nh, d]
     k = k_ref[0].astype(jnp.float32)  # [bs, nkv, d]
@@ -130,15 +142,19 @@ def paged_attention(
     trash_block: int,
     impl: Optional[str] = None,
     interpret: bool = False,
+    window: int = 0,
 ) -> jax.Array:
-    """Dispatching entry point (kernel on TPU, reference otherwise)."""
+    """Dispatching entry point (kernel on TPU, reference otherwise).
+    ``window``: static sliding-window band (uniform across layers)."""
     T, nh, d = q.shape
     NB, bs, nkv, _ = k_cache.shape
     use_kernel = impl == "kernel" or (
         impl is None and jax.default_backend() == "tpu" and d in (64, 128, 256)
     )
     if not use_kernel and not interpret:
-        return paged_attention_reference(q, k_cache, v_cache, block_tables, q_pos, trash_block)
+        return paged_attention_reference(
+            q, k_cache, v_cache, block_tables, q_pos, trash_block, window=window
+        )
 
     B = block_tables.shape[1]
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -157,7 +173,7 @@ def paged_attention(
         ],
     )
     kernel = functools.partial(
-        _paged_kernel, bs=bs, nh=nh, nkv=nkv, d=d, trash=trash_block
+        _paged_kernel, bs=bs, nh=nh, nkv=nkv, d=d, trash=trash_block, window=int(window)
     )
     return pl.pallas_call(
         kernel,

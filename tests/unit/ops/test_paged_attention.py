@@ -35,6 +35,38 @@ def test_paged_kernel_matches_reference(nh, nkv):
     np.testing.assert_allclose(np.asarray(out[7]), 0.0, atol=1e-6)
 
 
+def test_paged_kernel_window():
+    """Static sliding-window band in the paged kernel: the windowed kernel
+    must match a hand-banded dense softmax, and differ from the unwindowed
+    kernel for tokens deeper than the window."""
+    rng = np.random.default_rng(2)
+    T, nh, nkv, d, bs, NB, B = 4, 4, 2, 64, 16, 8, 3
+    trash = NB - 1
+    window = 12
+    q = jnp.asarray(rng.normal(size=(T, nh, d)), jnp.float32)
+    kc = jnp.asarray(rng.normal(size=(NB, bs, nkv, d)), jnp.float32)
+    vc = jnp.asarray(rng.normal(size=(NB, bs, nkv, d)), jnp.float32)
+    bt = np.full((T, B), trash, np.int32)
+    bt[:] = [0, 1, 2]
+    qpos = np.array([5, 20, 33, 40], np.int32)
+    ref = paged_attention_reference(
+        q, kc, vc, jnp.asarray(bt), jnp.asarray(qpos), trash, window=window
+    )
+    out = paged_attention(
+        q, kc, vc, jnp.asarray(bt), jnp.asarray(qpos), trash,
+        impl="kernel", interpret=True, window=window,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    # tokens past the window must see a different (banded) context
+    full = paged_attention(
+        q, kc, vc, jnp.asarray(bt), jnp.asarray(qpos), trash,
+        impl="kernel", interpret=True,
+    )
+    assert np.abs(np.asarray(out[1:]) - np.asarray(full[1:])).max() > 1e-3
+    # inside the window (qpos 5 < 12) nothing changes
+    np.testing.assert_allclose(np.asarray(out[0]), np.asarray(full[0]), atol=1e-6)
+
+
 def test_paged_kernel_bf16():
     rng = np.random.default_rng(1)
     T, nh, nkv, d, bs, NB, B = 4, 4, 2, 128, 32, 8, 2

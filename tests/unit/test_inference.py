@@ -227,6 +227,34 @@ class TestInferenceV2:
         for o, r in zip(outs, refs):
             np.testing.assert_array_equal(o, r)
 
+    @pytest.mark.parametrize("ds", [1, 4])
+    def test_windowed_model_serves_v2(self, tiny_model, ds):
+        """A uniform sliding-window model (mistral-v0.1/starcoder2 class)
+        serves through v2: paged attention applies the band, greedy output
+        matches the dense forward at both per-step and fused decode."""
+        import dataclasses
+
+        cfg, params = tiny_model
+        wcfg = dataclasses.replace(cfg, sliding_window=24)
+        from deepspeed_tpu.models.transformer import forward
+
+        prompt = np.arange(1, 33, dtype=np.int32)  # 32 tokens > window 24
+        toks = list(prompt)
+        for _ in range(6):
+            lg, _ = forward(params, jnp.asarray([toks]), wcfg)
+            toks.append(int(jnp.argmax(lg[0, -1])))
+        rc = RaggedInferenceEngineConfig.from_dict(
+            {
+                "dtype": "float32",
+                "decode_steps": ds,
+                "kv_cache": {"block_size": 16, "num_blocks": 64, "max_blocks_per_seq": 8},
+                "state_manager": {"max_ragged_batch_size": 64, "max_ragged_sequence_count": 4},
+            }
+        )
+        engine = InferenceEngineV2(wcfg, params, rc)
+        out = engine.generate([prompt], max_new_tokens=6)[0]
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(toks))
+
     def test_fused_decode_requires_prefill_done(self, tiny_model):
         cfg, params = tiny_model
         engine = self._engine(cfg, params)
