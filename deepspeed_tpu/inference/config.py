@@ -44,6 +44,10 @@ class DeepSpeedInferenceConfig(DSConfigModel):
     temperature: float = 1.0
     top_k: int = 0
     greedy: bool = True
+    # > 1: generate() fuses this many decode iterations into one device
+    # program (sampled token fed back in-device) — same knob/rationale as
+    # the v2 engine's decode_steps; output-identical to per-step decoding
+    decode_steps: int = 1
 
     @classmethod
     def from_dict(cls, d=None, strict: bool = False):

@@ -130,8 +130,32 @@ class InferenceEngine:
             logits, caches = T.decode_step(params, tokens, mc, caches, positions)
             return _sample(logits[:, -1].astype(jnp.float32), rng, temperature, greedy), caches
 
+        k = int(getattr(self._config, "decode_steps", 1) or 1)
+
+        def multi_decode(params, tokens, caches, pos0, i0, rng, temperature, greedy):
+            """k fused decode iterations (sampled token fed back in-device):
+            one host round-trip per k tokens — the v1 form of the v2 engine's
+            decode_steps. rng folding uses the ABSOLUTE step index (i0 + i),
+            so outputs are bit-identical to the per-step loop."""
+            b = tokens.shape[0]
+
+            def body(carry, i):
+                cur, caches = carry
+                positions = jnp.full((b, 1), pos0 + i, jnp.int32)
+                logits, caches = T.decode_step(params, cur, mc, caches, positions)
+                step_rng = jax.random.fold_in(rng, i0 + i)
+                nxt = _sample(logits[:, -1].astype(jnp.float32), step_rng, temperature, greedy)
+                return (nxt.reshape(b, 1).astype(jnp.int32), caches), nxt
+
+            (cur, caches), toks_out = jax.lax.scan(
+                body, (tokens, caches), jnp.arange(k, dtype=jnp.int32)
+            )
+            return toks_out, caches  # [k, b]
+
         self._prefill_jit = jax.jit(prefill, donate_argnums=(2,))
         self._decode_jit = jax.jit(decode, donate_argnums=(2,))
+        self._multi_decode_jit = jax.jit(multi_decode, donate_argnums=(2,)) if k > 1 else None
+        self._decode_steps = k
 
     def generate(
         self,
@@ -186,20 +210,41 @@ class InferenceEngine:
 
         out = [toks]
         done = np.zeros((b,), bool)
-        for i in range(max_new):
-            tok_np = np.asarray(cur).reshape(b, 1)
+
+        def emit(tok_np):
+            """EOS masking + bookkeeping for one generated token column."""
+            nonlocal done
             if eos_token_id is not None:
                 tok_np = np.where(done[:, None], eos_token_id, tok_np)
                 done |= tok_np[:, 0] == eos_token_id
             out.append(tok_np)
-            if eos_token_id is not None and done.all():
-                break
-            if i == max_new - 1:
-                break
-            step_rng = jax.random.fold_in(rng, i)
-            positions = jnp.full((b, 1), s + i, jnp.int32)
-            cur, caches = self._decode_jit(
-                self.params, jnp.asarray(tok_np), caches, positions,
-                step_rng, jnp.float32(temperature), jnp.bool_(greedy),
-            )
+            return tok_np
+
+        cur_np = emit(np.asarray(cur).reshape(b, 1))
+        i = 0  # decode steps completed
+        n_decode = max_new - 1
+        while i < n_decode and not (eos_token_id is not None and done.all()):
+            if self._multi_decode_jit is not None:
+                # fused rounds: one host round-trip per decode_steps tokens;
+                # a round past max_new/EOS overshoots and the extra columns
+                # are simply not emitted (no further decode follows)
+                toks_out, caches = self._multi_decode_jit(
+                    self.params, jnp.asarray(cur_np), caches,
+                    jnp.int32(s + i), jnp.int32(i), rng,
+                    jnp.float32(temperature), jnp.bool_(greedy),
+                )
+                for row in np.asarray(toks_out):  # [k, b]
+                    if i >= n_decode or (eos_token_id is not None and done.all()):
+                        break
+                    cur_np = emit(row.reshape(b, 1))
+                    i += 1
+            else:
+                step_rng = jax.random.fold_in(rng, i)
+                positions = jnp.full((b, 1), s + i, jnp.int32)
+                cur, caches = self._decode_jit(
+                    self.params, jnp.asarray(cur_np), caches, positions,
+                    step_rng, jnp.float32(temperature), jnp.bool_(greedy),
+                )
+                cur_np = emit(np.asarray(cur).reshape(b, 1))
+                i += 1
         return np.concatenate(out, axis=1)

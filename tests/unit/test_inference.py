@@ -35,6 +35,28 @@ def tiny_model():
 
 
 class TestInferenceV1:
+    @pytest.mark.parametrize("kw", [{"greedy": True}, {"greedy": False, "temperature": 0.9}])
+    def test_fused_decode_steps_matches_per_step(self, tiny_model, kw):
+        """v1 decode_steps: fused rounds are bit-identical to the per-step
+        loop (greedy AND sampled — the rng folds by absolute step index),
+        including a round count that doesn't divide max_new_tokens and EOS."""
+        cfg, params = tiny_model
+        prompt = np.arange(1, 9, dtype=np.int32)[None].repeat(2, 0)
+
+        def run(ds, **gen_kw):
+            engine = deepspeed_tpu.init_inference(
+                model=(cfg, params),
+                config={"dtype": "float32", "max_out_tokens": 64, "decode_steps": ds},
+            )
+            return engine.generate(prompt, max_new_tokens=11, seed=3, **gen_kw)
+
+        ref = run(1, **kw)
+        np.testing.assert_array_equal(run(4, **kw), ref)
+        # EOS mid-round: pick a token the reference emits
+        eos = int(ref[0, 8 + 4])
+        ref_eos = run(1, eos_token_id=eos, **kw)
+        np.testing.assert_array_equal(run(4, eos_token_id=eos, **kw), ref_eos)
+
     def test_greedy_matches_no_cache_reference(self, tiny_model):
         cfg, params = tiny_model
         prompt = np.arange(1, 9, dtype=np.int32)  # 8 tokens
