@@ -38,6 +38,8 @@ def _register_builtins():
         "MistralForCausalLM",
         "Qwen2ForCausalLM",
         "Qwen2MoeForCausalLM",
+        "Qwen3ForCausalLM",
+        "Qwen3MoeForCausalLM",
         "FalconForCausalLM",
         "PhiForCausalLM",
         "Phi3ForCausalLM",

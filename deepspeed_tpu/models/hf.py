@@ -7,7 +7,8 @@ qwen_v2_moe,falcon,phi,phi3}``): a HF causal-LM checkpoint directory becomes
 a (:class:`TransformerConfig`, stacked-params pytree) pair that trains or
 serves through ``deepspeed_tpu.initialize`` / ``init_inference`` unchanged.
 
-Supported ``model_type``s: llama, mistral, qwen2, qwen2_moe, mixtral,
+Supported ``model_type``s: llama, mistral, qwen2, qwen2_moe, qwen3,
+qwen3_moe (per-head q/k RMSNorm), mixtral,
 falcon, phi, phi3, gpt2, gpt_neo, opt, gemma, bloom, gptj, gpt_neox,
 internlm, stablelm, starcoder2, megatron_gpt (Megatron-LM GPT state-dict
 naming, per-head-interleaved fused qkv), plus the bert/distilbert encoder
@@ -169,6 +170,38 @@ def config_from_hf(hf_cfg) -> TransformerConfig:
         return _llama_like_config(get, attn_qkv_bias=bias, attn_out_bias=bias)
     if mt == "qwen2":
         return _llama_like_config(get, attn_qkv_bias=True)
+    if mt == "qwen3":
+        # qwen2 minus qkv bias, plus per-head q/k RMSNorm and a decoupled
+        # head_dim (always 128 regardless of hidden/heads)
+        head_dim = get("head_dim", None)
+        derived = get("hidden_size") // get("num_attention_heads")
+        return _llama_like_config(
+            get,
+            qk_norm=True,
+            head_dim_override=int(head_dim) if head_dim is not None and int(head_dim) != derived else None,
+        )
+    if mt == "qwen3_moe":
+        sparse_step = get("decoder_sparse_step", 1)
+        mlp_only = get("mlp_only_layers", []) or []
+        if sparse_step != 1 or mlp_only:
+            raise ValueError(
+                f"qwen3_moe: decoder_sparse_step={sparse_step}, mlp_only_layers="
+                f"{mlp_only} — only uniform MoE stacks are supported"
+            )
+        head_dim = get("head_dim", None)
+        derived = get("hidden_size") // get("num_attention_heads")
+        return _llama_like_config(
+            get,
+            qk_norm=True,
+            head_dim_override=int(head_dim) if head_dim is not None and int(head_dim) != derived else None,
+            ffn_hidden_size=get("moe_intermediate_size"),
+            n_experts=get("num_experts"),
+            moe_top_k=get("num_experts_per_tok"),
+            moe_norm_topk_prob=bool(get("norm_topk_prob", True)),
+            # drop-free (HF semantics) — same capacity stance as qwen2_moe
+            moe_capacity_factor=float(get("num_experts")) / float(get("num_experts_per_tok")),
+            moe_aux_loss_coef=float(get("router_aux_loss_coef", 0.001)),
+        )
     if mt == "qwen2_moe":
         sparse_step = get("decoder_sparse_step", 1)
         mlp_only = get("mlp_only_layers", []) or []
@@ -627,7 +660,7 @@ def config_from_hf(hf_cfg) -> TransformerConfig:
         f"unsupported model_type {mt!r}; supported: llama, mistral, qwen2, "
         "qwen2_moe, mixtral, falcon, phi, phi3, gpt2, gpt_neo, opt, gemma, "
         "bloom, gptj, gpt_neox, internlm, stablelm, starcoder2, "
-        "megatron_gpt, bert, distilbert, clip_text_model"
+        "qwen3, qwen3_moe, megatron_gpt, bert, distilbert, clip_text_model"
     )
 
 
@@ -661,6 +694,9 @@ def _llama_layer(take: _Taker, cfg: TransformerConfig, p: str, layers: Dict[str,
         layers["wv_b"].append(take(f"{p}.self_attn.v_proj.bias"))
     if cfg.attn_out_bias:
         layers["wo_b"].append(take(f"{p}.self_attn.o_proj.bias"))
+    if cfg.qk_norm:
+        layers["q_norm"].append(take(f"{p}.self_attn.q_norm.weight"))
+        layers["k_norm"].append(take(f"{p}.self_attn.k_norm.weight"))
     if cfg.n_experts > 0:
         # qwen2-moe: router gate [E, h] + per-expert FFNs + shared expert
         layers["router"].append(take.linear(f"{p}.mlp.gate.weight"))
@@ -1037,6 +1073,8 @@ _LAYER_EXTRACTORS: Dict[str, Callable] = {
     "mistral": _llama_layer,
     "qwen2": _llama_layer,
     "qwen2_moe": _llama_layer,
+    "qwen3": _llama_layer,
+    "qwen3_moe": _llama_layer,
     "falcon": _falcon_layer,
     "phi": _phi_layer,
     "phi3": _phi3_layer,
@@ -1063,6 +1101,8 @@ _TOPLEVEL_KEYS: Dict[str, Tuple[str, str, str, Optional[str]]] = {
     "mistral": ("model.embed_tokens.weight", "model.norm", "model.layers", None),
     "qwen2": ("model.embed_tokens.weight", "model.norm", "model.layers", None),
     "qwen2_moe": ("model.embed_tokens.weight", "model.norm", "model.layers", None),
+    "qwen3": ("model.embed_tokens.weight", "model.norm", "model.layers", None),
+    "qwen3_moe": ("model.embed_tokens.weight", "model.norm", "model.layers", None),
     "phi3": ("model.embed_tokens.weight", "model.norm", "model.layers", None),
     "phi": ("model.embed_tokens.weight", "model.final_layernorm", "model.layers", None),
     "falcon": ("transformer.word_embeddings.weight", "transformer.ln_f", "transformer.h", None),
@@ -1108,6 +1148,8 @@ def _expected_layer_keys(cfg: TransformerConfig) -> Dict[str, list]:
         keys += ["wq_b", "wk_b", "wv_b"]
     if cfg.attn_out_bias:
         keys.append("wo_b")
+    if cfg.qk_norm:
+        keys += ["q_norm", "k_norm"]
     if cfg.mlp_bias and cfg.n_experts == 0:
         keys += ["w_up_b", "w_down_b"] + (["w_gate_b"] if cfg.activation in ("swiglu", "geglu") else [])
     if cfg.n_experts > 0:

@@ -82,6 +82,9 @@ class TransformerConfig:
     # decoupled head dim (mistral-nemo / qwen3 style): projections become
     # [h, n_heads*head_dim] with head_dim != h/n_heads
     head_dim_override: Optional[int] = None
+    # qwen3-style per-head q/k RMSNorm over head_dim, applied to the
+    # head-reshaped projections BEFORE rope (layer weights q_norm/k_norm [d])
+    qk_norm: bool = False
     attn_qkv_bias: bool = False  # qwen2-style bias on q/k/v projections
     attn_out_bias: bool = False  # phi-style bias on the output projection
     mlp_bias: bool = False  # phi-style bias on MLP projections
@@ -299,6 +302,9 @@ def init_params(config: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
         layers["wq_b"] = jnp.zeros((L, nh * d), dtype)
         layers["wk_b"] = jnp.zeros((L, nkv * d), dtype)
         layers["wv_b"] = jnp.zeros((L, nkv * d), dtype)
+    if c.qk_norm:
+        layers["q_norm"] = jnp.ones((L, d), dtype)
+        layers["k_norm"] = jnp.ones((L, d), dtype)
     if c.attn_out_bias:
         layers["wo_b"] = jnp.zeros((L, h), dtype)
     if c.n_experts > 0:
@@ -388,6 +394,10 @@ def param_partition_specs(config: TransformerConfig) -> Dict[str, Any]:
         layers["wq_b"] = P(None, m)
         layers["wk_b"] = P(None, m)
         layers["wv_b"] = P(None, m)
+    if c.qk_norm:
+        # per-head-dim norms are head-count-free: replicated
+        layers["q_norm"] = P(None, None)
+        layers["k_norm"] = P(None, None)
     if c.attn_out_bias:
         layers["wo_b"] = P(None, None)  # row-parallel bias: replicated
     if c.n_experts > 0:
@@ -771,6 +781,13 @@ def _attention_block(c: TransformerConfig, lp, x, positions, segment_ids, kv_cac
     q = q.reshape(b, s, nh, d).transpose(0, 2, 1, 3)
     k = k.reshape(b, s, nkv, d).transpose(0, 2, 1, 3)
     v = v.reshape(b, s, nkv, d).transpose(0, 2, 1, 3)
+    if c.qk_norm:
+        # qwen3: per-head RMSNorm over head_dim before rope ([b, h, s, d] is
+        # not the fused kernel's row layout — the jnp form fuses fine in XLA)
+        from deepspeed_tpu.ops.normalization.fused_norm import rms_norm_reference
+
+        q = rms_norm_reference(q, lp["q_norm"], c.norm_eps)
+        k = rms_norm_reference(k, lp["k_norm"], c.norm_eps)
     if c.position == "rope":
         # seq len: the LIVE sequence length (HF's max(position_ids)+1) — in
         # decode that is cache fill + this block, traced; else the static s

@@ -340,6 +340,32 @@ def tiny_mistral_window(tmp_path_factory):
 
 
 @pytest.fixture(scope="module")
+def tiny_qwen3(tmp_path_factory):
+    # per-head q/k RMSNorm + decoupled head_dim, no qkv bias
+    return _save_tiny(
+        tmp_path_factory, "hf_qwen3",
+        transformers.Qwen3Config, transformers.Qwen3ForCausalLM,
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=24, max_position_embeddings=128, tie_word_embeddings=False,
+    )
+
+
+@pytest.fixture(scope="module")
+def tiny_qwen3_moe(tmp_path_factory):
+    return _save_tiny(
+        tmp_path_factory, "hf_qwen3_moe",
+        transformers.Qwen3MoeConfig, transformers.Qwen3MoeForCausalLM,
+        vocab_size=256, hidden_size=64, moe_intermediate_size=48,
+        num_experts=4, num_experts_per_tok=2, norm_topk_prob=True,
+        decoder_sparse_step=1, mlp_only_layers=[],
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=24, max_position_embeddings=128, tie_word_embeddings=False,
+        output_router_logits=False,
+    )
+
+
+@pytest.fixture(scope="module")
 def tiny_bert(tmp_path_factory):
     # post-LN bidirectional encoder + token types + masked-LM head
     return _save_tiny(
@@ -453,13 +479,22 @@ _FIXTURES = {
     "mistral_window": "tiny_mistral_window",
     "bert": "tiny_bert",
     "distilbert": "tiny_distilbert",
+    "qwen3": "tiny_qwen3",
+    "qwen3_moe": "tiny_qwen3_moe",
 }
 
 # gpt_neo's attn_scale=1.0 skips the 1/sqrt(d) shrink and bert's post-LN
 # renormalizes every residual add, so XLA:CPU's reduced-precision fp32
 # matmuls leave ~1.5x more absolute noise in the logits (exact-precision
 # parity is ~3e-6 / 2e-7 — verified while landing the arches)
-_ATOL_OVERRIDES = {"gpt_neo": 6e-3, "bert": 6e-3, "distilbert": 6e-3}
+_ATOL_OVERRIDES = {
+    "gpt_neo": 6e-3,
+    "bert": 6e-3,
+    "distilbert": 6e-3,
+    # reduced-precision CPU matmuls perturb the router softmax enough to
+    # shift expert mixing weights (exact-precision parity is 7e-7)
+    "qwen3_moe": 2e-2,
+}
 
 
 def _logits_parity(hf_model, path, atol=2e-3):
@@ -778,6 +813,11 @@ def test_logits_parity(arch, request):
         assert not cfg.attn_causal and cfg.norm_scheme == "post"
         assert cfg.mlm_head and not cfg.final_norm and cfg.embed_norm
         assert cfg.type_vocab_size == (2 if arch == "bert" else 0)
+    elif arch == "qwen3":
+        assert cfg.qk_norm and not cfg.attn_qkv_bias and cfg.head_dim == 24
+    elif arch == "qwen3_moe":
+        assert cfg.qk_norm and cfg.n_experts == 4 and cfg.moe_norm_topk_prob
+        assert cfg.moe_shared_expert_dim == 0
 
 
 @pytest.mark.parametrize(
@@ -821,7 +861,28 @@ def test_train_step_through_initialize(arch, request, devices8):
     assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
 
 
-@pytest.mark.parametrize("arch", ["qwen2", "phi"])
+def test_qwen3_serves_v2_paged(request):
+    """qwen3's per-head q/k RMSNorm must run in the PAGED layer body too
+    (skipping it would silently diverge from the dense forward): greedy
+    parity, v2 engine vs forward()."""
+    hf_model, path = request.getfixturevalue("tiny_qwen3")
+    from deepspeed_tpu.inference.v2.engine_factory import build_hf_engine
+
+    engine = build_hf_engine(path, {
+        "dtype": "float32",
+        "kv_cache": {"block_size": 16, "num_blocks": 64, "max_blocks_per_seq": 8},
+        "state_manager": {"max_ragged_batch_size": 64, "max_ragged_sequence_count": 4},
+    })
+    prompt = np.random.default_rng(5).integers(0, 256, size=(1, 12)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf_model.generate(
+            torch.tensor(prompt, dtype=torch.long), max_new_tokens=6, do_sample=False
+        ).numpy()[0]
+    out = np.asarray(engine.generate([prompt[0]], max_new_tokens=6)[0])
+    np.testing.assert_array_equal(out[: len(ref)], ref)
+
+
+@pytest.mark.parametrize("arch", ["qwen2", "phi", "qwen3"])
 def test_generate_through_inference_engine(arch, request):
     """init_inference path: checkpoint dir → v1 engine → generate."""
     _, path = request.getfixturevalue(_FIXTURES[arch])
