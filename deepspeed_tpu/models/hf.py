@@ -248,11 +248,9 @@ def config_from_hf(hf_cfg) -> TransformerConfig:
             moe_aux_loss_coef=float(get("router_aux_loss_coef", 0.001)),
         )
     if mt == "stablelm":
-        if get("qk_layernorm", False):
-            # stablelm-2-12b class: per-head q/k norms change the math —
-            # silently dropping the weights would return wrong logits
-            raise ValueError("stablelm: qk_layernorm checkpoints are not supported")
         return TransformerConfig(
+            qk_norm=bool(get("qk_layernorm", False)),
+            qk_norm_kind="layernorm_per_head",
             vocab_size=get("vocab_size"),
             hidden_size=get("hidden_size"),
             n_layers=get("num_hidden_layers"),
@@ -879,6 +877,15 @@ def _stablelm_layer(take: _Taker, cfg: TransformerConfig, p: str, layers: Dict[s
         if cfg.attn_qkv_bias:
             layers[f"{name}_b"].append(take(f"{p}.self_attn.{hf}.bias"))
     layers["wo"].append(take.linear(f"{p}.self_attn.o_proj.weight"))
+    if cfg.qk_norm:
+        # stablelm-2 qk_layernorm: a ModuleList of biasless per-head
+        # LayerNorms — stack the [d] weights into [n_heads, d]
+        layers["q_norm"].append(
+            np.stack([take(f"{p}.self_attn.q_layernorm.norms.{h}.weight") for h in range(cfg.n_heads)])
+        )
+        layers["k_norm"].append(
+            np.stack([take(f"{p}.self_attn.k_layernorm.norms.{h}.weight") for h in range(cfg.kv_heads)])
+        )
     layers["w_gate"].append(take.linear(f"{p}.mlp.gate_proj.weight"))
     layers["w_up"].append(take.linear(f"{p}.mlp.up_proj.weight"))
     layers["w_down"].append(take.linear(f"{p}.mlp.down_proj.weight"))

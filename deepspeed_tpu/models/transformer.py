@@ -82,9 +82,12 @@ class TransformerConfig:
     # decoupled head dim (mistral-nemo / qwen3 style): projections become
     # [h, n_heads*head_dim] with head_dim != h/n_heads
     head_dim_override: Optional[int] = None
-    # qwen3-style per-head q/k RMSNorm over head_dim, applied to the
-    # head-reshaped projections BEFORE rope (layer weights q_norm/k_norm [d])
+    # q/k normalization over head_dim, applied to the head-reshaped
+    # projections BEFORE rope. "rmsnorm" (qwen3): one [d] weight per layer
+    # shared across heads. "layernorm_per_head" (stablelm-2 qk_layernorm):
+    # biasless LayerNorm with PER-HEAD weights ([nh, d] / [nkv, d]).
     qk_norm: bool = False
+    qk_norm_kind: str = "rmsnorm"
     attn_qkv_bias: bool = False  # qwen2-style bias on q/k/v projections
     attn_out_bias: bool = False  # phi-style bias on the output projection
     mlp_bias: bool = False  # phi-style bias on MLP projections
@@ -181,6 +184,11 @@ class TransformerConfig:
     def __post_init__(self):
         if self.norm_scheme not in ("pre", "post"):
             raise ValueError(f"norm_scheme={self.norm_scheme!r}: expected 'pre' or 'post'")
+        if self.qk_norm_kind not in ("rmsnorm", "layernorm_per_head"):
+            raise ValueError(
+                f"qk_norm_kind={self.qk_norm_kind!r}: expected 'rmsnorm' or "
+                "'layernorm_per_head'"
+            )
         if self.position == "alibi" and (self.sliding_window > 0 or self.attn_scale is not None):
             # the alibi training branch rides the flash kernel's rank-1 bias
             # and takes no window/scale — silently ignoring them would train
@@ -303,8 +311,12 @@ def init_params(config: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
         layers["wk_b"] = jnp.zeros((L, nkv * d), dtype)
         layers["wv_b"] = jnp.zeros((L, nkv * d), dtype)
     if c.qk_norm:
-        layers["q_norm"] = jnp.ones((L, d), dtype)
-        layers["k_norm"] = jnp.ones((L, d), dtype)
+        if c.qk_norm_kind == "layernorm_per_head":
+            layers["q_norm"] = jnp.ones((L, nh, d), dtype)
+            layers["k_norm"] = jnp.ones((L, nkv, d), dtype)
+        else:
+            layers["q_norm"] = jnp.ones((L, d), dtype)
+            layers["k_norm"] = jnp.ones((L, d), dtype)
     if c.attn_out_bias:
         layers["wo_b"] = jnp.zeros((L, h), dtype)
     if c.n_experts > 0:
@@ -395,9 +407,14 @@ def param_partition_specs(config: TransformerConfig) -> Dict[str, Any]:
         layers["wk_b"] = P(None, m)
         layers["wv_b"] = P(None, m)
     if c.qk_norm:
-        # per-head-dim norms are head-count-free: replicated
-        layers["q_norm"] = P(None, None)
-        layers["k_norm"] = P(None, None)
+        if c.qk_norm_kind == "layernorm_per_head":
+            # per-head weights shard with the heads (column-parallel q/k)
+            layers["q_norm"] = P(None, m, None)
+            layers["k_norm"] = P(None, m, None)
+        else:
+            # head-count-free [d] weights: replicated
+            layers["q_norm"] = P(None, None)
+            layers["k_norm"] = P(None, None)
     if c.attn_out_bias:
         layers["wo_b"] = P(None, None)  # row-parallel bias: replicated
     if c.n_experts > 0:
@@ -756,6 +773,25 @@ def _proj(c: TransformerConfig, x, w):
     return qmatmul(x, w, c.matmul_precision)
 
 
+def qk_norm_apply(c: TransformerConfig, x, w, head_axis: int):
+    """THE q/k-norm application, shared by the training/decode attention
+    block and both v2 paged layer bodies. x: [..., d] with a head axis at
+    ``head_axis``; w: [d] (qwen3 rmsnorm, shared across heads) or [n_heads,
+    d] (stablelm-2 biasless per-head LayerNorm)."""
+    if c.qk_norm_kind == "rmsnorm":
+        from deepspeed_tpu.ops.normalization.fused_norm import rms_norm_reference
+
+        return rms_norm_reference(x, w, c.norm_eps)
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + c.norm_eps)
+    shape = [1] * x.ndim
+    shape[head_axis] = w.shape[0]
+    shape[-1] = w.shape[1]
+    return (y * w.astype(jnp.float32).reshape(shape)).astype(x.dtype)
+
+
 def _window_bias(c: TransformerConfig, q_glob, k_pos, local_flag):
     """[sq, sk] fp32 additive bias masking keys ≥ sliding_window behind the
     query (band convention shared via ops.attention.core.window_too_far).
@@ -782,12 +818,9 @@ def _attention_block(c: TransformerConfig, lp, x, positions, segment_ids, kv_cac
     k = k.reshape(b, s, nkv, d).transpose(0, 2, 1, 3)
     v = v.reshape(b, s, nkv, d).transpose(0, 2, 1, 3)
     if c.qk_norm:
-        # qwen3: per-head RMSNorm over head_dim before rope ([b, h, s, d] is
-        # not the fused kernel's row layout — the jnp form fuses fine in XLA)
-        from deepspeed_tpu.ops.normalization.fused_norm import rms_norm_reference
-
-        q = rms_norm_reference(q, lp["q_norm"], c.norm_eps)
-        k = rms_norm_reference(k, lp["k_norm"], c.norm_eps)
+        # qwen3 rmsnorm / stablelm-2 per-head layernorm, before rope
+        q = qk_norm_apply(c, q, lp["q_norm"], head_axis=1)
+        k = qk_norm_apply(c, k, lp["k_norm"], head_axis=1)
     if c.position == "rope":
         # seq len: the LIVE sequence length (HF's max(position_ids)+1) — in
         # decode that is cache fill + this block, traced; else the static s

@@ -861,6 +861,31 @@ def test_train_step_through_initialize(arch, request, devices8):
     assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
 
 
+def test_stablelm2_qk_layernorm_parity(tmp_path_factory):
+    """stablelm-2-12b class: per-head biasless q/k LayerNorms (previously a
+    hard refusal) import as qk_norm_kind='layernorm_per_head'. HF's own
+    _init_weights crashes on the biasless norms, so the tiny checkpoint is
+    built with no_init_weights + manual randomization."""
+    from transformers.modeling_utils import no_init_weights
+
+    cfg_t = transformers.StableLmConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        qk_layernorm=True, partial_rotary_factor=0.25,
+        max_position_embeddings=128, tie_word_embeddings=False,
+    )
+    with no_init_weights():
+        model = transformers.StableLmForCausalLM(cfg_t)
+    torch.manual_seed(0)
+    for p in model.parameters():
+        p.data.normal_(0, 0.05)
+    model = model.eval()
+    path = str(tmp_path_factory.mktemp("hf_stablelm_qk"))
+    model.save_pretrained(path)
+    cfg, _ = _logits_parity(model, path)
+    assert cfg.qk_norm and cfg.qk_norm_kind == "layernorm_per_head"
+
+
 def test_qwen3_serves_v2_paged(request):
     """qwen3's per-head q/k RMSNorm must run in the PAGED layer body too
     (skipping it would silently diverge from the dense forward): greedy
