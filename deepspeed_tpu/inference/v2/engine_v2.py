@@ -217,8 +217,8 @@ class InferenceEngineV2:
                 k = k.reshape(1, t_, nkv, d).transpose(0, 2, 1, 3)
                 v = v.reshape(1, t_, nkv, d).transpose(0, 2, 1, 3)
                 if c.qk_norm:
-                    q = T.qk_norm_apply(c, q, lp["q_norm"], head_axis=1)
-                    k = T.qk_norm_apply(c, k, lp["k_norm"], head_axis=1)
+                    q = T.qk_norm_apply(c, q, lp["q_norm"], head_axis=1, b=lp.get("q_norm_b"))
+                    k = T.qk_norm_apply(c, k, lp["k_norm"], head_axis=1, b=lp.get("k_norm_b"))
                 if c.position == "rope":
                     # live length (HF max(position_ids)+1) from the VALID
                     # tokens only — positions covers the padded bucket tail,
@@ -303,8 +303,8 @@ class InferenceEngineV2:
         k = k.reshape(t, nkv, d)
         v = v.reshape(t, nkv, d)
         if c.qk_norm:
-            q = T.qk_norm_apply(c, q, lp["q_norm"], head_axis=1)
-            k = T.qk_norm_apply(c, k, lp["k_norm"], head_axis=1)
+            q = T.qk_norm_apply(c, q, lp["q_norm"], head_axis=1, b=lp.get("q_norm_b"))
+            k = T.qk_norm_apply(c, k, lp["k_norm"], head_axis=1, b=lp.get("k_norm_b"))
         if c.position == "rope":
             q = T._rope(q.transpose(1, 0, 2)[None], positions[None], c, live)[0].transpose(1, 0, 2)
             k = T._rope(k.transpose(1, 0, 2)[None], positions[None], c, live)[0].transpose(1, 0, 2)

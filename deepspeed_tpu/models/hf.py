@@ -478,8 +478,6 @@ def config_from_hf(hf_cfg) -> TransformerConfig:
             mlp_bias=bool(get("bias", False)),
         )
     if mt == "phi":
-        if get("qk_layernorm", False):
-            raise ValueError("phi: qk_layernorm checkpoints are not supported")
         act = get("hidden_act", "gelu_new")
         if act not in ("gelu_new", "gelu_pytorch_tanh"):
             # a 'gelu' (erf) checkpoint would silently load with tanh GELU
@@ -505,6 +503,10 @@ def config_from_hf(hf_cfg) -> TransformerConfig:
             mlp_bias=True,
             lm_head_bias=True,
             rope_frac=float(get("partial_rotary_factor", 0.5)),
+            # phi-1/2 qk_layernorm: one affine LayerNorm(head_dim) shared
+            # across heads
+            qk_norm=bool(get("qk_layernorm", False)),
+            qk_norm_kind="layernorm",
         )
     if mt == "phi3":
         return _llama_like_config(get)
@@ -797,6 +799,11 @@ def _phi_layer(take: _Taker, cfg: TransformerConfig, p: str, layers: Dict[str, l
         layers[f"{name}_b"].append(take(f"{p}.self_attn.{hf}.bias"))
     layers["wo"].append(take.linear(f"{p}.self_attn.dense.weight"))
     layers["wo_b"].append(take(f"{p}.self_attn.dense.bias"))
+    if cfg.qk_norm:
+        layers["q_norm"].append(take(f"{p}.self_attn.q_layernorm.weight"))
+        layers["q_norm_b"].append(take(f"{p}.self_attn.q_layernorm.bias"))
+        layers["k_norm"].append(take(f"{p}.self_attn.k_layernorm.weight"))
+        layers["k_norm_b"].append(take(f"{p}.self_attn.k_layernorm.bias"))
     layers["w_up"].append(take.linear(f"{p}.mlp.fc1.weight"))
     layers["w_up_b"].append(take(f"{p}.mlp.fc1.bias"))
     layers["w_down"].append(take.linear(f"{p}.mlp.fc2.weight"))
@@ -1157,6 +1164,8 @@ def _expected_layer_keys(cfg: TransformerConfig) -> Dict[str, list]:
         keys.append("wo_b")
     if cfg.qk_norm:
         keys += ["q_norm", "k_norm"]
+        if cfg.qk_norm_kind == "layernorm":
+            keys += ["q_norm_b", "k_norm_b"]
     if cfg.mlp_bias and cfg.n_experts == 0:
         keys += ["w_up_b", "w_down_b"] + (["w_gate_b"] if cfg.activation in ("swiglu", "geglu") else [])
     if cfg.n_experts > 0:

@@ -86,6 +86,8 @@ class TransformerConfig:
     # projections BEFORE rope. "rmsnorm" (qwen3): one [d] weight per layer
     # shared across heads. "layernorm_per_head" (stablelm-2 qk_layernorm):
     # biasless LayerNorm with PER-HEAD weights ([nh, d] / [nkv, d]).
+    # "layernorm" (phi qk_layernorm): one affine LayerNorm ([d] weight +
+    # bias) shared across heads.
     qk_norm: bool = False
     qk_norm_kind: str = "rmsnorm"
     attn_qkv_bias: bool = False  # qwen2-style bias on q/k/v projections
@@ -184,10 +186,10 @@ class TransformerConfig:
     def __post_init__(self):
         if self.norm_scheme not in ("pre", "post"):
             raise ValueError(f"norm_scheme={self.norm_scheme!r}: expected 'pre' or 'post'")
-        if self.qk_norm_kind not in ("rmsnorm", "layernorm_per_head"):
+        if self.qk_norm_kind not in ("rmsnorm", "layernorm", "layernorm_per_head"):
             raise ValueError(
-                f"qk_norm_kind={self.qk_norm_kind!r}: expected 'rmsnorm' or "
-                "'layernorm_per_head'"
+                f"qk_norm_kind={self.qk_norm_kind!r}: expected 'rmsnorm', "
+                "'layernorm' or 'layernorm_per_head'"
             )
         if self.position == "alibi" and (self.sliding_window > 0 or self.attn_scale is not None):
             # the alibi training branch rides the flash kernel's rank-1 bias
@@ -317,6 +319,9 @@ def init_params(config: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
         else:
             layers["q_norm"] = jnp.ones((L, d), dtype)
             layers["k_norm"] = jnp.ones((L, d), dtype)
+            if c.qk_norm_kind == "layernorm":
+                layers["q_norm_b"] = jnp.zeros((L, d), dtype)
+                layers["k_norm_b"] = jnp.zeros((L, d), dtype)
     if c.attn_out_bias:
         layers["wo_b"] = jnp.zeros((L, h), dtype)
     if c.n_experts > 0:
@@ -415,6 +420,9 @@ def param_partition_specs(config: TransformerConfig) -> Dict[str, Any]:
             # head-count-free [d] weights: replicated
             layers["q_norm"] = P(None, None)
             layers["k_norm"] = P(None, None)
+            if c.qk_norm_kind == "layernorm":
+                layers["q_norm_b"] = P(None, None)
+                layers["k_norm_b"] = P(None, None)
     if c.attn_out_bias:
         layers["wo_b"] = P(None, None)  # row-parallel bias: replicated
     if c.n_experts > 0:
@@ -773,11 +781,12 @@ def _proj(c: TransformerConfig, x, w):
     return qmatmul(x, w, c.matmul_precision)
 
 
-def qk_norm_apply(c: TransformerConfig, x, w, head_axis: int):
+def qk_norm_apply(c: TransformerConfig, x, w, head_axis: int, b=None):
     """THE q/k-norm application, shared by the training/decode attention
     block and both v2 paged layer bodies. x: [..., d] with a head axis at
-    ``head_axis``; w: [d] (qwen3 rmsnorm, shared across heads) or [n_heads,
-    d] (stablelm-2 biasless per-head LayerNorm)."""
+    ``head_axis``; w: [d] (qwen3 rmsnorm / phi affine layernorm, shared
+    across heads) or [n_heads, d] (stablelm-2 biasless per-head LayerNorm);
+    ``b``: [d] bias for the phi form."""
     if c.qk_norm_kind == "rmsnorm":
         from deepspeed_tpu.ops.normalization.fused_norm import rms_norm_reference
 
@@ -786,6 +795,11 @@ def qk_norm_apply(c: TransformerConfig, x, w, head_axis: int):
     mu = jnp.mean(xf, axis=-1, keepdims=True)
     var = jnp.var(xf, axis=-1, keepdims=True)
     y = (xf - mu) * jax.lax.rsqrt(var + c.norm_eps)
+    if c.qk_norm_kind == "layernorm":  # shared affine (phi)
+        y = y * w.astype(jnp.float32)
+        if b is not None:
+            y = y + b.astype(jnp.float32)
+        return y.astype(x.dtype)
     shape = [1] * x.ndim
     shape[head_axis] = w.shape[0]
     shape[-1] = w.shape[1]
@@ -818,9 +832,9 @@ def _attention_block(c: TransformerConfig, lp, x, positions, segment_ids, kv_cac
     k = k.reshape(b, s, nkv, d).transpose(0, 2, 1, 3)
     v = v.reshape(b, s, nkv, d).transpose(0, 2, 1, 3)
     if c.qk_norm:
-        # qwen3 rmsnorm / stablelm-2 per-head layernorm, before rope
-        q = qk_norm_apply(c, q, lp["q_norm"], head_axis=1)
-        k = qk_norm_apply(c, k, lp["k_norm"], head_axis=1)
+        # qwen3 rmsnorm / phi affine layernorm / stablelm-2 per-head, pre-rope
+        q = qk_norm_apply(c, q, lp["q_norm"], head_axis=1, b=lp.get("q_norm_b"))
+        k = qk_norm_apply(c, k, lp["k_norm"], head_axis=1, b=lp.get("k_norm_b"))
     if c.position == "rope":
         # seq len: the LIVE sequence length (HF's max(position_ids)+1) — in
         # decode that is cache fill + this block, traced; else the static s

@@ -861,6 +861,20 @@ def test_train_step_through_initialize(arch, request, devices8):
     assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
 
 
+def test_phi_qk_layernorm_parity(tmp_path_factory):
+    """phi-1/2 qk_layernorm (one affine LayerNorm(head_dim) shared across
+    heads — previously a hard refusal) imports as qk_norm_kind='layernorm'."""
+    hf_model, path = _save_tiny(
+        tmp_path_factory, "hf_phi_qk",
+        transformers.PhiConfig, transformers.PhiForCausalLM,
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, qk_layernorm=True,
+        partial_rotary_factor=0.5, max_position_embeddings=128,
+    )
+    cfg, _ = _logits_parity(hf_model, path)
+    assert cfg.qk_norm and cfg.qk_norm_kind == "layernorm"
+
+
 def test_stablelm2_qk_layernorm_parity(tmp_path_factory):
     """stablelm-2-12b class: per-head biasless q/k LayerNorms (previously a
     hard refusal) import as qk_norm_kind='layernorm_per_head'. HF's own
