@@ -8,9 +8,9 @@ a (:class:`TransformerConfig`, stacked-params pytree) pair that trains or
 serves through ``deepspeed_tpu.initialize`` / ``init_inference`` unchanged.
 
 Supported ``model_type``s: llama, mistral, qwen2, qwen2_moe, qwen3,
-qwen3_moe (per-head q/k RMSNorm), mixtral,
-falcon, phi, phi3, gpt2, gpt_neo, opt, gemma, bloom, gptj, gpt_neox,
-internlm, stablelm, starcoder2, megatron_gpt (Megatron-LM GPT state-dict
+qwen3_moe (per-head q/k RMSNorm), mixtral, falcon, phi (incl. qk_layernorm),
+phi3, gpt2, gpt_neo, opt, gemma, bloom, gptj, gpt_neox, internlm, stablelm
+(incl. qk_layernorm), starcoder2, megatron_gpt (Megatron-LM GPT state-dict
 naming, per-head-interleaved fused qkv), plus the bert/distilbert encoder
 family (post-LN bidirectional stack + masked-LM head) and clip_text_model
 (the stable-diffusion text tower; unet/vae are N/A here — diffusers is not
