@@ -43,12 +43,7 @@ class InferenceEngineV2:
                 "v2 paged engine: alibi (bloom) is not supported — the paged "
                 "attention kernel takes no bias; serve bloom through the v1 engine"
             )
-        if model_config.attn_layer_pattern is not None or model_config.attn_scale is not None:
-            raise NotImplementedError(
-                "v2 paged engine: alternating local/global layer patterns and "
-                "scale-override attention (gpt_neo) are not supported — serve "
-                "through the v1 engine (uniform sliding windows ARE supported)"
-            )
+
         if not model_config.attn_causal:
             raise ValueError(
                 "v2 paged engine: encoder models (attn_causal=False) do not "
@@ -249,7 +244,8 @@ class InferenceEngineV2:
                 bias = jnp.where(mask, 0.0, -1e30).astype(jnp.float32)[None, None]
                 from deepspeed_tpu.ops.attention import mha_reference
 
-                out = mha_reference(q, k_ctx, v_ctx, causal=False, bias=bias)
+                out = mha_reference(q, k_ctx, v_ctx, causal=False, bias=bias,
+                                    scale=c.attn_scale)
                 out = out.transpose(0, 2, 1, 3).reshape(1, t_, nh * d)
                 attn_out = out @ lp["wo"]
                 if c.attn_out_bias:
@@ -273,13 +269,16 @@ class InferenceEngineV2:
         return jax.jit(row_step, donate_argnums=(5, 6))
 
     # ------------------------------------------------------------------
-    def _paged_layer(self, lp, x, blk, row, tok_tables, positions, live, kc_l, vc_l):
+    def _paged_layer(self, lp, x, blk, row, tok_tables, positions, live, kc_l, vc_l,
+                     window=None):
         """One transformer layer over a packed token batch with paged KV —
         THE decode layer body, shared by the batched SplitFuse step and the
         fused multi-step decode so the two paths cannot drift. x: [1, T, h];
         blk/row/positions: [T]; tok_tables: [T, B]; ``live`` is the traced
-        live sequence length for the rope-scaling switch. Returns
-        (x, kc_l, vc_l)."""
+        live sequence length for the rope-scaling switch. ``window``: static
+        per-CALL sliding window (defaults to the config's uniform window;
+        alternating-pattern stacks pass each layer's own 0-or-window).
+        Returns (x, kc_l, vc_l)."""
         import functools
 
         from deepspeed_tpu.ops.attention.paged_pallas import paged_attention
@@ -287,9 +286,10 @@ class InferenceEngineV2:
         c = self._mc
         dtype = T.DTYPES[c.dtype]
         trash = self.config.kv_cache.num_blocks
+        w = c.sliding_window if window is None else window
         paged = (
-            functools.partial(paged_attention, window=c.sliding_window)
-            if c.sliding_window
+            functools.partial(paged_attention, window=w, scale=c.attn_scale)
+            if (w or c.attn_scale is not None)
             else paged_attention
         )
         nh, nkv, d = c.n_heads, c.kv_heads, c.head_dim
@@ -324,6 +324,34 @@ class InferenceEngineV2:
         m = T._norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"), c.norm, c.norm_eps)
         mlp_out, _ = T._mlp_block(c, lp, m)
         return x + mlp_out, kc_l, vc_l
+
+    def _run_layers(self, params, x, blk, row, tok_tables, positions, live,
+                    k_cache, v_cache):
+        """Drive the layer stack over _paged_layer. Uniform stacks scan;
+        alternating local/global stacks (gpt_neo attn_layer_pattern) unroll
+        into a Python loop so each layer's window is STATIC (the paged
+        kernel takes no traced flag) — compile time grows with depth, which
+        is acceptable for a serving engine."""
+        c = self._mc
+        if c.attn_layer_pattern is None:
+            def layer_step(x, inputs):
+                lp, kc_l, vc_l = inputs
+                x, kc_l, vc_l = self._paged_layer(
+                    lp, x, blk, row, tok_tables, positions, live, kc_l, vc_l
+                )
+                return x, (kc_l, vc_l)
+
+            return jax.lax.scan(layer_step, x, (params["layers"], k_cache, v_cache))
+        for li, flag in enumerate(c.attn_layer_pattern):
+            lp = jax.tree.map(lambda a: a[li], params["layers"])
+            x, kc_l, vc_l = self._paged_layer(
+                lp, x, blk, row, tok_tables, positions, live,
+                k_cache[li], v_cache[li],
+                window=c.sliding_window if flag else 0,
+            )
+            k_cache = k_cache.at[li].set(kc_l)
+            v_cache = v_cache.at[li].set(vc_l)
+        return x, (k_cache, v_cache)
 
     def _build_batched_step(self):
         """ONE compiled step over the whole packed ragged batch (the actual
@@ -361,14 +389,9 @@ class InferenceEngineV2:
             # that would flip the switch early)
             live = jnp.max(positions[last_idx]) + 1
 
-            def layer_step(x, inputs):
-                lp, kc_l, vc_l = inputs
-                x, kc_l, vc_l = self._paged_layer(
-                    lp, x, blk, row, tok_tables, positions, live, kc_l, vc_l
-                )
-                return x, (kc_l, vc_l)
-
-            x, (k_new, v_new) = jax.lax.scan(layer_step, x, (params["layers"], k_cache, v_cache))
+            x, (k_new, v_new) = self._run_layers(
+                params, x, blk, row, tok_tables, positions, live, k_cache, v_cache
+            )
             x = T._norm(x, params["final_norm"], params.get("final_norm_b"), c.norm, c.norm_eps)
             last = x[0, jnp.clip(last_idx, 0, t - 1)]  # [R, h]
             logits = T._apply_lm_head(params, last, c)
@@ -411,14 +434,9 @@ class InferenceEngineV2:
             # live-length switch
             live = jnp.max(jnp.where(active, positions, 0)) + 1
 
-            def layer_step(x, inputs):
-                lp, kc_l, vc_l = inputs
-                x, kc_l, vc_l = self._paged_layer(
-                    lp, x, blk, row, tok_tables, positions, live, kc_l, vc_l
-                )
-                return x, (kc_l, vc_l)
-
-            x, (k_new, v_new) = jax.lax.scan(layer_step, x, (params["layers"], k_cache, v_cache))
+            x, (k_new, v_new) = self._run_layers(
+                params, x, blk, row, tok_tables, positions, live, k_cache, v_cache
+            )
             x = T._norm(x, params["final_norm"], params.get("final_norm_b"), c.norm, c.norm_eps)
             logits = T._apply_lm_head(params, x[0], c)  # [R, vocab]
             return jnp.argmax(logits, axis=-1).astype(jnp.int32), k_new, v_new
@@ -582,6 +600,11 @@ class InferenceEngineV2:
     def _step_per_row(self) -> Dict[int, np.ndarray]:
         """Round-1 execution model (one compiled call per sequence) — kept as
         the baseline the batched step is benchmarked against."""
+        if self._mc.attn_layer_pattern is not None:
+            raise NotImplementedError(
+                "_step_per_row: alternating layer patterns run only through "
+                "the batched step (its unrolled layer loop)"
+            )
         batch = self.scheduler.next_batch()
         self.last_scheduled_tokens = batch.total_tokens if batch is not None else 0
         self.last_capped |= self.scheduler.drain_capped()

@@ -34,7 +34,7 @@ NEG_INF = -1e30
 
 
 def paged_attention_reference(q, k_cache, v_cache, block_tables, q_pos, trash_block,
-                              window: int = 0):
+                              window: int = 0, scale=None):
     """jnp reference: per-token context gather + masked softmax, mapped over
     tokens so peak memory is one context window ([S, nkv, d]) rather than T
     of them. Shapes as module docstring; returns [T, nh, d]. ``window``:
@@ -58,7 +58,7 @@ def paged_attention_reference(q, k_cache, v_cache, block_tables, q_pos, trash_bl
 
             mask = mask & jnp.logical_not(window_too_far(pos, kpos, window))
         qg = qt.reshape(nkv, group, d).astype(jnp.float32)
-        scores = jnp.einsum("ngd,snd->ngs", qg, k_ctx) * (d**-0.5)
+        scores = jnp.einsum("ngd,snd->ngs", qg, k_ctx) * (scale if scale is not None else d**-0.5)
         scores = jnp.where(mask[None, None], scores, NEG_INF)
         w = jax.nn.softmax(scores, axis=-1)
         # fully-masked token (all-trash padding): return 0 like the kernel
@@ -72,13 +72,13 @@ def paged_attention_reference(q, k_cache, v_cache, block_tables, q_pos, trash_bl
 
 def _paged_kernel(
     bt_ref, qpos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, bs, nh, nkv, d,
-    trash, window=0
+    trash, window=0, scale=None
 ):
     t = pl.program_id(0)
     j = pl.program_id(1)
     B = pl.num_programs(1)
     group = nh // nkv
-    scale = d**-0.5
+    scale = scale if scale is not None else d**-0.5
 
     @pl.when(j == 0)
     def _init():
@@ -143,9 +143,11 @@ def paged_attention(
     impl: Optional[str] = None,
     interpret: bool = False,
     window: int = 0,
+    scale: Optional[float] = None,
 ) -> jax.Array:
     """Dispatching entry point (kernel on TPU, reference otherwise).
-    ``window``: static sliding-window band (uniform across layers)."""
+    ``window``: static sliding-window band (uniform across layers);
+    ``scale``: softmax scale override (gpt_neo's unscaled logits)."""
     T, nh, d = q.shape
     NB, bs, nkv, _ = k_cache.shape
     use_kernel = impl == "kernel" or (
@@ -153,7 +155,8 @@ def paged_attention(
     )
     if not use_kernel and not interpret:
         return paged_attention_reference(
-            q, k_cache, v_cache, block_tables, q_pos, trash_block, window=window
+            q, k_cache, v_cache, block_tables, q_pos, trash_block, window=window,
+            scale=scale,
         )
 
     B = block_tables.shape[1]
@@ -173,7 +176,8 @@ def paged_attention(
         ],
     )
     kernel = functools.partial(
-        _paged_kernel, bs=bs, nh=nh, nkv=nkv, d=d, trash=trash_block, window=int(window)
+        _paged_kernel, bs=bs, nh=nh, nkv=nkv, d=d, trash=trash_block,
+        window=int(window), scale=scale,
     )
     return pl.pallas_call(
         kernel,

@@ -900,6 +900,30 @@ def test_stablelm2_qk_layernorm_parity(tmp_path_factory):
     assert cfg.qk_norm and cfg.qk_norm_kind == "layernorm_per_head"
 
 
+@pytest.mark.parametrize("ds", [1, 4])
+def test_gpt_neo_serves_v2_paged(request, ds):
+    """gpt_neo (alternating local/global pattern + unscaled logits) serves
+    through the v2 paged engine: the layer stack unrolls with per-layer
+    STATIC windows and the kernel takes the scale override — greedy parity
+    vs HF at per-step AND fused decode."""
+    hf_model, path = request.getfixturevalue("tiny_gpt_neo")
+    from deepspeed_tpu.inference.v2.engine_factory import build_hf_engine
+
+    engine = build_hf_engine(path, {
+        "dtype": "float32",
+        "decode_steps": ds,
+        "kv_cache": {"block_size": 16, "num_blocks": 64, "max_blocks_per_seq": 8},
+        "state_manager": {"max_ragged_batch_size": 64, "max_ragged_sequence_count": 4},
+    })
+    prompt = np.random.default_rng(9).integers(0, 256, size=(1, 12)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf_model.generate(
+            torch.tensor(prompt, dtype=torch.long), max_new_tokens=8, do_sample=False
+        ).numpy()[0]
+    out = np.asarray(engine.generate([prompt[0]], max_new_tokens=8)[0])
+    np.testing.assert_array_equal(out[: len(ref)], ref)
+
+
 def test_qwen3_serves_v2_paged(request):
     """qwen3's per-head q/k RMSNorm must run in the PAGED layer body too
     (skipping it would silently diverge from the dense forward): greedy
