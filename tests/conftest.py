@@ -28,6 +28,14 @@ jax.config.update("jax_num_cpu_devices", 8)
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "smoke: fast one-test-per-subsystem subset for gates "
+        "(python -m pytest tests/ -m smoke -q, ~3-4 min serial)",
+    )
+
+
 @pytest.fixture(autouse=True)
 def _reset_topology():
     """Fresh topology per test (analogue of dist-env teardown in common.py)."""
