@@ -350,3 +350,43 @@ class Autotuner:
                 )
             lines.append(row + f" {val:>12}")
         return "\n".join(lines)
+
+
+def tune_serving(max_experiments: int = 6, metric: str = "gen_tok_s",
+                 timeout_s: int = 900, space=None, platform=None):
+    """Autotune the v2 serving engine's knobs against generated tok/s
+    (reference ``autotuning_metric`` throughput mode, autotuner.py:42,
+    applied to FastGen). Reuses the training tuner's subprocess scheduler —
+    every candidate runs isolated so an OOM/compile crash is a data point,
+    not a tuner death. Space: fused-round length x prompt-chunk grid x
+    KV block geometry, seeded with the hand-picked bench config first
+    (the tuner must FIND at least that).
+
+    ``space`` replaces the default candidate list entirely (tests use tiny
+    shapes). Returns (best_config, best_gen_tok_s, records)."""
+    from deepspeed_tpu.autotuning.scheduler import SubprocessRunner
+
+    default_space = [
+        # hand-picked bench config first (PERF.md round-5 serving sweep)
+        {"decode_steps": 64, "prompt_chunk": 512, "max_prompt_chunks": 2},
+        {"decode_steps": 32, "prompt_chunk": 512, "max_prompt_chunks": 2},
+        {"decode_steps": 64, "prompt_chunk": 256, "max_prompt_chunks": 4},
+        {"decode_steps": 64, "prompt_chunk": 512, "max_prompt_chunks": 2,
+         "token_budget": 2048},
+        {"decode_steps": 64, "prompt_chunk": 512, "max_prompt_chunks": 2,
+         "block_size": 256, "num_blocks": 256, "max_blocks_per_seq": 4},
+        {"decode_steps": 128, "prompt_chunk": 512, "max_prompt_chunks": 2,
+         "max_new": 128},
+    ]
+    if space is None:
+        space = default_space
+    runner = SubprocessRunner(metric=metric, timeout_s=timeout_s, platform=platform)
+    best, best_val, records = None, None, []
+    for exp in space[:max_experiments]:
+        payload = dict(exp)
+        payload["mode"] = "serving"
+        val = runner(payload)
+        records.append((dict(exp), val))
+        if val is not None and (best_val is None or val > best_val):
+            best, best_val = dict(exp), val
+    return best, best_val, records

@@ -21,7 +21,72 @@ import sys
 import time
 
 
+def run_serving(exp: dict) -> dict:
+    """Serving-throughput experiment (reference ``autotuning_metric``
+    throughput mode, autotuning/autotuner.py:42, pointed at the v2 engine):
+    measure generated tok/s of the FastGen-analogue workload (32 concurrent
+    sequences, mixed prompt lengths, 64 new tokens) under the given
+    scheduler/engine knobs."""
+    if exp.get("platform") == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["PALLAS_AXON_POOL_IPS"] = ""
+
+    import jax
+
+    if exp.get("platform") == "cpu":
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+    import numpy as np
+
+    from deepspeed_tpu.inference.config import RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.models import TransformerConfig, init_params
+
+    shape = dict(exp.get("shape") or {})
+    if not shape:
+        shape = dict(  # the bench 767M serving shape
+            vocab_size=32000, hidden_size=2304, n_layers=10, n_heads=18,
+            n_kv_heads=6, ffn_hidden_size=6912, max_seq_len=2048,
+            dtype="bfloat16",
+        )
+    cfg = TransformerConfig(**shape)
+    params = init_params(cfg, jax.random.key(0))
+    rc = RaggedInferenceEngineConfig.from_dict({
+        "dtype": cfg.dtype,
+        "decode_steps": int(exp.get("decode_steps", 64)),
+        "prompt_chunk": int(exp.get("prompt_chunk", 0)),
+        "max_prompt_chunks": int(exp.get("max_prompt_chunks", 0)),
+        "kv_cache": {
+            "block_size": int(exp.get("block_size", 128)),
+            "num_blocks": int(exp.get("num_blocks", 512)),
+            "max_blocks_per_seq": int(exp.get("max_blocks_per_seq", 8)),
+        },
+        "state_manager": {
+            "max_tracked_sequences": 64,
+            "max_ragged_batch_size": int(exp.get("token_budget", 1024)),
+            "max_ragged_sequence_count": int(exp.get("concurrency", 32)),
+            "max_context": 1024,
+        },
+    })
+    from deepspeed_tpu.inference.v2.engine_v2 import serving_benchmark
+
+    eng = InferenceEngineV2(cfg, params, rc)
+    best = serving_benchmark(
+        eng,
+        n_seq=int(exp.get("concurrency", 32)),
+        max_new=int(exp.get("max_new", 64)),
+        repeats=int(exp.get("repeats", 2)),
+        prompt_min=int(exp.get("prompt_min", 64)),
+        prompt_max=int(exp.get("prompt_max", 512)),
+    )
+    return {"ok": True, "gen_tok_s": round(best, 1)}
+
+
 def run(exp: dict) -> dict:
+    if exp.get("mode") == "serving":
+        return run_serving(exp)
     # flash block must be in the env BEFORE the ops import chain
     if exp.get("flash_block"):
         os.environ["DSTPU_FLASH_BLOCK"] = str(exp["flash_block"])

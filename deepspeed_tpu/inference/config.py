@@ -90,6 +90,21 @@ class RaggedInferenceEngineConfig(DSConfigModel):
     # bottleneck) is paid once per decode_steps tokens. Trade-off: EOS hits
     # mid-round waste the remaining iterations for that row.
     decode_steps: int = 1
+    # split-phase step grid (0 = derive from the token budget): each engine
+    # step serves <= max_prompt_chunks prompt chunks of <= prompt_chunk
+    # tokens alongside the full decode row set — the static-shape re-think
+    # of Dynamic SplitFuse packing (a handful of compiled shapes instead of
+    # one per ragged total)
+    prompt_chunk: int = 0
+    max_prompt_chunks: int = 0
+    # sampling (reference FastGen serves sampled decoding via MII on top of
+    # v2 logits; v1 parity knobs). greedy/top_k/top_p are STATIC — they
+    # shape the compiled programs; change them via engine.set_sampling()
+    greedy: bool = True
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 0.0
+    seed: int = 0
     quant: QuantConfig = submodel(QuantConfig)
     kv_cache: Optional[KVCacheConfig] = submodel(KVCacheConfig)
     state_manager: Optional[StateManagerConfig] = submodel(StateManagerConfig)

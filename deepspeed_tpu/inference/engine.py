@@ -192,7 +192,13 @@ class InferenceEngine:
             )
         if self._prefill_jit is None:
             self._build_steps()
-        cache_len = _bucket(total)
+        # fused rounds run in whole multiples of decode_steps: when k does
+        # not divide max_new-1 the final round writes KV for its overshoot
+        # tokens — allocate real slots for them so those writes never clamp
+        # onto (and corrupt) the last in-range cache entry (round-4 advisor)
+        k = self._decode_steps
+        overshoot = (k - ((max_new - 1) % k)) % k if k > 1 else 0
+        cache_len = _bucket(total + overshoot)
         caches = T.init_kv_cache(mc, b, cache_len)
 
         sb = _bucket(s)

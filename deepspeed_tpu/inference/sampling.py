@@ -1,0 +1,65 @@
+"""Shared token sampling for the inference engines.
+
+Reference semantics: v1 guard-railed generate (reference
+inference/engine.py:585) + the FastGen/MII sampling layer on top of v2
+logits (greedy, temperature, top-k, top-p nucleus). One jittable function
+serves both engines so the two paths cannot drift; the fused multi-step
+decode calls it in-device with a per-step folded rng (host round-trips per
+token are the classic serving bottleneck — PERF.md serving roofline).
+
+``top_k``/``top_p``/``greedy`` are STATIC (compile-time) knobs: top-p needs
+a vocab sort that should not be paid when off, and lax.top_k takes a static
+k. Temperature is traced.
+"""
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def filter_logits(logits, top_k: int = 0, top_p: float = 0.0):
+    """Mask logits outside the top-k set and/or the top-p nucleus.
+    logits: [..., vocab] fp32. Static knobs; 0 disables each filter."""
+    if top_k and top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, NEG_INF, logits)
+    if top_p and top_p > 0.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep tokens until the cumulative mass crosses top_p (the crossing
+        # token itself stays — HF convention)
+        keep_sorted = cum - probs < top_p
+        kth = jnp.max(jnp.where(keep_sorted, sorted_logits, NEG_INF), axis=-1, keepdims=True)
+        logits = jnp.where(logits < kth, NEG_INF, logits)
+    return logits
+
+
+def sample_tokens(
+    logits,
+    rng,
+    temperature=1.0,
+    greedy: bool = True,
+    top_k: int = 0,
+    top_p: float = 0.0,
+    return_logprobs: bool = False,
+):
+    """Sample one token per row. logits: [R, vocab] fp32; rng: a PRNG key
+    (callers fold in the absolute step index for fused loops). Returns
+    int32 [R] tokens, or (tokens, logprobs [R]) — the log-probability of
+    the sampled token under the POST-filter, post-temperature distribution
+    (greedy rows report the same quantity at the argmax)."""
+    logits = logits.astype(jnp.float32)
+    if greedy:
+        toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        dist = logits
+    else:
+        dist = filter_logits(logits, top_k=top_k, top_p=top_p) / jnp.maximum(
+            temperature, 1e-4
+        )
+        toks = jax.random.categorical(rng, dist).astype(jnp.int32)
+    if not return_logprobs:
+        return toks
+    logp = jax.nn.log_softmax(dist, axis=-1)
+    return toks, jnp.take_along_axis(logp, toks[:, None], axis=-1)[:, 0]

@@ -34,6 +34,58 @@ def _bucket(n):
     return ((n + 255) // 256) * 256
 
 
+def serving_benchmark(eng, n_seq=32, max_new=64, repeats=2, prompt_min=64,
+                      prompt_max=512, seed=0):
+    """The canonical serving-throughput workload (FastGen-analogue: n_seq
+    concurrent sequences, mixed prompt lengths, max_new generated tokens).
+    ONE definition shared by bench.py's serving bench and the autotuner's
+    serving experiments so their numbers stay comparable. Returns best
+    generated tok/s over ``repeats`` measured passes (first pass warms every
+    compiled program)."""
+    import time as _time
+
+    rng = np.random.default_rng(seed)
+    vocab = eng._mc.vocab_size
+
+    def batch():
+        return [
+            rng.integers(0, vocab, size=(int(l),)).astype(np.int32)
+            for l in rng.integers(prompt_min, prompt_max, size=n_seq)
+        ]
+
+    eng.generate(batch(), max_new_tokens=max_new)  # warm
+    best = 0.0
+    for _ in range(repeats):
+        prompts = batch()
+        t0 = _time.perf_counter()
+        outs = eng.generate(prompts, max_new_tokens=max_new)
+        dt = _time.perf_counter() - t0
+        gen = sum(len(o) - len(p) for o, p in zip(outs, prompts))
+        best = max(best, gen / dt)
+    return best
+
+
+def _materialize_rows(res: dict, want_tokens: bool = False) -> dict:
+    """{uid: (logits array, row[, token array])} -> {uid: host row}, pulling
+    each distinct ARRAY from the device exactly once (rows of one step share
+    their array). ``want_tokens``: take the in-program greedy-token array
+    instead of logits when present. Plain arrays (row=None) pass through for
+    test doubles."""
+    hosts = {}
+    out = {}
+    for uid, entry in res.items():
+        if isinstance(entry, tuple):
+            arr = entry[2] if (want_tokens and len(entry) > 2) else entry[0]
+            idx = entry[1]
+        else:
+            arr, idx = entry, None
+        key = id(arr)
+        if key not in hosts:
+            hosts[key] = np.asarray(arr)
+        out[uid] = hosts[key] if idx is None else hosts[key][idx]
+    return out
+
+
 class InferenceEngineV2:
     def __init__(self, model_config: T.TransformerConfig, params, config: Optional[RaggedInferenceEngineConfig] = None):
         self.config = config or RaggedInferenceEngineConfig()
@@ -62,7 +114,12 @@ class InferenceEngineV2:
         self.params = params
         kv = self.config.kv_cache
         self.state_manager = DSStateManager(self.config.state_manager, kv)
-        self.scheduler = RaggedScheduler(self.config.state_manager, self.state_manager)
+        self.scheduler = RaggedScheduler(
+            self.config.state_manager,
+            self.state_manager,
+            prompt_chunk=int(getattr(self.config, "prompt_chunk", 0) or 0),
+            max_prompt_chunks=int(getattr(self.config, "max_prompt_chunks", 0) or 0),
+        )
         c = model_config
         # --- tensor parallelism (reference config_v2.py:16 tp_size / :33
         # tensor_parallel): GSPMD shards the dense algebra from the param
@@ -112,11 +169,16 @@ class InferenceEngineV2:
             self._k_cache = jnp.zeros(shape, dtype)
             self._v_cache = jnp.zeros(shape, dtype)
         self._row_jit = {}
-        self._batched_jit = None  # shape-polymorphic: jit specializes per bucket
+        self._split_jit = {}  # (tq bucket,) -> compiled split-phase step
         self._multistep_jit = None
         self._multistep_n = 0
         self.last_scheduled_tokens = 0
         self.last_capped = set()
+        # sampling state: one base key; programs fold in the absolute decode
+        # step index so fused rounds reproduce the per-step loop exactly
+        self._rng = jax.random.key(int(getattr(self.config, "seed", 0) or 0))
+        self._sample_step = 0
+        self.last_logprobs: Dict[int, np.ndarray] = {}
         log_dist(
             f"InferenceEngineV2: {kv.num_blocks} KV blocks × {kv.block_size} tokens, "
             f"budget {self.config.state_manager.max_ragged_batch_size} tok/step"
@@ -124,35 +186,32 @@ class InferenceEngineV2:
             ranks=[0],
         )
 
-    def _paged_attention_sharded(self, kernel, q, kc_l, vc_l, tok_tables, positions, trash):
-        """The paged-attention call, TP-aware. Under tensor parallelism the
-        kernel runs inside a shard_map manual region over the model axis —
-        each rank attends its local q/kv heads (contiguous head sharding
-        keeps every GQA group on one rank, so the kernel's h→h//G map is
-        rank-local). GSPMD cannot partition a Pallas call itself; this island
-        is the standard composition (auto mode outside, manual inside)."""
-        if self._tp <= 1:
-            return kernel(q, kc_l, vc_l, tok_tables, positions, trash)
-        from jax.sharding import PartitionSpec as P
+    def set_sampling(self, greedy=None, temperature=None, top_k=None,
+                     top_p=None, seed=None):
+        """Update sampling knobs. greedy/top_k/top_p are compile-time
+        (they shape the programs), so compiled steps are invalidated."""
+        cfg = self.config
+        if greedy is not None:
+            cfg.greedy = bool(greedy)
+        if temperature is not None:
+            cfg.temperature = float(temperature)
+        if top_k is not None:
+            cfg.top_k = int(top_k)
+        if top_p is not None:
+            cfg.top_p = float(top_p)
+        if seed is not None:
+            self._rng = jax.random.key(int(seed))
+            self._sample_step = 0
+        self._split_jit = {}
+        self._multistep_jit = None
 
-        from deepspeed_tpu.parallel.topology import MODEL_AXIS
-
-        def local(q_l, kc, vc, tt, pos):
-            return kernel(q_l, kc, vc, tt, pos, trash)
-
-        return jax.shard_map(
-            local,
-            mesh=self._mesh,
-            in_specs=(
-                P(None, MODEL_AXIS, None),
-                P(None, None, MODEL_AXIS, None),
-                P(None, None, MODEL_AXIS, None),
-                P(),
-                P(),
-            ),
-            out_specs=P(None, MODEL_AXIS, None),
-            check_vma=False,
-        )(q, kc_l, vc_l, tok_tables, positions)
+    def _sampling_kw(self):
+        cfg = self.config
+        return dict(
+            greedy=bool(getattr(cfg, "greedy", True)),
+            top_k=int(getattr(cfg, "top_k", 0) or 0),
+            top_p=float(getattr(cfg, "top_p", 0.0) or 0.0),
+        )
 
     @staticmethod
     def _match_specs(params, specs):
@@ -269,32 +328,102 @@ class InferenceEngineV2:
         return jax.jit(row_step, donate_argnums=(5, 6))
 
     # ------------------------------------------------------------------
-    def _paged_layer(self, lp, x, blk, row, tok_tables, positions, live, kc_l, vc_l,
-                     window=None):
-        """One transformer layer over a packed token batch with paged KV —
-        THE decode layer body, shared by the batched SplitFuse step and the
-        fused multi-step decode so the two paths cannot drift. x: [1, T, h];
-        blk/row/positions: [T]; tok_tables: [T, B]; ``live`` is the traced
-        live sequence length for the rope-scaling switch. ``window``: static
-        per-CALL sliding window (defaults to the config's uniform window;
-        alternating-pattern stacks pass each layer's own 0-or-window).
-        Returns (x, kc_l, vc_l)."""
-        import functools
+    def _pool_views(self, k_cache, v_cache):
+        """Flat multi-layer block-pool views [L*NBp, bs, nkv, d] of the
+        carried 5-D caches — reshapes of contiguous leading dims (free),
+        never a per-layer slice (slicing a scan-carried cache copied 200 MB
+        per layer-step; PERF.md serving roofline)."""
+        c = self._mc
+        kv = self.config.kv_cache
+        L, NBp = c.n_layers, kv.num_blocks + 1
+        shape = (L * NBp, kv.block_size, c.kv_heads, c.head_dim)
+        return k_cache.reshape(shape), v_cache.reshape(shape)
 
-        from deepspeed_tpu.ops.attention.paged_pallas import paged_attention
+    def _attn_decode(self, q, k_pool, v_pool, tables_l, positions, window,
+                     trash_l, extra_kv=None, pool_limit=None):
+        """Decode attention: one token per row, per-ROW layer-offset tables
+        [R, B] into the flat pools — the dense XLA gather+einsum form (the
+        grid kernels lost the in-engine A/B: ~9 us/program launch overhead;
+        PERF.md serving roofline). GSPMD shards it (pool on the kv-head
+        dim) without a shard_map island. ``extra_kv``/``pool_limit``: the
+        write-after-read protocol (this step's K/V ride alongside instead
+        of a scatter-then-gather that copies the pool)."""
+        from deepspeed_tpu.ops.attention.paged_pallas import (
+            paged_decode_attention_dense,
+        )
 
         c = self._mc
-        dtype = T.DTYPES[c.dtype]
-        trash = self.config.kv_cache.num_blocks
-        w = c.sliding_window if window is None else window
-        paged = (
-            functools.partial(paged_attention, window=w, scale=c.attn_scale)
-            if (w or c.attn_scale is not None)
-            else paged_attention
+        return paged_decode_attention_dense(
+            q, k_pool, v_pool, tables_l, positions, trash_l,
+            window=int(window), scale=c.attn_scale,
+            extra_kv=extra_kv, pool_limit=pool_limit,
         )
+
+    def _scatter_kv(self, k_cache, v_cache, li, blk, row, k, v):
+        """Write the new tokens' K/V into the carried caches via ONE
+        single-dimension scatter on a flat slot view [L*NBp*bs, nkv, d] —
+        XLA applies it in place on the donated carry. The earlier
+        scan-over-layers form (caches as scan xs/ys, per-layer
+        advanced-index scatter) copied the 200 MB layer slice per
+        layer-step and dominated the decode round (PERF.md)."""
+        c = self._mc
+        kv = self.config.kv_cache
+        L, NBp, bs = c.n_layers, kv.num_blocks + 1, kv.block_size
+        nkv, d = c.kv_heads, c.head_dim
+        shape = k_cache.shape
+        slot = (li * NBp + blk) * bs + row
+        k_cache = k_cache.reshape(L * NBp * bs, nkv, d).at[slot].set(k).reshape(shape)
+        v_cache = v_cache.reshape(L * NBp * bs, nkv, d).at[slot].set(v).reshape(shape)
+        return k_cache, v_cache
+
+    def _layer_windows(self):
+        """Static per-layer window values: an int (uniform — one loop body
+        serves every layer) or a list (alternating local/global stacks,
+        unrolled). All-equal patterns (gpt_neo all-local stacks) collapse to
+        the uniform int — unrolling them only multiplied compile time
+        (round-4 advisor finding)."""
+        c = self._mc
+        if c.attn_layer_pattern is None:
+            return int(c.sliding_window or 0)
+        vals = [int(c.sliding_window or 0) if f else 0 for f in c.attn_layer_pattern]
+        if len(set(vals)) == 1:
+            return vals[0]
+        return vals
+
+    def _drive_layers(self, layer_fn, params, x, carry):
+        """Run ``layer_fn(lp, x, li, carry, window=...) -> (x, carry)`` over
+        the stack. Uniform windows: lax.fori_loop with a traced layer index
+        (the caches inside ``carry`` stay donated — in-place updates).
+        Per-layer windows (true alternating patterns): unrolled Python loop
+        with static indices."""
+        windows = self._layer_windows()
+        L = self._mc.n_layers
+        if not isinstance(windows, list):
+            def body(li, st):
+                x, carry = st
+                lp = jax.tree.map(
+                    lambda a: jax.lax.dynamic_index_in_dim(a, li, 0, keepdims=False),
+                    params["layers"],
+                )
+                return layer_fn(lp, x, li, carry, window=windows)
+
+            x, carry = jax.lax.fori_loop(0, L, body, (x, carry))
+            return x, carry
+        for li, w in enumerate(windows):
+            lp = jax.tree.map(lambda a: a[li], params["layers"])
+            x, carry = layer_fn(lp, x, li, carry, window=w)
+        return x, carry
+
+    def _layer_qkv(self, lp, x, positions, live):
+        """Shared per-layer prologue for the serving step bodies: pre-norm →
+        QKV projections (+ biases) → qk-norm → rope. One definition so the
+        split step and the fused round cannot drift on arch features
+        (qk_layernorm, biases, rope scaling). lp must be pre-dequantized.
+        Returns (a, q, k, v): the normed activations and [t, nh|nkv, d]
+        heads."""
+        c = self._mc
         nh, nkv, d = c.n_heads, c.kv_heads, c.head_dim
         t = x.shape[1]
-        lp = T._dequant_tree(lp, dtype)
         a = T._norm(x, lp["attn_norm"], lp.get("attn_norm_b"), c.norm, c.norm_eps)
         q, k, v = a[0] @ lp["wq"], a[0] @ lp["wk"], a[0] @ lp["wv"]
         if c.attn_qkv_bias:
@@ -308,154 +437,283 @@ class InferenceEngineV2:
         if c.position == "rope":
             q = T._rope(q.transpose(1, 0, 2)[None], positions[None], c, live)[0].transpose(1, 0, 2)
             k = T._rope(k.transpose(1, 0, 2)[None], positions[None], c, live)[0].transpose(1, 0, 2)
-        kc_l = kc_l.at[blk, row].set(k)
-        vc_l = vc_l.at[blk, row].set(v)
-        out = self._paged_attention_sharded(
-            paged, q, kc_l, vc_l, tok_tables, positions, trash
-        )
+        return a, q, k, v
+
+    def _layer_tail(self, lp, x, out):
+        """Shared per-layer epilogue: wo projection (+ bias), then the
+        parallel-block (falcon/phi) or sequential residual + MLP."""
+        c = self._mc
+        nh, d = c.n_heads, c.head_dim
+        t = x.shape[1]
         attn_out = (out.reshape(t, nh * d) @ lp["wo"])[None]
         if c.attn_out_bias:
             attn_out = attn_out + lp["wo_b"]
         if c.parallel_block:
             m = T._norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"), c.norm, c.norm_eps)
             mlp_out, _ = T._mlp_block(c, lp, m)
-            return x + attn_out + mlp_out, kc_l, vc_l
+            return x + attn_out + mlp_out
         x = x + attn_out
         m = T._norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"), c.norm, c.norm_eps)
         mlp_out, _ = T._mlp_block(c, lp, m)
-        return x + mlp_out, kc_l, vc_l
+        return x + mlp_out
 
-    def _run_layers(self, params, x, blk, row, tok_tables, positions, live,
-                    k_cache, v_cache):
-        """Drive the layer stack over _paged_layer. Uniform stacks scan;
-        alternating local/global stacks (gpt_neo attn_layer_pattern) unroll
-        into a Python loop so each layer's window is STATIC (the paged
-        kernel takes no traced flag) — compile time grows with depth, which
-        is acceptable for a serving engine."""
-        c = self._mc
-        if c.attn_layer_pattern is None:
-            def layer_step(x, inputs):
-                lp, kc_l, vc_l = inputs
-                x, kc_l, vc_l = self._paged_layer(
-                    lp, x, blk, row, tok_tables, positions, live, kc_l, vc_l
-                )
-                return x, (kc_l, vc_l)
-
-            return jax.lax.scan(layer_step, x, (params["layers"], k_cache, v_cache))
-        for li, flag in enumerate(c.attn_layer_pattern):
-            lp = jax.tree.map(lambda a: a[li], params["layers"])
-            x, kc_l, vc_l = self._paged_layer(
-                lp, x, blk, row, tok_tables, positions, live,
-                k_cache[li], v_cache[li],
-                window=c.sliding_window if flag else 0,
-            )
-            k_cache = k_cache.at[li].set(kc_l)
-            v_cache = v_cache.at[li].set(vc_l)
-        return x, (k_cache, v_cache)
-
-    def _build_batched_step(self):
-        """ONE compiled step over the whole packed ragged batch (the actual
-        SplitFuse execution: reference ragged_ops kernels run every scheduled
-        sequence in one launch; the round-1 per-sequence Python loop is kept
-        only as ``_step_per_row`` for comparison). All sequences' new tokens
-        are flattened to [T]; every matmul serves the fused batch; attention
-        is the paged block-table kernel (ops/attention/paged_pallas)."""
+    # ------------------------------------------------------------------
+    def _split_layer(self, lp, x, li, meta, carry, window=None):
+        """One transformer layer of the SPLIT-PHASE step: the packed token
+        axis is [R decode slots | Rc chunks x tq tokens]. QKV/MLP/norms run
+        on the whole packed batch (real MXU work); attention splits —
+        decode rows through _attn_decode (their own new K/V as the
+        extra_kv self column), chunk rows through paged_chunk_attention
+        (in-chunk causal over the chunk's fresh K/V + pool context below
+        the chunk start). The pool is gathered BEFORE the write and the
+        scatter is write-only — a scatter-then-gather made XLA copy the
+        full cache per layer-step (PERF.md serving roofline)."""
+        k_cache, v_cache = carry
         c = self._mc
         kv = self.config.kv_cache
-        bs = kv.block_size
-        B = kv.max_blocks_per_seq
-        trash = kv.num_blocks
-        R = self.config.state_manager.max_ragged_sequence_count
-        dtype = T.DTYPES[c.dtype]
+        NBp = kv.num_blocks + 1
+        w = c.sliding_window if window is None else window
+        nh, nkv, d = c.n_heads, c.kv_heads, c.head_dim
+        R, Rc, tq = meta["R"], meta["Rc"], meta["tq"]
+        lp = T._dequant_tree(lp, T.DTYPES[c.dtype])
+        _, q, k, v = self._layer_qkv(lp, x, meta["positions"], meta["live"])
+        # gathers read the STEP-START pool views (meta): layer li's region
+        # is untouched when layer li runs, and reading the carried cache
+        # after any layer's scatter would force XLA to copy the pool per
+        # layer (cross-layer read-after-write on one buffer)
+        k_pool, v_pool = meta["k_pool0"], meta["v_pool0"]
+        from deepspeed_tpu.ops.attention.paged_pallas import paged_chunk_attention
 
-        def step(params, tokens, seq_idx, positions, tables, last_idx, k_cache, v_cache):
-            """tokens/seq_idx/positions: [T] packed; tables: [R+1, B]
-            (row R all-trash for padding); last_idx: [R] flat index of each
-            row's last valid token. Returns (logits [R, vocab], caches)."""
-            t = tokens.shape[0]
-            x = T._scale_embed(params["embed"].astype(dtype)[tokens][None], c, dtype)  # [1, T, h]
-            if c.position == "learned":
-                x = x + params["pos_embed"][jnp.clip(positions, 0, c.max_seq_len - 1)][None]
-            if c.embed_norm:
-                x = T._embed_norm(params, c, x, stream=False)
-            tok_tables = tables[seq_idx]  # [T, B]
-            blk = jnp.take_along_axis(
-                tok_tables, jnp.clip(positions // bs, 0, B - 1)[:, None], axis=1
-            )[:, 0]
-            row = positions % bs
-            # live length (HF max(position_ids)+1): longrope/dynamic switch —
-            # batch-global like HF's packed update, taken over each row's
-            # LAST VALID token (padding tail tokens carry future positions
-            # that would flip the switch early)
-            live = jnp.max(positions[last_idx]) + 1
+        out_d = self._attn_decode(
+            q[:R], k_pool, v_pool, li * NBp + meta["dec_tables"],
+            meta["dec_pos"], w, li * NBp + kv.num_blocks,
+            extra_kv=(k[:R, None], v[:R, None], meta["dec_pos"][:, None]),
+            pool_limit=meta["dec_pos"],
+        )
+        out_c = paged_chunk_attention(
+            q[R:].reshape(Rc, tq, nh, d), k_pool, v_pool,
+            li * NBp + meta["chk_tables"], meta["chk_pos"],
+            li * NBp + kv.num_blocks,
+            window=int(w), scale=c.attn_scale,
+            new_kv=(k[R:].reshape(Rc, tq, nkv, d), v[R:].reshape(Rc, tq, nkv, d)),
+            pool_limit=meta["chk_start"],
+        )
+        k_cache, v_cache = self._scatter_kv(
+            k_cache, v_cache, li, meta["blk"], meta["row"], k, v
+        )
+        out = jnp.concatenate([out_d, out_c.reshape(Rc * tq, nh, d)], axis=0)
+        return self._layer_tail(lp, x, out), (k_cache, v_cache)
 
-            x, (k_new, v_new) = self._run_layers(
-                params, x, blk, row, tok_tables, positions, live, k_cache, v_cache
-            )
-            x = T._norm(x, params["final_norm"], params.get("final_norm_b"), c.norm, c.norm_eps)
-            last = x[0, jnp.clip(last_idx, 0, t - 1)]  # [R, h]
-            logits = T._apply_lm_head(params, last, c)
-            return logits.astype(jnp.float32), k_new, v_new
-
-        return jax.jit(step, donate_argnums=(6, 7))
-
-    def _build_multistep_decode(self, n_steps: int):
-        """``n_steps`` greedy decode iterations in ONE device program, the
-        argmax fed back in-device (reference FastGen keeps sampling on-device
-        for the same reason): the per-token host round-trip — measured
-        ~120 ms through a remote-tunnel device, and the classic serving
-        bottleneck everywhere — is paid once per ``n_steps`` tokens.
-
-        Every row is one running sequence (R = max_ragged_sequence_count;
-        inactive rows carry an all-trash block table, so their KV writes land
-        in the trash block and the paged kernel masks their context reads).
-        Block capacity for ``n_steps`` tokens per row must be allocated by
-        the caller BEFORE the call (decode_round does)."""
+    def _build_split_step(self, tq: int):
+        """ONE compiled step over the split-phase batch: R decode slots +
+        Rc prompt chunks of tq tokens (the static-shape SplitFuse). blk/row/
+        positions come pre-staged from the host — data-dependent anyway.
+        Returns (decode logits [R, vocab], chunk logits [Rc, vocab], caches).
+        """
         c = self._mc
-        kv = self.config.kv_cache
-        bs = kv.block_size
-        B = kv.max_blocks_per_seq
-        trash = kv.num_blocks
         R = self.config.state_manager.max_ragged_sequence_count
+        Rc = self.scheduler.max_prompt_chunks
         dtype = T.DTYPES[c.dtype]
 
-        def one_token(params, tokens, positions, tok_tables, active, k_cache, v_cache):
-            # tokens/positions/active: [R]; tok_tables: [R, B]
+        def step(params, tokens, positions, blk, row, dec_tables, dec_pos,
+                 chk_tables, chk_pos, chk_start, chk_last, rng, temperature,
+                 k_cache, v_cache):
             x = T._scale_embed(params["embed"].astype(dtype)[tokens][None], c, dtype)
             if c.position == "learned":
                 x = x + params["pos_embed"][jnp.clip(positions, 0, c.max_seq_len - 1)][None]
             if c.embed_norm:
                 x = T._embed_norm(params, c, x, stream=False)
-            blk = jnp.take_along_axis(
-                tok_tables, jnp.clip(positions // bs, 0, B - 1)[:, None], axis=1
-            )[:, 0]
-            row = positions % bs
-            # inactive rows carry position 0: exclude them from the rope
-            # live-length switch
-            live = jnp.max(jnp.where(active, positions, 0)) + 1
+            # live length (HF max(position_ids)+1) for the rope-scaling
+            # switch: padded slots carry position 0, so the plain max works
+            live = jnp.max(positions) + 1
+            k_pool0, v_pool0 = self._pool_views(k_cache, v_cache)
+            meta = {
+                "R": R, "Rc": Rc, "tq": tq, "positions": positions,
+                "blk": blk, "row": row, "live": live,
+                "dec_tables": dec_tables, "dec_pos": dec_pos,
+                "chk_tables": chk_tables, "chk_pos": chk_pos,
+                "chk_start": chk_start,
+                "k_pool0": k_pool0, "v_pool0": v_pool0,
+            }
 
-            x, (k_new, v_new) = self._run_layers(
-                params, x, blk, row, tok_tables, positions, live, k_cache, v_cache
+            def layer_fn(lp, x, li, carry, window=None):
+                return self._split_layer(lp, x, li, meta, carry, window=window)
+
+            x, (k_new, v_new) = self._drive_layers(
+                layer_fn, params, x, (k_cache, v_cache)
             )
             x = T._norm(x, params["final_norm"], params.get("final_norm_b"), c.norm, c.norm_eps)
-            logits = T._apply_lm_head(params, x[0], c)  # [R, vocab]
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32), k_new, v_new
+            dec_h = x[0, :R]  # [R, h]
+            chk_h = x[0, jnp.clip(chk_last, 0, x.shape[1] - 1)]  # [Rc, h]
+            logits_dec = T._apply_lm_head(params, dec_h, c)
+            logits_chk = T._apply_lm_head(params, chk_h, c)
+            # next tokens computed IN-program (sampled or greedy per the
+            # static config knobs): generate() holds only these tiny arrays
+            # across the prefill phase and drops the logits refs — holding
+            # the 4 MB logits buffers alive measurably stalled the step
+            # pipeline through the device tunnel
+            from deepspeed_tpu.inference.sampling import sample_tokens
 
-        def fused(params, tokens, positions, tables, active, k_cache, v_cache):
-            tok_tables = jnp.where(active[:, None], tables, trash)
-
-            def step_fn(carry, _):
-                toks, pos, kc, vc = carry
-                nxt, kc, vc = one_token(params, toks, pos, tok_tables, active, kc, vc)
-                nxt = jnp.where(active, nxt, toks)  # inactive rows freeze
-                return (nxt, pos + active.astype(jnp.int32), kc, vc), nxt
-
-            (_, _, kc, vc), toks_out = jax.lax.scan(
-                step_fn, (tokens, positions, k_cache, v_cache), None, length=n_steps
+            kw = self._sampling_kw()
+            toks_dec = sample_tokens(
+                logits_dec.astype(jnp.float32), jax.random.fold_in(rng, 0),
+                temperature=temperature, **kw,
             )
-            return toks_out, kc, vc  # toks_out: [n_steps, R]
+            toks_chk = sample_tokens(
+                logits_chk.astype(jnp.float32), jax.random.fold_in(rng, 1),
+                temperature=temperature, **kw,
+            )
+            return (
+                logits_dec.astype(jnp.float32), logits_chk.astype(jnp.float32),
+                toks_dec, toks_chk, k_new, v_new,
+            )
 
-        return jax.jit(fused, donate_argnums=(5, 6))
+        return jax.jit(step, donate_argnums=(12, 13))
+
+    def _round_layer(self, lp, x, li, meta, carry, window=None):
+        """One layer of one step of a fused decode ROUND: queries are the
+        round's step-``s`` tokens (one per row); context = the ROUND-START
+        pool (read-only all round) + the round's earlier tokens from the
+        carried side buffers [L, R, n, nkv, d]. The pool scatter is
+        write-only within the round, so XLA keeps the 2 GB carry in place;
+        the side buffers are the (40 MB) read-write surface."""
+        side_k, side_v, k_cache, v_cache = carry
+        c = self._mc
+        kv = self.config.kv_cache
+        NBp = kv.num_blocks + 1
+        w = c.sliding_window if window is None else window
+        lp = T._dequant_tree(lp, T.DTYPES[c.dtype])
+        _, q, k, v = self._layer_qkv(lp, x, meta["pos"], meta["live"])
+        # record this step's K/V in the side buffer BEFORE attention (the
+        # query sees itself through the extra columns)
+        side_k = jax.lax.dynamic_update_slice(
+            side_k, k[None, :, None], (li, 0, meta["s"], 0, 0)
+        )
+        side_v = jax.lax.dynamic_update_slice(
+            side_v, v[None, :, None], (li, 0, meta["s"], 0, 0)
+        )
+        sk = jax.lax.dynamic_index_in_dim(side_k, li, 0, keepdims=False)
+        sv = jax.lax.dynamic_index_in_dim(side_v, li, 0, keepdims=False)
+        # gathers read the ROUND-START pool views (meta), never the carried
+        # cache being scattered into — that read-after-write would force
+        # XLA to copy the pool every layer-step
+        out = self._attn_decode(
+            q, meta["k_pool0"], meta["v_pool0"], li * NBp + meta["tables"],
+            meta["pos"], w, li * NBp + kv.num_blocks,
+            extra_kv=(sk, sv, meta["epos"]),
+            pool_limit=meta["pos0"],
+        )
+        k_cache, v_cache = self._scatter_kv(
+            k_cache, v_cache, li, meta["blk"], meta["row"], k, v
+        )
+        return self._layer_tail(lp, x, out), (side_k, side_v, k_cache, v_cache)
+
+    def _build_multistep_decode(self, n_steps: int):
+        """``n_steps`` greedy decode iterations in ONE device program, the
+        argmax fed back in-device (reference FastGen keeps sampling
+        on-device for the same reason): the per-token host round-trip —
+        ~90 ms through a remote-tunnel device, and the classic serving
+        bottleneck everywhere — is paid once per ``n_steps`` tokens.
+
+        Every row is one running sequence (R = max_ragged_sequence_count;
+        inactive rows carry an all-trash block table and position 0, so
+        their context masks to nothing and their tokens freeze). Block
+        capacity for ``n_steps`` tokens per row must be allocated by the
+        caller BEFORE the call (decode_round does). Context protocol: the
+        pool is read at its ROUND-START state; the round's own tokens ride
+        in side buffers (see _round_layer)."""
+        c = self._mc
+        kv = self.config.kv_cache
+        bs = kv.block_size
+        B = kv.max_blocks_per_seq
+        trash = kv.num_blocks
+        R = self.config.state_manager.max_ragged_sequence_count
+        dtype = T.DTYPES[c.dtype]
+        L = c.n_layers
+
+        def fused(params, tokens, positions, tables, active, rng, temperature,
+                  k_cache, v_cache):
+            tok_tables = jnp.where(active[:, None], tables, trash)
+            pos0 = positions  # round-start positions (pool validity limit)
+            nkv, d = c.kv_heads, c.head_dim
+            side_shape = (L, R, n_steps, nkv, d)
+            side_k0 = jnp.zeros(side_shape, dtype)
+            side_v0 = jnp.zeros(side_shape, dtype)
+            j_idx = jnp.arange(n_steps, dtype=jnp.int32)
+            # round-start pool views: read-only for the whole round (the
+            # in-round tokens come from the side buffers); XLA pays one
+            # pool copy for the round's write chain instead of one per
+            # layer-step
+            k_pool0, v_pool0 = self._pool_views(k_cache, v_cache)
+
+            from deepspeed_tpu.inference.sampling import sample_tokens
+
+            kw = self._sampling_kw()
+
+            def one_token(params, toks, pos, s, side_k, side_v, kc, vc):
+                x = T._scale_embed(params["embed"].astype(dtype)[toks][None], c, dtype)
+                if c.position == "learned":
+                    x = x + params["pos_embed"][jnp.clip(pos, 0, c.max_seq_len - 1)][None]
+                if c.embed_norm:
+                    x = T._embed_norm(params, c, x, stream=False)
+                blk = jnp.take_along_axis(
+                    tok_tables, jnp.clip(pos // bs, 0, B - 1)[:, None], axis=1
+                )[:, 0]
+                row = pos % bs
+                # side slots 0..s are valid for active rows; -1 masks the rest
+                epos = jnp.where(
+                    (j_idx[None] <= s) & active[:, None],
+                    pos0[:, None] + j_idx[None], -1,
+                )
+                meta = {
+                    "tables": tok_tables, "pos": pos,
+                    # inactive rows: pos0 == 0 -> pool masks to nothing
+                    "pos0": jnp.where(active, pos0, 0),
+                    "s": s, "epos": epos, "blk": blk, "row": row,
+                    "k_pool0": k_pool0, "v_pool0": v_pool0,
+                    # inactive rows carry position 0: exclude them from the
+                    # rope live-length switch
+                    "live": jnp.max(jnp.where(active, pos, 0)) + 1,
+                }
+
+                def layer_fn(lp, x, li, carry, window=None):
+                    return self._round_layer(lp, x, li, meta, carry, window=window)
+
+                x, (side_k, side_v, kc, vc) = self._drive_layers(
+                    layer_fn, params, x, (side_k, side_v, kc, vc)
+                )
+                x = T._norm(x, params["final_norm"], params.get("final_norm_b"), c.norm, c.norm_eps)
+                logits = T._apply_lm_head(params, x[0], c)  # [R, vocab]
+                # per-step rng: fold the round-local step index into the
+                # per-round key. NOTE: the sampled stream therefore depends
+                # on the decode_steps partitioning (unlike v1's absolute-
+                # index folding) — same seed + same decode_steps reproduces
+                # exactly; changing decode_steps resamples
+                nxt, logp = sample_tokens(
+                    logits.astype(jnp.float32), jax.random.fold_in(rng, s),
+                    temperature=temperature, return_logprobs=True, **kw,
+                )
+                return nxt, logp, side_k, side_v, kc, vc
+
+            def step_fn(carry, s):
+                toks, pos, side_k, side_v, kc, vc = carry
+                nxt, logp, side_k, side_v, kc, vc = one_token(
+                    params, toks, pos, s, side_k, side_v, kc, vc
+                )
+                nxt = jnp.where(active, nxt, toks)  # inactive rows freeze
+                return (
+                    (nxt, pos + active.astype(jnp.int32), side_k, side_v, kc, vc),
+                    (nxt, logp),
+                )
+
+            (_, _, _, _, kc, vc), (toks_out, logps_out) = jax.lax.scan(
+                step_fn,
+                (tokens, positions, side_k0, side_v0, k_cache, v_cache),
+                jnp.arange(n_steps, dtype=jnp.int32),
+            )
+            return toks_out, logps_out, kc, vc  # [n_steps, R] each
+
+        return jax.jit(fused, donate_argnums=(7, 8))
 
     def decode_round(self, n_steps: Optional[int] = None) -> Dict[int, np.ndarray]:
         """One fused decode round: ``n_steps`` greedy tokens for every
@@ -472,7 +730,7 @@ class InferenceEngineV2:
         (generate() falls back to step() when a round serves nobody)."""
         n = int(n_steps or self.config.decode_steps)
         sched = self.scheduler
-        if sched._pending:
+        if sched.has_pending():
             raise RuntimeError(
                 "decode_round: prompt chunks are still pending — drive step() "
                 "until prefill completes before fused decode"
@@ -480,7 +738,7 @@ class InferenceEngineV2:
         max_context = self.config.state_manager.max_context
         R = self.config.state_manager.max_ragged_sequence_count
         uids = []
-        for uid in list(sched._running):
+        for uid in sched.running_uids():
             if len(uids) >= R:
                 break
             seq = self.state_manager.get_sequence(uid)
@@ -502,31 +760,35 @@ class InferenceEngineV2:
         active = np.zeros(R, bool)
         for i, uid in enumerate(uids):
             seq = self.state_manager.get_sequence(uid)
-            tokens[i] = sched._next_token[uid]
+            tokens[i] = sched.peek_next_token(uid)
             positions[i] = seq.seen_tokens
             tables[i, : len(seq.block_table)] = seq.block_table
             active[i] = True
         if self._multistep_jit is None or self._multistep_n != n:
             self._multistep_jit = self._build_multistep_decode(n)
             self._multistep_n = n
-        toks_out, self._k_cache, self._v_cache = self._multistep_jit(
+        round_rng = jax.random.fold_in(self._rng, 2 * self._sample_step + 1)
+        self._sample_step += 1
+        toks_out, logps_out, self._k_cache, self._v_cache = self._multistep_jit(
             self.params,
             jnp.asarray(tokens),
             jnp.asarray(positions),
             jnp.asarray(tables),
             jnp.asarray(active),
+            round_rng,
+            jnp.float32(getattr(self.config, "temperature", 1.0) or 1.0),
             self._k_cache,
             self._v_cache,
         )
         toks_out = np.asarray(toks_out)  # [n, R]
+        logps_out = np.asarray(logps_out)
         results: Dict[int, np.ndarray] = {}
+        self.last_logprobs = {}
         for i, uid in enumerate(uids):
-            seq = self.state_manager.get_sequence(uid)
             gen = toks_out[:, i]
-            seq.tokens.extend(int(t) for t in gen)
-            seq.seen_tokens += n
-            sched._next_token[uid] = int(gen[-1])
+            sched.apply_decode_round(uid, gen)
             results[uid] = gen
+            self.last_logprobs[uid] = logps_out[:, i]
         return results
 
     def put(self, batch_uids, batch_tokens) -> Dict[int, np.ndarray]:
@@ -539,62 +801,128 @@ class InferenceEngineV2:
 
     def step(self) -> Dict[int, np.ndarray]:
         """One engine step: the scheduler's packed batch advances in a single
-        device call (multi-sequence decode + prompt chunks fused)."""
+        device call (multi-sequence decode + prompt chunks fused). Returns
+        host logits; generate() uses ``_step_device`` to keep them on device
+        (one sync per *phase*, not per step)."""
+        return _materialize_rows(self._step_device())
+
+    def _step_device(self) -> Dict[int, jax.Array]:
+        """The split-phase step: stage the scheduler's batch onto the fixed
+        [R decode slots | Rc chunks x tq] grid, run ONE compiled program,
+        return {uid: DEVICE logits row} for rows whose prompt (or decode
+        token) completed — no host sync happens here, so prefill steps
+        pipeline behind the ~90 ms tunnel round-trip instead of paying it
+        each (PERF.md serving roofline)."""
         batch = self.scheduler.next_batch()
         self.last_scheduled_tokens = batch.total_tokens if batch is not None else 0
         self.last_capped |= self.scheduler.drain_capped()
         if batch is None:
             return {}
         kv = self.config.kv_cache
-        R = self.config.state_manager.max_ragged_sequence_count
+        sm = self.config.state_manager
+        R = sm.max_ragged_sequence_count
+        Rc = self.scheduler.max_prompt_chunks
         B = kv.max_blocks_per_seq
+        bs = kv.block_size
         trash = kv.num_blocks
 
-        total = batch.total_tokens
-        tb = _bucket(total)  # pads the token dim to a small set of compiled shapes
-        if self._batched_jit is None:
-            self._batched_jit = self._build_batched_step()
+        dec_rows = [
+            (uid, toks, start)
+            for uid, toks, start, dec in zip(
+                batch.uids, batch.tokens, batch.start_positions, batch.is_decode
+            )
+            if dec
+        ]
+        chk_rows = [
+            (uid, toks, start, chunked)
+            for uid, toks, start, chunked, dec in zip(
+                batch.uids, batch.tokens, batch.start_positions,
+                batch.is_prompt_chunk, batch.is_decode,
+            )
+            if not dec
+        ]
+        assert len(dec_rows) <= R and len(chk_rows) <= Rc
+        max_chunk = max((len(t) for _, t, _, _ in chk_rows), default=1)
+        # chunk-length buckets: two shapes keep short prompts off the full
+        # prompt_chunk pad without a compile per ragged length
+        tq = 128 if max_chunk <= 128 else self.scheduler.prompt_chunk
+        tq = min(tq, self.scheduler.prompt_chunk)
+        T_ = R + Rc * tq
 
-        tokens = np.zeros(tb, np.int32)
-        seq_idx = np.full(tb, R, np.int32)  # padding → all-trash table row
-        positions = np.zeros(tb, np.int32)
-        tables = np.full((R + 1, B), trash, np.int32)
-        last_idx = np.zeros(R, np.int32)
-        off = 0
-        for i, (uid, toks, start) in enumerate(
-            zip(batch.uids, batch.tokens, batch.start_positions)
-        ):
-            n = len(toks)
-            tokens[off : off + n] = toks
-            seq_idx[off : off + n] = i
-            positions[off : off + n] = start + np.arange(n)
+        tokens = np.zeros(T_, np.int32)
+        positions = np.zeros(T_, np.int32)
+        blk = np.full(T_, trash, np.int32)
+        row = np.zeros(T_, np.int32)
+        dec_tables = np.full((R, B), trash, np.int32)
+        dec_pos = np.full(R, -1, np.int32)  # -1 = inactive slot (masks all)
+        chk_tables = np.full((Rc, B), trash, np.int32)
+        chk_pos = np.full((Rc, tq), -1, np.int32)
+        chk_start = np.zeros(Rc, np.int32)  # 0 = inactive (empty pool window)
+        chk_last = np.zeros(Rc, np.int32)
+
+        for i, (uid, toks, start) in enumerate(dec_rows):
             seq = self.state_manager.get_sequence(uid)
-            # only the ALLOCATED slots: unused table entries must stay trash
-            # so the kernel's blk != trash guard holds for live rows too
+            tokens[i] = toks[0]
+            positions[i] = start
             nblk = len(seq.block_table)
-            tables[i, :nblk] = seq.block_table
-            last_idx[i] = off + n - 1
-            off += n
+            dec_tables[i, :nblk] = seq.block_table
+            dec_pos[i] = start
+            blk[i] = seq.block_table[min(start // bs, nblk - 1)]
+            row[i] = start % bs
+        for j, (uid, toks, start, _chunked) in enumerate(chk_rows):
+            seq = self.state_manager.get_sequence(uid)
+            n = len(toks)
+            off = R + j * tq
+            tokens[off : off + n] = toks
+            pos = start + np.arange(n)
+            positions[off : off + n] = pos
+            nblk = len(seq.block_table)
+            chk_tables[j, :nblk] = seq.block_table
+            chk_pos[j, :n] = pos
+            chk_start[j] = start
+            blk[off : off + n] = np.asarray(seq.block_table, np.int32)[
+                np.minimum(pos // bs, nblk - 1)
+            ]
+            row[off : off + n] = pos % bs
+            chk_last[j] = off + n - 1
 
-        logits, self._k_cache, self._v_cache = self._batched_jit(
+        if tq not in self._split_jit:
+            self._split_jit[tq] = self._build_split_step(tq)
+        step_rng = jax.random.fold_in(self._rng, 2 * self._sample_step)
+        self._sample_step += 1
+        (logits_dec, logits_chk, toks_dec, toks_chk,
+         self._k_cache, self._v_cache) = self._split_jit[tq](
             self.params,
             jnp.asarray(tokens),
-            jnp.asarray(seq_idx),
             jnp.asarray(positions),
-            jnp.asarray(tables),
-            jnp.asarray(last_idx),
+            jnp.asarray(blk),
+            jnp.asarray(row),
+            jnp.asarray(dec_tables),
+            jnp.asarray(dec_pos),
+            jnp.asarray(chk_tables),
+            jnp.asarray(chk_pos),
+            jnp.asarray(chk_start),
+            jnp.asarray(chk_last),
+            step_rng,
+            jnp.float32(getattr(self.config, "temperature", 1.0) or 1.0),
             self._k_cache,
             self._v_cache,
         )
-        logits = np.asarray(logits)
-        results: Dict[int, np.ndarray] = {}
-        for i, (uid, toks, chunked) in enumerate(
-            zip(batch.uids, batch.tokens, batch.is_prompt_chunk)
-        ):
+        # rows are referenced as (logits array, row index, greedy-token
+        # array): slicing logits_dec[i] here would issue one tiny device op
+        # per completed row per step — through a remote tunnel those
+        # dominate the whole prefill phase. Callers materialize each ARRAY
+        # once; generate() keeps only the token arrays alive.
+        results: Dict[int, tuple] = {}
+        for i, (uid, toks, _start) in enumerate(dec_rows):
             seq = self.state_manager.get_sequence(uid)
             seq.seen_tokens += len(toks)
-            if not chunked:  # prompt complete (or decode token): logits usable
-                results[uid] = logits[i]
+            results[uid] = (logits_dec, i, toks_dec)
+        for j, (uid, toks, _start, chunked) in enumerate(chk_rows):
+            seq = self.state_manager.get_sequence(uid)
+            seq.seen_tokens += len(toks)
+            if not chunked:  # prompt complete: last-token logits usable
+                results[uid] = (logits_chk, j, toks_chk)
         return results
 
     def _step_per_row(self) -> Dict[int, np.ndarray]:
@@ -639,7 +967,14 @@ class InferenceEngineV2:
     # -- convenience generation loop (greedy) ---------------------------------
     def generate(self, prompts, max_new_tokens: int = 32, eos_token_id: Optional[int] = None):
         """Drive submit/step/feedback to completion for a list of prompts.
-        Returns list of np arrays (prompt + generated)."""
+        Returns list of np arrays (prompt + generated).
+
+        Two-phase flow: (1) prefill — split-phase steps dispatched WITHOUT
+        reading logits back (device arrays held), so consecutive steps
+        pipeline behind the host→device round-trip; one sync at the end
+        feeds every completed prompt's argmax back. (2) decode — fused
+        multi-token rounds. The old interleaved loop remains underneath as
+        the fallback for caps/memory-pressure cases."""
         uids = list(range(len(prompts)))
         for uid, p in zip(uids, prompts):
             self.scheduler.submit(uid, p)
@@ -647,6 +982,30 @@ class InferenceEngineV2:
         outputs = {uid: list(np.asarray(p, np.int32).reshape(-1)) for uid, p in zip(uids, prompts)}
         self.last_capped = set()
         ds = int(getattr(self.config, "decode_steps", 1) or 1)
+
+        # ---- phase 1: prefill without per-step syncs ----
+        held: Dict[int, tuple] = {}
+        while self.scheduler.has_pending():
+            res = self._step_device()
+            if self.last_scheduled_tokens == 0:
+                break  # pool pressure: the interleaved loop below owns waiting
+            # hold only the tiny in-program-argmax token arrays; dropping
+            # the logits refs lets the runtime recycle their buffers (held
+            # logits stalled the pipeline ~70 ms/step through the tunnel)
+            held.update({
+                u: (e[2], e[1]) if isinstance(e, tuple) and len(e) > 2 else e
+                for u, e in res.items()
+            })
+        for uid, lg in _materialize_rows(held).items():  # ONE sync per phase
+            nxt = int(lg) if np.ndim(lg) == 0 else int(np.argmax(lg))
+            outputs[uid].append(nxt)
+            remaining[uid] -= 1
+            if remaining[uid] <= 0 or (eos_token_id is not None and nxt == eos_token_id):
+                self.scheduler.finish(uid)
+            else:
+                self.scheduler.feedback(uid, nxt)
+
+        # ---- phase 2: fused decode rounds + interleaved fallback ----
         while self.scheduler.has_work():
             if ds > 1 and not self.scheduler._pending and self.scheduler._running:
                 # fused multi-token decode: full ds-rounds for every eligible
@@ -670,7 +1029,7 @@ class InferenceEngineV2:
                         ):
                             self.scheduler.finish(uid)
                     continue
-            results = self.step()
+            res = self._step_device()
             # Liveness: if nothing was scheduled and work remains, no call we
             # make below can change scheduler state — fail loudly instead of
             # busy-looping (e.g. KV pool too fragmented for any pending
@@ -681,8 +1040,11 @@ class InferenceEngineV2:
                     f"(free KV blocks={self.state_manager.free_blocks}); "
                     "increase kv_cache.num_blocks or reduce concurrency"
                 )
-            for uid, logits in results.items():
-                nxt = int(np.argmax(logits))
+            # the in-program next tokens (sampled or greedy per config) —
+            # argmax-of-logits here would silently mix greedy tokens into a
+            # sampled stream (round-5 review finding)
+            for uid, tok in _materialize_rows(res, want_tokens=True).items():
+                nxt = int(tok) if np.ndim(tok) == 0 else int(np.argmax(tok))
                 outputs[uid].append(nxt)
                 remaining[uid] -= 1
                 if remaining[uid] <= 0 or (eos_token_id is not None and nxt == eos_token_id):

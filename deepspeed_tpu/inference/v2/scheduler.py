@@ -26,6 +26,7 @@ class RaggedBatch:
     tokens: List[np.ndarray]  # per-row new tokens
     start_positions: List[int]  # first position of those tokens in the sequence
     is_prompt_chunk: List[bool]  # True if more of this prompt remains after the step
+    is_decode: List[bool] = field(default_factory=list)  # row came from _running
 
     @property
     def total_tokens(self):
@@ -36,11 +37,19 @@ class RaggedBatch:
 
 
 class RaggedScheduler:
-    """Tracks pending prompt queues + running sequences and emits RaggedBatches."""
+    """Tracks pending prompt queues + running sequences and emits RaggedBatches.
 
-    def __init__(self, config, manager):
+    ``prompt_chunk``/``max_prompt_chunks`` bound the prompt side of a batch
+    to a fixed grid (≤ max_prompt_chunks rows of ≤ prompt_chunk tokens) so
+    the engine's split-phase program compiles to a handful of shapes —
+    the static-shape re-think of Dynamic SplitFuse's arbitrary packing."""
+
+    def __init__(self, config, manager, prompt_chunk: int = 0, max_prompt_chunks: int = 0):
         self._config = config
         self._mgr = manager
+        budget = config.max_ragged_batch_size
+        self.prompt_chunk = int(prompt_chunk) or min(512, budget)
+        self.max_prompt_chunks = int(max_prompt_chunks) or max(1, budget // self.prompt_chunk)
         self._pending: List[Tuple[int, np.ndarray]] = []  # (uid, remaining prompt)
         self._running: List[int] = []  # uids with a sampled next token to feed
         self._next_token: Dict[int, int] = {}
@@ -109,10 +118,32 @@ class RaggedScheduler:
     def has_work(self) -> bool:
         return bool(self._pending or self._running)
 
+    # -- engine-facing accessors (the decode round's bookkeeping runs through
+    # these instead of reaching into privates — round-4 advisor finding) ----
+    def has_pending(self) -> bool:
+        return bool(self._pending)
+
+    def running_uids(self) -> List[int]:
+        return list(self._running)
+
+    def peek_next_token(self, uid: int) -> Optional[int]:
+        return self._next_token.get(uid)
+
+    def apply_decode_round(self, uid: int, gen_tokens) -> None:
+        """Record ``gen_tokens`` greedy tokens produced for a RUNNING uid by
+        a fused decode round: history, seen-token count, and the pending
+        next-token all advance together."""
+        seq = self._mgr.get_sequence(uid)
+        if seq is None or seq.finished:
+            return
+        seq.tokens.extend(int(t) for t in gen_tokens)
+        seq.seen_tokens += len(gen_tokens)
+        self._next_token[uid] = int(gen_tokens[-1])
+
     def next_batch(self) -> Optional[RaggedBatch]:
         budget = self._config.max_ragged_batch_size
         max_rows = self._config.max_ragged_sequence_count
-        uids, tokens, starts, chunked = [], [], [], []
+        uids, tokens, starts, chunked, decode = [], [], [], [], []
 
         # 1. decode tokens for running sequences (fuse)
         for uid in list(self._running):
@@ -137,18 +168,21 @@ class RaggedScheduler:
             tokens.append(np.asarray([tok], np.int32))
             starts.append(seq.seen_tokens)
             chunked.append(False)
+            decode.append(True)
             self._running.remove(uid)
             self._next_token.pop(uid, None)
             budget -= 1
 
-        # 2. prompt chunks (split)
+        # 2. prompt chunks (split): at most max_prompt_chunks rows of at most
+        # prompt_chunk tokens — the fixed grid the split-phase program pads to
         still_pending = []
+        n_chunks = 0
         for uid, remaining in self._pending:
-            if len(uids) >= max_rows or budget <= 0:
+            if n_chunks >= self.max_prompt_chunks or budget <= 0:
                 still_pending.append((uid, remaining))
                 continue
             seq = self._mgr.get_sequence(uid)
-            take = min(budget, len(remaining))
+            take = min(budget, self.prompt_chunk, len(remaining))
             if take == 0 or not self._mgr.extend(seq, take):
                 still_pending.append((uid, remaining))
                 continue
@@ -157,11 +191,16 @@ class RaggedScheduler:
             tokens.append(chunk)
             starts.append(seq.seen_tokens)
             chunked.append(len(rest) > 0)
+            decode.append(False)
             budget -= take
+            n_chunks += 1
             if len(rest):
                 still_pending.append((uid, rest))
         self._pending = still_pending
 
         if not uids:
             return None
-        return RaggedBatch(uids=uids, tokens=tokens, start_positions=starts, is_prompt_chunk=chunked)
+        return RaggedBatch(
+            uids=uids, tokens=tokens, start_positions=starts,
+            is_prompt_chunk=chunked, is_decode=decode,
+        )

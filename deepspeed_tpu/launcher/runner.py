@@ -266,6 +266,12 @@ def run_local(args, env: Dict[str, str]) -> int:
 
 
 def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "generate":
+        # serve a real checkpoint dir: dstpu generate --model DIR --prompt ...
+        from deepspeed_tpu.inference.cli import generate_main
+
+        return generate_main(argv[1:])
     args = parse_args(argv)
     if args.autotuning:
         return run_autotuning(args)

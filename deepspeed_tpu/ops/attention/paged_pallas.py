@@ -1,24 +1,29 @@
-"""Paged (block-table) attention kernel for the ragged inference batch.
+"""Paged (block-table) attention for the ragged inference batch.
 
 TPU-native analogue of the reference blocked-flash ragged kernels
 (``inference/v2/kernels/ragged_ops/blocked_flash``, ``linear_blocked_kv_rotary``):
 every query token carries its own block table and context length, so one
-kernel call serves a fused batch of decode tokens and prompt chunks from
-different sequences (the Dynamic SplitFuse execution model).
+call serves a fused batch of decode tokens and prompt chunks from different
+sequences (the Dynamic SplitFuse execution model).
 
 Layout:
-  q            [T, nh, d]     — packed new-token queries (T = token budget)
-  k/v cache    [NB, bs, nkv, d] — the paged pool, one layer's slice
-  block_tables [T, B]         — per TOKEN block table (row's table gathered
-                                by seq index before the call)
-  q_pos        [T]            — global position of each query in its sequence
+  q            [T, nh, d]       — packed new-token queries
+  k/v pool     [NB, bs, nkv, d] — paged block pool (token-major). The engine
+                 passes a FLAT multi-layer view ([L*NBp, bs, nkv, d]) with
+                 layer-offset block tables, so the pool never needs a
+                 per-layer slice (slicing a scan-carried cache copied 200 MB
+                 per layer-step — the round-4 serving bottleneck, PERF.md)
+  block_tables per token [T, B] or per row [R, B]
+  q_pos        global position of each query in its sequence
 
-Kernel structure: grid (T, B); per program one query token against one of
-its context blocks. The block index comes from a scalar-prefetched table
-(``PrefetchScalarGridSpec``) so the DMA of the right cache block overlaps
-compute — the TPU form of the reference kernel's block-table gather. Online
-softmax accumulates in VMEM scratch across the B (sequential) grid dim.
-GQA handled by an unrolled per-kv-head loop (MXU dots on [group, d]@[d, bs]).
+Implementations:
+  * ``paged_decode_attention_dense`` / ``paged_chunk_attention`` — plain XLA
+    (block gather + masked einsum). Profiled fastest on the bench shapes:
+    per-Pallas-program launch overhead (~9 us) dominates grid kernels at
+    serving grids, while the gather is one fused op.
+  * ``paged_attention`` — the (T, B)-grid Pallas kernel (one program per
+    (token, context-block), scalar-prefetched DMA). Kept for the per-token
+    fused path and as the ``kernel`` impl option.
 """
 
 import functools
@@ -190,3 +195,175 @@ def paged_attention(
         ),
         interpret=interpret,
     )(block_tables.astype(jnp.int32), q_pos.astype(jnp.int32), q, k_cache, v_cache)
+
+
+def paged_decode_attention_dense(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    block_tables: jax.Array,
+    q_pos: jax.Array,
+    trash_block,
+    window: int = 0,
+    scale: Optional[float] = None,
+    extra_kv=None,
+    pool_limit=None,
+) -> jax.Array:
+    """Decode attention as plain XLA (block gather + masked einsum) — no
+    Pallas. On the profile (PERF.md serving roofline) per-program launch
+    overhead (~9 us x grid size) dominates grid kernels at decode shapes,
+    while the whole-table gather is a single fused op; the gather over-reads
+    unallocated (trash) slots but stays ahead until contexts are long.
+    GSPMD shards it (cache on the kv-head dim) without a shard_map island.
+    q [R, nh, d], tables [R, B] per-row; ``trash_block`` may be traced
+    (layer-offset trash ids).
+
+    ``extra_kv`` = (ke [R, E, nkv, d], ve, epos [R, E]): NOT-YET-CACHED
+    tokens (this step's / this round's K/V), appended as extra score
+    columns; epos are their global positions, -1 = invalid. ``pool_limit``
+    [R]: pool positions >= pool_limit are masked (default q_pos + 1, i.e.
+    the causal <=). The pool is gathered BEFORE this step's writes — a
+    scatter-then-gather of the same pool made XLA materialize a full cache
+    copy per layer-step (PERF.md serving roofline, the round-4 bottleneck).
+    """
+    R, nh, d = q.shape
+    NB, bs, nkv, _ = k_cache.shape
+    B = block_tables.shape[1]
+    S = B * bs
+    group = nh // nkv
+    k_ctx = (
+        k_cache[block_tables].transpose(0, 3, 1, 2, 4).reshape(R, nkv, S, d)
+    ).astype(jnp.float32)
+    v_ctx = (
+        v_cache[block_tables].transpose(0, 3, 1, 2, 4).reshape(R, nkv, S, d)
+    ).astype(jnp.float32)
+    kpos = jnp.arange(S, dtype=jnp.int32)
+    limit = (q_pos + 1) if pool_limit is None else pool_limit
+    mask = (kpos[None] < limit[:, None]) & jnp.repeat(
+        block_tables != trash_block, bs, axis=1
+    )  # [R, S]
+    if window:
+        from deepspeed_tpu.ops.attention.core import window_too_far
+
+        mask = mask & jnp.logical_not(
+            window_too_far(q_pos[:, None], kpos[None], window)
+        )
+    qg = q.reshape(R, nkv, group, d).astype(jnp.float32) * (
+        scale if scale is not None else d**-0.5
+    )
+    s = jnp.einsum("rngd,rnsd->rngs", qg, k_ctx)
+    s = jnp.where(mask[:, None, None], s, NEG_INF)
+    if extra_kv is not None:
+        ke, ve, epos = extra_kv
+        E = ke.shape[1]
+        emask = (epos >= 0) & (epos <= q_pos[:, None])  # [R, E]
+        if window:
+            from deepspeed_tpu.ops.attention.core import window_too_far
+
+            emask = emask & jnp.logical_not(
+                window_too_far(q_pos[:, None], epos, window)
+            )
+        ke32 = ke.transpose(0, 2, 1, 3).astype(jnp.float32)  # [R, nkv, E, d]
+        ve32 = ve.transpose(0, 2, 1, 3).astype(jnp.float32)
+        se = jnp.einsum("rngd,rned->rnge", qg, ke32)
+        se = jnp.where(emask[:, None, None], se, NEG_INF)
+        s = jnp.concatenate([s, se], axis=-1)
+        any_valid = jnp.any(mask, axis=1) | jnp.any(emask, axis=1)
+        w = jax.nn.softmax(s, axis=-1)
+        w = jnp.where(any_valid[:, None, None, None], w, 0.0)
+        out = jnp.einsum("rngs,rnsd->rngd", w[..., :S], v_ctx) + jnp.einsum(
+            "rnge,rned->rngd", w[..., S:], ve32
+        )
+        return out.reshape(R, nh, d).astype(q.dtype)
+    w = jax.nn.softmax(s, axis=-1)
+    w = jnp.where(jnp.any(mask, axis=1)[:, None, None, None], w, 0.0)
+    out = jnp.einsum("rngs,rnsd->rngd", w, v_ctx)
+    return out.reshape(R, nh, d).astype(q.dtype)
+
+
+def paged_chunk_attention(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    row_tables: jax.Array,
+    q_pos: jax.Array,
+    trash_block,
+    window: int = 0,
+    scale: Optional[float] = None,
+    new_kv=None,
+    pool_limit=None,
+) -> jax.Array:
+    """Prefill-chunk attention: Rc rows x tq new tokens each, every row's
+    tokens sharing that ROW's block table (q [Rc, tq, nh, d],
+    row_tables [Rc, B], q_pos [Rc, tq] global positions, -1 = padding).
+    One context gather per ROW (not per token — the (T, B)-grid kernel's
+    launch-overhead failure mode at prefill grids) then a dense masked
+    softmax; chunk MXU work is real matmuls. Padded tail tokens (q_pos < 0)
+    emit exactly 0.
+
+    ``new_kv`` = (ke [Rc, tq, nkv, d], ve): THIS chunk's not-yet-cached
+    K/V — in-chunk attention runs causally over them while the pool covers
+    only positions < ``pool_limit`` [Rc] (the chunk's start). Without
+    new_kv the pool is assumed to already hold the chunk (legacy form) and
+    pool_limit defaults to the causal <=."""
+    Rc, tq, nh, d = q.shape
+    NB, bs, nkv, _ = k_cache.shape
+    B = row_tables.shape[1]
+    S = B * bs
+    group = nh // nkv
+    k_ctx = (
+        k_cache[row_tables].transpose(0, 3, 1, 2, 4).reshape(Rc, nkv, S, d)
+    ).astype(jnp.float32)
+    v_ctx = (
+        v_cache[row_tables].transpose(0, 3, 1, 2, 4).reshape(Rc, nkv, S, d)
+    ).astype(jnp.float32)
+    kpos = jnp.arange(S, dtype=jnp.int32)
+    blk_valid = jnp.repeat(row_tables != trash_block, bs, axis=1)  # [Rc, S]
+    if pool_limit is None:
+        pool_ok = kpos[None, None] <= q_pos[:, :, None]
+    else:
+        pool_ok = jnp.broadcast_to(
+            (kpos[None] < pool_limit[:, None])[:, None], (Rc, tq, S)
+        )
+    mask = pool_ok & (q_pos[:, :, None] >= 0) & blk_valid[:, None]  # [Rc, tq, S]
+    if window:
+        from deepspeed_tpu.ops.attention.core import window_too_far
+
+        mask = mask & jnp.logical_not(
+            window_too_far(q_pos[:, :, None], kpos[None, None], window)
+        )
+    qg = q.reshape(Rc, tq, nkv, group, d).astype(jnp.float32) * (
+        scale if scale is not None else d**-0.5
+    )
+    s = jnp.einsum("rtngd,rnsd->rntgs", qg, k_ctx)
+    s = jnp.where(mask[:, None, :, None], s, NEG_INF)
+    if new_kv is not None:
+        ke, ve = new_kv
+        # in-chunk causal: key j visible to query i iff 0 <= pos_j <= pos_i
+        cmask = (
+            (q_pos[:, None, :] >= 0)
+            & (q_pos[:, :, None] >= 0)
+            & (q_pos[:, None, :] <= q_pos[:, :, None])
+        )  # [Rc, tq(i), tq(j)]
+        if window:
+            from deepspeed_tpu.ops.attention.core import window_too_far
+
+            cmask = cmask & jnp.logical_not(
+                window_too_far(q_pos[:, :, None], q_pos[:, None, :], window)
+            )
+        ke32 = ke.transpose(0, 2, 1, 3).astype(jnp.float32)  # [Rc, nkv, tq, d]
+        ve32 = ve.transpose(0, 2, 1, 3).astype(jnp.float32)
+        sc = jnp.einsum("rtngd,rnjd->rntgj", qg, ke32)
+        sc = jnp.where(cmask[:, None, :, None], sc, NEG_INF)
+        s = jnp.concatenate([s, sc], axis=-1)
+        any_valid = jnp.any(mask, axis=2) | jnp.any(cmask, axis=2)  # [Rc, tq]
+        w = jax.nn.softmax(s, axis=-1)
+        w = jnp.where(any_valid[:, None, :, None, None], w, 0.0)
+        out = jnp.einsum("rntgs,rnsd->rtngd", w[..., :S], v_ctx) + jnp.einsum(
+            "rntgj,rnjd->rtngd", w[..., S:], ve32
+        )
+        return out.reshape(Rc, tq, nh, d).astype(q.dtype)
+    w = jax.nn.softmax(s, axis=-1)
+    w = jnp.where(jnp.any(mask, axis=2)[:, None, :, None, None], w, 0.0)
+    out = jnp.einsum("rntgs,rnsd->rtngd", w, v_ctx)
+    return out.reshape(Rc, tq, nh, d).astype(q.dtype)

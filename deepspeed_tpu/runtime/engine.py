@@ -345,6 +345,12 @@ class DeepSpeedEngine:
                 betas=tuple(d.get("betas", (0.9, 0.999))),
                 eps=d.get("eps", 1e-8),
                 weight_decay=d.get("weight_decay", 0.0),
+                # int8 moment streaming: the tier is PCIe-wire-limited and
+                # bytes are the lever (PERF.md streamed-7B roofline)
+                quant_bits=int(getattr(
+                    config.zero_optimization.offload_optimizer,
+                    "stream_quant_bits", 0,
+                ) or 0),
             )
         self._host_opt = None
         self._host_step_jit = None

@@ -37,6 +37,59 @@ class StreamedAdamState(NamedTuple):
     nu: Any
 
 
+QUANT_BLOCK = 256  # elements per int8 block (fp32 scale each)
+
+
+def _quant_eligible(shape) -> bool:
+    """int8-moment eligibility: >=2-D with a 256-aligned LAST dim (blocks
+    tile the minor axis, so the scale tree keeps the leaf's rank and every
+    chunk window slices both the same way)."""
+    return len(shape) >= 2 and shape[-1] % QUANT_BLOCK == 0
+
+
+def _q8(x):
+    """Blockwise int8 quantization. x: [..., row] fp32 with row % 256 == 0.
+    Returns (q int8 same shape, s fp32 [..., row/256])."""
+    shape = x.shape
+    blocks = x.reshape(shape[:-1] + (shape[-1] // QUANT_BLOCK, QUANT_BLOCK))
+    s = jnp.max(jnp.abs(blocks), axis=-1) / 127.0
+    q = jnp.round(blocks / jnp.maximum(s[..., None], 1e-30))
+    return (
+        jnp.clip(q, -127, 127).astype(jnp.int8).reshape(shape),
+        s.astype(jnp.float32),
+    )
+
+
+def _dq8(q, s):
+    shape = q.shape
+    blocks = q.reshape(shape[:-1] + (shape[-1] // QUANT_BLOCK, QUANT_BLOCK))
+    return (blocks.astype(jnp.float32) * s[..., None]).reshape(shape)
+
+
+def _q8_nu(nu):
+    """Second-moment quantization: linear int8 on SQRT(nu) — nu spans many
+    orders of magnitude within a block (linear int8 on nu itself measurably
+    bent the loss trajectory; sqrt halves the dynamic range in log space,
+    the same reason bitsandbytes uses a nonlinear map for Adam's nu)."""
+    return _q8(jnp.sqrt(nu))
+
+
+def _dq8_nu(q, s):
+    r = _dq8(q, s)
+    return r * r
+
+
+def _q8_mu(mu):
+    """First-moment quantization: linear int8 on the SIGNED sqrt — same
+    dynamic-range compression as the nu map, sign carried through."""
+    return _q8(jnp.sign(mu) * jnp.sqrt(jnp.abs(mu)))
+
+
+def _dq8_mu(q, s):
+    r = _dq8(q, s)
+    return jnp.sign(r) * (r * r)
+
+
 def _is_host(x) -> bool:
     try:
         return jax.typeof(x).memory_space == jax.memory.Space.Host
@@ -127,6 +180,104 @@ def streamed_adamw_leaf(
     return jax.lax.fori_loop(0, n_chunks, body, (m, mu, nu, p))
 
 
+def streamed_adamw_leaf_q8(
+    g, m, mu, nu, p, lr, *, b1, b2, eps, wd, c1, c2, chunk=DEFAULT_CHUNK_ELEMS
+):
+    """Quantized-moment variant: mu/nu are {"q": int8 leaf, "s": fp32
+    per-256-block scales, FLAT 1-D} dicts. Halves the wire bytes of the
+    state round trip (the streamed step is PCIe-limited — PERF.md
+    streamed-7B roofline); dequant → AdamW → requant runs on-chip per
+    window, so quantization error does not accumulate within a step, only
+    across steps (the sqrt-compressed maps keep the trajectory within a few
+    percent of fp32 — parity guard in tests/unit/test_weight_stream.py)."""
+    n = int(m.size)
+    host = _is_host(m)
+    shape = m.shape
+    row_elems = n // shape[0] if shape else n
+    bpr = row_elems // QUANT_BLOCK  # scale blocks per leading-axis row
+    rows = max(1, min(shape[0] if shape else 1, chunk // max(row_elems, 1)))
+    aligned = True
+    if len(shape) == 2 and rows < shape[0]:
+        # int8 windows map dim0 onto sublanes with 32-row chunk granularity
+        rows = max(32, rows - rows % 32)
+        aligned = shape[0] % 32 == 0
+    if not host or n <= chunk or not aligned:
+        gm = _to_dev(g) if _is_host(g) else g
+        mm = _to_dev(m) if _is_host(m) else m
+
+        def deq(pair, dq):
+            q = _to_dev(pair["q"]) if host else pair["q"]
+            sc = _to_dev(pair["s"]) if host else pair["s"]
+            return dq(q, sc)
+
+        mu_f = deq(mu, _dq8_mu)
+        nu_f = deq(nu, _dq8_nu)
+        m2, mu2, nu2 = _adamw_math(gm, mm, mu_f, nu_f, lr, b1, b2, eps, wd, c1, c2)
+        p2 = m2.astype(p.dtype)
+        mu_q, mu_s = _q8_mu(mu2)
+        nu_q, nu_s = _q8_nu(nu2)
+        if host:
+            m2 = _to_host(m2)
+            mu_q, mu_s = _to_host(mu_q), _to_host(mu_s)
+            nu_q, nu_s = _to_host(nu_q), _to_host(nu_s)
+        # the param mirror follows the PARAM's placement, not the master's:
+        # destreamed small leaves keep device-resident params even though
+        # their masters are host-offloaded (placement drift here recompiles
+        # the grads program against new input shardings every step)
+        if _is_host(p):
+            p2 = _to_host(p2)
+        return m2, {"q": mu_q, "s": mu_s}, {"q": nu_q, "s": nu_s}, p2
+
+    dim0 = shape[0]
+    n_chunks = -(-dim0 // rows)
+    window = (rows,) + shape[1:]
+    swindow = (rows,) + shape[1:-1] + (shape[-1] // QUANT_BLOCK,)
+    zero_tail = (0,) * (len(shape) - 1)
+
+    # The scale arrays stay WHOLE on device for the loop (<= a few MB per
+    # leaf — 1/256 of the data) and round-trip host as full-array copies:
+    # host-side windowed updates of the scale shapes are unlowerable (XLA
+    # lays [d0, small] out column-major, turning the leading-dim update
+    # into a lane-dim slice libtpu's async DUS rejects).
+    mu_s_dev = _to_dev(mu["s"])
+    nu_s_dev = _to_dev(nu["s"])
+
+    def body(i, carry):
+        mo, mu_qo, mu_sd, nu_qo, nu_sd, po = carry
+        # clamped tail re-covers part of the previous window; reads touch
+        # INPUT buffers only, so the double-write is idempotent for the
+        # host outputs. The DEVICE-carried scales are read via the ORIGINAL
+        # inputs' windows (mu_s_dev closure) for the same reason.
+        off = jnp.minimum(i * rows, dim0 - rows)
+        start = (off,) + zero_tail
+        ds = lambda a: _to_dev(jax.lax.dynamic_slice(a, start, window))  # noqa: E731
+        mu_f = _dq8_mu(ds(mu["q"]), jax.lax.dynamic_slice(mu_s_dev, start, swindow))
+        nu_f = _dq8_nu(ds(nu["q"]), jax.lax.dynamic_slice(nu_s_dev, start, swindow))
+        m2, mu2, nu2 = _adamw_math(
+            ds(g), ds(m), mu_f, nu_f, lr, b1, b2, eps, wd, c1, c2
+        )
+        p2 = m2.astype(p.dtype)
+        mu_q, mu_s = _q8_mu(mu2)
+        nu_q, nu_s = _q8_nu(nu2)
+        mo = jax.lax.dynamic_update_slice(mo, _to_host(m2), start)
+        mu_qo = jax.lax.dynamic_update_slice(mu_qo, _to_host(mu_q), start)
+        mu_sd = jax.lax.dynamic_update_slice(mu_sd, mu_s, start)  # device DUS
+        nu_qo = jax.lax.dynamic_update_slice(nu_qo, _to_host(nu_q), start)
+        nu_sd = jax.lax.dynamic_update_slice(nu_sd, nu_s, start)
+        po = jax.lax.dynamic_update_slice(po, _to_host(p2), start)
+        return mo, mu_qo, mu_sd, nu_qo, nu_sd, po
+
+    mo, mu_qo, mu_sd, nu_qo, nu_sd, po = jax.lax.fori_loop(
+        0, n_chunks, body, (m, mu["q"], mu_s_dev, nu["q"], nu_s_dev, p)
+    )
+    return (
+        mo,
+        {"q": mu_qo, "s": _to_host(mu_sd)},
+        {"q": nu_qo, "s": _to_host(nu_sd)},
+        po,
+    )
+
+
 class StreamedAdamW:
     """DeepSpeedOptimizer-compatible streamed AdamW (weight_stream tier).
 
@@ -136,11 +287,16 @@ class StreamedAdamW:
     """
 
     def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
-                 chunk_elems=DEFAULT_CHUNK_ELEMS):
+                 chunk_elems=DEFAULT_CHUNK_ELEMS, quant_bits=0):
         self.name = "streamed_adamw"
         self.defaults = {"lr": lr, "betas": betas, "eps": eps, "weight_decay": weight_decay}
         self._lr = lr
         self.chunk_elems = chunk_elems
+        # 8: moments stored/streamed as int8 blocks + fp32 scales (eligible
+        # leaves only — see _quant_eligible); halves the state wire bytes
+        self.quant_bits = int(quant_bits or 0)
+        if self.quant_bits not in (0, 8):
+            raise ValueError(f"stream_quant_bits must be 0 or 8, got {quant_bits}")
         self.collective_grad_exchange = False
         self.state_partition_specs = None
         self.canonicalize_checkpoint_state = None
@@ -155,46 +311,65 @@ class StreamedAdamW:
     def param_groups(self):
         return [{"lr": self._lr, **self.defaults}]
 
+    def _moment_like(self, m):
+        """Zero moment state for one master leaf: a plain fp32 array, or the
+        {"q": int8, "s": fp32 scales} pair when quantized streaming applies.
+        Scales keep the leaf's RANK (blocks tile the minor axis): chunk
+        windows slice the leading (sublane) dim of data and scales the same
+        way — 1-D scale buffers are unsliceable (libtpu: "Lane slice
+        updating is not supported in async dynamic update slice")."""
+        if self.quant_bits == 8 and _quant_eligible(m.shape):
+            return {
+                "q": jnp.zeros(m.shape, jnp.int8),
+                "s": jnp.zeros(m.shape[:-1] + (m.shape[-1] // QUANT_BLOCK,), jnp.float32),
+            }
+        return jnp.zeros_like(m)
+
+    @staticmethod
+    def _is_moment_leaf(x):
+        return isinstance(x, dict) and "q" in x
+
     def init(self, params):
         from deepspeed_tpu.runtime.optimizers import OptState
 
         # copy=True: for fp32 params astype would ALIAS the param buffer, and
         # the donated leaf update would then delete the live params
         master = jax.tree.map(lambda p: jnp.array(p, jnp.float32, copy=True), params)
-        zeros = jax.tree.map(jnp.zeros_like, master)
         return OptState(
             master=master,
             inner=StreamedAdamState(
                 count=jnp.zeros((), jnp.int32),
-                mu=zeros,
-                nu=jax.tree.map(jnp.zeros_like, master),
+                mu=jax.tree.map(self._moment_like, master),
+                nu=jax.tree.map(self._moment_like, master),
             ),
         )
 
-    def _leaf_jit(self):
+    def _leaf_jit(self, quantized: bool):
         """One jitted per-leaf update, donate the state buffers — jax caches
         a compilation per leaf shape. Eager per-leaf calls keep host TEMP
         memory bounded at ONE leaf's copies: a single whole-step jit leaves
         XLA free to interleave every leaf's fori_loop, and its static buffer
         assignment then holds a full temp copy of the entire state (~94 GB
         at 7B, observed via CompiledMemoryStats.host_temp_size)."""
-        if getattr(self, "_leaf_step", None) is None:
+        attr = "_leaf_step_q8" if quantized else "_leaf_step"
+        if getattr(self, attr, None) is None:
             b1, b2 = self.defaults["betas"]
             eps = self.defaults["eps"]
             wd = self.defaults["weight_decay"]
             chunk = self.chunk_elems
+            leaf_fn = streamed_adamw_leaf_q8 if quantized else streamed_adamw_leaf
 
             def leaf_step(g, m, mu, nu, p, lr, count):
                 cf = count.astype(jnp.float32)
                 c1 = 1.0 - jnp.power(jnp.float32(b1), cf)
                 c2 = 1.0 - jnp.power(jnp.float32(b2), cf)
-                return streamed_adamw_leaf(
+                return leaf_fn(
                     g, m, mu, nu, p, lr, b1=b1, b2=b2, eps=eps, wd=wd,
                     c1=c1, c2=c2, chunk=chunk,
                 )
 
-            self._leaf_step = jax.jit(leaf_step, donate_argnums=(1, 2, 3, 4))
-        return self._leaf_step
+            setattr(self, attr, jax.jit(leaf_step, donate_argnums=(1, 2, 3, 4)))
+        return getattr(self, attr)
 
     def step(self, grads, state, params, lr):
         """Eager per-leaf application (called OUTSIDE any surrounding jit by
@@ -202,21 +377,29 @@ class StreamedAdamW:
         from deepspeed_tpu.runtime.optimizers import OptState
 
         count = state.inner.count + 1
-        fn = self._leaf_jit()
+        is_leaf = self._is_moment_leaf
         flat_g, treedef = jax.tree_util.tree_flatten(grads)
         flat_m = jax.tree_util.tree_leaves(state.master)
-        flat_mu = jax.tree_util.tree_leaves(state.inner.mu)
-        flat_nu = jax.tree_util.tree_leaves(state.inner.nu)
+        flat_mu = jax.tree_util.tree_leaves(state.inner.mu, is_leaf=is_leaf)
+        flat_nu = jax.tree_util.tree_leaves(state.inner.nu, is_leaf=is_leaf)
         flat_p = jax.tree_util.tree_leaves(params)
         out_m, out_mu, out_nu, out_p = [], [], [], []
         for g, m, mu, nu, p in zip(flat_g, flat_m, flat_mu, flat_nu, flat_p):
+            fn = self._leaf_jit(quantized=self._is_moment_leaf(mu))
             m2, mu2, nu2, p2 = fn(g, m, mu, nu, p, lr, count)
             out_m.append(m2)
             out_mu.append(mu2)
             out_nu.append(nu2)
             out_p.append(p2)
         unflat = treedef.unflatten
+        # unflatten with dict moment leaves: rebuild against the leaf list
+        mu_tree = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(state.inner.mu, is_leaf=is_leaf), out_mu
+        )
+        nu_tree = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(state.inner.nu, is_leaf=is_leaf), out_nu
+        )
         return unflat(out_p), OptState(
             master=unflat(out_m),
-            inner=StreamedAdamState(count=count, mu=unflat(out_mu), nu=unflat(out_nu)),
+            inner=StreamedAdamState(count=count, mu=mu_tree, nu=nu_tree),
         )

@@ -63,6 +63,13 @@ class DeepSpeedZeroOffloadOptimizerConfig(DSConfigModel):
     # whole optimizer host-side against RAM-resident state via CPU-Adam
     super_offload: bool = False
     cpuadam_cores_perc: float = 0.8
+    # weight_stream tier: store/stream the Adam moments as int8 blocks with
+    # fp32 per-256-block scales (ZeRO++ quantized exchange applied to the
+    # ZeRO-Infinity swap traffic — reference stage3.py:1610
+    # quantize_nontrainable_params + partitioned_optimizer_swapper). The
+    # streamed step is wire-limited; bytes are the lever (PERF.md
+    # streamed-7B roofline). 0 = fp32 state (default), 8 = int8 moments.
+    stream_quant_bits: int = 0
 
     def _validate(self):
         if self.device not in (OffloadDeviceEnum.none, OffloadDeviceEnum.cpu, OffloadDeviceEnum.nvme):

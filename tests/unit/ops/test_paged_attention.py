@@ -107,7 +107,7 @@ def _make_engine(seed=0):
 
 
 def test_batched_step_matches_per_row():
-    """The fused single-call step must produce the same tokens as the
+    """The fused single-call split step must produce the same tokens as the
     round-1 per-sequence loop."""
     prompts = [
         np.arange(1, 9, dtype=np.int32),
@@ -118,7 +118,11 @@ def test_batched_step_matches_per_row():
     out_a = eng_a.generate([p.copy() for p in prompts], max_new_tokens=6)
 
     eng_b, _ = _make_engine()
-    eng_b.step = eng_b._step_per_row  # force the legacy execution model
+    # force the legacy execution model under generate()'s phased loop
+    eng_b.step = eng_b._step_per_row
+    eng_b._step_device = lambda: {
+        u: jnp.asarray(l) for u, l in eng_b._step_per_row().items()
+    }
     out_b = eng_b.generate([p.copy() for p in prompts], max_new_tokens=6)
     for a, b in zip(out_a, out_b):
         np.testing.assert_array_equal(a, b)
@@ -143,12 +147,23 @@ def test_batched_step_is_one_device_call():
     prompts = [np.arange(1 + i, 9 + i, dtype=np.int32) for i in range(n_seq)]
 
     eng_a, _ = _make_engine()
-    eng_a._batched_jit = _CountingJit(eng_a._build_batched_step())
+    split_counters = {}
+    orig_split = eng_a._build_split_step
+
+    def counting_split(tq):
+        c = _CountingJit(orig_split(tq))
+        split_counters[tq] = c
+        return c
+
+    eng_a._build_split_step = counting_split
     eng_a.generate([p.copy() for p in prompts], max_new_tokens=steps)
-    batched_calls = eng_a._batched_jit.calls
+    batched_calls = sum(c.calls for c in split_counters.values())
 
     eng_b, _ = _make_engine()
     eng_b.step = eng_b._step_per_row
+    eng_b._step_device = lambda: {
+        u: jnp.asarray(l) for u, l in eng_b._step_per_row().items()
+    }
     counters = {}
 
     orig_build = eng_b._build_row_step
@@ -164,4 +179,166 @@ def test_batched_step_is_one_device_call():
 
     # per-row: ~n_seq calls per decode step; batched: exactly 1
     assert per_row_calls >= 2 * batched_calls, (batched_calls, per_row_calls)
-    assert batched_calls <= steps + 2, batched_calls
+    assert batched_calls <= steps + n_seq + 2, batched_calls
+
+
+# ---------------------------------------------------------------------------
+# XLA-dense decode / chunk attention (the serving hot paths)
+# ---------------------------------------------------------------------------
+from deepspeed_tpu.ops.attention.paged_pallas import (
+    paged_chunk_attention,
+    paged_decode_attention_dense,
+)
+
+
+@pytest.mark.parametrize("kw", [{}, {"window": 12}, {"scale": 1.0}])
+def test_decode_dense_matches_reference(kw):
+    rng = np.random.default_rng(6)
+    R, nh, nkv, d, bs, NB, B = 5, 8, 4, 64, 16, 12, 3
+    trash = NB - 1
+    q = jnp.asarray(rng.normal(size=(R, nh, d)), jnp.float32)
+    kc = jnp.asarray(rng.normal(size=(NB, bs, nkv, d)), jnp.float32)
+    vc = jnp.asarray(rng.normal(size=(NB, bs, nkv, d)), jnp.float32)
+    bt = np.full((R, B), trash, np.int32)
+    bt[0] = [0, 1, 2]
+    bt[1] = [3, 4, trash]
+    bt[2] = [5, trash, trash]
+    bt[3] = [6, 7, 8]
+    qpos = np.array([40, 20, 3, 47, 0], np.int32)  # row 4 inactive
+    ref = paged_attention_reference(
+        q, kc, vc, jnp.asarray(bt), jnp.asarray(qpos), trash, **kw
+    )
+    out = paged_decode_attention_dense(
+        q, kc, vc, jnp.asarray(bt), jnp.asarray(qpos), trash, **kw
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(out[4]), 0.0, atol=1e-6)
+
+
+@pytest.mark.parametrize("kw", [{}, {"window": 12}, {"scale": 1.0}])
+def test_chunk_attention_matches_reference(kw):
+    """Chunk rows vs the per-token reference: expand each row's table/
+    positions to per-token form; padded tail (q_pos=-1) emits zero."""
+    rng = np.random.default_rng(7)
+    Rc, tq, nh, nkv, d, bs, NB, B = 2, 8, 4, 2, 64, 16, 12, 3
+    trash = NB - 1
+    q = jnp.asarray(rng.normal(size=(Rc, tq, nh, d)), jnp.float32)
+    kc = jnp.asarray(rng.normal(size=(NB, bs, nkv, d)), jnp.float32)
+    vc = jnp.asarray(rng.normal(size=(NB, bs, nkv, d)), jnp.float32)
+    row_tables = np.array([[0, 1, 2], [3, 4, trash]], np.int32)
+    # row 0: tokens at positions 18..25 (mid-prefill); row 1: 5 valid + 3 pad
+    q_pos = np.stack([
+        np.arange(18, 18 + tq, dtype=np.int32),
+        np.array([3, 4, 5, 6, 7, -1, -1, -1], np.int32),
+    ])
+    out = paged_chunk_attention(
+        q, kc, vc, jnp.asarray(row_tables), jnp.asarray(q_pos), trash, **kw
+    )
+    # flatten to the per-token reference form
+    flat_q = q.reshape(Rc * tq, nh, d)
+    flat_bt = np.repeat(row_tables, tq, axis=0)
+    flat_pos = q_pos.reshape(-1)
+    # reference has no -1 convention: route padded tokens to an all-trash row
+    flat_bt[flat_pos < 0] = trash
+    ref = paged_attention_reference(
+        flat_q, kc, vc, jnp.asarray(flat_bt),
+        jnp.asarray(np.maximum(flat_pos, 0)), trash, **kw
+    ).reshape(Rc, tq, nh, d)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(out[1, 5:]), 0.0, atol=1e-6)
+
+
+def test_decode_dense_extra_kv_equals_post_write():
+    """Pre-write pool + extra_kv (the write-after-read decode form) must
+    equal the legacy form where the tokens are already in the pool."""
+    rng = np.random.default_rng(8)
+    R, nh, nkv, d, bs, NB, B = 4, 8, 4, 64, 16, 12, 3
+    trash = NB - 1
+    q = jnp.asarray(rng.normal(size=(R, nh, d)), jnp.float32)
+    kc = jnp.asarray(rng.normal(size=(NB, bs, nkv, d)), jnp.float32)
+    vc = jnp.asarray(rng.normal(size=(NB, bs, nkv, d)), jnp.float32)
+    bt = np.array([[0, 1, 2], [3, 4, trash], [5, trash, trash], [6, 7, 8]], np.int32)
+    # each row: 2 "round" tokens at positions pos0, pos0+1; query = 2nd one
+    pos0 = np.array([20, 3, 8, 40], np.int32)
+    qpos = pos0 + 1
+    ke = jnp.asarray(rng.normal(size=(R, 2, nkv, d)), jnp.float32)
+    ve = jnp.asarray(rng.normal(size=(R, 2, nkv, d)), jnp.float32)
+    epos = np.stack([pos0, pos0 + 1], axis=1).astype(np.int32)
+    # legacy oracle: write the extra tokens into a copy of the pool
+    kc2, vc2 = np.asarray(kc).copy(), np.asarray(vc).copy()
+    for r in range(R):
+        for j in range(2):
+            p = int(epos[r, j])
+            blk = int(bt[r, p // bs])
+            kc2[blk, p % bs] = np.asarray(ke)[r, j]
+            vc2[blk, p % bs] = np.asarray(ve)[r, j]
+    ref = paged_decode_attention_dense(
+        q, jnp.asarray(kc2), jnp.asarray(vc2), jnp.asarray(bt),
+        jnp.asarray(qpos), trash,
+    )
+    out = paged_decode_attention_dense(
+        q, kc, vc, jnp.asarray(bt), jnp.asarray(qpos), trash,
+        extra_kv=(ke, ve, jnp.asarray(epos)),
+        pool_limit=jnp.asarray(pos0),
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    # invalid extra slots (epos -1) change nothing
+    epos_inv = epos.copy(); epos_inv[:, 1] = -1
+    kc3, vc3 = np.asarray(kc).copy(), np.asarray(vc).copy()
+    for r in range(R):
+        p = int(epos[r, 0])
+        blk = int(bt[r, p // bs])
+        kc3[blk, p % bs] = np.asarray(ke)[r, 0]
+        vc3[blk, p % bs] = np.asarray(ve)[r, 0]
+    ref1 = paged_decode_attention_dense(
+        q, jnp.asarray(kc3), jnp.asarray(vc3), jnp.asarray(bt),
+        jnp.asarray(qpos), trash,
+        # slot pos0+1 was never written: cap the pool at the written prefix
+        pool_limit=jnp.asarray(pos0 + 1),
+    )
+    out1 = paged_decode_attention_dense(
+        q, kc, vc, jnp.asarray(bt), jnp.asarray(qpos), trash,
+        extra_kv=(ke, ve, jnp.asarray(epos_inv)),
+        pool_limit=jnp.asarray(pos0),
+    )
+    # qpos = pos0+1 but slot 1 invalid: only slot 0 contributes
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(ref1), atol=2e-5)
+
+
+def test_chunk_attention_new_kv_equals_post_write():
+    """Pre-write pool + in-chunk causal new_kv must equal the legacy form
+    with the chunk already written to the pool."""
+    rng = np.random.default_rng(9)
+    Rc, tq, nh, nkv, d, bs, NB, B = 2, 6, 4, 2, 64, 16, 12, 3
+    trash = NB - 1
+    q = jnp.asarray(rng.normal(size=(Rc, tq, nh, d)), jnp.float32)
+    kc = jnp.asarray(rng.normal(size=(NB, bs, nkv, d)), jnp.float32)
+    vc = jnp.asarray(rng.normal(size=(NB, bs, nkv, d)), jnp.float32)
+    bt = np.array([[0, 1, 2], [3, 4, trash]], np.int32)
+    start = np.array([18, 3], np.int32)
+    # row 1: only 4 valid tokens
+    q_pos = np.stack([
+        np.arange(18, 18 + tq, dtype=np.int32),
+        np.array([3, 4, 5, 6, -1, -1], np.int32),
+    ])
+    ke = jnp.asarray(rng.normal(size=(Rc, tq, nkv, d)), jnp.float32)
+    ve = jnp.asarray(rng.normal(size=(Rc, tq, nkv, d)), jnp.float32)
+    kc2, vc2 = np.asarray(kc).copy(), np.asarray(vc).copy()
+    for r in range(Rc):
+        for j in range(tq):
+            p = int(q_pos[r, j])
+            if p < 0:
+                continue
+            blk = int(bt[r, p // bs])
+            kc2[blk, p % bs] = np.asarray(ke)[r, j]
+            vc2[blk, p % bs] = np.asarray(ve)[r, j]
+    ref = paged_chunk_attention(
+        q, jnp.asarray(kc2), jnp.asarray(vc2), jnp.asarray(bt),
+        jnp.asarray(q_pos), trash,
+    )
+    out = paged_chunk_attention(
+        q, kc, vc, jnp.asarray(bt), jnp.asarray(q_pos), trash,
+        new_kv=(ke, ve), pool_limit=jnp.asarray(start),
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(out[1, 4:]), 0.0, atol=1e-6)

@@ -170,7 +170,7 @@ class TestInferenceV2:
             # params actually sharded over the model axis (not replicated)
             wq = engine.params["layers"]["wq"]
             assert len(wq.sharding.device_set) == 8
-            assert engine._k_cache.sharding.spec[3] is not None
+            assert engine._k_cache.sharding.spec[3] is not None  # [L, NB, bs, nkv, d]
             outs = engine.generate(prompts, max_new_tokens=5)
             for o, r in zip(outs, refs):
                 np.testing.assert_array_equal(o, r)
@@ -367,3 +367,31 @@ class TestInferenceV2:
         # 16-token block fills: 10 prompt + 6 generated, then capped stop
         assert len(out[0]) <= 16 + 1  # +1: last sampled token is host-side
         assert 0 in engine.last_capped
+
+
+def test_v1_fused_decode_overshoot_preserves_cache():
+    """decode_steps not dividing max_new-1: the final fused round's
+    overshoot KV writes must land in allocated spare slots, not clamp onto
+    the last in-range entry (round-4 advisor). Proof: generation with a
+    non-dividing decode_steps is token-identical to per-step decoding even
+    when the total lands exactly on a cache bucket boundary."""
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.models import TransformerConfig, init_params
+
+    mc = TransformerConfig(
+        vocab_size=128, hidden_size=64, n_layers=2, n_heads=4,
+        max_seq_len=256, dtype="float32",
+    )
+    params = init_params(mc, jax.random.key(3))
+    prompt = np.arange(1, 25, dtype=np.int32)[None]  # s=24
+    # s + max_new = 32 = exact bucket edge; decode_steps=5 !| max_new-1=7
+    ref = InferenceEngine(
+        mc, DeepSpeedInferenceConfig.from_dict({"dtype": "float32"}), params
+    ).generate(prompt, max_new_tokens=8)
+    out = InferenceEngine(
+        mc,
+        DeepSpeedInferenceConfig.from_dict({"dtype": "float32", "decode_steps": 5}),
+        params,
+    ).generate(prompt, max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))

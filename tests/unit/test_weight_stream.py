@@ -103,3 +103,61 @@ class TestGuards:
         monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
         with pytest.raises(NotImplementedError, match="Adam"):
             self._engine({"optimizer": {"type": "Lamb", "params": {"lr": 1e-3}}})
+
+
+def test_streamed_adamw_q8_trajectory_parity():
+    """int8-moment streaming (stream_quant_bits=8) must track the fp32-state
+    trajectory: same synthetic 20-step loss descent within a small relative
+    gap (VERDICT r5 guard for the quantized streamed-7B tier). Blocks are
+    256-wide, so the test leaf minor dims are 256-aligned; the 1-D bias leaf
+    is ineligible and must silently stay fp32."""
+    from deepspeed_tpu.runtime.streamed_adam import (
+        QUANT_BLOCK,
+        StreamedAdamW,
+        _dq8,
+        _q8,
+    )
+
+    # quantization primitive roundtrip: blockwise error bounded by s/2
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(4, 512)) * np.exp(rng.normal(size=(4, 512))), jnp.float32)
+    q, s = _q8(x)
+    assert q.dtype == jnp.int8 and s.shape == (4, 512 // QUANT_BLOCK)
+    err = np.abs(np.asarray(_dq8(q, s)) - np.asarray(x))
+    bound = np.repeat(np.asarray(s), QUANT_BLOCK, axis=1) * 0.5 + 1e-12
+    assert (err <= bound).all()
+
+    def run(quant_bits):
+        opt = StreamedAdamW(lr=5e-2, betas=(0.9, 0.999), eps=1e-8,
+                            weight_decay=0.0, quant_bits=quant_bits)
+        params = {
+            "w": jnp.asarray(rng2.normal(size=(16, 256)) * 0.5, jnp.float32),
+            "b": jnp.zeros((7,), jnp.float32),  # ineligible: stays fp32
+        }
+        state = opt.init(params)
+        if quant_bits == 8:
+            assert isinstance(state.inner.mu["w"], dict)
+            assert state.inner.mu["w"]["q"].dtype == jnp.int8
+            assert not isinstance(state.inner.mu["b"], dict)
+        losses = []
+        tgt = jnp.asarray(rng2b.normal(size=(16, 256)), jnp.float32)
+
+        def loss_fn(p):
+            return jnp.mean((p["w"] - tgt) ** 2) + jnp.mean(p["b"] ** 2)
+
+        for _ in range(20):
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            losses.append(float(loss))
+            params, state = opt.step(grads, state, params, jnp.float32(5e-2))
+        return losses
+
+    import numpy as _np
+    rng2 = _np.random.default_rng(1); rng2b = _np.random.default_rng(2)
+    fp32_losses = run(0)
+    rng2 = _np.random.default_rng(1); rng2b = _np.random.default_rng(2)
+    q8_losses = run(8)
+    # identical descent shape; late-step relative gap stays small
+    assert q8_losses[0] == fp32_losses[0]
+    for a, b in zip(q8_losses, fp32_losses):
+        assert abs(a - b) <= 0.03 * max(abs(b), 1e-6), (a, b)
+    assert q8_losses[-1] < 0.5 * q8_losses[0]  # actually descending
