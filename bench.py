@@ -287,13 +287,17 @@ def bench_serving(train_cfg):
     params = init_params(cfg, jax.random.key(0))
     rc = RaggedInferenceEngineConfig.from_dict({
         "dtype": "bfloat16", "decode_steps": 64,
-        # 256x4 prompt-chunk grid: found by `dstpu_bench --tune-serving`
-        # (979.8 vs 812.2 gen tok/s for the hand-picked 512x2 — the tuner
-        # beat the hand-picked config, PERF.md round-5 serving sweep)
+        # tuned for THIS workload by `dstpu_bench --tune-serving` (PERF.md
+        # round-5 serving sweep): 256x4 prompt-chunk grid (979.8 vs 812.2
+        # for the hand-picked 512x2) and a block table sized to the
+        # workload's <=576-token contexts (B=5 x 128 — the decode gather
+        # reads the whole table, so over-provisioned slots are pure wasted
+        # HBM traffic). An operator serving longer contexts raises
+        # max_blocks_per_seq/max_context and re-tunes.
         "prompt_chunk": 256, "max_prompt_chunks": 4,
-        "kv_cache": {"block_size": 128, "num_blocks": 512, "max_blocks_per_seq": 8},
+        "kv_cache": {"block_size": 128, "num_blocks": 512, "max_blocks_per_seq": 5},
         "state_manager": {"max_tracked_sequences": 64, "max_ragged_batch_size": 1024,
-                          "max_ragged_sequence_count": 32, "max_context": 1024},
+                          "max_ragged_sequence_count": 32, "max_context": 640},
     })
     from deepspeed_tpu.inference.v2.engine_v2 import serving_benchmark
 

@@ -352,7 +352,7 @@ class Autotuner:
         return "\n".join(lines)
 
 
-def tune_serving(max_experiments: int = 6, metric: str = "gen_tok_s",
+def tune_serving(max_experiments: int = 8, metric: str = "gen_tok_s",
                  timeout_s: int = 900, space=None, platform=None):
     """Autotune the v2 serving engine's knobs against generated tok/s
     (reference ``autotuning_metric`` throughput mode, autotuner.py:42,
@@ -377,6 +377,10 @@ def tune_serving(max_experiments: int = 6, metric: str = "gen_tok_s",
          "block_size": 256, "num_blocks": 256, "max_blocks_per_seq": 4},
         {"decode_steps": 128, "prompt_chunk": 512, "max_prompt_chunks": 2,
          "max_new": 128},
+        # right-sized block table: the decode gather reads the WHOLE table,
+        # so slots beyond the workload's max context are wasted HBM traffic
+        {"decode_steps": 64, "prompt_chunk": 256, "max_prompt_chunks": 4,
+         "max_blocks_per_seq": 5, "max_context": 640},
     ]
     if space is None:
         space = default_space

@@ -67,7 +67,7 @@ def run_serving(exp: dict) -> dict:
             "max_tracked_sequences": 64,
             "max_ragged_batch_size": int(exp.get("token_budget", 1024)),
             "max_ragged_sequence_count": int(exp.get("concurrency", 32)),
-            "max_context": 1024,
+            "max_context": int(exp.get("max_context", 1024)),
         },
     })
     from deepspeed_tpu.inference.v2.engine_v2 import serving_benchmark
