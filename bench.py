@@ -113,7 +113,10 @@ def bench_7b_streamed(peak: float):
     from deepspeed_tpu.parallel.topology import reset_topology
 
     last_err = None
-    for bsz in (8, 4, 1):
+    # 16 measured as the largest batch that compiles at 7B (24/32 exceed
+    # HBM); the wire traffic is per-STEP so batch 8 -> 16 bought
+    # 770 -> 1175 tok/s on top of the int8 moment streaming (PERF.md)
+    for bsz in (16, 8, 4, 1):
         try:
             out = _bench_7b_streamed_at(peak, bsz)
             if last_err:
