@@ -132,6 +132,62 @@ def bench_7b_streamed(peak: float):
     raise RuntimeError(last_err)
 
 
+def bench_overlap_ab(cfg, seq, steps=5, warmup=2):
+    """A/B the bucketed ZeRO-3 comm/compute overlap (``overlap_comm``):
+    the same ZeRO-3 data-parallel engine with the default bucketed
+    collectives + chunked-scan prefetch vs the per-leaf escape hatch
+    (``overlap_comm: false``). The two runs must report the same loss —
+    the bucketed exchange is bitwise-identical — so the delta is pure
+    schedule. Only meaningful with >1 device (collectives are what gets
+    bucketed); single-device boxes skip."""
+    import gc
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import init_params, make_loss_fn
+    from deepspeed_tpu.parallel.topology import reset_topology
+
+    ndev = len(jax.devices())
+    if ndev < 2:
+        return {"skipped": "needs >1 device"}
+    bsz = ndev * max(1, int(os.environ.get("DSTPU_BENCH_AB_MICRO", "2")))
+    toks = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=(bsz, seq + 1)
+    ).astype(np.int32)
+    out = {}
+    for label, overlap in (("overlap_on", True), ("overlap_off", False)):
+        reset_topology()
+        gc.collect()
+        params = init_params(cfg, jax.random.key(0))
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=make_loss_fn(cfg),
+            model_parameters=params,
+            config={
+                "train_batch_size": bsz,
+                "bf16": {"enabled": jax.default_backend() == "tpu"},
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+                "zero_optimization": {"stage": 3, "overlap_comm": overlap},
+                "mesh": {"data": ndev},
+                "steps_per_print": 10**9,
+            },
+        )
+        batch = {"input_ids": toks}
+        for _ in range(warmup):
+            float(engine.train_batch(batch=batch))
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = engine.train_batch(batch=batch)
+        loss = float(loss)  # device sync before stopping the clock
+        dt = (time.perf_counter() - t0) / steps
+        out[label] = {"s_per_step": round(dt, 4), "loss": round(loss, 5)}
+        del engine, params
+    out["speedup"] = round(
+        out["overlap_off"]["s_per_step"] / out["overlap_on"]["s_per_step"], 3
+    )
+    reset_topology()
+    gc.collect()
+    return out
+
+
 def v5e64_projection():
     """Analytic feasibility of the north-star config (Llama-2-7B ZeRO-3 on
     v5e-64) from the autotuner's memory model — per-chip model-state +
@@ -214,7 +270,9 @@ def main():
             vocab_size=512, hidden_size=128, n_layers=2, n_heads=4,
             max_seq_len=256, dtype="float32",
         )
-        bsz, seq, steps, warmup = 4, 128, 3, 1
+        # batch scales with the (possibly virtual) device count so the DP
+        # micro-batch stays >=1 when XLA_FLAGS fakes a multi-device mesh
+        bsz, seq, steps, warmup = max(4, len(jax.devices())), 128, 3, 1
 
     params = init_params(cfg, jax.random.key(0))
     engine, _, _, _ = deepspeed_tpu.initialize(
@@ -255,6 +313,11 @@ def main():
     if streamed_7b is not None:
         out["streamed_7b"] = streamed_7b
         out["v5e64_projection"] = v5e64_projection()
+    if os.environ.get("DSTPU_BENCH_SKIP_OVERLAP_AB", "0") != "1":
+        try:
+            out["overlap_ab"] = bench_overlap_ab(cfg, seq)
+        except Exception as e:  # the headline metric must survive
+            out["overlap_ab"] = {"error": f"{type(e).__name__}: {e}"[:200]}
     if on_tpu and os.environ.get("DSTPU_BENCH_SKIP_SERVING", "0") != "1":
         # free the training engine's HBM residency (params + fp32 Adam state
         # ~12.7 GB) before the serving engine allocates its KV pool

@@ -9,6 +9,7 @@ Public API mirrors the reference ``deepspeed/__init__.py``:
 
 from typing import Any, Callable, Optional, Union
 
+from deepspeed_tpu import _jax_compat  # noqa: F401  — must run before jax users below
 from deepspeed_tpu.version import __version__
 from deepspeed_tpu import comm
 from deepspeed_tpu.runtime import zero

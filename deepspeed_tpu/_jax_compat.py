@@ -1,0 +1,117 @@
+"""Compatibility shims for older jax releases.
+
+The codebase targets the current jax API surface (``jax.shard_map``,
+``jax.typeof``, ``jax.memory.Space``, per-device ``pinned_host`` memories).
+Older runtimes (jax < 0.5) ship the same functionality under
+``jax.experimental.shard_map`` and have no typed memory spaces on CPU.  This
+module patches the gaps once, at package import, so the rest of the code can
+use the modern spellings unconditionally:
+
+  * ``jax.shard_map`` — thin wrapper over ``jax.experimental.shard_map``
+    translating ``check_vma`` -> ``check_rep`` and dropping ``axis_names``
+    (implicit in the mesh there).
+  * ``jax.memory.Space`` / ``jax.typeof`` — sentinel fallback.  On a backend
+    with a single memory space (CPU without ``pinned_host``) every array
+    reports ``Space.Device`` and ``device_put`` to a Space is the identity,
+    which preserves numerics: host staging becomes a no-op rather than an
+    error.  On real TPU runtimes the native API is untouched.
+  * ``host_memory_kind()`` — returns ``"pinned_host"`` when the default
+    device exposes that memory kind, else ``None`` (NamedSharding treats
+    ``memory_kind=None`` as the default memory).
+"""
+
+import types
+
+import jax
+
+__all__ = ["host_memory_kind"]
+
+
+def _ensure_shard_map():
+    if hasattr(jax, "shard_map"):
+        return
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None, axis_names=None,
+                  check_vma=None, **kwargs):
+        del axis_names  # implicit in `mesh` for the legacy API
+        if check_vma is not None and "check_rep" not in kwargs:
+            kwargs["check_rep"] = check_vma
+        return _legacy_shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, **kwargs)
+
+    jax.shard_map = shard_map
+
+
+def _ensure_axis_size():
+    if hasattr(jax.lax, "axis_size"):
+        return
+    from jax._src import core as _core
+
+    def axis_size(axis_name):
+        frame = _core.axis_frame(axis_name)
+        return getattr(frame, "size", frame)
+
+    jax.lax.axis_size = axis_size
+
+
+class _SpaceSentinel:
+    """Stand-in for ``jax.memory.Space`` members on single-memory backends."""
+
+    def __init__(self, name):
+        self._name = name
+
+    def __repr__(self):
+        return f"MemorySpace({self._name})"
+
+
+def _ensure_memory_space():
+    if hasattr(jax, "memory") and hasattr(jax, "typeof"):
+        return
+
+    device = _SpaceSentinel("Device")
+    host = _SpaceSentinel("Host")
+
+    if not hasattr(jax, "memory"):
+        memory = types.SimpleNamespace(
+            Space=types.SimpleNamespace(Device=device, Host=host))
+        jax.memory = memory
+    else:  # pragma: no cover - memory exists but typeof missing
+        device = jax.memory.Space.Device
+        host = jax.memory.Space.Host
+
+    if not hasattr(jax, "typeof"):
+        _everything_on_device = types.SimpleNamespace(memory_space=device)
+
+        def typeof(x):
+            del x
+            return _everything_on_device
+
+        jax.typeof = typeof
+
+    # device_put(x, Space.*) degrades to identity: one memory space means the
+    # host/device distinction carries no information, and numerics are
+    # unchanged (staging vjps become identities).
+    _orig_device_put = jax.device_put
+
+    def device_put(x, device_or_space=None, *args, **kwargs):
+        if device_or_space is device or device_or_space is host:
+            return x
+        return _orig_device_put(x, device_or_space, *args, **kwargs)
+
+    jax.device_put = device_put
+
+
+def host_memory_kind():
+    """``"pinned_host"`` when supported by the default device, else ``None``."""
+    try:
+        dev = jax.devices()[0]
+        kinds = {m.kind for m in dev.addressable_memories()}
+    except Exception:
+        return "pinned_host"
+    return "pinned_host" if "pinned_host" in kinds else None
+
+
+_ensure_shard_map()
+_ensure_axis_size()
+_ensure_memory_space()

@@ -101,6 +101,15 @@ def generate_main(argv=None) -> int:
         outs = eng.generate(enc, max_new_tokens=args.max_new_tokens, eos_token_id=eos)
         gen_ids = [np.asarray(o)[len(e):] for o, e in zip(outs, enc)]
     else:
+        if args.top_k or args.top_p:
+            import sys
+
+            print(
+                "warning: --top-k/--top-p are ignored by --engine v1 "
+                "(its sampler is temperature-only); use --engine v2 for "
+                "filtered sampling",
+                file=sys.stderr,
+            )
         from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
         from deepspeed_tpu.inference.engine import InferenceEngine
 

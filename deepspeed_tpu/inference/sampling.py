@@ -55,8 +55,11 @@ def sample_tokens(
         toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         dist = logits
     else:
-        dist = filter_logits(logits, top_k=top_k, top_p=top_p) / jnp.maximum(
-            temperature, 1e-4
+        # temperature FIRST, then top-k/top-p on the scaled logits (the HF /
+        # MII LogitsWarper order: top_p mass is measured on the tempered
+        # distribution, so temperature changes WHICH tokens survive the cut)
+        dist = filter_logits(
+            logits / jnp.maximum(temperature, 1e-4), top_k=top_k, top_p=top_p
         )
         toks = jax.random.categorical(rng, dist).astype(jnp.int32)
     if not return_logprobs:

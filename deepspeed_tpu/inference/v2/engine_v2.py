@@ -568,7 +568,10 @@ class InferenceEngineV2:
                 toks_dec, toks_chk, k_new, v_new,
             )
 
-        return jax.jit(step, donate_argnums=(12, 13))
+        # donate BOTH cache pools (args 13 and 14 — k_cache, v_cache) so the
+        # scatter updates alias in place; donating 12 would hand XLA the
+        # scalar `temperature` instead of v_cache and copy a full V pool
+        return jax.jit(step, donate_argnums=(13, 14))
 
     def _round_layer(self, lp, x, li, meta, carry, window=None):
         """One layer of one step of a fused decode ROUND: queries are the
@@ -841,7 +844,11 @@ class InferenceEngineV2:
             )
             if not dec
         ]
-        assert len(dec_rows) <= R and len(chk_rows) <= Rc
+        if len(dec_rows) > R or len(chk_rows) > Rc:
+            raise RuntimeError(
+                f"split-phase batch overflow: {len(dec_rows)} decode rows "
+                f"(cap {R}), {len(chk_rows)} prompt chunks (cap {Rc})"
+            )
         max_chunk = max((len(t) for _, t, _, _ in chk_rows), default=1)
         # chunk-length buckets: two shapes keep short prompts off the full
         # prompt_chunk pad without a compile per ragged length
@@ -1014,7 +1021,11 @@ class InferenceEngineV2:
                 # slot supply cannot run out: submit() caps tracked
                 # sequences at max_tracked_sequences and nothing finishes
                 # during phase 1, so completions per phase <= cap
-                assert next_slot < cap, "prefill-phase completions exceed slot capacity"
+                if next_slot >= cap:
+                    raise RuntimeError(
+                        "prefill-phase completions exceed slot capacity "
+                        f"({next_slot} >= {cap})"
+                    )
                 g = groups[id(e[2])]
                 g[1].append(e[1])
                 g[2].append(next_slot)

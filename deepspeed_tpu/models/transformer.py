@@ -31,6 +31,7 @@ TPU-first design decisions:
 
 import dataclasses
 import math
+from contextlib import contextmanager
 from functools import partial
 from typing import Any, Dict, Optional, Tuple
 
@@ -547,6 +548,36 @@ def _stream_active(c: TransformerConfig) -> bool:
     return c.weight_stream and jax.default_backend() == "tpu"
 
 
+# ---------------------------------------------------------------------------
+# bucketed parameter prefetch (ZeRO-3 comm/compute overlap)
+# ---------------------------------------------------------------------------
+# Scan-chunk width for ``forward_hidden``: with chunk B > 1 the layer scan
+# runs over L/B chunks of B layers each, the inner B layers unrolled in the
+# scan body. Layer b+1's parameter all-gather (ZeRO-3 GSPMD) or
+# pinned_host→HBM stage (weight_stream) is data-independent of layer b's
+# output, so inside ONE body the latency-hiding scheduler overlaps
+# collective(b+1) with compute(b) — impossible across sequential scan
+# iterations, where iteration i+1's HLO only exists after iteration i
+# completes. B=2 is the two-slot double buffer; the engine sizes B from
+# ``stage3_prefetch_bucket_size`` (runtime/zero/overlap.py overlap_chunk).
+# Set via the ``overlap_scan`` context manager around TRACING (the engine
+# wraps its loss calls); read once at trace time, so compiled steps keep the
+# chunking they were traced with.
+_OVERLAP_SCAN_CHUNK = 1
+
+
+@contextmanager
+def overlap_scan(chunk_layers: int):
+    """Trace-scoped layer-scan chunking for comm/compute overlap."""
+    global _OVERLAP_SCAN_CHUNK
+    prev = _OVERLAP_SCAN_CHUNK
+    _OVERLAP_SCAN_CHUNK = max(1, int(chunk_layers))
+    try:
+        yield
+    finally:
+        _OVERLAP_SCAN_CHUNK = prev
+
+
 def _maybe_stage(w):
     """Stage only leaves that actually live in host memory (the engine keeps
     small leaves — norm vectors, biases — device-resident: their [1, h] scan
@@ -1045,21 +1076,44 @@ def forward_hidden(
 
     if c.attn_layer_pattern is not None:
         flags = jnp.asarray(c.attn_layer_pattern, jnp.int32)
+        xs = (params["layers"], flags)
 
-        def scan_body(carry, xs):
-            lp, flag = xs
-            y, aux = layer_fn(lp, carry, positions, segment_ids, flag)
-            return y, aux
+        def call_layer(xs_i, x):
+            lp, flag = xs_i
+            return layer_fn(lp, x, positions, segment_ids, flag)
+    else:
+        xs = params["layers"]
 
-        x, aux_losses = jax.lax.scan(scan_body, x, (params["layers"], flags))
+        def call_layer(xs_i, x):
+            return layer_fn(xs_i, x, positions, segment_ids)
+
+    n_layer = jax.tree_util.tree_leaves(xs)[0].shape[0]
+    chunk = _OVERLAP_SCAN_CHUNK
+    if chunk > 1 and n_layer % chunk == 0:
+        # bucketed prefetch: scan L/chunk chunks, the inner `chunk` layers
+        # unrolled so layer b+1's weight gather/stage (which stays INSIDE
+        # the remat'd layer body — hoisting it out would pin every gathered
+        # layer as a saved residual) sits in the same scan body as layer
+        # b's compute, where the scheduler can overlap them
+        xs_c = jax.tree.map(
+            lambda a: a.reshape((n_layer // chunk, chunk) + a.shape[1:]), xs
+        )
+
+        def scan_body(carry, xs_b):
+            x = carry
+            auxs = []
+            for b_i in range(chunk):
+                x, aux = call_layer(jax.tree.map(lambda a: a[b_i], xs_b), x)
+                auxs.append(aux)
+            return x, jnp.stack(auxs)
+
+        x, aux_losses = jax.lax.scan(scan_body, x, xs_c)
     else:
 
-        def scan_body(carry, lp):
-            x = carry
-            x, aux = layer_fn(lp, x, positions, segment_ids)
-            return x, aux
+        def scan_body(carry, xs_i):
+            return call_layer(xs_i, carry)
 
-        x, aux_losses = jax.lax.scan(scan_body, x, params["layers"])
+        x, aux_losses = jax.lax.scan(scan_body, x, xs)
     if c.final_norm:
         fn_w = _maybe_stage(params["final_norm"]) if stream else params["final_norm"]
         fn_b = params.get("final_norm_b")

@@ -188,7 +188,10 @@ def paged_attention(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((T, nh, d), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        # pre-0.5 jax spells it TPUCompilerParams
+        compiler_params=getattr(
+            pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+        )(
             # tokens are independent (scratch re-inits at j==0) → megacore
             # can split the T dim; only the block dim accumulates
             dimension_semantics=("parallel", "arbitrary")

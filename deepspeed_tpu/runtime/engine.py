@@ -351,6 +351,9 @@ class DeepSpeedEngine:
                     config.zero_optimization.offload_optimizer,
                     "stream_quant_bits", 0,
                 ) or 0),
+                # double-buffered state-window streaming rides the same
+                # escape hatch as the collective overlap scheduler
+                overlap=config.zero_optimization.overlap_enabled,
             )
         self._host_opt = None
         self._host_step_jit = None
@@ -385,6 +388,38 @@ class DeepSpeedEngine:
                 if self.plan.offload_optimizer:
                     self.opt_state = jax.device_put(self.opt_state, self._state_shardings)
         self.params = self._park_params(self.params)
+
+        # Bucketed comm/compute overlap (runtime/zero/overlap.py): resolve
+        # the overlap_comm knob once and size the transformer scan-chunk for
+        # parameter prefetch from the model's per-layer footprint. The
+        # chunked and unchunked/unbucketed paths are loss-identical — the
+        # escape hatch (overlap_comm: false) only changes the schedule.
+        zcfg_o = config.zero_optimization
+        self._overlap = zcfg_o.overlap_enabled
+        self._reduce_bucket_bytes = int(zcfg_o.reduce_bucket_size)
+        self._prefetch_bucket_bytes = int(zcfg_o.effective_prefetch_bucket_size)
+        self._overlap_scan_chunk = 1
+        if (
+            self._overlap
+            and _mc is not None
+            and (zcfg_o.stage == 3 or self._weight_stream)
+        ):
+            try:
+                from deepspeed_tpu.runtime.zero.overlap import overlap_chunk
+
+                stacked = self.params.get("layers") if isinstance(self.params, dict) else None
+                if stacked is not None:
+                    leaves = jax.tree_util.tree_leaves(stacked)
+                    n_layer = int(leaves[0].shape[0])
+                    layer_bytes = sum(
+                        int(np.prod(l.shape[1:] or (1,))) * np.dtype(l.dtype).itemsize
+                        for l in leaves
+                    )
+                    self._overlap_scan_chunk = overlap_chunk(
+                        n_layer, layer_bytes, self._prefetch_bucket_bytes
+                    )
+            except Exception:  # non-transformer param trees: no scan to chunk
+                self._overlap_scan_chunk = 1
 
         # loss scaling
         self.scaler_cfg = ls.make_config(config.fp16) if self.fp16_enabled else ls.LossScalerConfig(
@@ -640,10 +675,18 @@ class DeepSpeedEngine:
         return jax.random.fold_in(self._rng_key, step)
 
     def _call_loss(self, params, batch, rng):
-        if self._loss_fn_takes_rng:
-            out = self.loss_fn(params, batch, rng)
-        else:
-            out = self.loss_fn(params, batch)
+        ctx = contextlib.nullcontext()
+        if getattr(self, "_overlap_scan_chunk", 1) > 1:
+            # trace-scoped: the model's layer scan runs chunked (bucketed
+            # parameter prefetch — models/transformer.py overlap_scan)
+            from deepspeed_tpu.models.transformer import overlap_scan
+
+            ctx = overlap_scan(self._overlap_scan_chunk)
+        with ctx:
+            if self._loss_fn_takes_rng:
+                out = self.loss_fn(params, batch, rng)
+            else:
+                out = self.loss_fn(params, batch)
         if isinstance(out, tuple):
             return out[0], out[1] if len(out) > 1 else None
         return out, None
@@ -1059,6 +1102,17 @@ class DeepSpeedEngine:
             _data_only, grad_specs, is_leaf=lambda x: isinstance(x, P)
         )
 
+        overlap = getattr(self, "_overlap", True)
+        if overlap:
+            from deepspeed_tpu.runtime.zero.overlap import (
+                assign_buckets,
+                bucketed_all_gather,
+                bucketed_loco_quantized_reduce_scatter,
+                bucketed_psum_scatter,
+                bucketed_quantized_all_gather,
+                bucketed_quantized_reduce_scatter,
+            )
+
         def gather_leaf(x, spec):
             k = self._data_dim(spec)
             if k is None:
@@ -1087,11 +1141,106 @@ class DeepSpeedEngine:
                 jax.lax.psum_scatter(g, DATA_AXIS, scatter_dimension=k, tiled=True) / W
             ).astype(g.dtype), err
 
+        def _nbytes(x):
+            return int(np.prod(x.shape or (1,))) * np.dtype(x.dtype).itemsize
+
+        def gather_all(flat_p, flat_ps):
+            """All-gather the ZeRO-3 param slices. Overlap ON: sharded
+            leaves group into prefetch-bucket-sized fused collectives
+            (one wire launch per bucket — independent ops the scheduler
+            pipelines); OFF: the original per-leaf chain. Both orders
+            produce bitwise-identical gathered leaves."""
+            if not overlap:
+                return [gather_leaf(x, s) for x, s in zip(flat_p, flat_ps)]
+            ks = [self._data_dim(s) for s in flat_ps]
+            out = list(flat_p)
+            if qwz:
+                idxs = [i for i, k in enumerate(ks) if k is not None]
+                groups = [idxs] if idxs else []
+            else:
+                # plain gathers concatenate raw payloads: same-dtype only
+                by_dt = {}
+                for i, k in enumerate(ks):
+                    if k is not None:
+                        by_dt.setdefault(flat_p[i].dtype, []).append(i)
+                groups = list(by_dt.values())
+            fuse = (
+                bucketed_quantized_all_gather if qwz else bucketed_all_gather
+            )
+            for idxs in groups:
+                buckets = assign_buckets(
+                    [_nbytes(flat_p[i]) for i in idxs], self._prefetch_bucket_bytes
+                )
+                for b in buckets:
+                    sel = [idxs[j] for j in b]
+                    res = fuse(
+                        [flat_p[i] for i in sel], [ks[i] for i in sel], DATA_AXIS
+                    )
+                    for i, r in zip(sel, res):
+                        out[i] = r
+            return out
+
+        def reduce_all(flat_g, flat_gs, flat_e):
+            """Reduce-scatter the grads. Overlap ON: dim-sharded leaves
+            group into reduce-bucket-sized fused collectives launched as
+            each bucket's grads exist — independent of later buckets, so
+            the scheduler overlaps them with remaining backward compute.
+            Replicated (k=None) leaves and the unbucketed path keep the
+            per-leaf collectives. Returns (reduced list, new-err list)."""
+            ks = [self._data_dim(s) for s in flat_gs]
+            if not overlap:
+                pairs = [
+                    reduce_leaf(g, s, e)
+                    for g, s, e in zip(flat_g, flat_gs, flat_e)
+                ]
+                return [p[0] for p in pairs], [p[1] for p in pairs]
+            out_g = list(flat_g)
+            out_e = list(flat_e)
+            q_idx, plain_by_dt = [], {}
+            for i, (g, k) in enumerate(zip(flat_g, ks)):
+                if k is None or not (qgz and g.size >= self.QGZ_MIN_SIZE):
+                    if k is None:
+                        out_g[i], out_e[i] = reduce_leaf(g, None, flat_e[i])
+                    else:
+                        plain_by_dt.setdefault(g.dtype, []).append(i)
+                else:
+                    q_idx.append(i)
+            for idxs in plain_by_dt.values():
+                buckets = assign_buckets(
+                    [_nbytes(flat_g[i]) for i in idxs], self._reduce_bucket_bytes
+                )
+                for b in buckets:
+                    sel = [idxs[j] for j in b]
+                    res = bucketed_psum_scatter(
+                        [flat_g[i] for i in sel], [ks[i] for i in sel], DATA_AXIS
+                    )
+                    for i, r in zip(sel, res):
+                        out_g[i] = r
+            buckets = assign_buckets(
+                [_nbytes(flat_g[i]) for i in q_idx], self._reduce_bucket_bytes
+            )
+            for b in buckets:
+                sel = [q_idx[j] for j in b]
+                gs = [flat_g[i] for i in sel]
+                ds = [ks[i] for i in sel]
+                if loco:
+                    res, errs = bucketed_loco_quantized_reduce_scatter(
+                        gs, [flat_e[i] for i in sel], ds, DATA_AXIS,
+                        err_beta=err_beta,
+                    )
+                    for i, r, e2 in zip(sel, res, errs):
+                        out_g[i], out_e[i] = r, e2
+                else:
+                    res = bucketed_quantized_reduce_scatter(gs, ds, DATA_AXIS)
+                    for i, r in zip(sel, res):
+                        out_g[i] = r
+            return out_g, out_e
+
         def inner(params, mb, rng, scale, loco_state):
             flat_p, treedef = jax.tree_util.tree_flatten(params)
             flat_ps = treedef.flatten_up_to(param_specs)
             full = jax.tree_util.tree_unflatten(
-                treedef, [gather_leaf(x, s) for x, s in zip(flat_p, flat_ps)]
+                treedef, gather_all(flat_p, flat_ps)
             )
 
             def scaled_loss(p):
@@ -1102,15 +1251,14 @@ class DeepSpeedEngine:
             flat_g = treedef.flatten_up_to(g_full)
             flat_gs = treedef.flatten_up_to(grad_specs)
             # local err slices arrive [1, ...] (P(DATA_AXIS) on dim 0)
-            flat_e = treedef.flatten_up_to(loco_state)
-            pairs = [
-                reduce_leaf(g, s, e[0] if e.size else e)
-                for g, s, e in zip(flat_g, flat_gs, flat_e)
+            flat_e = [
+                e[0] if e.size else e
+                for e in treedef.flatten_up_to(loco_state)
             ]
-            grads = jax.tree_util.tree_unflatten(treedef, [p[0] for p in pairs])
+            red_g, red_e = reduce_all(flat_g, flat_gs, flat_e)
+            grads = jax.tree_util.tree_unflatten(treedef, red_g)
             new_loco = jax.tree_util.tree_unflatten(
-                treedef,
-                [e2[None] if e2.size else e2 for e2 in (p[1] for p in pairs)],
+                treedef, [e2[None] if e2.size else e2 for e2 in red_e]
             )
             return jax.lax.pmean(loss_scaled, DATA_AXIS) / scale, grads, new_loco
 
@@ -1676,6 +1824,8 @@ class DeepSpeedEngine:
         try:
             log_dist("flops profile: lowering step for cost analysis (one-time)", ranks=[0])
             cost = self._train_step_jit.lower(*args).compile().cost_analysis() or {}
+            if isinstance(cost, (list, tuple)):  # pre-0.5 jax: per-device dicts
+                cost = cost[0] if cost else {}
         except Exception as e:  # profiling must never break training
             logger.warning(f"flops profile failed: {e}")
             return

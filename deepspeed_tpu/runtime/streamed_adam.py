@@ -118,10 +118,20 @@ def _adamw_math(g, m, mu, nu, lr, b1, b2, eps, wd, c1, c2):
 
 
 def streamed_adamw_leaf(
-    g, m, mu, nu, p, lr, *, b1, b2, eps, wd, c1, c2, chunk=DEFAULT_CHUNK_ELEMS
+    g, m, mu, nu, p, lr, *, b1, b2, eps, wd, c1, c2, chunk=DEFAULT_CHUNK_ELEMS,
+    double_buffer=True,
 ):
     """Update one leaf. Host leaves stream through the device in 1-D chunks;
     device leaves (small) update in one pass.
+
+    ``double_buffer`` (default ON — engine escape hatch ``overlap_comm:
+    false``): the loop carries window ``i``'s device slices staged during
+    iteration ``i-1`` and stages window ``i+1`` before computing ``i``, so
+    the host→HBM copies overlap the AdamW math instead of serializing ahead
+    of it — an explicit two-slot buffer in place of XLA's implicit latency
+    hiding. Reads touch INPUT buffers only (writes land in separate carry
+    copies), so pre-staging never observes a partial update and the
+    schedule change is numerics-free.
 
     Returns (new_master, new_mu, new_nu, new_param) in the input placements.
     """
@@ -158,18 +168,20 @@ def streamed_adamw_leaf(
     window = (rows,) + shape[1:]
     zero_tail = (0,) * (len(shape) - 1)
 
-    def body(i, carry):
-        mo, muo, nuo, po = carry
+    def _start(i):
         # clamped start: the tail window re-covers part of the previous one;
         # the update reads INPUT buffers only, so the overlap writes the
         # same values twice (idempotent)
-        off = jnp.minimum(i * rows, dim0 - rows)
-        start = (off,) + zero_tail
-        ds = lambda a: jax.lax.dynamic_slice(a, start, window)  # noqa: E731
-        m2, mu2, nu2 = _adamw_math(
-            _to_dev(ds(g)), _to_dev(ds(m)), _to_dev(ds(mu)), _to_dev(ds(nu)),
-            lr, b1, b2, eps, wd, c1, c2,
-        )
+        return (jnp.minimum(i * rows, dim0 - rows),) + zero_tail
+
+    def _stage(i):
+        start = _start(i)
+        ds = lambda a: _to_dev(jax.lax.dynamic_slice(a, start, window))  # noqa: E731
+        return ds(g), ds(m), ds(mu), ds(nu)
+
+    def _writeback(i, carry, m2, mu2, nu2):
+        mo, muo, nuo, po = carry
+        start = _start(i)
         p2 = m2.astype(p.dtype)
         mo = jax.lax.dynamic_update_slice(mo, _to_host(m2), start)
         muo = jax.lax.dynamic_update_slice(muo, _to_host(mu2), start)
@@ -177,11 +189,32 @@ def streamed_adamw_leaf(
         po = jax.lax.dynamic_update_slice(po, _to_host(p2), start)
         return mo, muo, nuo, po
 
+    if double_buffer:
+
+        def body(i, carry):
+            out, staged = carry
+            # stage window i+1 FIRST — independent of window i's math, so
+            # the copy pipelines behind it (slot 2; the final iteration's
+            # clamped re-stage is discarded)
+            nxt = _stage(jnp.minimum(i + 1, n_chunks - 1))
+            gm, mm, mum, num = staged
+            m2, mu2, nu2 = _adamw_math(gm, mm, mum, num, lr, b1, b2, eps, wd, c1, c2)
+            return _writeback(i, out, m2, mu2, nu2), nxt
+
+        out, _ = jax.lax.fori_loop(0, n_chunks, body, ((m, mu, nu, p), _stage(0)))
+        return out
+
+    def body(i, carry):
+        gm, mm, mum, num = _stage(i)
+        m2, mu2, nu2 = _adamw_math(gm, mm, mum, num, lr, b1, b2, eps, wd, c1, c2)
+        return _writeback(i, carry, m2, mu2, nu2)
+
     return jax.lax.fori_loop(0, n_chunks, body, (m, mu, nu, p))
 
 
 def streamed_adamw_leaf_q8(
-    g, m, mu, nu, p, lr, *, b1, b2, eps, wd, c1, c2, chunk=DEFAULT_CHUNK_ELEMS
+    g, m, mu, nu, p, lr, *, b1, b2, eps, wd, c1, c2, chunk=DEFAULT_CHUNK_ELEMS,
+    double_buffer=True,
 ):
     """Quantized-moment variant: mu/nu are {"q": int8 leaf, "s": fp32
     per-256-block scales, FLAT 1-D} dicts. Halves the wire bytes of the
@@ -242,20 +275,26 @@ def streamed_adamw_leaf_q8(
     mu_s_dev = _to_dev(mu["s"])
     nu_s_dev = _to_dev(nu["s"])
 
-    def body(i, carry):
-        mo, mu_qo, mu_sd, nu_qo, nu_sd, po = carry
+    def _start(i):
         # clamped tail re-covers part of the previous window; reads touch
         # INPUT buffers only, so the double-write is idempotent for the
         # host outputs. The DEVICE-carried scales are read via the ORIGINAL
         # inputs' windows (mu_s_dev closure) for the same reason.
-        off = jnp.minimum(i * rows, dim0 - rows)
-        start = (off,) + zero_tail
+        return (jnp.minimum(i * rows, dim0 - rows),) + zero_tail
+
+    def _stage(i):
+        start = _start(i)
         ds = lambda a: _to_dev(jax.lax.dynamic_slice(a, start, window))  # noqa: E731
-        mu_f = _dq8_mu(ds(mu["q"]), jax.lax.dynamic_slice(mu_s_dev, start, swindow))
-        nu_f = _dq8_nu(ds(nu["q"]), jax.lax.dynamic_slice(nu_s_dev, start, swindow))
-        m2, mu2, nu2 = _adamw_math(
-            ds(g), ds(m), mu_f, nu_f, lr, b1, b2, eps, wd, c1, c2
-        )
+        ss = lambda a: jax.lax.dynamic_slice(a, start, swindow)  # noqa: E731
+        return ds(g), ds(m), ds(mu["q"]), ss(mu_s_dev), ds(nu["q"]), ss(nu_s_dev)
+
+    def _update(i, carry, staged):
+        mo, mu_qo, mu_sd, nu_qo, nu_sd, po = carry
+        gm, mm, mu_qw, mu_sw, nu_qw, nu_sw = staged
+        start = _start(i)
+        mu_f = _dq8_mu(mu_qw, mu_sw)
+        nu_f = _dq8_nu(nu_qw, nu_sw)
+        m2, mu2, nu2 = _adamw_math(gm, mm, mu_f, nu_f, lr, b1, b2, eps, wd, c1, c2)
         p2 = m2.astype(p.dtype)
         mu_q, mu_s = _q8_mu(mu2)
         nu_q, nu_s = _q8_nu(nu2)
@@ -267,9 +306,26 @@ def streamed_adamw_leaf_q8(
         po = jax.lax.dynamic_update_slice(po, _to_host(p2), start)
         return mo, mu_qo, mu_sd, nu_qo, nu_sd, po
 
-    mo, mu_qo, mu_sd, nu_qo, nu_sd, po = jax.lax.fori_loop(
-        0, n_chunks, body, (m, mu["q"], mu_s_dev, nu["q"], nu_s_dev, p)
-    )
+    init = (m, mu["q"], mu_s_dev, nu["q"], nu_s_dev, p)
+    if double_buffer:
+        # two-slot window streaming: compute window i from the slices staged
+        # last iteration while window i+1's host→HBM copies run behind it
+        def body(i, carry):
+            out, staged = carry
+            nxt = _stage(jnp.minimum(i + 1, n_chunks - 1))
+            return _update(i, out, staged), nxt
+
+        (mo, mu_qo, mu_sd, nu_qo, nu_sd, po), _ = jax.lax.fori_loop(
+            0, n_chunks, body, (init, _stage(0))
+        )
+    else:
+
+        def body(i, carry):
+            return _update(i, carry, _stage(i))
+
+        mo, mu_qo, mu_sd, nu_qo, nu_sd, po = jax.lax.fori_loop(
+            0, n_chunks, body, init
+        )
     return (
         mo,
         {"q": mu_qo, "s": _to_host(mu_sd)},
@@ -287,11 +343,13 @@ class StreamedAdamW:
     """
 
     def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
-                 chunk_elems=DEFAULT_CHUNK_ELEMS, quant_bits=0):
+                 chunk_elems=DEFAULT_CHUNK_ELEMS, quant_bits=0, overlap=True):
         self.name = "streamed_adamw"
         self.defaults = {"lr": lr, "betas": betas, "eps": eps, "weight_decay": weight_decay}
         self._lr = lr
         self.chunk_elems = chunk_elems
+        # double-buffered window streaming (engine overlap_comm escape hatch)
+        self.overlap = bool(overlap)
         # 8: moments stored/streamed as int8 blocks + fp32 scales (eligible
         # leaves only — see _quant_eligible); halves the state wire bytes
         self.quant_bits = int(quant_bits or 0)
@@ -357,6 +415,7 @@ class StreamedAdamW:
             eps = self.defaults["eps"]
             wd = self.defaults["weight_decay"]
             chunk = self.chunk_elems
+            dbuf = self.overlap
             leaf_fn = streamed_adamw_leaf_q8 if quantized else streamed_adamw_leaf
 
             def leaf_step(g, m, mu, nu, p, lr, count):
@@ -365,7 +424,7 @@ class StreamedAdamW:
                 c2 = 1.0 - jnp.power(jnp.float32(b2), cf)
                 return leaf_fn(
                     g, m, mu, nu, p, lr, b1=b1, b2=b2, eps=eps, wd=wd,
-                    c1=c1, c2=c2, chunk=chunk,
+                    c1=c1, c2=c2, chunk=chunk, double_buffer=dbuf,
                 )
 
             setattr(self, attr, jax.jit(leaf_step, donate_argnums=(1, 2, 3, 4)))

@@ -90,10 +90,18 @@ class DeepSpeedZeroConfig(DSConfigModel):
     stage: int = 0
     contiguous_gradients: bool = True  # [compat]
     reduce_scatter: bool = True  # [compat] — always reduce-scatter on TPU for stage>=2
-    reduce_bucket_size: int = 500_000_000  # [compat]
+    # grad reduce-scatter bucket target (bytes): leaves are grouped into
+    # buckets of this size and each bucket crosses the wire in ONE
+    # collective, launched independently so the scheduler can pipeline them
+    # behind remaining backward compute (runtime/zero/overlap.py)
+    reduce_bucket_size: int = 500_000_000
     allgather_partitions: bool = True  # [compat]
     allgather_bucket_size: int = 500_000_000  # [compat]
-    overlap_comm: Optional[bool] = None  # [compat] — XLA latency-hiding scheduler
+    # Bucketed comm/compute overlap (reference overlap_comm + the stage-3
+    # prefetch coordinator): None = auto (ON — the overlapped and
+    # unoverlapped paths are loss-bitwise identical), False = escape hatch
+    # forcing the per-leaf/serial schedule, True = explicit opt-in.
+    overlap_comm: Optional[bool] = None
     load_from_fp32_weights: bool = True
     elastic_checkpoint: bool = False
     # Offload
@@ -103,7 +111,13 @@ class DeepSpeedZeroConfig(DSConfigModel):
     sub_group_size: int = 1_000_000_000
     max_live_parameters: int = 1_000_000_000  # [compat]
     max_reuse_distance: int = 1_000_000_000  # [compat]
-    prefetch_bucket_size: int = 50_000_000  # [compat]
+    # parameter-prefetch window (bytes): bounds how many layers' worth of
+    # gathered/staged weights sit in HBM ahead of the layer being computed
+    # (transformer scan chunking — overlap.overlap_chunk) and the qwZ
+    # gather bucket target. ``stage3_prefetch_bucket_size`` is the
+    # reference's spelling for the same knob and takes precedence when set.
+    prefetch_bucket_size: int = 50_000_000
+    stage3_prefetch_bucket_size: Optional[int] = None
     param_persistence_threshold: int = 100_000  # params smaller than this stay replicated
     model_persistence_threshold: int = 9223372036854775807
     gather_16bit_weights_on_model_save: bool = False
@@ -124,8 +138,23 @@ class DeepSpeedZeroConfig(DSConfigModel):
     override_module_apply: bool = True
     log_trace_cache_warnings: bool = False
 
+    @property
+    def overlap_enabled(self) -> bool:
+        """overlap_comm resolved: None (auto) and True → on; False → off."""
+        return self.overlap_comm is not False
+
+    @property
+    def effective_prefetch_bucket_size(self) -> int:
+        if self.stage3_prefetch_bucket_size is not None:
+            return int(self.stage3_prefetch_bucket_size)
+        return int(self.prefetch_bucket_size)
+
     def _validate(self):
         if not 0 <= self.stage <= 3:
             raise ConfigError(f"ZeRO stage must be 0-3, got {self.stage}")
         if self.zero_hpz_partition_size < 1:
             raise ConfigError("zero_hpz_partition_size must be >= 1")
+        if self.reduce_bucket_size <= 0:
+            raise ConfigError("reduce_bucket_size must be > 0")
+        if self.effective_prefetch_bucket_size <= 0:
+            raise ConfigError("prefetch_bucket_size must be > 0")

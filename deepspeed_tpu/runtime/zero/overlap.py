@@ -1,0 +1,315 @@
+"""Bucketed comm/compute overlap for the ZeRO-3 step.
+
+The reference DeepSpeed hides ZeRO-3 communication behind compute with three
+hand-rolled schedulers: the partitioned-param coordinator prefetches
+all-gathers ``stage3_prefetch_bucket_size`` bytes ahead, gradient
+reduce-scatters launch per ``reduce_bucket_size`` bucket as backward produces
+them (stage3.py ``__reduce_and_partition_ipg_grads``), and ZeRO-Infinity
+double-buffers the NVMe/host weight windows. On TPU the XLA latency-hiding
+scheduler can do the overlap — but only when the program hands it
+independent collectives to move. This module restructures the step so it
+does:
+
+* ``assign_buckets`` groups leaves into size-targeted buckets (every leaf in
+  exactly one bucket, greedy in traversal order — the reference's
+  ``reduce_bucket_size`` semantics).
+* The ``bucketed_*`` collectives fuse each bucket's per-leaf exchanges into
+  ONE wire collective (payloads concatenated along the block axis). Each
+  leaf is quantized/laid out exactly as the per-leaf functions in
+  ``ops/quantizer/block_quant.py`` do, so results are BITWISE identical to
+  the unbucketed path — the escape hatch (``overlap_comm: false``) and the
+  default path must produce the same losses. Fewer, larger collectives give
+  the scheduler long independent transfers to pipeline behind compute
+  instead of a serial chain of per-leaf launches.
+* ``overlap_chunk`` picks the transformer-scan chunk width for bucketed
+  parameter prefetch: scanning ``B`` layers per step puts layer ``b+1``'s
+  all-gather (or pinned_host→HBM stage) in the SAME scan body as layer
+  ``b``'s compute, where the scheduler can overlap them — impossible across
+  sequential scan iterations.
+
+All ``bucketed_*`` functions must be called INSIDE ``shard_map`` over
+``axis_name`` (same contract as their per-leaf counterparts).
+"""
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.quantizer.block_quant import _dequantize_rows, _quantize_rows
+
+__all__ = [
+    "assign_buckets",
+    "overlap_chunk",
+    "bucketed_all_gather",
+    "bucketed_psum_scatter",
+    "bucketed_quantized_all_gather",
+    "bucketed_quantized_reduce_scatter",
+    "bucketed_loco_quantized_reduce_scatter",
+]
+
+
+def assign_buckets(sizes: Sequence[int], target_bytes: int) -> List[List[int]]:
+    """Greedy size-targeted bucketing (reference ``reduce_bucket_size``):
+    walk ``sizes`` in order, close the current bucket when adding the next
+    leaf would exceed ``target_bytes`` (a leaf larger than the target gets a
+    bucket of its own). Every index lands in exactly one bucket; order is
+    preserved so bucket k's exchange depends only on leaves before bucket
+    k+1's — the property the scheduler needs to pipeline them."""
+    if target_bytes <= 0:
+        return [[i] for i in range(len(sizes))]
+    buckets: List[List[int]] = []
+    cur: List[int] = []
+    cur_bytes = 0
+    for i, sz in enumerate(sizes):
+        if cur and cur_bytes + sz > target_bytes:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += sz
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def overlap_chunk(n_layers: int, layer_bytes: int, target_bytes: int,
+                  max_chunk: int = 8) -> int:
+    """Scan-chunk width for bucketed parameter prefetch: the largest divisor
+    ``B`` of ``n_layers`` with ``B * layer_bytes <= target_bytes`` — i.e. at
+    most one prefetch bucket of layer weights live in HBM beyond the layer
+    being computed. Floors at 2 when any >=2 divisor exists (depth-1
+    prefetch is the point of overlap; the knob then only grows the window)
+    and caps at ``max_chunk`` (chunking unrolls the scan body B-fold —
+    compile time, not memory, bounds the useful width). Returns 1 when no
+    divisor works (prime depth): the caller falls back to the plain scan."""
+    if n_layers <= 1 or layer_bytes <= 0:
+        return 1
+    divisors = [d for d in range(2, min(n_layers, max_chunk) + 1) if n_layers % d == 0]
+    if not divisors:
+        return 1
+    fitting = [d for d in divisors if d * layer_bytes <= target_bytes]
+    return max(fitting) if fitting else divisors[0]
+
+
+# ---------------------------------------------------------------------------
+# bucketed wire collectives (shard_map manual region)
+# ---------------------------------------------------------------------------
+def _rows_for_scatter(x: jax.Array, dim: int, W: int, block_size: int):
+    """Per-leaf reduce-scatter layout — identical to
+    ``quantized_reduce_scatter_along``: moveaxis ``dim``→0, reshape to
+    [W, m] (row w is rank w's shard), pad the row to ``block_size``."""
+    moved = jnp.moveaxis(x.astype(jnp.float32), dim, 0)
+    rows = moved.reshape(W, -1)
+    m = rows.shape[1]
+    pad = (-m) % block_size
+    if pad:
+        rows = jnp.pad(rows, ((0, 0), (0, pad)))
+    return rows, m, moved.shape[1:]
+
+
+def _unscatter(total: jax.Array, x: jax.Array, dim: int, W: int, rest_shape,
+               mean: bool) -> jax.Array:
+    D = x.shape[dim]
+    if mean:
+        total = total / W
+    out = total.reshape((D // W,) + tuple(rest_shape))
+    return jnp.moveaxis(out, 0, dim).astype(x.dtype)
+
+
+def bucketed_quantized_reduce_scatter(
+    leaves: Sequence[jax.Array],
+    dims: Sequence[int],
+    axis_name: str,
+    bits: int = 8,
+    block_size: int = 256,
+    mean: bool = True,
+) -> List[jax.Array]:
+    """One bucket's qgZ exchange: each leaf quantized exactly as
+    ``quantized_reduce_scatter_along`` (same row layout, same per-leaf
+    blocking), payloads+scales concatenated along the BLOCK axis so the
+    bucket crosses the wire in ONE all-to-all pair. Splitting the received
+    concat recovers each leaf's per-leaf exchange bitwise."""
+    W = jax.lax.axis_size(axis_name)
+    payloads, scales, metas = [], [], []
+    for x, k in zip(leaves, dims):
+        rows, m, rest = _rows_for_scatter(x, k, W, block_size)
+        p, s = _quantize_rows(rows, bits, block_size)
+        payloads.append(p)
+        scales.append(s)
+        metas.append((m, rest, p.shape[1]))
+    payload_rx = jax.lax.all_to_all(
+        jnp.concatenate(payloads, axis=1), axis_name,
+        split_axis=0, concat_axis=0, tiled=True,
+    )
+    scales_rx = jax.lax.all_to_all(
+        jnp.concatenate(scales, axis=1), axis_name,
+        split_axis=0, concat_axis=0, tiled=True,
+    )
+    out, off = [], 0
+    for x, k, (m, rest, nb) in zip(leaves, dims, metas):
+        deq = _dequantize_rows(
+            payload_rx[:, off:off + nb], scales_rx[:, off:off + nb], bits, block_size
+        )
+        total = jnp.sum(deq, axis=0)[:m]
+        out.append(_unscatter(total, x, k, W, rest, mean))
+        off += nb
+    return out
+
+
+def bucketed_loco_quantized_reduce_scatter(
+    leaves: Sequence[jax.Array],
+    errs: Sequence[jax.Array],
+    dims: Sequence[int],
+    axis_name: str,
+    bits: int = 8,
+    block_size: int = 256,
+    err_beta: float = 0.8,
+    mean: bool = True,
+):
+    """LoCo error-feedback variant: the compensated gradient ``x + err`` is
+    quantized per leaf (identical to ``loco_quantized_reduce_scatter_along``
+    including the local pre-exchange residual and the EMA update), only the
+    all-to-all pair is fused across the bucket. Returns
+    (reduced slices, new error buffers)."""
+    W = jax.lax.axis_size(axis_name)
+    payloads, scales, metas, new_errs = [], [], [], []
+    for x, err, k in zip(leaves, errs, dims):
+        comp = x.astype(jnp.float32) + err.astype(jnp.float32)
+        moved = jnp.moveaxis(comp, k, 0)
+        rest = moved.shape[1:]
+        rows = moved.reshape(W, -1)
+        m = rows.shape[1]
+        pad = (-m) % block_size
+        if pad:
+            rows = jnp.pad(rows, ((0, 0), (0, pad)))
+        p, s = _quantize_rows(rows, bits, block_size)
+        deq_local = _dequantize_rows(p, s, bits, block_size)
+        resid = (rows - deq_local)[:, :m].reshape((x.shape[k],) + rest)
+        resid = jnp.moveaxis(resid, 0, k)
+        new_errs.append(
+            (err_beta * err.astype(jnp.float32) + (1.0 - err_beta) * resid)
+            .astype(err.dtype)
+        )
+        payloads.append(p)
+        scales.append(s)
+        metas.append((m, rest, p.shape[1]))
+    payload_rx = jax.lax.all_to_all(
+        jnp.concatenate(payloads, axis=1), axis_name,
+        split_axis=0, concat_axis=0, tiled=True,
+    )
+    scales_rx = jax.lax.all_to_all(
+        jnp.concatenate(scales, axis=1), axis_name,
+        split_axis=0, concat_axis=0, tiled=True,
+    )
+    out, off = [], 0
+    for x, k, (m, rest, nb) in zip(leaves, dims, metas):
+        deq = _dequantize_rows(
+            payload_rx[:, off:off + nb], scales_rx[:, off:off + nb], bits, block_size
+        )
+        total = jnp.sum(deq, axis=0)[:m]
+        out.append(_unscatter(total, x, k, W, rest, mean))
+        off += nb
+    return out, new_errs
+
+
+def bucketed_quantized_all_gather(
+    leaves: Sequence[jax.Array],
+    dims: Sequence[int],
+    axis_name: str,
+    bits: int = 8,
+    block_size: int = 256,
+) -> List[jax.Array]:
+    """One bucket's qwZ gather: per-leaf quantization identical to
+    ``quantized_all_gather_along`` ([1, m] local rows), payloads fused into
+    one all-gather pair along the block axis."""
+    payloads, scales, metas = [], [], []
+    for x, k in zip(leaves, dims):
+        moved = jnp.moveaxis(x, k, 0)
+        rows = moved.reshape(1, -1).astype(jnp.float32)
+        m = rows.shape[1]
+        pad = (-m) % block_size
+        if pad:
+            rows = jnp.pad(rows, ((0, 0), (0, pad)))
+        p, s = _quantize_rows(rows, bits, block_size)
+        payloads.append(p)
+        scales.append(s)
+        metas.append((m, moved.shape, p.shape[1]))
+    payload_all = jax.lax.all_gather(
+        jnp.concatenate(payloads, axis=1), axis_name, axis=0, tiled=True
+    )
+    scales_all = jax.lax.all_gather(
+        jnp.concatenate(scales, axis=1), axis_name, axis=0, tiled=True
+    )
+    W = payload_all.shape[0]
+    out, off = [], 0
+    for x, k, (m, moved_shape, nb) in zip(leaves, dims, metas):
+        deq = _dequantize_rows(
+            payload_all[:, off:off + nb], scales_all[:, off:off + nb], bits, block_size
+        )
+        full = deq[:, :m].reshape((W * moved_shape[0],) + tuple(moved_shape[1:]))
+        out.append(jnp.moveaxis(full, 0, k).astype(x.dtype))
+        off += nb
+    return out
+
+
+def bucketed_all_gather(
+    leaves: Sequence[jax.Array],
+    dims: Sequence[int],
+    axis_name: str,
+) -> List[jax.Array]:
+    """Unquantized bucket gather: each local shard flattened to [1, m]
+    (leading axis = gather dim, so rank r's row chunk IS its dim-k slice),
+    concatenated and gathered in ONE collective, then split and restored —
+    value-identical to per-leaf ``jax.lax.all_gather(..., tiled=True)``."""
+    flats, metas = [], []
+    for x, k in zip(leaves, dims):
+        moved = jnp.moveaxis(x, k, 0)
+        flats.append(moved.reshape(1, -1))
+        metas.append((moved.shape, moved.size))
+    widths = {f.dtype for f in flats}
+    assert len(widths) == 1, "bucket leaves must share a dtype"
+    gathered = jax.lax.all_gather(
+        jnp.concatenate(flats, axis=1), axis_name, axis=0, tiled=True
+    )  # [W, sum_m]
+    W = gathered.shape[0]
+    out, off = [], 0
+    for x, k, (moved_shape, m) in zip(leaves, dims, metas):
+        full = gathered[:, off:off + m].reshape(
+            (W * moved_shape[0],) + tuple(moved_shape[1:])
+        )
+        out.append(jnp.moveaxis(full, 0, k))
+        off += m
+    return out
+
+
+def bucketed_psum_scatter(
+    leaves: Sequence[jax.Array],
+    dims: Sequence[int],
+    axis_name: str,
+    mean: bool = True,
+) -> List[jax.Array]:
+    """Unquantized bucket reduce-scatter: rows laid out [W, shard] per leaf
+    (row w destined for rank w), concatenated along columns, ONE tiled
+    psum_scatter, then split — elementwise sums are unchanged, so the
+    result matches per-leaf ``psum_scatter(..., scatter_dimension=k)``."""
+    W = jax.lax.axis_size(axis_name)
+    rows_list, metas = [], []
+    for g, k in zip(leaves, dims):
+        moved = jnp.moveaxis(g, k, 0)
+        rows = moved.reshape(W, -1)
+        rows_list.append(rows)
+        metas.append((moved.shape, rows.shape[1]))
+    reduced = jax.lax.psum_scatter(
+        jnp.concatenate(rows_list, axis=1), axis_name,
+        scatter_dimension=0, tiled=True,
+    )  # [1, sum_m] (tiled: W rows scatter W-ways)
+    reduced = reduced.reshape(-1)
+    out, off = [], 0
+    for g, k, (moved_shape, m) in zip(leaves, dims, metas):
+        sl = reduced[off:off + m]
+        if mean:
+            sl = sl / W
+        shard = sl.reshape((moved_shape[0] // W,) + tuple(moved_shape[1:]))
+        out.append(jnp.moveaxis(shard, 0, k).astype(g.dtype))
+        off += m
+    return out

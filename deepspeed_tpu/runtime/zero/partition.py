@@ -35,6 +35,7 @@ import jax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
+from deepspeed_tpu._jax_compat import host_memory_kind
 from deepspeed_tpu.parallel.topology import DATA_AXIS, ZERO_AXES, Topology
 
 
@@ -135,11 +136,11 @@ class ZeroShardingPlan:
 
     @property
     def state_memory_kind(self):
-        return "pinned_host" if self.offload_optimizer else None
+        return host_memory_kind() if self.offload_optimizer else None
 
     @property
     def param_memory_kind(self):
-        return "pinned_host" if self.offload_param else None
+        return host_memory_kind() if self.offload_param else None
 
     def device_shardings(self, shardings):
         """The HBM-resident twin of a (possibly host-kind) sharding tree —
@@ -316,8 +317,8 @@ def build_zero_plan(
         return lambda spec: NamedSharding(mesh, spec, memory_kind=kind)
 
     is_spec = lambda x: isinstance(x, PartitionSpec)
-    param_kind = "pinned_host" if offload_param else None
-    master_kind = "pinned_host" if offload_optimizer else None
+    param_kind = host_memory_kind() if offload_param else None
+    master_kind = host_memory_kind() if offload_optimizer else None
     return ZeroShardingPlan(
         stage=stage,
         topology=topology,

@@ -31,7 +31,10 @@ os.environ["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={{world}}"
 os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", world)
+try:
+    jax.config.update("jax_num_cpu_devices", world)
+except AttributeError:
+    pass  # pre-0.5 jax: the XLA_FLAGS fallback above covers it
 import numpy as np
 import deepspeed_tpu
 

@@ -9,11 +9,16 @@ import optax
 import pytest
 
 import deepspeed_tpu
+from deepspeed_tpu._jax_compat import host_memory_kind
 from deepspeed_tpu.parallel.topology import Topology, set_topology
 
 from tests.unit.simple_model import batch_of, make_mlp_params, mlp_loss_fn, random_dataset
 
 LR = 1e-2
+
+# None on runtimes whose CPU devices expose a single memory space (jax<0.5):
+# offload there is numerics-only — placement assertions don't apply
+HOST_KIND = host_memory_kind()
 
 
 def _pure_optax_losses(params, dataset, n_steps, batch_size, gas=1):
@@ -300,10 +305,11 @@ class TestZeroOffload:
         got, engine = self._offload_losses(stage, dataset, n_steps=5)
         np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
         # optimizer state actually lives in host memory
-        master_leaf = engine.opt_state.master["layer_0"]["w"]
-        assert master_leaf.sharding.memory_kind == "pinned_host"
-        # params stay in device memory
-        assert engine.params["layer_0"]["w"].sharding.memory_kind == "device"
+        if HOST_KIND is not None:
+            master_leaf = engine.opt_state.master["layer_0"]["w"]
+            assert master_leaf.sharding.memory_kind == HOST_KIND
+            # params stay in device memory
+            assert engine.params["layer_0"]["w"].sharding.memory_kind == "device"
 
     def test_offload_param_tier(self, devices8):
         """offload_param: params also live in pinned_host between steps."""
@@ -312,7 +318,8 @@ class TestZeroOffload:
         ref = _pure_optax_losses(params, dataset, n_steps=3, batch_size=8)
         got, engine = self._offload_losses(3, dataset, n_steps=3, offload_param=True)
         np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
-        assert engine.params["layer_0"]["w"].sharding.memory_kind == "pinned_host"
+        if HOST_KIND is not None:
+            assert engine.params["layer_0"]["w"].sharding.memory_kind == HOST_KIND
 
     def test_nvme_pluggable_writer_roundtrip(self, tmp_path, devices8):
         """Regression: host-tier state saved through a pluggable checkpoint
@@ -361,7 +368,8 @@ class TestZeroOffload:
         engine2.load_checkpoint(str(tmp_path), tag="off")
         after = np.asarray(jax.device_get(engine2.opt_state.master["layer_0"]["w"]))
         np.testing.assert_allclose(before, after, rtol=0, atol=0)
-        assert engine2.opt_state.master["layer_0"]["w"].sharding.memory_kind == "pinned_host"
+        if HOST_KIND is not None:
+            assert engine2.opt_state.master["layer_0"]["w"].sharding.memory_kind == HOST_KIND
 
 
 class TestSuperOffloadTwinFlow:
@@ -420,7 +428,8 @@ class TestSuperOffloadTwinFlow:
             s.memory_kind
             for s in jax.tree.leaves(engine._state_shardings)
         }
-        assert "pinned_host" in kinds and "device" in kinds, kinds
+        if HOST_KIND is not None:
+            assert HOST_KIND in kinds and "device" in kinds, kinds
         got = []
         pos = 0
         for _ in range(3):
