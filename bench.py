@@ -402,5 +402,102 @@ def bench_serving(train_cfg):
     }
 
 
+def bench_serving_load(
+    n_requests=None, rate_rps=None, max_new=None, slo_e2e_s=None,
+    cfg=None, params=None, seed=0,
+):
+    """Serving-LOAD benchmark (``python bench.py --serving-load``): drive the
+    full serving stack — ServingDriver admission/streaming over the v2
+    engine — with Poisson arrivals (open-loop, the serving-systems standard:
+    closed-loop clients hide queueing delay) and report the request-level
+    numbers an operator actually SLOs on: TTFT, TPOT, e2e latency
+    (p50/p95), and goodput (generated tok/s counting only requests that
+    finished within the SLO). Runs on CPU with a tiny model by default;
+    knobs via env: DSTPU_SERVE_N, DSTPU_SERVE_RATE, DSTPU_SERVE_MAX_NEW,
+    DSTPU_SERVE_SLO_S."""
+    from deepspeed_tpu.inference.config import RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.models import TransformerConfig, init_params
+    from deepspeed_tpu.serving.driver import RequestRejected, ServingDriver
+    from deepspeed_tpu.serving.request import SamplingParams
+
+    n_requests = int(n_requests or os.environ.get("DSTPU_SERVE_N", 24))
+    rate_rps = float(rate_rps or os.environ.get("DSTPU_SERVE_RATE", 16.0))
+    max_new = int(max_new or os.environ.get("DSTPU_SERVE_MAX_NEW", 12))
+    slo = slo_e2e_s or os.environ.get("DSTPU_SERVE_SLO_S")
+    slo = float(slo) if slo is not None else None
+
+    if cfg is None:
+        cfg = TransformerConfig(
+            vocab_size=512, hidden_size=128, n_layers=2, n_heads=4,
+            max_seq_len=512, dtype="float32",
+        )
+        params = init_params(cfg, jax.random.key(0))
+    rc = RaggedInferenceEngineConfig.from_dict({
+        "dtype": cfg.dtype,
+        "kv_cache": {"block_size": 16, "num_blocks": 256, "max_blocks_per_seq": 8},
+        "state_manager": {"max_tracked_sequences": 64, "max_ragged_batch_size": 256,
+                          "max_ragged_sequence_count": 16, "max_context": 128},
+    })
+    engine = InferenceEngineV2(cfg, params, rc)
+    driver = ServingDriver(engine, max_queue=n_requests, kv_headroom=0.05)
+    driver.start()
+
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, cfg.vocab_size, size=(int(l),)).astype(np.int32)
+               for l in rng.integers(8, 48, size=n_requests)]
+    # warm the compiled step shapes so the measured run isn't compile-bound
+    warm = driver.submit(prompts[0], params=SamplingParams(max_new_tokens=4, ignore_eos=True))
+    warm.wait(120)
+
+    gaps = rng.exponential(1.0 / rate_rps, size=n_requests)
+    reqs, rejected = [], 0
+    t0 = time.perf_counter()
+    for prompt, gap in zip(prompts, gaps):
+        time.sleep(float(gap))
+        try:
+            reqs.append(driver.submit(
+                prompt, params=SamplingParams(max_new_tokens=max_new, ignore_eos=True)
+            ))
+        except RequestRejected:
+            rejected += 1
+    for r in reqs:
+        r.wait(300)
+    wall = time.perf_counter() - t0
+    driver.shutdown(drain=True, timeout=60)
+
+    done = [r for r in reqs if r.state == "finished"]
+    good = [r for r in done if slo is None or (r.e2e_s is not None and r.e2e_s <= slo)]
+
+    def pct(vals, q):
+        if not vals:
+            return None
+        return round(float(np.percentile(np.asarray(vals), q)), 4)
+
+    ttfts = [r.ttft_s for r in done if r.ttft_s is not None]
+    tpots = [r.tpot_s for r in done if r.tpot_s is not None]
+    e2es = [r.e2e_s for r in done if r.e2e_s is not None]
+    return {
+        "mode": "serving_load",
+        "n_requests": n_requests,
+        "offered_rps": rate_rps,
+        "completed": len(done),
+        "rejected": rejected,
+        "timed_out": sum(1 for r in reqs if r.state == "timed_out"),
+        "failed": sum(1 for r in reqs if r.state == "failed"),
+        "ttft_p50_s": pct(ttfts, 50), "ttft_p95_s": pct(ttfts, 95),
+        "tpot_p50_s": pct(tpots, 50), "tpot_p95_s": pct(tpots, 95),
+        "e2e_p50_s": pct(e2es, 50), "e2e_p95_s": pct(e2es, 95),
+        "slo_e2e_s": slo,
+        "goodput_tok_s": round(sum(len(r.generated) for r in good) / wall, 1),
+        "throughput_tok_s": round(sum(len(r.generated) for r in done) / wall, 1),
+    }
+
+
 if __name__ == "__main__":
-    main()
+    import sys
+
+    if "--serving-load" in sys.argv[1:]:
+        print(json.dumps(bench_serving_load()))
+    else:
+        main()

@@ -1,4 +1,4 @@
-"""``dstpu generate`` — serve a real HF checkpoint directory end to end.
+"""``dstpu generate`` / ``dstpu serve`` — real HF checkpoints end to end.
 
 The last mile of the serving stack (reference bar: real-model checkpoint
 loading in reference inference/engine.py:303 + module_inject/
@@ -10,6 +10,15 @@ paged/continuous-batching engine — all offline (no network at load time).
     dstpu generate --model /path/to/hf_dir --prompt "Once upon a time" \\
         --max-new-tokens 64 [--engine v2] [--sample --temperature 0.8] \\
         [--tp 2] [--dtype bfloat16]
+
+``serve`` runs the same v2 engine behind the long-lived serving driver +
+HTTP front end (deepspeed_tpu/serving/):
+
+    python -m deepspeed_tpu.inference.cli serve --model /path/to/hf_dir \\
+        --port 8000 [--num-blocks 512] [--max-context 4096] [--timeout 120]
+
+    curl -N -X POST http://127.0.0.1:8000/generate \\
+        -d '{"prompt": "Once upon a time", "max_new_tokens": 64, "stream": true}'
 """
 
 import argparse
@@ -140,5 +149,119 @@ def generate_main(argv=None) -> int:
     return 0
 
 
+def serve_parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="dstpu serve",
+        description="serve a local HF checkpoint dir over HTTP "
+        "(continuous batching, streaming)",
+    )
+    p.add_argument("--model", required=True, help="HF checkpoint directory")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000, help="0 = ephemeral")
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--tp", type=int, default=1, help="tensor-parallel ways")
+    p.add_argument("--block-size", type=int, default=128)
+    p.add_argument("--num-blocks", type=int, default=512, help="KV pool size")
+    p.add_argument("--max-blocks-per-seq", type=int, default=32)
+    p.add_argument("--max-context", type=int, default=4096)
+    p.add_argument("--max-concurrent", type=int, default=64,
+                   help="max tracked sequences (in-engine concurrency)")
+    p.add_argument("--max-queue", type=int, default=128,
+                   help="admission queue bound (further submits get 503)")
+    p.add_argument("--kv-headroom", type=float, default=0.05,
+                   help="fraction of KV blocks kept free at admission")
+    p.add_argument("--timeout", type=float, default=None,
+                   help="default per-request timeout in seconds")
+    p.add_argument("--decode-steps", type=int, default=1,
+                   help="fuse this many decode iterations per device call")
+    p.add_argument("--sample", action="store_true")
+    p.add_argument("--temperature", type=float, default=1.0)
+    p.add_argument("--top-k", type=int, default=0)
+    p.add_argument("--top-p", type=float, default=0.0)
+    p.add_argument("--seed", type=int, default=0)
+    return p.parse_args(argv)
+
+
+def build_serving_stack(args, cfg=None, params=None, tok=None):
+    """Engine + driver from parsed serve args (split out so tests can build
+    the stack without a socket). Pass cfg/params/tok to skip checkpoint
+    loading."""
+    from deepspeed_tpu.inference.config import RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.serving.driver import ServingDriver
+
+    if cfg is None or params is None:
+        from deepspeed_tpu.models import load_hf_model
+
+        cfg, params = load_hf_model(args.model, dtype=args.dtype)
+    if tok is None and args.model:
+        from deepspeed_tpu.tokenizer import load_tokenizer
+
+        tok = load_tokenizer(args.model)
+    if args.tp > 1:
+        from deepspeed_tpu.parallel.topology import Topology, set_topology
+
+        set_topology(Topology(model=args.tp, data=0))
+    rc = RaggedInferenceEngineConfig.from_dict({
+        "dtype": args.dtype, "tp_size": args.tp,
+        "decode_steps": args.decode_steps,
+        "greedy": not args.sample, "temperature": args.temperature,
+        "top_k": args.top_k, "top_p": args.top_p, "seed": args.seed,
+        "kv_cache": {
+            "block_size": args.block_size,
+            "num_blocks": args.num_blocks,
+            "max_blocks_per_seq": args.max_blocks_per_seq,
+        },
+        "state_manager": {
+            "max_tracked_sequences": args.max_concurrent,
+            "max_ragged_batch_size": 1024,
+            "max_ragged_sequence_count": min(32, args.max_concurrent),
+            "max_context": args.max_context,
+        },
+    })
+    engine = InferenceEngineV2(cfg, params, rc)
+    driver = ServingDriver(
+        engine,
+        eos_token_id=getattr(tok, "eos_token_id", None),
+        max_queue=args.max_queue,
+        kv_headroom=args.kv_headroom,
+        default_timeout_s=args.timeout,
+        decode_steps=args.decode_steps,
+    )
+    return driver, tok
+
+
+def serve_main(argv=None) -> int:
+    from deepspeed_tpu.serving.server import start_server
+
+    args = serve_parse_args(argv)
+    driver, tok = build_serving_stack(args)
+    driver.start()
+    server = start_server(driver, host=args.host, port=args.port, tokenizer=tok)
+    host, port = server.server_address[:2]
+    print(f"dstpu serve: listening on http://{host}:{port} "
+          f"(/generate, /health, /metrics)", file=sys.stderr)
+    try:
+        while True:
+            import time
+
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        print("dstpu serve: draining...", file=sys.stderr)
+    finally:
+        server.shutdown()
+        driver.shutdown(drain=True, timeout=60)
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "serve":
+        return serve_main(argv[1:])
+    if argv and argv[0] == "generate":
+        argv = argv[1:]
+    return generate_main(argv)
+
+
 if __name__ == "__main__":
-    sys.exit(generate_main())
+    sys.exit(main())

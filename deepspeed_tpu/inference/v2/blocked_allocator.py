@@ -19,6 +19,10 @@ class BlockedAllocator:
         self._next = np.arange(1, num_blocks + 1, dtype=np.int64)
         self._head = 0
         self._free = num_blocks
+        # allocated bitmap: a double-free would splice a block into the free
+        # list twice, handing ONE KV block to TWO sequences — silent cache
+        # corruption. Refusing loudly is the only safe behavior.
+        self._allocated = np.zeros(num_blocks, dtype=bool)
 
     @property
     def free_blocks(self) -> int:
@@ -34,15 +38,30 @@ class BlockedAllocator:
         out = np.empty(num_blocks, np.int64)
         for i in range(num_blocks):
             out[i] = self._head
+            self._allocated[self._head] = True
             self._head = self._next[self._head]
         self._free -= num_blocks
         return out
 
     def free(self, blocks: Iterable[int]) -> None:
         blocks = list(int(b) for b in np.atleast_1d(np.asarray(blocks, np.int64)))
+        # validate the WHOLE set before mutating: a partial free on error
+        # would leave the list in an in-between state
         for b in blocks:
             if not (0 <= b < self._num_blocks):
                 raise ValueError(f"invalid block {b}")
+            if not self._allocated[b]:
+                raise ValueError(
+                    f"double free of block {b}: freeing an unallocated block "
+                    "would hand one KV block to two sequences"
+                )
+        seen = set()
+        for b in blocks:
+            if b in seen:
+                raise ValueError(f"block {b} appears twice in one free() call")
+            seen.add(b)
+        for b in blocks:
+            self._allocated[b] = False
             self._next[b] = self._head
             self._head = b
         self._free += len(blocks)

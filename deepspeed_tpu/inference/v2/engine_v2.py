@@ -809,6 +809,17 @@ class InferenceEngineV2:
         (one sync per *phase*, not per step)."""
         return _materialize_rows(self._step_device())
 
+    def step_tokens(self) -> Dict[int, int]:
+        """One engine step returning ``{uid: next-token int}`` for rows that
+        completed a prompt or decode token — the serving driver's step
+        primitive. Takes the IN-PROGRAM sampled token (greedy or sampled per
+        the engine's static sampling config), never a host argmax, so driven
+        serving reproduces ``generate()`` token-for-token."""
+        out: Dict[int, int] = {}
+        for uid, tok in _materialize_rows(self._step_device(), want_tokens=True).items():
+            out[uid] = int(tok) if np.ndim(tok) == 0 else int(np.argmax(tok))
+        return out
+
     def _step_device(self) -> Dict[int, jax.Array]:
         """The split-phase step: stage the scheduler's batch onto the fixed
         [R decode slots | Rc chunks x tq] grid, run ONE compiled program,

@@ -67,6 +67,13 @@ class RaggedScheduler:
         if len(toks) == 0:
             raise ValueError("empty prompt: nothing to schedule")
         existing = self._mgr.get_sequence(uid)
+        if existing is not None and existing.finished:
+            # Resubmit of a finish()ed uid whose state somehow survived the
+            # flush: extending it would replay the stale seen_tokens into
+            # start positions and feedback() would drop tokens forever
+            # (finished=True). Start fresh instead.
+            self.finish(uid)
+            existing = None
         prior = len(existing.tokens) if existing is not None else 0
         total = prior + len(toks)
         if total > self._config.max_context:
@@ -106,6 +113,11 @@ class RaggedScheduler:
         self._next_token.pop(uid, None)
         if uid in self._running:
             self._running.remove(uid)
+        # Drop unscheduled prompt chunks too (cancel mid-prefill): a stale
+        # pending entry would crash next_batch (its sequence is flushed) or,
+        # after a resubmit of the uid, prepend the OLD prompt's remainder to
+        # the new sequence.
+        self._pending = [(u, r) for u, r in self._pending if u != uid]
         self._mgr.flush_sequence(uid)
 
     def drain_capped(self) -> set:
@@ -182,6 +194,8 @@ class RaggedScheduler:
                 still_pending.append((uid, remaining))
                 continue
             seq = self._mgr.get_sequence(uid)
+            if seq is None or seq.finished:
+                continue  # finished underneath us: drop the stale chunk
             take = min(budget, self.prompt_chunk, len(remaining))
             if take == 0 or not self._mgr.extend(seq, take):
                 still_pending.append((uid, remaining))

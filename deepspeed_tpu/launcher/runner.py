@@ -272,6 +272,11 @@ def main(argv=None) -> int:
         from deepspeed_tpu.inference.cli import generate_main
 
         return generate_main(argv[1:])
+    if argv and argv[0] == "serve":
+        # long-lived HTTP serving: dstpu serve --model DIR --port 8000
+        from deepspeed_tpu.inference.cli import serve_main
+
+        return serve_main(argv[1:])
     args = parse_args(argv)
     if args.autotuning:
         return run_autotuning(args)

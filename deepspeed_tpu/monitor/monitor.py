@@ -3,13 +3,56 @@
 Analogue of the reference ``deepspeed/monitor/`` (``MonitorMaster``
 monitor.py:30 fanning out to TensorBoard/W&B/Comet/CSV writers). Events are
 ``(name, value, global_sample)`` triples (reference ``write_events``).
+
+The Prometheus writer renders the text exposition format with no external
+dependency so training and serving metrics share one sink: the serving
+layer's ``/metrics`` endpoint and this writer's textfile output use the
+same formatting helpers below.
 """
 
 import csv
 import os
-from typing import List, Tuple
+import re
+import tempfile
+from typing import List, Optional, Tuple
 
 from deepspeed_tpu.utils.logging import logger
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def prometheus_metric_name(name: str) -> str:
+    """Sanitize an event name into a legal Prometheus metric name
+    (``Train/Samples/loss`` → ``Train_Samples_loss``)."""
+    name = _PROM_BAD.sub("_", str(name))
+    if name and name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def render_prometheus_text(samples: List[Tuple]) -> str:
+    """Render ``(name, labels_dict_or_None, value, type_or_None)`` samples as
+    Prometheus text exposition. Consecutive samples of one metric share a
+    single ``# TYPE`` header."""
+    lines = []
+    typed = set()
+    for name, labels, value, mtype in samples:
+        base = name.split("{")[0]
+        if mtype == "histogram" and base.endswith("_bucket"):
+            base = base[: -len("_bucket")]  # TYPE header names the family
+        if mtype and base not in typed:
+            lines.append(f"# TYPE {base} {mtype}")
+            typed.add(base)
+        label_s = ""
+        if labels:
+            inner = ",".join(
+                '%s="%s"' % (k, str(v).replace("\\", "\\\\").replace('"', '\\"'))
+                for k, v in labels.items()
+            )
+            label_s = "{" + inner + "}"
+        vs = "+Inf" if value == float("inf") else repr(float(value))
+        lines.append(f"{name}{label_s} {vs}")
+    return "\n".join(lines) + "\n"
 
 
 class Monitor:
@@ -125,6 +168,56 @@ class csvMonitor(Monitor):
                 w.writerow([step, value])
 
 
+class PrometheusMonitor(Monitor):
+    """Text-exposition writer (no dependencies): keeps the latest value per
+    event name and renders them as Prometheus gauges — served in-memory via
+    ``expose()`` (the serving layer's ``/metrics`` endpoint) and optionally
+    written to a node-exporter textfile (``output_path``/``job_name``.prom,
+    atomic rename so the collector never reads a torn file)."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self._values = {}
+        self._path = None
+        if self.enabled and getattr(config, "output_path", ""):
+            os.makedirs(config.output_path, exist_ok=True)
+            job = getattr(config, "job_name", None) or "deepspeed_tpu"
+            self._path = os.path.join(config.output_path, f"{job}.prom")
+
+    def write_events(self, event_list):
+        if not self.enabled:
+            return
+        for name, value, step in event_list:
+            try:
+                self._values[prometheus_metric_name(name)] = (float(value), int(step))
+            except (TypeError, ValueError):
+                continue  # non-numeric events have no Prometheus form
+        if self._path is not None:
+            self._flush_file()
+
+    def expose(self) -> str:
+        """Current state in Prometheus text exposition format."""
+        samples = []
+        for name in sorted(self._values):
+            value, step = self._values[name]
+            samples.append((name, None, value, "gauge"))
+            samples.append((name + "_last_step", None, step, "gauge"))
+        return render_prometheus_text(samples) if samples else ""
+
+    def _flush_file(self):
+        text = self.expose()
+        d = os.path.dirname(self._path)
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(text)
+            os.replace(tmp, self._path)
+        except OSError as e:
+            logger.warning(f"Prometheus textfile write failed: {e}")
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+
+
 class MonitorMaster(Monitor):
     """Fan-out to every enabled writer; rank-0 only (reference monitor.py:30)."""
 
@@ -135,17 +228,27 @@ class MonitorMaster(Monitor):
         self.wandb_monitor = WandbMonitor(ds_config.wandb)
         self.csv_monitor = csvMonitor(ds_config.csv_monitor)
         self.comet_monitor = CometMonitor(ds_config.comet)
+        self.prometheus_monitor = PrometheusMonitor(
+            getattr(ds_config, "prometheus", None) or type("_Off", (), {"enabled": False})()
+        )
         self._rank0 = jax.process_index() == 0
         self.enabled = self._rank0 and (
             self.tb_monitor.enabled
             or self.wandb_monitor.enabled
             or self.csv_monitor.enabled
             or self.comet_monitor.enabled
+            or self.prometheus_monitor.enabled
         )
 
     def write_events(self, event_list):
         if not self.enabled:
             return
-        for m in (self.tb_monitor, self.wandb_monitor, self.csv_monitor, self.comet_monitor):
+        for m in (
+            self.tb_monitor,
+            self.wandb_monitor,
+            self.csv_monitor,
+            self.comet_monitor,
+            self.prometheus_monitor,
+        ):
             if m.enabled:
                 m.write_events(event_list)

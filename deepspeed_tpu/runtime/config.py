@@ -142,6 +142,18 @@ class CSVConfig(DSConfigModel):
 
 
 @dataclass
+class PrometheusConfig(DSConfigModel):
+    """``prometheus`` monitor section: dependency-free text-exposition
+    writer (monitor/monitor.py PrometheusMonitor). ``output_path`` empty =
+    in-memory only (scraped via the serving layer's /metrics); set it to a
+    node-exporter textfile-collector dir to publish training metrics."""
+
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+@dataclass
 class CheckpointConfig(DSConfigModel):
     """``checkpoint`` section (reference runtime/config.py checkpoint params)."""
 
@@ -252,6 +264,7 @@ class DeepSpeedConfig(DSConfigModel):
     wandb: WandbConfig = submodel(WandbConfig)
     csv_monitor: CSVConfig = submodel(CSVConfig)
     comet: CometConfig = submodel(CometConfig)
+    prometheus: PrometheusConfig = submodel(PrometheusConfig)
     checkpoint: CheckpointConfig = submodel(CheckpointConfig)
     data_types: DataTypesConfig = submodel(DataTypesConfig)
     mesh: MeshConfig = submodel(MeshConfig)

@@ -1,0 +1,31 @@
+"""TPU-native serving layer over the v2 paged/continuous-batching engine.
+
+The reference project ships its inference engine behind a serving stack
+(DeepSpeed-MII / FastGen): a long-lived driver owns the request lifecycle,
+admission control, streaming, and telemetry, while the engine only packs
+ragged batches. This package is that layer for ``InferenceEngineV2``:
+
+  * ``request``    — ``Request`` lifecycle + per-request ``SamplingParams``
+  * ``driver``     — background continuous-batching loop with KV-aware
+                     admission control, timeouts, error isolation, drain
+  * ``streaming``  — per-request token iterators + incremental detokenization
+  * ``metrics``    — TTFT/TPOT/e2e histograms, queue/KV gauges, Prometheus
+                     text exposition, Monitor-writer bridge
+  * ``server``     — stdlib-only HTTP front end (/generate, /health, /metrics)
+"""
+
+from deepspeed_tpu.serving.driver import RequestRejected, ServingDriver
+from deepspeed_tpu.serving.metrics import ServingMetrics
+from deepspeed_tpu.serving.request import Request, RequestState, SamplingParams
+from deepspeed_tpu.serving.streaming import IncrementalDetokenizer, TokenStream
+
+__all__ = [
+    "IncrementalDetokenizer",
+    "Request",
+    "RequestRejected",
+    "RequestState",
+    "SamplingParams",
+    "ServingDriver",
+    "ServingMetrics",
+    "TokenStream",
+]

@@ -1,0 +1,480 @@
+"""Continuous-batching serving driver.
+
+The long-lived loop MII/FastGen runs around the engine, rebuilt for the v2
+TPU engine: a background thread pumps ``engine.step_tokens()`` /
+``engine.decode_round()`` while callers submit ``Request``s from any
+thread and stream tokens out.
+
+Responsibilities (and how each maps to the loop):
+
+  * **Admission control** — a bounded queue plus KV-aware gating: a prompt
+    is only handed to the scheduler when its full token budget
+    (prompt + max_new_tokens) fits in ``BlockedAllocator.free_blocks``
+    under a configurable occupancy headroom, and the tracked-sequence cap
+    has room. Requests that could NEVER fit (max_context / per-seq block
+    cap) are rejected at submit.
+  * **Timeouts** — per-request deadlines checked every loop pass (queued
+    requests time out in the queue too).
+  * **Error isolation** — a failing request (stop_fn raising, bad state)
+    is finished and its KV blocks freed without killing the loop; an
+    engine-level step failure fails the in-flight set but the driver keeps
+    serving new requests.
+  * **Graceful drain/shutdown** — ``drain()`` stops admissions and runs the
+    accepted set to completion; ``shutdown()`` additionally stops the loop.
+
+The driver needs only a small engine protocol — ``scheduler`` (the
+``RaggedScheduler`` API), ``state_manager`` (``free_blocks``), and
+``step_tokens()`` returning ``{uid: next-token int}`` — so tests drive it
+with a compute-free fake over the REAL scheduler/allocator stack.
+"""
+
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional
+
+import numpy as np
+
+from deepspeed_tpu.serving.metrics import ServingMetrics
+from deepspeed_tpu.serving.request import Request, RequestState, SamplingParams
+from deepspeed_tpu.serving.streaming import TokenStream
+from deepspeed_tpu.utils.logging import logger
+
+
+class RequestRejected(Exception):
+    """Submit refused (queue full, draining, or the prompt can never fit)."""
+
+    def __init__(self, reason: str, message: str = ""):
+        super().__init__(message or reason)
+        self.reason = reason
+
+
+class ServingDriver:
+    def __init__(
+        self,
+        engine,
+        eos_token_id: Optional[int] = None,
+        max_queue: int = 128,
+        kv_headroom: float = 0.0,
+        default_timeout_s: Optional[float] = None,
+        decode_steps: int = 1,
+        poll_interval_s: float = 0.02,
+        monitor=None,
+    ):
+        self.engine = engine
+        self.eos_token_id = eos_token_id
+        self.max_queue = int(max_queue)
+        self.kv_headroom = float(kv_headroom)
+        self.default_timeout_s = default_timeout_s
+        self.decode_steps = int(decode_steps)
+        self.poll_interval_s = float(poll_interval_s)
+        self.monitor = monitor
+        self.metrics = ServingMetrics()
+
+        self._cond = threading.Condition()
+        self._queue: deque = deque()  # Requests awaiting admission
+        self._active: Dict[int, Request] = {}  # uid -> Request in the scheduler
+        self._cancel_uids: set = set()
+        self._next_uid = 0
+        self._draining = False
+        self._stopping = False
+        self._idle = threading.Event()
+        self._idle.set()
+        self._thread: Optional[threading.Thread] = None
+        self._kv_total = int(self._kv_cfg("num_blocks", 0))
+        self.metrics.update_kv(self._free_blocks(), self._kv_total)
+
+    # -- engine accessors (guarded so fakes stay minimal) ----------------
+    def _kv_cfg(self, name, default):
+        kv = getattr(getattr(self.engine, "config", None), "kv_cache", None)
+        return getattr(kv, name, default) if kv is not None else default
+
+    def _sm_cfg(self, name, default):
+        sm = getattr(getattr(self.engine, "config", None), "state_manager", None)
+        return getattr(sm, name, default) if sm is not None else default
+
+    def _free_blocks(self) -> int:
+        return int(getattr(self.engine.state_manager, "free_blocks", 0))
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "ServingDriver":
+        if self._thread is not None:
+            raise RuntimeError("driver already started")
+        self._thread = threading.Thread(target=self._loop, name="serving-driver", daemon=True)
+        self._thread.start()
+        return self
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb):
+        self.shutdown(drain=exc_type is None)
+
+    # -- public API ------------------------------------------------------
+    def submit(
+        self,
+        prompt_tokens,
+        params: Optional[SamplingParams] = None,
+        timeout_s: Optional[float] = None,
+        stop_fn=None,
+    ) -> Request:
+        """Accept a request into the admission queue and return it (its
+        ``.stream`` is live immediately). Raises ``RequestRejected`` when the
+        driver is draining/stopped, the queue is full, or the prompt can
+        never be scheduled (max_context / per-sequence block cap)."""
+        prompt = np.asarray(prompt_tokens, np.int32).reshape(-1)
+        params = params or SamplingParams()
+        if len(prompt) == 0:
+            self._reject("empty_prompt")
+        total = len(prompt) + params.max_new_tokens
+        max_ctx = self._sm_cfg("max_context", None)
+        if max_ctx is not None and len(prompt) >= max_ctx:
+            self._reject("max_context", f"prompt of {len(prompt)} tokens >= max_context={max_ctx}")
+        check = getattr(self.engine.state_manager, "check_admissible", None)
+        if check is not None:
+            try:
+                # the PROMPT must fit; generation may be cut short by the
+                # block cap (reported as a length_cap finish)
+                check(len(prompt))
+            except ValueError as e:
+                self._reject("inadmissible", str(e))
+        timeout = timeout_s if timeout_s is not None else self.default_timeout_s
+        with self._cond:
+            if self._draining or self._stopping:
+                self._reject("draining")
+            if len(self._queue) >= self.max_queue:
+                self._reject("queue_full", f"admission queue full ({self.max_queue})")
+            req = Request(
+                uid=self._next_uid,
+                prompt_tokens=prompt,
+                params=params,
+                deadline=(time.monotonic() + timeout) if timeout else None,
+                stop_fn=stop_fn,
+            )
+            self._next_uid += 1
+            req.stream = TokenStream(req.uid)
+            self._queue.append(req)
+            self._idle.clear()
+            self.metrics.inc("requests_submitted_total")
+            self.metrics.set_gauge("queue_depth", len(self._queue))
+            self._cond.notify_all()
+        return req
+
+    def cancel(self, uid: int) -> bool:
+        """Request cancellation; True if the uid was live. Queued requests
+        cancel immediately; active ones are finished by the loop."""
+        with self._cond:
+            for req in list(self._queue):
+                if req.uid == uid:
+                    self._queue.remove(req)
+                    self._terminate(req, RequestState.CANCELLED, "cancelled")
+                    self.metrics.set_gauge("queue_depth", len(self._queue))
+                    return True
+            if uid in self._active:
+                self._cancel_uids.add(uid)
+                self._cond.notify_all()
+                return True
+        return False
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Stop admitting new requests and run the accepted set (queued +
+        active) to completion. Returns True once idle."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+        return self._idle.wait(timeout)
+
+    def shutdown(self, drain: bool = True, timeout: Optional[float] = None) -> None:
+        """Stop the loop. ``drain=True`` completes accepted requests first;
+        ``drain=False`` cancels everything in flight."""
+        if drain:
+            self.drain(timeout)
+        with self._cond:
+            self._stopping = True
+            if not drain:
+                for req in list(self._queue):
+                    self._terminate(req, RequestState.CANCELLED, "shutdown")
+                self._queue.clear()
+                self._cancel_uids.update(self._active.keys())
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+        self._flush_monitor()
+
+    @property
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    @property
+    def num_active(self) -> int:
+        with self._cond:
+            return len(self._active)
+
+    def health(self) -> Dict:
+        with self._cond:
+            return {
+                "status": "draining" if self._draining else "ok",
+                "queue_depth": len(self._queue),
+                "active_requests": len(self._active),
+                "kv_free_blocks": self._free_blocks(),
+                "kv_total_blocks": self._kv_total,
+            }
+
+    # -- internals -------------------------------------------------------
+    def _reject(self, reason: str, message: str = ""):
+        self.metrics.inc("requests_rejected_total")
+        raise RequestRejected(reason, message)
+
+    def _terminate(self, req: Request, state: str, reason: str, error: Optional[str] = None):
+        """Move a request to a terminal state (caller already detached it
+        from queue/active and released scheduler state if needed)."""
+        req.state = state
+        req.finish_reason = reason
+        req.error = error
+        req.t_finish = time.monotonic()
+        if req.stream is not None:
+            req.stream.close(reason, error=error)
+        req._done.set()
+        self.metrics.observe_request(req)
+        key = {
+            RequestState.FINISHED: "requests_finished_total",
+            RequestState.CANCELLED: "requests_cancelled_total",
+            RequestState.TIMED_OUT: "requests_timed_out_total",
+            RequestState.FAILED: "requests_failed_total",
+        }.get(state)
+        if key:
+            self.metrics.inc(key)
+
+    def _finish_active(self, req: Request, state: str, reason: str,
+                       error: Optional[str] = None, scheduler_done: bool = False):
+        """Terminal transition for an ACTIVE request: release its scheduler
+        state (frees KV blocks + pending prompt chunks) and close out."""
+        if not scheduler_done:
+            try:
+                self.engine.scheduler.finish(req.uid)
+            except Exception as e:  # never let cleanup kill the loop
+                logger.warning(f"serving: finish({req.uid}) raised: {e}")
+        self._active.pop(req.uid, None)
+        self._cancel_uids.discard(req.uid)
+        self._terminate(req, state, reason, error)
+
+    # admission ---------------------------------------------------------
+    def _blocks_needed(self, req: Request) -> int:
+        bs = int(self._kv_cfg("block_size", 1))
+        cap = int(self._kv_cfg("max_blocks_per_seq", 1 << 30))
+        total = len(req.prompt_tokens) + req.params.max_new_tokens
+        return min((total + bs - 1) // bs, cap)
+
+    def _admissible(self, req: Request) -> bool:
+        max_tracked = self._sm_cfg("max_tracked_sequences", None)
+        if max_tracked is not None and len(self._active) >= int(max_tracked):
+            return False
+        free = self._free_blocks()
+        if not self._active:
+            # empty engine: headroom gating would starve a request larger
+            # than the reserve forever — admit whatever fits outright
+            return self._blocks_needed(req) <= free
+        headroom = int(self.kv_headroom * self._kv_total)
+        return self._blocks_needed(req) + headroom <= free
+
+    def _admit_locked(self) -> bool:
+        admitted = False
+        while self._queue:
+            req = self._queue[0]
+            if not self._admissible(req):
+                self.metrics.inc("admission_blocked_total")
+                break
+            self._queue.popleft()
+            try:
+                self.engine.scheduler.submit(req.uid, req.prompt_tokens)
+            except Exception as e:
+                # late inadmissibility (e.g. raced config change): isolate
+                self._terminate(req, RequestState.REJECTED, "inadmissible", str(e))
+                self.metrics.inc("requests_rejected_total")
+                continue
+            req.state = RequestState.PREFILL
+            req.t_admitted = time.monotonic()
+            self._active[req.uid] = req
+            self.metrics.inc("prefill_tokens_total", len(req.prompt_tokens))
+            admitted = True
+        self.metrics.set_gauge("queue_depth", len(self._queue))
+        self.metrics.set_gauge("active_requests", len(self._active))
+        return admitted
+
+    # timeouts / cancels ------------------------------------------------
+    def _next_deadline_locked(self) -> Optional[float]:
+        deadlines = [r.deadline for r in self._queue if r.deadline is not None]
+        deadlines += [r.deadline for r in self._active.values() if r.deadline is not None]
+        return min(deadlines) if deadlines else None
+
+    def _expire_locked(self):
+        now = time.monotonic()
+        for req in [r for r in self._queue if r.deadline is not None and now >= r.deadline]:
+            self._queue.remove(req)
+            self._terminate(req, RequestState.TIMED_OUT, "timeout")
+        for req in [r for r in list(self._active.values())
+                    if r.deadline is not None and now >= r.deadline]:
+            self._finish_active(req, RequestState.TIMED_OUT, "timeout")
+        for uid in list(self._cancel_uids):
+            req = self._active.get(uid)
+            if req is not None:
+                self._finish_active(req, RequestState.CANCELLED, "cancelled")
+            self._cancel_uids.discard(uid)
+
+    # token delivery ----------------------------------------------------
+    def _deliver(self, req: Request, token: int, feedback: bool = True) -> None:
+        """One generated token for an active request: record, stream, stop.
+        ``feedback=False`` for fused-round tokens — ``apply_decode_round``
+        already advanced the scheduler, a second feedback would double-append.
+        ``stop_fn`` exceptions propagate (caller isolates the request)."""
+        now = time.monotonic()
+        if req.t_first_token is None:
+            req.t_first_token = now
+            req.state = RequestState.DECODE
+        req.generated.append(int(token))
+        self.metrics.inc("decode_tokens_total")
+        req.stream.put(int(token))
+        reason = req.should_stop(int(token), self.eos_token_id)
+        if reason is not None:
+            self._finish_active(req, RequestState.FINISHED, reason)
+        elif feedback:
+            self.engine.scheduler.feedback(req.uid, int(token))
+
+    def _deliver_or_fail(self, req: Request, token: int, feedback: bool = True) -> bool:
+        """Error isolation: a per-request failure finishes ONLY that request
+        (blocks freed via scheduler.finish) and the loop keeps serving.
+        Returns False when the request terminated."""
+        try:
+            self._deliver(req, token, feedback=feedback)
+        except Exception as e:
+            logger.warning(f"serving: request {req.uid} failed: {type(e).__name__}: {e}")
+            self._finish_active(req, RequestState.FAILED, "error", error=f"{type(e).__name__}: {e}")
+            return False
+        return not req.is_terminal
+
+    # engine stepping ---------------------------------------------------
+    def _reap_capped(self):
+        """Sequences the scheduler force-finished at the block/context cap:
+        their blocks are already freed — report a length_cap finish."""
+        capped = set()
+        sched_drain = getattr(self.engine.scheduler, "drain_capped", None)
+        if sched_drain is not None:
+            capped |= sched_drain()
+        last = getattr(self.engine, "last_capped", None)
+        if last:
+            capped |= set(last)
+            self.engine.last_capped = set()
+        for uid in capped:
+            req = self._active.get(uid)
+            if req is not None:
+                self._finish_active(req, RequestState.FINISHED, "length_cap",
+                                    scheduler_done=True)
+
+    def _step_once(self) -> bool:
+        """One engine step (or fused decode round). Returns True if any
+        token landed / request advanced (progress)."""
+        sched = self.engine.scheduler
+        use_round = (
+            self.decode_steps > 1
+            and hasattr(self.engine, "decode_round")
+            and not sched.has_pending()
+            and bool(sched.running_uids())
+        )
+        progress = False
+        try:
+            if use_round:
+                round_res = self.engine.decode_round(self.decode_steps)
+                if round_res:
+                    self.metrics.inc("engine_steps_total")
+                    for uid, toks in round_res.items():
+                        req = self._active.get(uid)
+                        if req is None:
+                            sched.finish(uid)
+                            continue
+                        for tok in toks:
+                            progress = True
+                            if not self._deliver_or_fail(req, int(tok), feedback=False):
+                                break
+                    self._reap_capped()
+                    return progress
+            results = self.engine.step_tokens()
+            self.metrics.inc("engine_steps_total")
+        except Exception as e:
+            # engine-level failure: per-request state is unknowable, so the
+            # in-flight set fails — but the driver survives for new requests
+            logger.warning(f"serving: engine step failed: {type(e).__name__}: {e}")
+            for req in list(self._active.values()):
+                self._finish_active(req, RequestState.FAILED, "engine_error",
+                                    error=f"{type(e).__name__}: {e}")
+            return True
+        for uid, tok in results.items():
+            req = self._active.get(uid)
+            if req is None:
+                # finished between steps (cancel/timeout): drop the token,
+                # make sure scheduler state is gone
+                sched.finish(uid)
+                continue
+            progress = True
+            self._deliver_or_fail(req, int(tok))
+        self._reap_capped()
+        return progress
+
+    def _flush_monitor(self):
+        if self.monitor is not None:
+            try:
+                self.monitor.write_events(self.metrics.to_events())
+            except Exception as e:
+                logger.warning(f"serving: monitor write failed: {e}")
+
+    # the loop ----------------------------------------------------------
+    def _loop(self):
+        stall_wait = False
+        while True:
+            with self._cond:
+                while True:
+                    if self._stopping and not self._active and not self._queue:
+                        self._idle.set()
+                        return
+                    work = (
+                        bool(self._cancel_uids)
+                        or self.engine.scheduler.has_work()
+                        or (self._queue and self._admissible(self._queue[0]))
+                    )
+                    now = time.monotonic()
+                    deadline = self._next_deadline_locked()
+                    if deadline is not None and now >= deadline:
+                        break  # timeouts due
+                    if work and not stall_wait:
+                        break
+                    if not self._active and not self._queue:
+                        self._idle.set()
+                        self._flush_monitor()
+                    # sleep until: new submit/cancel (notify), the next
+                    # deadline, or — when the scheduler is stalled on KV
+                    # blocks — a short poll. NEVER a busy spin.
+                    timeout = None
+                    if deadline is not None:
+                        timeout = max(0.0, deadline - now)
+                    if stall_wait:
+                        timeout = min(self.poll_interval_s, timeout) if timeout else self.poll_interval_s
+                    self._cond.wait(timeout)
+                    stall_wait = False
+                self._idle.clear()
+                self._expire_locked()
+                self._admit_locked()
+            stepped = False
+            if self.engine.scheduler.has_work():
+                stepped = self._step_once()
+                with self._cond:
+                    self._admit_locked()  # finished requests freed blocks
+                    self.metrics.update_kv(self._free_blocks(), self._kv_total)
+                    self.metrics.set_gauge("active_requests", len(self._active))
+                    if not self._active and not self._queue:
+                        self._idle.set()
+                        self._flush_monitor()
+            # a zero-progress pass with work outstanding means the scheduler
+            # is waiting on KV blocks (or the queue head is inadmissible):
+            # back off onto the condition instead of spinning
+            stall_wait = not stepped

@@ -1,0 +1,185 @@
+"""Serving telemetry: latency histograms, throughput counters, KV gauges.
+
+The metric set is the FastGen/MII serving dashboard: TTFT (time to first
+token — prefill + queueing), TPOT (time per output token — decode cadence),
+e2e latency, queue depth, KV-block occupancy, and prefill-vs-decode token
+throughput. Two sinks share one source: ``prometheus_text()`` renders the
+text exposition for the HTTP ``/metrics`` endpoint, and ``to_events()``
+bridges the same numbers into the ``monitor.Monitor`` writer interface
+(TensorBoard/W&B/CSV/Comet/Prometheus-textfile) so serving telemetry lands
+next to training telemetry.
+"""
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from deepspeed_tpu.monitor.monitor import (
+    prometheus_metric_name,
+    render_prometheus_text,
+)
+
+# Latency buckets in seconds (log-ish spacing from 1 ms to 2 min): one set
+# serves TTFT, TPOT, and e2e — cross-metric comparability beats per-metric
+# tightness for dashboards.
+DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+    0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+)
+
+
+class Histogram:
+    """Prometheus-style cumulative histogram (counts per le-bucket + sum)."""
+
+    def __init__(self, buckets: Tuple[float, ...] = DEFAULT_BUCKETS):
+        self.buckets = tuple(sorted(buckets))
+        self.counts = [0] * (len(self.buckets) + 1)  # +1: the +Inf bucket
+        self.total = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        self.count += 1
+        self.total += value
+        for i, b in enumerate(self.buckets):
+            if value <= b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def quantile(self, q: float) -> float:
+        """Bucket-resolution quantile (upper bound of the bucket holding the
+        q-th observation) — good enough for bench reporting."""
+        if not self.count:
+            return 0.0
+        target = q * self.count
+        seen = 0
+        for i, b in enumerate(self.buckets):
+            seen += self.counts[i]
+            if seen >= target:
+                return b
+        return float("inf")
+
+    def prom_samples(self, name: str) -> List[Tuple]:
+        out = []
+        cum = 0
+        for i, b in enumerate(self.buckets):
+            cum += self.counts[i]
+            out.append((f"{name}_bucket", {"le": repr(float(b))}, cum, "histogram"))
+        out.append((f"{name}_bucket", {"le": "+Inf"}, self.count, "histogram"))
+        out.append((f"{name}_sum", None, self.total, None))
+        out.append((f"{name}_count", None, self.count, None))
+        return out
+
+
+class ServingMetrics:
+    """Thread-safe registry the driver and server write into."""
+
+    PREFIX = "dstpu_serving"
+
+    def __init__(self, buckets: Tuple[float, ...] = DEFAULT_BUCKETS):
+        self._lock = threading.Lock()
+        self.ttft = Histogram(buckets)
+        self.tpot = Histogram(buckets)
+        self.e2e = Histogram(buckets)
+        self.counters: Dict[str, float] = {
+            "requests_submitted_total": 0,
+            "requests_rejected_total": 0,
+            "requests_finished_total": 0,
+            "requests_cancelled_total": 0,
+            "requests_timed_out_total": 0,
+            "requests_failed_total": 0,
+            "prefill_tokens_total": 0,
+            "decode_tokens_total": 0,
+            "engine_steps_total": 0,
+            "admission_blocked_total": 0,
+        }
+        self.gauges: Dict[str, float] = {
+            "queue_depth": 0,
+            "active_requests": 0,
+            "kv_free_blocks": 0,
+            "kv_total_blocks": 0,
+            "kv_occupancy": 0.0,
+        }
+
+    # -- writers ---------------------------------------------------------
+    def inc(self, name: str, delta: float = 1) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + delta
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self.gauges[name] = value
+
+    def observe_request(self, req) -> None:
+        """Fold a TERMINAL request's latencies in (whatever stamps exist)."""
+        with self._lock:
+            if req.ttft_s is not None:
+                self.ttft.observe(req.ttft_s)
+            if req.tpot_s is not None:
+                self.tpot.observe(req.tpot_s)
+            if req.e2e_s is not None:
+                self.e2e.observe(req.e2e_s)
+
+    def update_kv(self, free_blocks: int, total_blocks: int) -> None:
+        with self._lock:
+            self.gauges["kv_free_blocks"] = free_blocks
+            self.gauges["kv_total_blocks"] = total_blocks
+            if total_blocks:
+                self.gauges["kv_occupancy"] = 1.0 - free_blocks / total_blocks
+
+    # -- readers ---------------------------------------------------------
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            out = dict(self.counters)
+            out.update(self.gauges)
+            out["ttft_mean_s"] = self.ttft.mean
+            out["tpot_mean_s"] = self.tpot.mean
+            out["e2e_mean_s"] = self.e2e.mean
+            return out
+
+    def prometheus_text(self) -> str:
+        p = self.PREFIX
+        with self._lock:
+            samples = []
+            for name in sorted(self.counters):
+                samples.append((f"{p}_{name}", None, self.counters[name], "counter"))
+            for name in sorted(self.gauges):
+                samples.append((f"{p}_{name}", None, self.gauges[name], "gauge"))
+            for hname, hist in (
+                ("ttft_seconds", self.ttft),
+                ("tpot_seconds", self.tpot),
+                ("e2e_latency_seconds", self.e2e),
+            ):
+                samples.extend(hist.prom_samples(f"{p}_{hname}"))
+        return render_prometheus_text(samples)
+
+    def to_events(self, step: Optional[int] = None) -> List[Tuple]:
+        """The Monitor-writer bridge: (name, value, step) triples. ``step``
+        defaults to the finished-request count (a monotone serving clock)."""
+        with self._lock:
+            if step is None:
+                step = int(self.counters["requests_finished_total"])
+            events = []
+            for name, value in {**self.counters, **self.gauges}.items():
+                events.append((f"Serving/{name}", value, step))
+            for hname, hist in (
+                ("ttft_s", self.ttft),
+                ("tpot_s", self.tpot),
+                ("e2e_s", self.e2e),
+            ):
+                if hist.count:
+                    events.append((f"Serving/{hname}_mean", hist.mean, step))
+                    events.append((f"Serving/{hname}_p95", hist.quantile(0.95), step))
+            return events
+
+
+# re-export for callers that want consistent naming with the monitor sink
+__all__ = [
+    "DEFAULT_BUCKETS",
+    "Histogram",
+    "ServingMetrics",
+    "prometheus_metric_name",
+]

@@ -1,0 +1,150 @@
+"""Request lifecycle for the serving driver.
+
+Reference analogue: MII's ``RaggedRequest``/``RequestStatus`` around the
+FastGen engine — a serving request is not a prompt array but a state
+machine (queued → prefill → decode → terminal) carrying its own sampling
+parameters, stop conditions, and deadline. The driver owns every
+transition; the ``Request`` object is what callers (HTTP handlers, bench
+clients, tests) hold while tokens stream out.
+"""
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class RequestState:
+    """Lifecycle states (string constants — cheap to compare and to export
+    as a metric label; no enum dependency in hot paths)."""
+
+    QUEUED = "queued"        # accepted into the admission queue
+    PREFILL = "prefill"      # submitted to the scheduler, prompt in flight
+    DECODE = "decode"        # first token produced, decoding
+    FINISHED = "finished"    # completed normally (eos / stop / max tokens)
+    CANCELLED = "cancelled"  # caller cancelled
+    TIMED_OUT = "timed_out"  # deadline elapsed before completion
+    REJECTED = "rejected"    # never admitted (queue full / inadmissible / draining)
+    FAILED = "failed"        # isolated error (stop_fn raised, engine error)
+
+    TERMINAL = frozenset({FINISHED, CANCELLED, TIMED_OUT, REJECTED, FAILED})
+    ACTIVE = frozenset({PREFILL, DECODE})
+
+
+@dataclass
+class SamplingParams:
+    """Per-request generation knobs.
+
+    ``temperature``/``top_k``/``top_p`` are recorded per request for the
+    serving front end, but the v2 engine compiles its sampling programs
+    from the ENGINE config (they are static, program-shaping knobs — see
+    ``RaggedInferenceEngineConfig``). The driver therefore applies the
+    request-level values only when they are expressible without a
+    recompile: requests inherit the engine's sampler, and stop handling
+    (eos / stop ids / stop_fn / max_new_tokens) is fully per-request.
+    """
+
+    max_new_tokens: int = 64
+    temperature: Optional[float] = None
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    eos_token_id: Optional[int] = None  # None = use the driver's default
+    ignore_eos: bool = False
+    stop_token_ids: Tuple[int, ...] = ()
+
+    def __post_init__(self):
+        if self.max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {self.max_new_tokens}")
+        self.stop_token_ids = tuple(int(t) for t in self.stop_token_ids)
+
+
+@dataclass
+class Request:
+    """One serving request: prompt + params + lifecycle + timing.
+
+    Timing fields are ``time.monotonic()`` stamps; latency metrics
+    (TTFT/TPOT/e2e) derive from their differences, so wall-clock jumps
+    cannot corrupt histograms.
+    """
+
+    uid: int
+    prompt_tokens: np.ndarray
+    params: SamplingParams = field(default_factory=SamplingParams)
+    deadline: Optional[float] = None  # monotonic stamp; None = no timeout
+    # Custom stop predicate called with (request, token) after each generated
+    # token; True stops the request. Exceptions inside it fail ONLY this
+    # request (driver error isolation).
+    stop_fn: Optional[Callable[["Request", int], bool]] = None
+
+    state: str = RequestState.QUEUED
+    finish_reason: Optional[str] = None
+    error: Optional[str] = None
+    generated: List[int] = field(default_factory=list)
+
+    t_submit: float = field(default_factory=time.monotonic)
+    t_admitted: Optional[float] = None
+    t_first_token: Optional[float] = None
+    t_finish: Optional[float] = None
+
+    stream: Optional["TokenStream"] = None  # attached by the driver
+    _done: threading.Event = field(default_factory=threading.Event, repr=False)
+
+    def __post_init__(self):
+        self.prompt_tokens = np.asarray(self.prompt_tokens, np.int32).reshape(-1)
+
+    # -- state ----------------------------------------------------------
+    @property
+    def is_terminal(self) -> bool:
+        return self.state in RequestState.TERMINAL
+
+    @property
+    def num_generated(self) -> int:
+        return len(self.generated)
+
+    @property
+    def remaining_tokens(self) -> int:
+        return max(0, self.params.max_new_tokens - len(self.generated))
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until the request reaches a terminal state."""
+        return self._done.wait(timeout)
+
+    # -- latency views (None until the underlying stamps exist) ---------
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.t_first_token is None:
+            return None
+        return self.t_first_token - self.t_submit
+
+    @property
+    def tpot_s(self) -> Optional[float]:
+        """Mean time-per-output-token over the decode phase."""
+        if self.t_first_token is None or self.t_finish is None:
+            return None
+        n = len(self.generated) - 1
+        if n < 1:
+            return None
+        return (self.t_finish - self.t_first_token) / n
+
+    @property
+    def e2e_s(self) -> Optional[float]:
+        if self.t_finish is None:
+            return None
+        return self.t_finish - self.t_submit
+
+    # -- stop-condition evaluation (driver calls after each token) ------
+    def should_stop(self, token: int, default_eos: Optional[int]) -> Optional[str]:
+        """Return a finish reason if ``token`` ends this request, else None.
+        ``stop_fn`` exceptions propagate to the driver, which isolates them."""
+        eos = self.params.eos_token_id if self.params.eos_token_id is not None else default_eos
+        if not self.params.ignore_eos and eos is not None and token == int(eos):
+            return "eos"
+        if token in self.params.stop_token_ids:
+            return "stop_token"
+        if self.stop_fn is not None and self.stop_fn(self, token):
+            return "stop_fn"
+        if len(self.generated) >= self.params.max_new_tokens:
+            return "max_tokens"
+        return None

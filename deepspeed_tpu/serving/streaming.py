@@ -1,0 +1,116 @@
+"""Per-request token streaming + incremental detokenization.
+
+The driver appends tokens to a ``TokenStream`` as decode rounds complete;
+any number of consumer threads (HTTP handlers, bench clients) iterate it
+concurrently with generation. ``IncrementalDetokenizer`` turns the id
+stream into text pieces without re-emitting earlier text and without
+splitting multi-token UTF-8 sequences (the classic streaming-detok bug:
+byte-level BPE tokens are not codepoint-aligned, so a naive per-token
+decode emits U+FFFD replacement chars mid-character).
+"""
+
+import threading
+from collections import deque
+from typing import Iterator, Optional
+
+
+class StreamClosed(Exception):
+    """Raised by ``get()`` when the stream ended and no tokens remain."""
+
+
+class TokenStream:
+    """Thread-safe token queue with an end-of-stream marker.
+
+    Producer: ``put(token)`` then ``close(reason)``. Consumer: iterate, or
+    ``get(timeout)``. Iteration ends when the stream is closed and drained;
+    ``finish_reason`` is readable afterwards.
+    """
+
+    def __init__(self, uid: int):
+        self.uid = uid
+        self._q = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+        self.finish_reason: Optional[str] = None
+        self.error: Optional[str] = None
+
+    # -- producer (driver thread) ---------------------------------------
+    def put(self, token: int) -> None:
+        with self._cond:
+            if self._closed:
+                return  # late tokens after close (e.g. cancel) are dropped
+            self._q.append(int(token))
+            self._cond.notify_all()
+
+    def close(self, finish_reason: str, error: Optional[str] = None) -> None:
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self.finish_reason = finish_reason
+            self.error = error
+            self._cond.notify_all()
+
+    # -- consumer --------------------------------------------------------
+    @property
+    def closed(self) -> bool:
+        with self._cond:
+            return self._closed and not self._q
+
+    def get(self, timeout: Optional[float] = None) -> int:
+        """Next token; raises ``StreamClosed`` at end-of-stream, ``TimeoutError``
+        if ``timeout`` elapses with the stream still open."""
+        with self._cond:
+            while not self._q:
+                if self._closed:
+                    raise StreamClosed(self.finish_reason)
+                if not self._cond.wait(timeout):
+                    raise TimeoutError(f"no token within {timeout}s (uid={self.uid})")
+            return self._q.popleft()
+
+    def __iter__(self) -> Iterator[int]:
+        while True:
+            try:
+                yield self.get()
+            except StreamClosed:
+                return
+
+
+class IncrementalDetokenizer:
+    """Turn a token-id stream into text pieces, emitting only complete
+    codepoints: decode the full generated prefix each push and emit the
+    suffix past what was already emitted, holding back while the decode
+    ends in U+FFFD (a partial UTF-8 sequence awaiting its next token)."""
+
+    def __init__(self, tokenizer):
+        self._tok = tokenizer
+        self._ids = []
+        self._emitted = 0  # chars already handed out
+
+    def push(self, token_id: int) -> str:
+        self._ids.append(int(token_id))
+        text = self._tok.decode(self._ids)
+        if text.endswith("�"):
+            return ""  # mid-codepoint: wait for the completing token
+        piece = text[self._emitted:]
+        self._emitted = len(text)
+        return piece
+
+    def flush(self) -> str:
+        """Emit whatever remains (end of stream: a trailing U+FFFD is real)."""
+        text = self._tok.decode(self._ids)
+        piece = text[self._emitted:]
+        self._emitted = len(text)
+        return piece
+
+
+def stream_text(stream: TokenStream, tokenizer) -> Iterator[str]:
+    """Iterate a ``TokenStream`` as incremental text pieces."""
+    detok = IncrementalDetokenizer(tokenizer)
+    for tok in stream:
+        piece = detok.push(tok)
+        if piece:
+            yield piece
+    tail = detok.flush()
+    if tail:
+        yield tail

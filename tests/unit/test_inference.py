@@ -124,6 +124,72 @@ class TestBlockedAllocator:
         with pytest.raises(ValueError):
             a.free([7])
 
+    def test_double_free_rejected(self):
+        """Double frees must raise instead of silently forking the free
+        list (two sequences would later be handed the same block and write
+        each other's KV)."""
+        a = BlockedAllocator(8)
+        blocks = a.allocate(3)
+        a.free(blocks)
+        with pytest.raises(ValueError, match="double free"):
+            a.free(blocks)
+        with pytest.raises(ValueError, match="double free"):
+            a.free([int(blocks[0])])
+        # duplicate ids within ONE free() call are caught before mutation
+        b = a.allocate(2)
+        with pytest.raises(ValueError):
+            a.free([int(b[0]), int(b[0])])
+        a.free(b)  # failed call above must not have freed anything
+        assert a.free_blocks == 8
+        # pool still consistent: every block allocatable exactly once
+        assert sorted(int(x) for x in a.allocate(8)) == list(range(8))
+
+
+class TestRaggedScheduler:
+    def _stack(self, **kw):
+        from deepspeed_tpu.inference.config import KVCacheConfig, StateManagerConfig
+        from deepspeed_tpu.inference.v2.ragged_manager import DSStateManager
+        from deepspeed_tpu.inference.v2.scheduler import RaggedScheduler
+
+        kv = KVCacheConfig(block_size=4, num_blocks=32, max_blocks_per_seq=8)
+        sm = StateManagerConfig(max_tracked_sequences=8, max_ragged_batch_size=64,
+                                max_ragged_sequence_count=4, max_context=128, **kw)
+        mgr = DSStateManager(sm, kv)
+        return RaggedScheduler(sm, mgr, prompt_chunk=4), mgr
+
+    def test_resubmit_after_finish_starts_fresh(self):
+        """A finished uid resubmitted must get a FRESH sequence, not extend
+        the flushed one (stale seen_tokens would corrupt start positions)."""
+        sched, mgr = self._stack()
+        sched.submit(7, np.arange(1, 5, dtype=np.int32))
+        assert sched.next_batch() is not None
+        sched.feedback(7, 99)
+        sched.finish(7)
+        sched.submit(7, np.asarray([41, 42], np.int32))
+        seq = mgr.get_sequence(7)
+        assert not seq.finished
+        assert seq.tokens == [41, 42] and seq.seen_tokens == 0
+        batch = sched.next_batch()
+        assert batch.uids == [7]
+        assert batch.start_positions == [0]
+        np.testing.assert_array_equal(batch.tokens[0], [41, 42])
+
+    def test_finish_mid_prefill_drops_pending_chunks(self):
+        """Cancel while prompt chunks are still pending: the stale chunks
+        must not crash next_batch or prepend the old prompt on resubmit."""
+        sched, mgr = self._stack()
+        sched.submit(3, np.arange(1, 11, dtype=np.int32))  # 10 toks, chunk=4
+        first = sched.next_batch()
+        assert first.is_prompt_chunk == [True]  # 6 tokens still pending
+        sched.finish(3)  # cancel mid-prefill
+        assert not sched.has_work()
+        assert mgr.free_blocks == 32
+        assert sched.next_batch() is None
+        sched.submit(3, np.asarray([70, 71], np.int32))
+        batch = sched.next_batch()
+        np.testing.assert_array_equal(batch.tokens[0], [70, 71])
+        assert batch.start_positions == [0]
+
 
 class TestInferenceV2:
     def _engine(self, cfg, params, **kv):

@@ -1,0 +1,111 @@
+"""HTTP front-end tests: request parsing without sockets, plus one
+real-socket round trip on an ephemeral loopback port (marked slow — the
+tier-1 gate runs ``-m 'not slow'``; everything interesting about the
+handler body is covered socket-free via ``parse_generate`` +
+``test_serving.py``'s driver tests)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.serving.request import SamplingParams
+from deepspeed_tpu.serving.server import parse_generate, start_server
+from tests.unit.test_serving import FakeEngine
+
+
+class _WordTok:
+    eos_token_id = 0
+
+    def encode(self, text):
+        return np.asarray([len(w) for w in text.split()], np.int32)
+
+    def decode(self, ids):
+        return " ".join("x" * int(i) for i in ids)
+
+
+class TestParseGenerate:
+    def test_tokens_path(self):
+        prompt, params, stream, timeout = parse_generate(
+            {"tokens": [1, 2, 3], "max_new_tokens": 7, "stream": True,
+             "timeout_s": 2.5, "stop_token_ids": [9], "ignore_eos": True}
+        )
+        assert prompt.dtype == np.int32 and prompt.tolist() == [1, 2, 3]
+        assert isinstance(params, SamplingParams)
+        assert params.max_new_tokens == 7
+        assert params.stop_token_ids == (9,)
+        assert params.ignore_eos is True
+        assert stream is True and timeout == 2.5
+
+    def test_prompt_needs_tokenizer(self):
+        with pytest.raises(ValueError, match="tokens"):
+            parse_generate({"prompt": "hi"}, tokenizer=None)
+        prompt, _, _, _ = parse_generate({"prompt": "aa bbb"}, tokenizer=_WordTok())
+        assert prompt.tolist() == [2, 3]
+
+    @pytest.mark.parametrize("body,msg", [
+        ([1, 2], "JSON object"),
+        ({}, "needs"),
+        ({"tokens": []}, "empty"),
+        ({"tokens": [1], "timeout_s": -1}, "positive"),
+    ])
+    def test_invalid_bodies(self, body, msg):
+        with pytest.raises(ValueError, match=msg):
+            parse_generate(body)
+
+
+@pytest.mark.slow
+class TestServingHTTP:
+    def test_real_socket_round_trip(self):
+        from deepspeed_tpu.serving.driver import ServingDriver
+
+        eng = FakeEngine()
+        driver = ServingDriver(eng, max_queue=16)
+        driver.start()
+        server = start_server(driver, host="127.0.0.1", port=0, tokenizer=None)
+        host, port = server.server_address[:2]
+        base = f"http://{host}:{port}"
+        try:
+            with urllib.request.urlopen(f"{base}/health", timeout=10) as r:
+                health = json.loads(r.read())
+            assert health["status"] == "ok"
+            assert health["kv_total_blocks"] == eng.config.kv_cache.num_blocks
+
+            # non-streaming generate: full completion as one JSON object
+            body = json.dumps({"tokens": [5, 6], "max_new_tokens": 4,
+                               "ignore_eos": True}).encode()
+            req = urllib.request.Request(f"{base}/generate", data=body,
+                                         method="POST")
+            with urllib.request.urlopen(req, timeout=30) as r:
+                out = json.loads(r.read())
+            assert out["finish_reason"] == "max_tokens"
+            assert out["tokens"] == [7, 8, 9, 10]
+
+            # streaming generate: chunked jsonl, one token per line
+            body = json.dumps({"tokens": [20], "max_new_tokens": 3,
+                               "ignore_eos": True, "stream": True}).encode()
+            req = urllib.request.Request(f"{base}/generate", data=body,
+                                         method="POST")
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert r.headers["Transfer-Encoding"] == "chunked"
+                lines = [json.loads(l) for l in r.read().splitlines() if l]
+            assert [l["token"] for l in lines] == [21, 22, 23]
+
+            with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+                metrics = r.read().decode()
+            assert "dstpu_serving_requests_finished_total 2" in metrics
+            assert "# TYPE dstpu_serving_ttft_seconds histogram" in metrics
+
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                bad = urllib.request.Request(f"{base}/generate", data=b"{}",
+                                             method="POST")
+                urllib.request.urlopen(bad, timeout=10)
+            assert ei.value.code == 400
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{base}/nope", timeout=10)
+            assert ei.value.code == 404
+        finally:
+            server.shutdown()
+            driver.shutdown(drain=False)
