@@ -414,7 +414,14 @@ def bench_serving_load(
     (p50/p95), and goodput (generated tok/s counting only requests that
     finished within the SLO). Runs on CPU with a tiny model by default;
     knobs via env: DSTPU_SERVE_N, DSTPU_SERVE_RATE, DSTPU_SERVE_MAX_NEW,
-    DSTPU_SERVE_SLO_S."""
+    DSTPU_SERVE_SLO_S.
+
+    Prefix-caching knobs: DSTPU_SERVE_PREFIX_FRAC (fraction of requests
+    that share a common system-prompt prefix, default 0 — set 0.8 to model
+    a chat workload) and DSTPU_SERVE_PREFIX_CACHE (1 on / 0 off, default
+    1). With a shared prefix the report splits TTFT by hit vs cold requests
+    and adds the cache's hit-rate, so the cache's win is measured on the
+    requests it actually serves."""
     from deepspeed_tpu.inference.config import RaggedInferenceEngineConfig
     from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
     from deepspeed_tpu.models import TransformerConfig, init_params
@@ -426,6 +433,8 @@ def bench_serving_load(
     max_new = int(max_new or os.environ.get("DSTPU_SERVE_MAX_NEW", 12))
     slo = slo_e2e_s or os.environ.get("DSTPU_SERVE_SLO_S")
     slo = float(slo) if slo is not None else None
+    prefix_frac = float(os.environ.get("DSTPU_SERVE_PREFIX_FRAC", 0.0))
+    prefix_cache = os.environ.get("DSTPU_SERVE_PREFIX_CACHE", "1") != "0"
 
     if cfg is None:
         cfg = TransformerConfig(
@@ -433,37 +442,57 @@ def bench_serving_load(
             max_seq_len=512, dtype="float32",
         )
         params = init_params(cfg, jax.random.key(0))
+    # per-step token budget 96: a cold system-prompt request needs 2-3
+    # prefill steps, a cache hit needs one — TTFT then measures the steps
+    # the cache actually removes (per-step overhead dominates tiny-model
+    # prefill, so a within-step token discount alone would be invisible)
     rc = RaggedInferenceEngineConfig.from_dict({
         "dtype": cfg.dtype,
-        "kv_cache": {"block_size": 16, "num_blocks": 256, "max_blocks_per_seq": 8},
-        "state_manager": {"max_tracked_sequences": 64, "max_ragged_batch_size": 256,
-                          "max_ragged_sequence_count": 16, "max_context": 128},
+        "kv_cache": {"block_size": 16, "num_blocks": 384, "max_blocks_per_seq": 16,
+                     "prefix_cache": prefix_cache},
+        "state_manager": {"max_tracked_sequences": 64, "max_ragged_batch_size": 96,
+                          "max_ragged_sequence_count": 16, "max_context": 256},
     })
     engine = InferenceEngineV2(cfg, params, rc)
     driver = ServingDriver(engine, max_queue=n_requests, kv_headroom=0.05)
     driver.start()
 
     rng = np.random.default_rng(seed)
-    prompts = [rng.integers(0, cfg.vocab_size, size=(int(l),)).astype(np.int32)
-               for l in rng.integers(8, 48, size=n_requests)]
-    # warm the compiled step shapes so the measured run isn't compile-bound
-    warm = driver.submit(prompts[0], params=SamplingParams(max_new_tokens=4, ignore_eos=True))
+    # a shared system prompt: 10 full blocks, so every sharing request hits
+    # the same cached prefix; its unique tail still forces a real prefill
+    sys_prompt = rng.integers(0, cfg.vocab_size, size=(160,)).astype(np.int32)
+    shares = rng.random(n_requests) < prefix_frac
+    prompts = []
+    for i, l in enumerate(rng.integers(8, 32, size=n_requests)):
+        tail = rng.integers(0, cfg.vocab_size, size=(int(l),)).astype(np.int32)
+        prompts.append(np.concatenate([sys_prompt, tail]) if shares[i] else tail)
+    # warm the compiled step shapes so the measured run isn't compile-bound;
+    # the warm request also primes the cache with the system prompt (the
+    # steady-state a live server reaches after one cold request)
+    warm_tail = rng.integers(0, cfg.vocab_size, size=(8,)).astype(np.int32)
+    warm_prompt = (np.concatenate([sys_prompt, warm_tail]) if prefix_frac > 0
+                   else warm_tail)
+    warm = driver.submit(warm_prompt, params=SamplingParams(max_new_tokens=4, ignore_eos=True))
     warm.wait(120)
 
     gaps = rng.exponential(1.0 / rate_rps, size=n_requests)
     reqs, rejected = [], 0
+    req_shares = []
     t0 = time.perf_counter()
-    for prompt, gap in zip(prompts, gaps):
+    for i, (prompt, gap) in enumerate(zip(prompts, gaps)):
         time.sleep(float(gap))
         try:
             reqs.append(driver.submit(
                 prompt, params=SamplingParams(max_new_tokens=max_new, ignore_eos=True)
             ))
+            req_shares.append(bool(shares[i]))
         except RequestRejected:
             rejected += 1
     for r in reqs:
         r.wait(300)
     wall = time.perf_counter() - t0
+    cache = engine.prefix_cache
+    cache_stats = cache.stats() if cache is not None else None
     driver.shutdown(drain=True, timeout=60)
 
     done = [r for r in reqs if r.state == "finished"]
@@ -477,6 +506,31 @@ def bench_serving_load(
     ttfts = [r.ttft_s for r in done if r.ttft_s is not None]
     tpots = [r.tpot_s for r in done if r.tpot_s is not None]
     e2es = [r.e2e_s for r in done if r.e2e_s is not None]
+
+    # hit-vs-cold TTFT split: "hit" = the request shared the system prefix
+    # (with the cache on, its prefill skipped the shared blocks)
+    hit_ttfts = [r.ttft_s for r, s in zip(reqs, req_shares)
+                 if s and r.state == "finished" and r.ttft_s is not None]
+    cold_ttfts = [r.ttft_s for r, s in zip(reqs, req_shares)
+                  if not s and r.state == "finished" and r.ttft_s is not None]
+    prefix_report = {}
+    if prefix_frac > 0:
+        prefix_report = {
+            "prefix_frac": prefix_frac,
+            "prefix_cache": prefix_cache,
+            "ttft_hit_mean_s": (round(float(np.mean(hit_ttfts)), 4)
+                                if hit_ttfts else None),
+            "ttft_cold_mean_s": (round(float(np.mean(cold_ttfts)), 4)
+                                 if cold_ttfts else None),
+            "prefix_hit_rate": (round(cache_stats["hit_rate"], 3)
+                                if cache_stats else 0.0),
+            "prefix_hit_tokens": (int(cache_stats["hit_tokens"])
+                                  if cache_stats else 0),
+            "prefix_cached_blocks": (int(cache_stats["cached_blocks"])
+                                     if cache_stats else 0),
+            "prefix_evictions": (int(cache_stats["evictions"])
+                                 if cache_stats else 0),
+        }
     return {
         "mode": "serving_load",
         "n_requests": n_requests,
@@ -491,6 +545,7 @@ def bench_serving_load(
         "slo_e2e_s": slo,
         "goodput_tok_s": round(sum(len(r.generated) for r in good) / wall, 1),
         "throughput_tok_s": round(sum(len(r.generated) for r in done) / wall, 1),
+        **prefix_report,
     }
 
 

@@ -174,6 +174,12 @@ def serve_parse_args(argv=None):
                    help="default per-request timeout in seconds")
     p.add_argument("--decode-steps", type=int, default=1,
                    help="fuse this many decode iterations per device call")
+    p.add_argument("--no-prefix-cache", action="store_true",
+                   help="disable automatic prefix caching (on by default "
+                   "when serving: repeated prompt prefixes share KV blocks "
+                   "and skip their prefill)")
+    p.add_argument("--prefix-cache-blocks", type=int, default=0,
+                   help="cap on trie-held KV blocks (0 = bounded by pool)")
     p.add_argument("--sample", action="store_true")
     p.add_argument("--temperature", type=float, default=1.0)
     p.add_argument("--top-k", type=int, default=0)
@@ -211,6 +217,8 @@ def build_serving_stack(args, cfg=None, params=None, tok=None):
             "block_size": args.block_size,
             "num_blocks": args.num_blocks,
             "max_blocks_per_seq": args.max_blocks_per_seq,
+            "prefix_cache": not getattr(args, "no_prefix_cache", False),
+            "prefix_cache_blocks": getattr(args, "prefix_cache_blocks", 0),
         },
         "state_manager": {
             "max_tracked_sequences": args.max_concurrent,

@@ -65,6 +65,17 @@ class KVCacheConfig(DSConfigModel):
     block_size: int = 128  # tokens per KV block (reference v2 kv block)
     num_blocks: int = 256
     max_blocks_per_seq: int = 32
+    # automatic prefix caching: full prompt blocks are kept in a token-trie
+    # after prefill and shared (refcounted) with later requests whose
+    # prompts start with the same block-aligned tokens — a hit skips that
+    # much prefill. Off by default at the engine level so plain generate()
+    # keeps its exact allocation behavior; the serving stack (dstpu serve,
+    # bench --serving-load) turns it on unless told otherwise. Outputs are
+    # bit-identical either way.
+    prefix_cache: bool = False
+    # cap on trie-held blocks (0 = bounded only by the pool); evicting is
+    # LRU over cached blocks no live sequence shares
+    prefix_cache_blocks: int = 0
 
 
 @dataclass

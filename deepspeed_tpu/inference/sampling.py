@@ -36,6 +36,28 @@ def filter_logits(logits, top_k: int = 0, top_p: float = 0.0):
     return logits
 
 
+def row_keys(rng, uids, positions):
+    """Content-addressed per-row sampling keys: fold each row's sequence
+    uid and the GLOBAL position of its logits source into the base key.
+    A token's key then depends only on (seed, uid, position) — never on
+    how the scheduler packed the batch, how a prompt was chunked, the
+    decode_steps partitioning, or whether a prefix-cache hit skipped part
+    of prefill — so sampled streams are bit-identical across all of those
+    execution choices."""
+    return jax.vmap(
+        lambda u, p: jax.random.fold_in(jax.random.fold_in(rng, u), p)
+    )(jnp.asarray(uids, jnp.int32), jnp.asarray(positions, jnp.int32))
+
+
+def _is_key_batch(rng) -> bool:
+    try:
+        if jnp.issubdtype(rng.dtype, jax.dtypes.prng_key):
+            return rng.ndim >= 1
+    except (AttributeError, TypeError):
+        pass
+    return getattr(rng, "ndim", 0) >= 2  # raw uint32 keys: [R, 2]
+
+
 def sample_tokens(
     logits,
     rng,
@@ -46,10 +68,11 @@ def sample_tokens(
     return_logprobs: bool = False,
 ):
     """Sample one token per row. logits: [R, vocab] fp32; rng: a PRNG key
-    (callers fold in the absolute step index for fused loops). Returns
-    int32 [R] tokens, or (tokens, logprobs [R]) — the log-probability of
-    the sampled token under the POST-filter, post-temperature distribution
-    (greedy rows report the same quantity at the argmax)."""
+    shared by all rows, or a batch of per-row keys (see ``row_keys``) for
+    packing-invariant streams. Returns int32 [R] tokens, or (tokens,
+    logprobs [R]) — the log-probability of the sampled token under the
+    POST-filter, post-temperature distribution (greedy rows report the
+    same quantity at the argmax)."""
     logits = logits.astype(jnp.float32)
     if greedy:
         toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -61,7 +84,12 @@ def sample_tokens(
         dist = filter_logits(
             logits / jnp.maximum(temperature, 1e-4), top_k=top_k, top_p=top_p
         )
-        toks = jax.random.categorical(rng, dist).astype(jnp.int32)
+        if _is_key_batch(rng):
+            toks = jax.vmap(
+                lambda k, d: jax.random.categorical(k, d)
+            )(rng, dist).astype(jnp.int32)
+        else:
+            toks = jax.random.categorical(rng, dist).astype(jnp.int32)
     if not return_logprobs:
         return toks
     logp = jax.nn.log_softmax(dist, axis=-1)

@@ -7,6 +7,7 @@ Reference: deepspeed/inference/v2/ — ``InferenceEngineV2`` (engine_v2.py:30),
 """
 
 from deepspeed_tpu.inference.v2.blocked_allocator import BlockedAllocator
+from deepspeed_tpu.inference.v2.prefix_cache import PrefixCache
 from deepspeed_tpu.inference.v2.ragged_manager import DSSequenceDescriptor, DSStateManager
 from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
 from deepspeed_tpu.inference.v2.scheduler import RaggedBatch, RaggedScheduler
@@ -16,6 +17,7 @@ __all__ = [
     "DSSequenceDescriptor",
     "DSStateManager",
     "InferenceEngineV2",
+    "PrefixCache",
     "RaggedBatch",
     "RaggedScheduler",
 ]

@@ -174,17 +174,30 @@ class InferenceEngineV2:
         self._multistep_n = 0
         self.last_scheduled_tokens = 0
         self.last_capped = set()
-        # sampling state: one base key; programs fold in the absolute decode
-        # step index so fused rounds reproduce the per-step loop exactly
+        # sampling state: one base key; programs fold in each row's (uid,
+        # source position) so a token's key is content-addressed — invariant
+        # to batch packing, prompt chunking, fused-round partitioning, and
+        # prefix-cache hits (sampling.row_keys)
         self._rng = jax.random.key(int(getattr(self.config, "seed", 0) or 0))
-        self._sample_step = 0
         self.last_logprobs: Dict[int, np.ndarray] = {}
         log_dist(
             f"InferenceEngineV2: {kv.num_blocks} KV blocks × {kv.block_size} tokens, "
             f"budget {self.config.state_manager.max_ragged_batch_size} tok/step"
-            + (f", tp={self._tp}" if self._tp > 1 else ""),
+            + (f", tp={self._tp}" if self._tp > 1 else "")
+            + (", prefix_cache=on" if self.state_manager.prefix_cache is not None else ""),
             ranks=[0],
         )
+
+    @property
+    def prefix_cache(self):
+        """The pool's automatic prefix cache (None when kv_cache.prefix_cache
+        is off). Cache-seeded sequences enter prefill with a pre-populated
+        block table and a nonzero start offset; the split-phase step already
+        serves that shape — every prompt chunk after the first is exactly a
+        nonzero-start prefill against existing blocks, and the chunk
+        program's pool gather (``pool_limit=chk_start``) reads the shared
+        blocks' KV like any other context below the chunk."""
+        return self.state_manager.prefix_cache
 
     def set_sampling(self, greedy=None, temperature=None, top_k=None,
                      top_p=None, seed=None):
@@ -201,7 +214,6 @@ class InferenceEngineV2:
             cfg.top_p = float(top_p)
         if seed is not None:
             self._rng = jax.random.key(int(seed))
-            self._sample_step = 0
         self._split_jit = {}
         self._multistep_jit = None
 
@@ -516,8 +528,8 @@ class InferenceEngineV2:
         dtype = T.DTYPES[c.dtype]
 
         def step(params, tokens, positions, blk, row, dec_tables, dec_pos,
-                 chk_tables, chk_pos, chk_start, chk_last, rng, temperature,
-                 k_cache, v_cache):
+                 dec_uids, chk_tables, chk_pos, chk_start, chk_last, chk_uids,
+                 rng, temperature, k_cache, v_cache):
             x = T._scale_embed(params["embed"].astype(dtype)[tokens][None], c, dtype)
             if c.position == "learned":
                 x = x + params["pos_embed"][jnp.clip(positions, 0, c.max_seq_len - 1)][None]
@@ -551,16 +563,22 @@ class InferenceEngineV2:
             # static config knobs): generate() holds only these tiny arrays
             # across the prefill phase and drops the logits refs — holding
             # the 4 MB logits buffers alive measurably stalled the step
-            # pipeline through the device tunnel
-            from deepspeed_tpu.inference.sampling import sample_tokens
+            # pipeline through the device tunnel. Keys are per-row,
+            # content-addressed on (uid, logits-source position) so the
+            # sampled stream is invariant to batch packing, prompt
+            # chunking, and prefix-cache hits.
+            from deepspeed_tpu.inference.sampling import row_keys, sample_tokens
 
             kw = self._sampling_kw()
             toks_dec = sample_tokens(
-                logits_dec.astype(jnp.float32), jax.random.fold_in(rng, 0),
+                logits_dec.astype(jnp.float32),
+                row_keys(rng, dec_uids, dec_pos),
                 temperature=temperature, **kw,
             )
+            chk_src = positions[jnp.clip(chk_last, 0, positions.shape[0] - 1)]
             toks_chk = sample_tokens(
-                logits_chk.astype(jnp.float32), jax.random.fold_in(rng, 1),
+                logits_chk.astype(jnp.float32),
+                row_keys(rng, chk_uids, chk_src),
                 temperature=temperature, **kw,
             )
             return (
@@ -568,10 +586,10 @@ class InferenceEngineV2:
                 toks_dec, toks_chk, k_new, v_new,
             )
 
-        # donate BOTH cache pools (args 13 and 14 — k_cache, v_cache) so the
-        # scatter updates alias in place; donating 12 would hand XLA the
+        # donate BOTH cache pools (args 15 and 16 — k_cache, v_cache) so the
+        # scatter updates alias in place; donating 14 would hand XLA the
         # scalar `temperature` instead of v_cache and copy a full V pool
-        return jax.jit(step, donate_argnums=(13, 14))
+        return jax.jit(step, donate_argnums=(15, 16))
 
     def _round_layer(self, lp, x, li, meta, carry, window=None):
         """One layer of one step of a fused decode ROUND: queries are the
@@ -634,8 +652,8 @@ class InferenceEngineV2:
         dtype = T.DTYPES[c.dtype]
         L = c.n_layers
 
-        def fused(params, tokens, positions, tables, active, rng, temperature,
-                  k_cache, v_cache):
+        def fused(params, tokens, positions, tables, uids, active, rng,
+                  temperature, k_cache, v_cache):
             tok_tables = jnp.where(active[:, None], tables, trash)
             pos0 = positions  # round-start positions (pool validity limit)
             nkv, d = c.kv_heads, c.head_dim
@@ -649,7 +667,7 @@ class InferenceEngineV2:
             # layer-step
             k_pool0, v_pool0 = self._pool_views(k_cache, v_cache)
 
-            from deepspeed_tpu.inference.sampling import sample_tokens
+            from deepspeed_tpu.inference.sampling import row_keys, sample_tokens
 
             kw = self._sampling_kw()
 
@@ -687,13 +705,13 @@ class InferenceEngineV2:
                 )
                 x = T._norm(x, params["final_norm"], params.get("final_norm_b"), c.norm, c.norm_eps)
                 logits = T._apply_lm_head(params, x[0], c)  # [R, vocab]
-                # per-step rng: fold the round-local step index into the
-                # per-round key. NOTE: the sampled stream therefore depends
-                # on the decode_steps partitioning (unlike v1's absolute-
-                # index folding) — same seed + same decode_steps reproduces
-                # exactly; changing decode_steps resamples
+                # content-addressed per-row keys on (uid, source position):
+                # the stream for a given token is identical whether it was
+                # produced here, by the split-phase step, or under a
+                # different decode_steps partitioning or prefix-cache state
                 nxt, logp = sample_tokens(
-                    logits.astype(jnp.float32), jax.random.fold_in(rng, s),
+                    logits.astype(jnp.float32),
+                    row_keys(rng, uids, jnp.where(active, pos, -1)),
                     temperature=temperature, return_logprobs=True, **kw,
                 )
                 return nxt, logp, side_k, side_v, kc, vc
@@ -716,7 +734,7 @@ class InferenceEngineV2:
             )
             return toks_out, logps_out, kc, vc  # [n_steps, R] each
 
-        return jax.jit(fused, donate_argnums=(7, 8))
+        return jax.jit(fused, donate_argnums=(8, 9))
 
     def decode_round(self, n_steps: Optional[int] = None) -> Dict[int, np.ndarray]:
         """One fused decode round: ``n_steps`` greedy tokens for every
@@ -760,25 +778,26 @@ class InferenceEngineV2:
         tokens = np.zeros(R, np.int32)
         positions = np.zeros(R, np.int32)
         tables = np.full((R, B), trash, np.int32)
+        uid_arr = np.zeros(R, np.int32)
         active = np.zeros(R, bool)
         for i, uid in enumerate(uids):
             seq = self.state_manager.get_sequence(uid)
             tokens[i] = sched.peek_next_token(uid)
             positions[i] = seq.seen_tokens
             tables[i, : len(seq.block_table)] = seq.block_table
+            uid_arr[i] = uid
             active[i] = True
         if self._multistep_jit is None or self._multistep_n != n:
             self._multistep_jit = self._build_multistep_decode(n)
             self._multistep_n = n
-        round_rng = jax.random.fold_in(self._rng, 2 * self._sample_step + 1)
-        self._sample_step += 1
         toks_out, logps_out, self._k_cache, self._v_cache = self._multistep_jit(
             self.params,
             jnp.asarray(tokens),
             jnp.asarray(positions),
             jnp.asarray(tables),
+            jnp.asarray(uid_arr),
             jnp.asarray(active),
-            round_rng,
+            self._rng,
             jnp.float32(getattr(self.config, "temperature", 1.0) or 1.0),
             self._k_cache,
             self._v_cache,
@@ -873,10 +892,12 @@ class InferenceEngineV2:
         row = np.zeros(T_, np.int32)
         dec_tables = np.full((R, B), trash, np.int32)
         dec_pos = np.full(R, -1, np.int32)  # -1 = inactive slot (masks all)
+        dec_uids = np.zeros(R, np.int32)
         chk_tables = np.full((Rc, B), trash, np.int32)
         chk_pos = np.full((Rc, tq), -1, np.int32)
         chk_start = np.zeros(Rc, np.int32)  # 0 = inactive (empty pool window)
         chk_last = np.zeros(Rc, np.int32)
+        chk_uids = np.zeros(Rc, np.int32)
 
         for i, (uid, toks, start) in enumerate(dec_rows):
             seq = self.state_manager.get_sequence(uid)
@@ -885,6 +906,7 @@ class InferenceEngineV2:
             nblk = len(seq.block_table)
             dec_tables[i, :nblk] = seq.block_table
             dec_pos[i] = start
+            dec_uids[i] = uid
             blk[i] = seq.block_table[min(start // bs, nblk - 1)]
             row[i] = start % bs
         for j, (uid, toks, start, _chunked) in enumerate(chk_rows):
@@ -898,6 +920,7 @@ class InferenceEngineV2:
             chk_tables[j, :nblk] = seq.block_table
             chk_pos[j, :n] = pos
             chk_start[j] = start
+            chk_uids[j] = uid
             blk[off : off + n] = np.asarray(seq.block_table, np.int32)[
                 np.minimum(pos // bs, nblk - 1)
             ]
@@ -906,8 +929,6 @@ class InferenceEngineV2:
 
         if tq not in self._split_jit:
             self._split_jit[tq] = self._build_split_step(tq)
-        step_rng = jax.random.fold_in(self._rng, 2 * self._sample_step)
-        self._sample_step += 1
         (logits_dec, logits_chk, toks_dec, toks_chk,
          self._k_cache, self._v_cache) = self._split_jit[tq](
             self.params,
@@ -917,11 +938,13 @@ class InferenceEngineV2:
             jnp.asarray(row),
             jnp.asarray(dec_tables),
             jnp.asarray(dec_pos),
+            jnp.asarray(dec_uids),
             jnp.asarray(chk_tables),
             jnp.asarray(chk_pos),
             jnp.asarray(chk_start),
             jnp.asarray(chk_last),
-            step_rng,
+            jnp.asarray(chk_uids),
+            self._rng,
             jnp.float32(getattr(self.config, "temperature", 1.0) or 1.0),
             self._k_cache,
             self._v_cache,

@@ -16,6 +16,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from deepspeed_tpu.inference.v2.blocked_allocator import BlockedAllocator
+from deepspeed_tpu.inference.v2.prefix_cache import PrefixCache
 
 
 @dataclass
@@ -37,6 +38,15 @@ class DSStateManager:
         self._kv = kv_config
         self._alloc = BlockedAllocator(kv_config.num_blocks)
         self._seqs: Dict[int, DSSequenceDescriptor] = {}
+        self.prefix_cache: Optional[PrefixCache] = (
+            PrefixCache(
+                kv_config.block_size,
+                self._alloc,
+                max_cached_blocks=int(getattr(kv_config, "prefix_cache_blocks", 0) or 0),
+            )
+            if getattr(kv_config, "prefix_cache", False)
+            else None
+        )
 
     # -- reference API --------------------------------------------------------
     @property
@@ -89,15 +99,66 @@ class DSStateManager:
             )
 
     def extend(self, seq: DSSequenceDescriptor, new_tokens: int) -> bool:
-        """Reserve blocks for new_tokens; False if pool exhausted."""
+        """Reserve blocks for new_tokens; False if pool exhausted. When the
+        pool runs dry and a prefix cache is live, LRU cached blocks no
+        sequence shares are evicted to make room — cached KV is a reuse
+        *opportunity*, never a reason to stall live work."""
         need = self.blocks_needed(seq, new_tokens)
-        if need > self._alloc.free_blocks:
-            return False
         if len(seq.block_table) + need > self._kv.max_blocks_per_seq:
+            return False
+        short = need - self._alloc.free_blocks
+        if short > 0 and self.prefix_cache is not None:
+            self.prefix_cache.evict(short)
+        if need > self._alloc.free_blocks:
             return False
         if need:
             seq.block_table.extend(int(b) for b in self._alloc.allocate(need))
         return True
+
+    # -- prefix cache bridge ---------------------------------------------
+    def seed_from_cache(self, seq: DSSequenceDescriptor, prompt_tokens) -> int:
+        """Seed a FRESH sequence's block table with cached blocks covering
+        the longest block-aligned prefix of ``prompt_tokens`` present in
+        the trie (taking one reference per block for this sequence).
+        Returns the number of prompt tokens whose KV is already in the
+        pool — prefill starts there. No-op (0) without a cache or for a
+        non-fresh sequence."""
+        if self.prefix_cache is None or seq.seen_tokens or seq.block_table:
+            return 0
+        blocks, n_tokens = self.prefix_cache.acquire(prompt_tokens)
+        if n_tokens:
+            seq.block_table.extend(int(b) for b in blocks)
+            seq.seen_tokens = n_tokens
+        return n_tokens
+
+    def cache_prefill_blocks(self, seq: DSSequenceDescriptor, upto_tokens: int) -> int:
+        """Register the full blocks covering ``seq.tokens[:upto_tokens]``
+        in the trie (their KV is written by the step that scheduled them).
+        Shared path segments dedupe to the first writer's blocks."""
+        if self.prefix_cache is None:
+            return 0
+        n_full = min(upto_tokens // self._kv.block_size, len(seq.block_table))
+        if n_full == 0:
+            return 0
+        return self.prefix_cache.insert(
+            seq.tokens[: n_full * self._kv.block_size], seq.block_table[:n_full]
+        )
+
+    def kv_block_accounting(self) -> Dict[str, int]:
+        """The pool conservation law, for invariant checks: every block is
+        exactly one of free / referenced by a live block table (deduped) /
+        held only by the cache. Shared blocks (live AND cached) count once,
+        on the live side."""
+        live = set()
+        for seq in self._seqs.values():
+            live.update(int(b) for b in seq.block_table)
+        cached = set(self.prefix_cache.cached_block_ids()) if self.prefix_cache else set()
+        return {
+            "total": self._alloc.total_blocks,
+            "free": self._alloc.free_blocks,
+            "live": len(live),
+            "cached_only": len(cached - live),
+        }
 
     def flush_sequence(self, uid: int) -> None:
         """Release a finished sequence's blocks (reference flush)."""

@@ -83,6 +83,7 @@ class RaggedScheduler:
             )
         self._mgr.check_admissible(total)
         seq = self._mgr.get_or_create_sequence(uid)
+        fresh = not seq.tokens and seq.seen_tokens == 0 and not seq.block_table
         seq.tokens.extend(int(t) for t in toks)
         # Continuation while a decode token is outstanding: fold the pending
         # sampled token (already in seq.tokens via feedback()) into this
@@ -94,6 +95,14 @@ class RaggedScheduler:
             if pending is not None:
                 toks = np.concatenate([np.asarray([pending], np.int32), toks])
         self.capped.discard(uid)  # a fresh submit supersedes old capped state
+        seed = getattr(self._mgr, "seed_from_cache", None)
+        if fresh and seed is not None:
+            # Prefix-cache consult (no-op when the cache is off): a hit
+            # seeds the block table with shared, already-populated blocks
+            # and prefill starts at the first uncached block boundary.
+            n_cached = seed(seq, toks)
+            if n_cached:
+                toks = toks[n_cached:]
         self._pending.append((uid, toks))
 
     def feedback(self, uid: int, sampled_token: int) -> None:
@@ -186,19 +195,31 @@ class RaggedScheduler:
             budget -= 1
 
         # 2. prompt chunks (split): at most max_prompt_chunks rows of at most
-        # prompt_chunk tokens — the fixed grid the split-phase program pads to
-        still_pending = []
+        # prompt_chunk tokens — the fixed grid the split-phase program pads to.
+        # Packing order: the OLDEST pending request always gets the first
+        # chunk slot (so a stream of cache-hit requests with tiny remaining
+        # prefills can never starve a cold prompt out of the grid), then
+        # shortest-remaining-prefill first — hit requests clear the prompt
+        # phase fast, which is the whole TTFT win — with oldest-first as the
+        # tie-break. ``_pending`` list order IS arrival order (submit
+        # appends; the rebuild below preserves relative positions).
+        entries = list(self._pending)
+        order = list(range(len(entries)))
+        if len(order) > 1:
+            order = [0] + sorted(order[1:], key=lambda i: (len(entries[i][1]), i))
+        keep: Dict[int, np.ndarray] = {}
         n_chunks = 0
-        for uid, remaining in self._pending:
+        for i in order:
+            uid, remaining = entries[i]
             if n_chunks >= self.max_prompt_chunks or budget <= 0:
-                still_pending.append((uid, remaining))
+                keep[i] = remaining
                 continue
             seq = self._mgr.get_sequence(uid)
             if seq is None or seq.finished:
                 continue  # finished underneath us: drop the stale chunk
             take = min(budget, self.prompt_chunk, len(remaining))
             if take == 0 or not self._mgr.extend(seq, take):
-                still_pending.append((uid, remaining))
+                keep[i] = remaining
                 continue
             chunk, rest = remaining[:take], remaining[take:]
             uids.append(uid)
@@ -208,9 +229,17 @@ class RaggedScheduler:
             decode.append(False)
             budget -= take
             n_chunks += 1
+            # the step consuming this batch writes the chunk's KV, so every
+            # full block below seen_tokens+take is cacheable now — any later
+            # reader's program runs after this one in device order
+            cache_blocks = getattr(self._mgr, "cache_prefill_blocks", None)
+            if cache_blocks is not None:
+                cache_blocks(seq, seq.seen_tokens + take)
             if len(rest):
-                still_pending.append((uid, rest))
-        self._pending = still_pending
+                keep[i] = rest
+        self._pending = [
+            (entries[i][0], keep[i]) for i in range(len(entries)) if i in keep
+        ]
 
         if not uids:
             return None

@@ -96,6 +96,9 @@ class ServingDriver:
     def _free_blocks(self) -> int:
         return int(getattr(self.engine.state_manager, "free_blocks", 0))
 
+    def _prefix_cache(self):
+        return getattr(getattr(self.engine, "state_manager", None), "prefix_cache", None)
+
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "ServingDriver":
         if self._thread is not None:
@@ -262,16 +265,35 @@ class ServingDriver:
 
     # admission ---------------------------------------------------------
     def _blocks_needed(self, req: Request) -> int:
+        """Blocks this request would CHARGE against ``free_blocks``: its
+        full token budget, minus blocks a prefix-cache hit would seed for
+        free (shared blocks cost no new allocation). Charging uncached
+        blocks only is what lets a hot shared prompt multiply effective KV
+        capacity — thousands of hit requests each charge only their
+        private suffix."""
         bs = int(self._kv_cfg("block_size", 1))
         cap = int(self._kv_cfg("max_blocks_per_seq", 1 << 30))
         total = len(req.prompt_tokens) + req.params.max_new_tokens
-        return min((total + bs - 1) // bs, cap)
+        need = min((total + bs - 1) // bs, cap)
+        cache = self._prefix_cache()
+        if cache is not None:
+            need = max(0, need - cache.peek(req.prompt_tokens))
+        return need
 
     def _admissible(self, req: Request) -> bool:
         max_tracked = self._sm_cfg("max_tracked_sequences", None)
         if max_tracked is not None and len(self._active) >= int(max_tracked):
             return False
         free = self._free_blocks()
+        cache = self._prefix_cache()
+        if cache is not None:
+            # cached blocks no sequence shares are reclaimable on demand
+            # (extend() evicts LRU when the pool runs dry) — a pool full of
+            # idle cache must not read as "no room". Blocks this request
+            # would HIT are excluded: they'll be shared, not evicted (and
+            # _blocks_needed already discounts them).
+            idle = int(cache.stats()["cached_blocks_idle"])
+            free += max(0, idle - cache.peek(req.prompt_tokens))
         if not self._active:
             # empty engine: headroom gating would starve a request larger
             # than the reserve forever — admit whatever fits outright
@@ -408,6 +430,16 @@ class ServingDriver:
             for req in list(self._active.values()):
                 self._finish_active(req, RequestState.FAILED, "engine_error",
                                     error=f"{type(e).__name__}: {e}")
+            cache = self._prefix_cache()
+            if cache is not None:
+                # the failed step may have left cached blocks' device KV
+                # unwritten/garbage — a later hit would serve corrupt
+                # context. Drop the whole trie (all actives just finished,
+                # so every cached block frees outright).
+                try:
+                    cache.clear()
+                except Exception as ce:
+                    logger.warning(f"serving: prefix-cache clear failed: {ce}")
             return True
         for uid, tok in results.items():
             req = self._active.get(uid)
@@ -470,6 +502,9 @@ class ServingDriver:
                 with self._cond:
                     self._admit_locked()  # finished requests freed blocks
                     self.metrics.update_kv(self._free_blocks(), self._kv_total)
+                    cache = self._prefix_cache()
+                    if cache is not None:
+                        self.metrics.update_prefix_cache(cache.stats())
                     self.metrics.set_gauge("active_requests", len(self._active))
                     if not self._active and not self._queue:
                         self._idle.set()

@@ -95,6 +95,12 @@ class ServingMetrics:
             "decode_tokens_total": 0,
             "engine_steps_total": 0,
             "admission_blocked_total": 0,
+            # prefix cache (mirrors of PrefixCache's monotone counters)
+            "prefix_queries_total": 0,
+            "prefix_hits_total": 0,
+            "prefix_hit_tokens_total": 0,
+            "prefix_inserted_blocks_total": 0,
+            "prefix_evictions_total": 0,
         }
         self.gauges: Dict[str, float] = {
             "queue_depth": 0,
@@ -102,6 +108,9 @@ class ServingMetrics:
             "kv_free_blocks": 0,
             "kv_total_blocks": 0,
             "kv_occupancy": 0.0,
+            "prefix_cached_blocks": 0,
+            "prefix_cached_blocks_idle": 0,
+            "prefix_hit_rate": 0.0,
         }
 
     # -- writers ---------------------------------------------------------
@@ -129,6 +138,20 @@ class ServingMetrics:
             self.gauges["kv_total_blocks"] = total_blocks
             if total_blocks:
                 self.gauges["kv_occupancy"] = 1.0 - free_blocks / total_blocks
+
+    def update_prefix_cache(self, stats: Dict[str, float]) -> None:
+        """Mirror a ``PrefixCache.stats()`` snapshot. The source counters
+        are monotone, so assigning (not incrementing) keeps Prometheus
+        counter semantics."""
+        with self._lock:
+            self.counters["prefix_queries_total"] = stats["queries"]
+            self.counters["prefix_hits_total"] = stats["hits"]
+            self.counters["prefix_hit_tokens_total"] = stats["hit_tokens"]
+            self.counters["prefix_inserted_blocks_total"] = stats["inserted_blocks"]
+            self.counters["prefix_evictions_total"] = stats["evictions"]
+            self.gauges["prefix_cached_blocks"] = stats["cached_blocks"]
+            self.gauges["prefix_cached_blocks_idle"] = stats["cached_blocks_idle"]
+            self.gauges["prefix_hit_rate"] = stats["hit_rate"]
 
     # -- readers ---------------------------------------------------------
     def snapshot(self) -> Dict[str, float]:

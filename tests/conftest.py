@@ -59,7 +59,7 @@ _SMOKE_FILES = {
     "test_zenflow.py", "test_zero_init.py", "test_weight_stream.py",
     "test_misc_runtime.py", "test_user_models.py", "test_inference_quant.py",
     "test_compressed.py", "test_zero_one_lamb.py", "test_elastic_agent.py",
-    "test_overlap.py", "test_serving.py",
+    "test_overlap.py", "test_serving.py", "test_prefix_cache.py",
     "test_flash_attention.py", "test_paged_attention.py", "test_kernels.py",
     "test_qmatmul.py", "test_moe_gemm.py", "test_native_ops.py",
     "test_sparse_attention.py", "test_transformer_layer.py",

@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Pre-commit gate: the fast one-test-per-subsystem smoke tier plus the full
+# prefix-cache suite (allocator refcount invariants, trie properties, pool
+# conservation under serve/cancel/timeout, cache-on/off output parity).
+#
+#   tools/run_smoke.sh            # ~4-5 min serial on CPU
+#
+# The full tier-1 gate (python -m pytest tests/ -q -m 'not slow') is the
+# merge bar; this script is the quick local check to run before every
+# commit. Exits nonzero on any failure.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+echo "== smoke tier (one test per subsystem) =="
+python -m pytest tests/ -q -m smoke -p no:cacheprovider
+
+echo "== prefix-cache suite =="
+python -m pytest tests/unit/test_prefix_cache.py -q -p no:cacheprovider
+
+echo "run_smoke: all gates passed"
