@@ -50,8 +50,10 @@ def initialize(
     ``mesh`` section sizes the parallelism grid.
     """
     log_dist(f"DeepSpeedTPU info: version={__version__}", ranks=[0])
-    assert model is not None, "deepspeed_tpu.initialize: model (loss function) is required"
-    assert model_parameters is not None, "deepspeed_tpu.initialize: model_parameters (params pytree) is required"
+    if model is None:
+        raise ValueError("deepspeed_tpu.initialize: model (loss function) is required")
+    if model_parameters is None:
+        raise ValueError("deepspeed_tpu.initialize: model_parameters (params pytree) is required")
 
     config = config if config is not None else config_params
     if config is None and args is not None and hasattr(args, "deepspeed_config") and args.deepspeed_config:

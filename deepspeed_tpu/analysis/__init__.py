@@ -1,0 +1,18 @@
+"""dstpu static-analysis subsystem.
+
+Tier A (``framework`` + ``rules``): pure-AST lint rules, no jax import —
+see ``dstpu lint`` / ``python -m deepspeed_tpu.analysis.cli``.
+Tier B (``verify``): compile-time donation-alias and recompile verification
+of the repo's jitted entry points (imports jax, runs on CPU).
+"""
+
+from deepspeed_tpu.analysis.framework import (  # noqa: F401
+    DEFAULT_HOT_PREFIXES,
+    Finding,
+    REGISTRY,
+    Rule,
+    register,
+    render_json,
+    render_text,
+    run_lint,
+)

@@ -1,0 +1,106 @@
+"""``dstpu lint`` — CLI for the two-tier static-analysis suite.
+
+    dstpu lint deepspeed_tpu/                 # Tier A rules, human output
+    dstpu lint deepspeed_tpu/ --format json   # machine-readable
+    dstpu lint --verify                       # Tier B compile-time verifier
+    dstpu lint deepspeed_tpu/ --verify --fail-on error   # the CI gate
+
+Exit code: 1 when any Tier-A finding is at or above ``--fail-on``
+(default: error), or any Tier-B check fails; 0 otherwise.
+Also runnable as ``python -m deepspeed_tpu.analysis.cli``.
+"""
+
+import argparse
+import os
+import sys
+
+
+def _default_lint_root() -> str:
+    # the package tree itself: lint what ships
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dstpu lint",
+        description="JAX-aware static analysis (Tier A: AST rules; "
+                    "Tier B: compile-time donation/recompile verifier)")
+    parser.add_argument("paths", nargs="*",
+                        help="files/directories to lint (default: the "
+                             "deepspeed_tpu package, unless --verify alone)")
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--fail-on", choices=("error", "warning", "never"),
+                        default="error",
+                        help="minimum severity that makes the exit code "
+                             "nonzero (default: error)")
+    parser.add_argument("--select", action="append", default=None,
+                        metavar="RULE", help="run only the named rule(s)")
+    parser.add_argument("--ignore", action="append", default=None,
+                        metavar="RULE", help="skip the named rule(s)")
+    parser.add_argument("--hot-prefix", action="append", default=None,
+                        metavar="FRAG",
+                        help="path fragment marking a hot module for the "
+                             "host-sync rule (default: serving/, "
+                             "inference/v2/, runtime/zero/)")
+    parser.add_argument("--verify", action="store_true",
+                        help="run the Tier-B compile-time verifier "
+                             "(lowers jitted entry points on CPU)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    args = parser.parse_args(argv)
+
+    from deepspeed_tpu.analysis import framework
+
+    if args.list_rules:
+        for rule in framework.resolve_rules():
+            print(f"{rule.name:28s} [{rule.severity}] {rule.description}")
+        return 0
+
+    paths = args.paths
+    if not paths and not args.verify:
+        paths = [_default_lint_root()]
+
+    findings = []
+    if paths:
+        try:
+            findings = framework.run_lint(
+                paths,
+                select=args.select,
+                ignore=args.ignore,
+                hot_prefixes=tuple(args.hot_prefix) if args.hot_prefix
+                else framework.DEFAULT_HOT_PREFIXES,
+            )
+        except KeyError as e:
+            print(f"dstpu lint: {e.args[0]}", file=sys.stderr)
+            return 2
+
+    verify_results, verify_ok = [], True
+    if args.verify:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        from deepspeed_tpu.analysis.verify import run_verify
+
+        verify_results, verify_ok = run_verify(verbose=(args.format == "text"))
+
+    if args.format == "json":
+        print(framework.render_json(
+            findings,
+            verify=[r.to_dict() for r in verify_results] if args.verify else None))
+    elif paths:
+        print(framework.render_text(findings))
+
+    rc = 0
+    if args.fail_on != "never":
+        threshold = framework.SEVERITIES.index(args.fail_on)
+        if any(framework.SEVERITIES.index(f.severity) >= threshold for f in findings):
+            rc = 1
+    if not verify_ok:
+        rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(lint_main())

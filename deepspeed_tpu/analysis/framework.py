@@ -1,0 +1,250 @@
+"""Tier-A rule framework for ``dstpu lint``.
+
+Pure-AST static analysis: no jax import, no code execution, so the linter
+runs in any environment (pre-commit hooks, CI containers without
+accelerators) in well under a second for the whole package.
+
+Concepts
+--------
+* ``Rule`` — a named check with a default severity. ``check(ctx)`` yields
+  ``Finding``s for one parsed file.
+* ``REGISTRY`` — rules register themselves at import time (see
+  ``analysis.rules``); ``run_lint`` runs every registered rule unless a
+  ``select`` subset is given.
+* suppression — ``# dstpu: noqa`` silences every rule on that line,
+  ``# dstpu: noqa[rule-a,rule-b]`` silences the named rules only. The
+  comment goes on the *first* line of the flagged statement.
+* hot modules — some rules (host-sync) only apply to latency-critical
+  subtrees; ``LintContext.hot_module`` is computed from ``hot_prefixes``
+  path fragments (default: serving/, inference/v2/, runtime/zero/).
+"""
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence
+
+SEVERITIES = ("info", "warning", "error")
+
+#: path fragments marking latency-critical subtrees (host-sync rule scope)
+DEFAULT_HOT_PREFIXES = ("serving/", "inference/v2/", "runtime/zero/")
+
+_NOQA_RE = re.compile(r"#\s*dstpu:\s*noqa(?:\[([^\]]*)\])?", re.IGNORECASE)
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    severity: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "severity": self.severity,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+        }
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.severity}] {self.rule}: {self.message}"
+
+
+class Rule:
+    """Base class for lint rules. Subclasses set ``name``, ``severity``,
+    ``description`` and implement ``check``."""
+
+    name: str = ""
+    severity: str = "error"
+    description: str = ""
+
+    def check(self, ctx: "LintContext") -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+REGISTRY: Dict[str, Rule] = {}
+
+
+def register(rule_cls):
+    """Class decorator: instantiate and add to the registry."""
+    rule = rule_cls()
+    if not rule.name:
+        raise ValueError(f"rule {rule_cls.__name__} has no name")
+    if rule.severity not in SEVERITIES:
+        raise ValueError(f"rule {rule.name}: bad severity {rule.severity!r}")
+    if rule.name in REGISTRY:
+        raise ValueError(f"duplicate rule name {rule.name!r}")
+    REGISTRY[rule.name] = rule
+    return rule_cls
+
+
+class LintContext:
+    """Everything a rule needs to analyze one file."""
+
+    def __init__(self, path: str, text: str, tree: ast.AST,
+                 hot_prefixes: Sequence[str] = DEFAULT_HOT_PREFIXES):
+        self.path = path
+        self.text = text
+        self.tree = tree
+        norm = path.replace(os.sep, "/")
+        self.hot_module = any(frag in norm for frag in hot_prefixes)
+        self._noqa = _collect_noqa(text)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        rules = self._noqa.get(line)
+        return rules is not None and ("*" in rules or rule in rules)
+
+    def finding(self, rule: "Rule", node, message: str,
+                severity: Optional[str] = None) -> Optional[Finding]:
+        """Build a Finding for an AST node (or int line), honoring noqa.
+        Returns None when suppressed."""
+        line = node if isinstance(node, int) else getattr(node, "lineno", 0)
+        col = 0 if isinstance(node, int) else getattr(node, "col_offset", 0)
+        if self.suppressed(rule.name, line):
+            return None
+        return Finding(
+            rule=rule.name,
+            severity=severity or rule.severity,
+            path=self.path,
+            line=line,
+            col=col,
+            message=message,
+        )
+
+
+def _collect_noqa(text: str) -> Dict[int, set]:
+    """Map line number -> suppressed rule names ({'*'} = all). Uses the
+    tokenizer so noqa markers inside string literals don't count."""
+    out: Dict[int, set] = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(text).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _NOQA_RE.search(tok.string)
+            if not m:
+                continue
+            names = m.group(1)
+            rules = (
+                {r.strip() for r in names.split(",") if r.strip()}
+                if names is not None
+                else {"*"}
+            )
+            out.setdefault(tok.start[0], set()).update(rules)
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        # unparseable files surface as parse-error findings elsewhere
+        pass
+    return out
+
+
+# ---------------------------------------------------------------------------
+# running
+# ---------------------------------------------------------------------------
+def iter_py_files(paths: Sequence[str]) -> List[str]:
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d not in ("__pycache__", ".git", "build", ".eggs")
+                )
+                files.extend(
+                    os.path.join(root, n) for n in sorted(names) if n.endswith(".py")
+                )
+        elif p.endswith(".py"):
+            files.append(p)
+    return files
+
+
+def resolve_rules(select: Optional[Sequence[str]] = None,
+                  ignore: Optional[Sequence[str]] = None) -> List[Rule]:
+    # rule modules self-register on first import
+    from deepspeed_tpu.analysis import rules as _rules  # noqa: F401
+
+    names = list(select) if select else sorted(REGISTRY)
+    unknown = [n for n in names if n not in REGISTRY]
+    if unknown:
+        raise KeyError(f"unknown rule(s): {', '.join(unknown)}; known: {', '.join(sorted(REGISTRY))}")
+    if ignore:
+        names = [n for n in names if n not in set(ignore)]
+    return [REGISTRY[n] for n in names]
+
+
+def lint_file(path: str, rules: Sequence[Rule],
+              hot_prefixes: Sequence[str] = DEFAULT_HOT_PREFIXES) -> List[Finding]:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            text = f.read()
+    except OSError as e:
+        return [Finding("parse-error", "error", path, 0, 0, f"cannot read: {e}")]
+    try:
+        tree = ast.parse(text, filename=path)
+    except SyntaxError as e:
+        return [Finding("parse-error", "error", path, e.lineno or 0, e.offset or 0,
+                        f"syntax error: {e.msg}")]
+    ctx = LintContext(path, text, tree, hot_prefixes=hot_prefixes)
+    findings: List[Finding] = []
+    for rule in rules:
+        findings.extend(f for f in rule.check(ctx) if f is not None)
+    return findings
+
+
+def run_lint(paths: Sequence[str],
+             select: Optional[Sequence[str]] = None,
+             ignore: Optional[Sequence[str]] = None,
+             hot_prefixes: Sequence[str] = DEFAULT_HOT_PREFIXES) -> List[Finding]:
+    rules = resolve_rules(select, ignore)
+    findings: List[Finding] = []
+    for path in iter_py_files(paths):
+        findings.extend(lint_file(path, rules, hot_prefixes=hot_prefixes))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# output
+# ---------------------------------------------------------------------------
+def severity_counts(findings: Sequence[Finding]) -> Dict[str, int]:
+    counts = {s: 0 for s in SEVERITIES}
+    for f in findings:
+        counts[f.severity] = counts.get(f.severity, 0) + 1
+    return counts
+
+
+def render_text(findings: Sequence[Finding]) -> str:
+    lines = [f.render() for f in findings]
+    counts = severity_counts(findings)
+    lines.append(
+        f"dstpu lint: {len(findings)} finding(s) "
+        f"({counts['error']} error, {counts['warning']} warning, {counts['info']} info)"
+    )
+    return "\n".join(lines)
+
+
+def render_json(findings: Sequence[Finding], verify: Optional[list] = None) -> str:
+    doc = {
+        "version": 1,
+        "findings": [f.to_dict() for f in findings],
+        "counts": severity_counts(findings),
+    }
+    if verify is not None:
+        doc["verify"] = verify
+    return json.dumps(doc, indent=2, sort_keys=True)
+
+
+def max_severity(findings: Sequence[Finding]) -> Optional[str]:
+    worst = None
+    for f in findings:
+        if worst is None or SEVERITIES.index(f.severity) > SEVERITIES.index(worst):
+            worst = f.severity
+    return worst

@@ -1,0 +1,13 @@
+"""Rule catalog: importing this package registers every rule.
+
+Adding a rule: create a module here with a ``@register``-decorated
+``Rule`` subclass and import it below (docs/ANALYSIS.md walks through it).
+"""
+
+from deepspeed_tpu.analysis.rules import (  # noqa: F401
+    asserts,
+    concurrency,
+    donation,
+    host_sync,
+    jit_purity,
+)

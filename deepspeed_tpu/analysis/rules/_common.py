@@ -1,0 +1,131 @@
+"""Shared AST helpers for the rule modules: jax.jit call detection,
+scope-aware function resolution, and jitted-function discovery."""
+
+import ast
+from typing import Dict, List, Optional, Tuple, Union
+
+FuncNode = Union[ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda]
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'jax.jit' for Attribute(Name('jax'), 'jit'); None for anything
+    that is not a plain dotted name."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def is_jax_jit(node: ast.AST) -> bool:
+    """Matches ``jax.jit`` and bare ``jit`` (from jax import jit)."""
+    name = dotted_name(node)
+    return name in ("jax.jit", "jit")
+
+
+def jit_call_kwargs(call: ast.Call) -> Dict[str, ast.expr]:
+    return {kw.arg: kw.value for kw in call.keywords if kw.arg is not None}
+
+
+def partial_jit_kwargs(call: ast.Call) -> Optional[Dict[str, ast.expr]]:
+    """``partial(jax.jit, donate_argnums=...)`` / ``functools.partial(...)``
+    -> its keyword dict; None when not a jit partial."""
+    name = dotted_name(call.func)
+    if name not in ("partial", "functools.partial"):
+        return None
+    if call.args and is_jax_jit(call.args[0]):
+        return jit_call_kwargs(call)
+    return None
+
+
+def const_argnums(node: Optional[ast.expr]) -> Optional[List[int]]:
+    """Literal donate_argnums/static_argnums value -> list of ints; None
+    when absent or not statically resolvable."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+            else:
+                return None
+        return out
+    return None
+
+
+def positional_arity(fn: FuncNode) -> Tuple[int, bool]:
+    """(number of positional parameters, has *args)."""
+    a = fn.args
+    return len(a.posonlyargs) + len(a.args), a.vararg is not None
+
+
+def func_label(fn: FuncNode) -> str:
+    return getattr(fn, "name", "<lambda>")
+
+
+class ScopeResolver(ast.NodeVisitor):
+    """Source-order walk that keeps a stack of lexical scopes mapping
+    names to their FunctionDef/Lambda, so ``jax.jit(step, ...)`` after
+    ``def step(...)`` resolves. Subclasses override ``handle_call`` /
+    ``handle_functiondef``."""
+
+    def __init__(self):
+        self._scopes: List[Dict[str, FuncNode]] = [{}]
+
+    # -- scope machinery ------------------------------------------------
+    def lookup(self, name: str) -> Optional[FuncNode]:
+        for scope in reversed(self._scopes):
+            if name in scope:
+                return scope[name]
+        return None
+
+    def _visit_scope(self, node):
+        self._scopes.append({})
+        self.generic_visit(node)
+        self._scopes.pop()
+
+    def visit_FunctionDef(self, node):
+        self._scopes[-1][node.name] = node
+        self.handle_functiondef(node)
+        self._visit_scope(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        self._visit_scope(node)
+
+    def visit_Assign(self, node):
+        # fn = lambda ...: — name the lambda so jit(fn) resolves
+        if isinstance(node.value, ast.Lambda) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            self._scopes[-1][node.targets[0].id] = node.value
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        self.handle_call(node)
+        self.generic_visit(node)
+
+    # -- hooks ----------------------------------------------------------
+    def handle_call(self, node: ast.Call):
+        pass
+
+    def handle_functiondef(self, node):
+        pass
+
+    def resolve_jit_target(self, call: ast.Call) -> Optional[FuncNode]:
+        """First positional arg of a jax.jit call -> the function node it
+        names (same-module lexical lookup), or the inline lambda itself."""
+        if not call.args:
+            return None
+        target = call.args[0]
+        if isinstance(target, ast.Lambda):
+            return target
+        if isinstance(target, ast.Name):
+            return self.lookup(target.id)
+        return None

@@ -1,0 +1,32 @@
+"""Rule ``bare-assert``: ``assert`` as a runtime invariant guard in library
+code.
+
+``python -O`` strips every assert, so an invariant guarded by one simply
+stops being checked in optimized deployments — the guard must be an
+explicit ``raise ValueError/RuntimeError``. Test code is exempt by
+convention (pytest assertions are the idiom there); this rule is meant to
+run over the package tree only.
+"""
+
+import ast
+
+from deepspeed_tpu.analysis.framework import Rule, register
+
+
+@register
+class BareAssertRule(Rule):
+    name = "bare-assert"
+    severity = "error"
+    description = (
+        "assert used as a runtime invariant guard vanishes under python -O; "
+        "raise ValueError/RuntimeError instead"
+    )
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assert):
+                yield ctx.finding(
+                    self, node,
+                    "bare assert guards a runtime invariant but vanishes under "
+                    "python -O; raise ValueError/RuntimeError (or suppress with "
+                    "# dstpu: noqa[bare-assert] for debug-only checks)")

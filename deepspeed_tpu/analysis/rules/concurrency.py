@@ -1,0 +1,173 @@
+"""Concurrency rules for driver-style threaded code.
+
+``cond-wait-no-predicate``: ``Condition.wait()`` outside a ``while`` loop.
+Condition variables wake spuriously and on every ``notify_all``; a wait
+that is not re-checked in a predicate loop acts on stale state. (The
+serving driver's ``self._cond.wait(timeout)`` inside its ``while True``
+re-check loop is the canonical correct shape.)
+
+``unlocked-shared-mutation``: an attribute that is written under
+``with self._lock:`` somewhere in a class is shared state; writing it from
+another method WITHOUT the lock is a race. Methods named ``*_locked`` are
+exempt by convention (they document being called with the lock held), as
+is ``__init__`` (no concurrent access before construction completes).
+"""
+
+import ast
+import re
+
+from deepspeed_tpu.analysis.framework import Rule, register
+from deepspeed_tpu.analysis.rules._common import dotted_name
+
+_COND_NAME = re.compile(r"(cond|condition|cv)$", re.IGNORECASE)
+_LOCK_FACTORIES = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "Lock", "RLock", "Condition",
+}
+
+
+def _receiver_name(func: ast.AST):
+    """'x' for x.wait, '_cond' for self._cond.wait."""
+    if isinstance(func, ast.Attribute) and isinstance(func.value, (ast.Attribute, ast.Name)):
+        v = func.value
+        return v.attr if isinstance(v, ast.Attribute) else v.id
+    return None
+
+
+@register
+class CondWaitNoPredicateRule(Rule):
+    name = "cond-wait-no-predicate"
+    severity = "warning"
+    description = (
+        "Condition.wait() must sit inside a while loop that re-checks its "
+        "predicate (spurious wakeups, notify_all broadcast)"
+    )
+
+    def check(self, ctx):
+        rule = self
+        findings = []
+
+        class V(ast.NodeVisitor):
+            def __init__(self):
+                self.while_depth = 0
+
+            def visit_While(self, node):
+                self.while_depth += 1
+                self.generic_visit(node)
+                self.while_depth -= 1
+
+            def visit_FunctionDef(self, node):
+                saved, self.while_depth = self.while_depth, 0
+                self.generic_visit(node)
+                self.while_depth = saved
+
+            visit_AsyncFunctionDef = visit_Lambda = visit_FunctionDef
+
+            def visit_Call(self, node):
+                func = node.func
+                if (isinstance(func, ast.Attribute) and func.attr in ("wait", "wait_for")):
+                    recv = _receiver_name(func)
+                    if recv and _COND_NAME.search(recv):
+                        # wait_for runs its own predicate loop internally
+                        if func.attr == "wait" and self.while_depth == 0:
+                            findings.append(ctx.finding(
+                                rule, node,
+                                f"{recv}.wait() outside a while predicate "
+                                f"loop acts on spurious/stale wakeups; wrap "
+                                f"in `while not <predicate>:` or use "
+                                f"wait_for()"))
+                self.generic_visit(node)
+
+        V().visit(ctx.tree)
+        return findings
+
+
+@register
+class UnlockedSharedMutationRule(Rule):
+    name = "unlocked-shared-mutation"
+    severity = "warning"
+    description = (
+        "attribute written under `with self.<lock>:` elsewhere in the class "
+        "is mutated here without the lock"
+    )
+
+    def check(self, ctx):
+        findings = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(self._check_class(ctx, node))
+        return findings
+
+    # -- per class ------------------------------------------------------
+    def _check_class(self, ctx, cls: ast.ClassDef):
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        lock_attrs = set()
+        for m in methods:
+            for node in ast.walk(m):
+                if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                    if dotted_name(node.value.func) in _LOCK_FACTORIES:
+                        for t in node.targets:
+                            if self._self_attr(t):
+                                lock_attrs.add(t.attr)
+        if not lock_attrs:
+            return []
+
+        writes = []  # (method, attr, node, under_lock)
+        for m in methods:
+            self._collect_writes(m, m.body, lock_attrs, under=False, out=writes)
+
+        guarded = {attr for (_m, attr, _n, locked) in writes if locked}
+        guarded -= lock_attrs
+        out = []
+        for m, attr, node, locked in writes:
+            if locked or attr not in guarded:
+                continue
+            if m.name == "__init__" or m.name.endswith("_locked"):
+                continue
+            out.append(ctx.finding(
+                self, node,
+                f"self.{attr} is written under the lock elsewhere in "
+                f"{cls.name} but mutated here without it; move this write "
+                f"under `with self.{sorted(lock_attrs)[0]}:` (or rename the "
+                f"method *_locked if the caller holds it)"))
+        return out
+
+    @staticmethod
+    def _self_attr(node):
+        return (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name) and node.value.id == "self")
+
+    def _collect_writes(self, method, body, lock_attrs, under, out):
+        for node in body:
+            locked_here = under
+            if isinstance(node, ast.With):
+                held = any(
+                    self._self_attr(item.context_expr) and item.context_expr.attr in lock_attrs
+                    for item in node.items
+                )
+                self._collect_writes(method, node.body, lock_attrs,
+                                     under or held, out)
+                continue
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if self._self_attr(t):
+                        out.append((method, t.attr, node, locked_here))
+            elif isinstance(node, ast.AugAssign) and self._self_attr(node.target):
+                out.append((method, node.target.attr, node, locked_here))
+            # recurse into compound statements, but not nested defs
+            for child_body in _sub_bodies(node):
+                self._collect_writes(method, child_body, lock_attrs, locked_here, out)
+
+
+def _sub_bodies(node):
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)):
+        return []
+    bodies = []
+    for field in ("body", "orelse", "finalbody"):
+        b = getattr(node, field, None)
+        if b:
+            bodies.append(b)
+    for h in getattr(node, "handlers", []) or []:
+        bodies.append(h.body)
+    return bodies

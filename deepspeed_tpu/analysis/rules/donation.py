@@ -1,0 +1,98 @@
+"""Rule ``donate-arity``: donate_argnums/static_argnums indices must match
+the wrapped function's positional signature.
+
+The motivating bug class: a signature gains a parameter and a hand-counted
+``donate_argnums`` tuple silently shifts — XLA then aliases the wrong
+buffer (or a scalar) and the cache it was supposed to donate is copied
+whole every step. Arity drift is fully decidable from the AST whenever the
+jitted function is defined in the same module (the repo's universal
+pattern: ``def step(...): ...; return jax.jit(step, donate_argnums=...)``).
+"""
+
+import ast
+
+from deepspeed_tpu.analysis.framework import Rule, register
+from deepspeed_tpu.analysis.rules._common import (
+    ScopeResolver,
+    const_argnums,
+    func_label,
+    is_jax_jit,
+    jit_call_kwargs,
+    partial_jit_kwargs,
+    positional_arity,
+)
+
+
+@register
+class DonateArityRule(Rule):
+    name = "donate-arity"
+    severity = "error"
+    description = (
+        "donate_argnums/static_argnums must be in-range, duplicate-free, "
+        "and non-overlapping for the function handed to jax.jit"
+    )
+
+    def check(self, ctx):
+        rule = self
+        findings = []
+
+        class V(ScopeResolver):
+            def handle_call(self, call):
+                if is_jax_jit(call.func):
+                    kwargs = jit_call_kwargs(call)
+                    fn = self.resolve_jit_target(call)
+                    findings.extend(_check_site(ctx, rule, call, kwargs, fn))
+
+            def handle_functiondef(self, node):
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call):
+                        kwargs = (
+                            jit_call_kwargs(dec) if is_jax_jit(dec.func)
+                            else partial_jit_kwargs(dec)
+                        )
+                        if kwargs is not None:
+                            findings.extend(_check_site(ctx, rule, dec, kwargs, node))
+
+        V().visit(ctx.tree)
+        return findings
+
+
+def _check_site(ctx, rule, site, kwargs, fn):
+    donate = const_argnums(kwargs.get("donate_argnums"))
+    static = const_argnums(kwargs.get("static_argnums"))
+    out = []
+
+    for label, nums in (("donate_argnums", donate), ("static_argnums", static)):
+        if nums is None:
+            continue
+        seen = set()
+        for i in nums:
+            if i in seen:
+                out.append(ctx.finding(rule, site, f"{label} lists index {i} twice"))
+            seen.add(i)
+            if i < 0:
+                out.append(ctx.finding(
+                    rule, site,
+                    f"{label} index {i} is negative — jax resolves argnums "
+                    f"positionally; use the explicit position"))
+    if donate is not None and static is not None:
+        overlap = sorted(set(donate) & set(static))
+        for i in overlap:
+            out.append(ctx.finding(
+                rule, site,
+                f"index {i} appears in both donate_argnums and static_argnums "
+                f"(jax rejects the intersection at trace time)"))
+
+    if fn is not None:
+        n_pos, has_vararg = positional_arity(fn)
+        for label, nums in (("donate_argnums", donate), ("static_argnums", static)):
+            if nums is None or has_vararg:
+                continue
+            for i in nums:
+                if i >= n_pos:
+                    out.append(ctx.finding(
+                        rule, site,
+                        f"{label} index {i} is out of range for "
+                        f"'{func_label(fn)}' which takes {n_pos} positional "
+                        f"argument(s)"))
+    return out

@@ -1,0 +1,83 @@
+"""Rule ``host-sync-in-loop``: device-sync calls inside Python loops in
+latency-critical modules.
+
+Each ``np.asarray``/``.item()``/``float()``/``block_until_ready``/
+``device_get`` on a device array blocks the host until the device catches
+up; inside a per-step or per-row loop those round-trips serialize the whole
+pipeline (the measured failure mode behind engine_v2's one-sync-per-phase
+prefill design). The rule fires only in hot modules (serving/,
+inference/v2/, runtime/zero/ by default) and only inside ``for``/``while``
+bodies — a deliberate, batched transfer point is annotated with
+``# dstpu: noqa[host-sync-in-loop]`` which doubles as documentation.
+"""
+
+import ast
+
+from deepspeed_tpu.analysis.framework import Rule, register
+from deepspeed_tpu.analysis.rules._common import dotted_name
+
+_SYNC_ATTRS = {"block_until_ready", "item"}
+_SYNC_CALLS = {
+    "jax.device_get", "device_get",
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+}
+
+
+@register
+class HostSyncInLoopRule(Rule):
+    name = "host-sync-in-loop"
+    severity = "warning"
+    description = (
+        "host-sync call (block_until_ready/device_get/np.asarray/.item()/"
+        "float()) inside a loop in a hot module stalls the device pipeline "
+        "once per iteration"
+    )
+
+    def check(self, ctx):
+        if not ctx.hot_module:
+            return []
+        rule = self
+        findings = []
+
+        class V(ast.NodeVisitor):
+            def __init__(self):
+                self.loop_depth = 0
+
+            def _loop(self, node):
+                self.loop_depth += 1
+                self.generic_visit(node)
+                self.loop_depth -= 1
+
+            visit_For = visit_AsyncFor = visit_While = _loop
+
+            def visit_FunctionDef(self, node):
+                # a def inside a loop body is not executed per-iteration
+                saved, self.loop_depth = self.loop_depth, 0
+                self.generic_visit(node)
+                self.loop_depth = saved
+
+            visit_AsyncFunctionDef = visit_Lambda = visit_FunctionDef
+
+            def visit_Call(self, node):
+                if self.loop_depth > 0:
+                    msg = _sync_message(node)
+                    if msg:
+                        findings.append(ctx.finding(rule, node, msg))
+                self.generic_visit(node)
+
+        V().visit(ctx.tree)
+        return findings
+
+
+def _sync_message(call: ast.Call):
+    if isinstance(call.func, ast.Attribute) and call.func.attr in _SYNC_ATTRS:
+        return (f".{call.func.attr}() forces a device sync every iteration; "
+                f"hoist it out of the loop or batch the transfer")
+    name = dotted_name(call.func)
+    if name in _SYNC_CALLS:
+        return (f"{name}() on a device value copies to host every iteration; "
+                f"hoist it out of the loop or batch the transfer")
+    if name == "float" and call.args and not isinstance(call.args[0], ast.Constant):
+        return ("float() on a device scalar forces a device sync every "
+                "iteration; hoist it out of the loop or batch the transfer")
+    return None

@@ -1,0 +1,84 @@
+"""Rule ``impure-jit``: Python side effects and impure RNG inside
+jit-decorated functions.
+
+``random.*`` / ``np.random.*`` / ``time.*`` run ONCE at trace time and bake
+a constant into the compiled program — every subsequent call replays the
+same "random" number or timestamp, the classic silent-staleness tracer
+hazard. ``print`` runs at trace time only (use ``jax.debug.print``).
+``jax.random`` is the sanctioned in-program RNG and is not flagged.
+"""
+
+import ast
+
+from deepspeed_tpu.analysis.framework import Rule, register
+from deepspeed_tpu.analysis.rules._common import (
+    ScopeResolver,
+    dotted_name,
+    is_jax_jit,
+    partial_jit_kwargs,
+)
+
+_TIME_MODULES = {"time", "_time"}
+_RNG_MODULES = {"random", "np.random", "numpy.random", "_random"}
+
+
+@register
+class ImpureJitRule(Rule):
+    name = "impure-jit"
+    severity = "error"
+    description = (
+        "impure call (random.*, np.random.*, time.*, print) inside a jitted "
+        "function executes at trace time only and bakes a constant into the "
+        "compiled program"
+    )
+
+    def check(self, ctx):
+        rule = self
+        jitted = []  # function nodes handed to jax.jit
+
+        class Collect(ScopeResolver):
+            def handle_call(self, call):
+                if is_jax_jit(call.func):
+                    fn = self.resolve_jit_target(call)
+                    if fn is not None:
+                        jitted.append(fn)
+
+            def handle_functiondef(self, node):
+                for dec in node.decorator_list:
+                    if is_jax_jit(dec):
+                        jitted.append(node)
+                    elif isinstance(dec, ast.Call) and (
+                            is_jax_jit(dec.func) or partial_jit_kwargs(dec) is not None):
+                        jitted.append(node)
+
+        Collect().visit(ctx.tree)
+
+        findings = []
+        seen_lines = set()
+        for fn in jitted:
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                msg = _impure_message(node)
+                if msg and node.lineno not in seen_lines:
+                    seen_lines.add(node.lineno)
+                    findings.append(ctx.finding(rule, node, msg))
+        return findings
+
+
+def _impure_message(call: ast.Call):
+    name = dotted_name(call.func)
+    if name is None:
+        return None
+    if name == "print":
+        return ("print() inside a jitted function runs at trace time only; "
+                "use jax.debug.print for runtime output")
+    mod = name.rsplit(".", 1)[0] if "." in name else None
+    if mod in _TIME_MODULES:
+        return (f"{name}() inside a jitted function is evaluated once at "
+                f"trace time and frozen into the program")
+    if mod in _RNG_MODULES or name in _RNG_MODULES:
+        return (f"{name}() inside a jitted function draws ONE value at trace "
+                f"time and replays it every call; thread a jax.random key "
+                f"instead")
+    return None

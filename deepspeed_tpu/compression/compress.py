@@ -38,7 +38,8 @@ def init_compression(
     lr_cfg = ccfg.get("layer_reduction", {}) or {}
     if lr_cfg.get("enabled"):
         keep = lr_cfg.get("teacher_layer", lr_cfg.get("keep_layers"))
-        assert keep, "layer_reduction requires 'teacher_layer' (kept layer indices)"
+        if not keep:
+            raise ValueError("layer_reduction requires 'teacher_layer' (kept layer indices)")
         params = reduce_layers(params, keep)
         log_dist(f"layer_reduction: kept layers {list(keep)}", ranks=[0])
 

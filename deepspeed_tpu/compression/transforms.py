@@ -123,7 +123,8 @@ def head_mask(w: jax.Array, num_heads: int, dense_ratio: float) -> jax.Array:
     (reference head_pruning on the attention output projection); stacked
     leading dims get independent per-layer masks."""
     in_dim, out_dim = w.shape[-2], w.shape[-1]
-    assert out_dim % num_heads == 0, f"out dim {out_dim} not divisible by heads {num_heads}"
+    if out_dim % num_heads != 0:
+        raise ValueError(f"out dim {out_dim} not divisible by heads {num_heads}")
     d = out_dim // num_heads
     lead = w.shape[:-2]
     per_head = jnp.linalg.norm(

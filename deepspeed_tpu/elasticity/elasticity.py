@@ -209,7 +209,8 @@ def compute_elastic_config(
         )
     if not return_microbatch:
         return batch, valid
-    assert dp_world > 0, "return_microbatch requires world_size"
+    if dp_world <= 0:
+        raise ValueError("return_microbatch requires world_size")
     micro = micro02 if micro02 is not None else micro_batch_for_world(
         batch, ecfg.micro_batch_sizes, dp_world, ecfg.prefer_larger_batch
     )

@@ -61,7 +61,8 @@ class InferenceEngine:
             self.model_config, params = model
         else:
             self.model_config = model
-        assert params is not None, "InferenceEngine needs model params"
+        if params is None:
+            raise ValueError("InferenceEngine needs model params")
         self._config = config
         tp = config.tensor_parallel.tp_size if config.tensor_parallel else 1
         self.topo = topology or (get_topology() if tp <= 1 else Topology(model=tp, data=0))

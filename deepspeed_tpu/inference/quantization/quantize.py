@@ -99,7 +99,8 @@ def quantize_inference_params(
 ) -> Any:
     """Matmul-weight leaves → :class:`QuantizedWeight`; everything else
     unchanged. Consumed transparently by the model family."""
-    assert bits in (8, 4), f"bits must be 4 or 8, got {bits}"
+    if bits not in (8, 4):
+        raise ValueError(f"bits must be 4 or 8, got {bits}")
 
     def visit(path, leaf):
         name = path_str(path)

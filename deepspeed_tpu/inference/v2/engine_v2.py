@@ -81,7 +81,8 @@ def _materialize_rows(res: dict, want_tokens: bool = False) -> dict:
             arr, idx = entry, None
         key = id(arr)
         if key not in hosts:
-            hosts[key] = np.asarray(arr)
+            # memoized by id(): each distinct device array transfers once
+            hosts[key] = np.asarray(arr)  # dstpu: noqa[host-sync-in-loop]
         out[uid] = hosts[key] if idx is None else hosts[key][idx]
     return out
 
@@ -921,7 +922,8 @@ class InferenceEngineV2:
             chk_pos[j, :n] = pos
             chk_start[j] = start
             chk_uids[j] = uid
-            blk[off : off + n] = np.asarray(seq.block_table, np.int32)[
+            # host-side scheduler metadata, not a device value
+            blk[off : off + n] = np.asarray(seq.block_table, np.int32)[  # dstpu: noqa[host-sync-in-loop]
                 np.minimum(pos // bs, nblk - 1)
             ]
             row[off : off + n] = pos % bs
@@ -1002,7 +1004,8 @@ class InferenceEngineV2:
             )
             seq.seen_tokens += t
             if not chunked:  # prompt complete (or decode token): logits usable
-                results[uid] = np.asarray(logits)
+                # deliberate materialization point: one transfer per finished row
+                results[uid] = np.asarray(logits)  # dstpu: noqa[host-sync-in-loop]
         return results
 
     # -- convenience generation loop (greedy) ---------------------------------

@@ -186,7 +186,8 @@ class RaggedScheduler:
             if not self._mgr.extend(seq, 1):
                 continue  # no memory: sequence waits this step
             uids.append(uid)
-            tokens.append(np.asarray([tok], np.int32))
+            # builds from a python int, no device transfer
+            tokens.append(np.asarray([tok], np.int32))  # dstpu: noqa[host-sync-in-loop]
             starts.append(seq.seen_tokens)
             chunked.append(False)
             decode.append(True)

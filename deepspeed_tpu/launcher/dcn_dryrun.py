@@ -97,7 +97,8 @@ def _child_env(n_local_devices: int, extra=None) -> dict:
 def run_two_process_dryrun(n_devices: int, log_prefix="dcn-dryrun", timeout_s=420.0):
     """Parent orchestrator — see module docstring. Raises on any phase
     failure or parity miss."""
-    assert n_devices % 2 == 0, "two-process leg needs an even device count"
+    if n_devices % 2 != 0:
+        raise ValueError("two-process leg needs an even device count")
     n_local = n_devices // 2
     with tempfile.TemporaryDirectory(prefix="dstpu_dcn_") as tmp:
         ckpt_dir = os.path.join(tmp, "ckpt")
@@ -193,16 +194,21 @@ def run_two_process_dryrun(n_devices: int, log_prefix="dcn-dryrun", timeout_s=42
     oracle = results["oracle"]["losses"]
     worker = results["worker"]["losses"]
     resumed = results["resume"]["losses"]
-    assert len(worker) == _STEPS and len(oracle) == _STEPS + 1 and len(resumed) == 1
+    if not (len(worker) == _STEPS and len(oracle) == _STEPS + 1 and len(resumed) == 1):
+        raise RuntimeError(
+            f"{log_prefix}: phase result counts off — worker {len(worker)}, "
+            f"oracle {len(oracle)}, resumed {len(resumed)}")
     for i, (w, o) in enumerate(zip(worker, oracle)):
-        assert abs(w - o) <= 1e-3 * max(abs(o), 1e-6), (
-            f"{log_prefix}: 2-process step {i} loss {w:.6f} != 1-process {o:.6f}"
-            " — cross-process collectives changed the math"
+        if abs(w - o) > 1e-3 * max(abs(o), 1e-6):
+            raise RuntimeError(
+                f"{log_prefix}: 2-process step {i} loss {w:.6f} != 1-process {o:.6f}"
+                " — cross-process collectives changed the math"
+            )
+    if abs(resumed[0] - oracle[_STEPS]) > 1e-3 * max(abs(oracle[_STEPS]), 1e-6):
+        raise RuntimeError(
+            f"{log_prefix}: resumed step loss {resumed[0]:.6f} != oracle "
+            f"{oracle[_STEPS]:.6f} — process-count reshape broke the state"
         )
-    assert abs(resumed[0] - oracle[_STEPS]) <= 1e-3 * max(abs(oracle[_STEPS]), 1e-6), (
-        f"{log_prefix}: resumed step loss {resumed[0]:.6f} != oracle "
-        f"{oracle[_STEPS]:.6f} — process-count reshape broke the state"
-    )
     print(
         f"{log_prefix} OK: 2proc x {n_local}dev zero3+tp{_TP} losses "
         f"{[round(x, 4) for x in worker]} == 1proc oracle; UCP resume @1proc "
@@ -305,8 +311,10 @@ def _role_worker(args):
     # the launcher (launch.py) exported DSTPU_COORDINATOR/DSTPU_PROCESS_ID/
     # DSTPU_NUM_PROCESSES; this is the production bootstrap path
     comm.init_distributed()
-    assert jax.process_count() == 2, jax.process_count()
-    assert len(jax.devices()) == args.n_devices, len(jax.devices())
+    if jax.process_count() != 2:
+        raise RuntimeError(f"expected 2 jax processes, got {jax.process_count()}")
+    if len(jax.devices()) != args.n_devices:
+        raise RuntimeError(f"expected {args.n_devices} devices, got {len(jax.devices())}")
     engine, cfg, tbs = _build(args.n_devices)
     losses = [
         float(engine.train_batch(batch=_batch(cfg, tbs, s))) for s in range(_STEPS)
@@ -320,7 +328,8 @@ def _role_resume(args):
     _setup_jax(args.n_devices)
     engine, cfg, tbs = _build(args.n_devices)
     loaded = engine.load_checkpoint(args.ckpt_dir, tag="dcn")
-    assert loaded is not None and loaded[0], "resume phase found no checkpoint"
+    if loaded is None or not loaded[0]:
+        raise RuntimeError("resume phase found no checkpoint")
     loss = float(engine.train_batch(batch=_batch(cfg, tbs, _STEPS)))
     _write(args.out_dir, "resume", {"losses": [loss]})
 

@@ -231,7 +231,9 @@ class TransformerConfig:
     def head_dim(self) -> int:
         if self.head_dim_override is not None:
             return self.head_dim_override
-        assert self.hidden_size % self.n_heads == 0
+        if self.hidden_size % self.n_heads != 0:
+            raise ValueError(f"hidden_size {self.hidden_size} not divisible by "
+                             f"n_heads {self.n_heads}")
         return self.hidden_size // self.n_heads
 
     @property

@@ -53,7 +53,8 @@ def _native_lib():
 
 
 def _fp(a):
-    assert a.dtype == np.float32 and a.flags["C_CONTIGUOUS"]
+    if a.dtype != np.float32 or not a.flags["C_CONTIGUOUS"]:
+        raise ValueError("cpu adam buffers must be C-contiguous float32 arrays")
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
 
 
@@ -89,12 +90,16 @@ class DeepSpeedCPUAdam:
         lr = self.lr if lr is None else float(lr)
         self.steps = int(step) if step is not None else self.steps + 1
         n = params.size
-        assert grads.size == n and exp_avg.size == n and exp_avg_sq.size == n
+        if not (grads.size == n and exp_avg.size == n and exp_avg_sq.size == n):
+            raise ValueError(
+                f"param size {n} != grads {grads.size} / exp_avg {exp_avg.size} "
+                f"/ exp_avg_sq {exp_avg_sq.size}")
         if self._lib is not None:
             rc = self._lib.dstpu_adam_update(
                 self._id, self.steps, lr, _fp(params), _fp(grads), _fp(exp_avg),
                 _fp(exp_avg_sq), n)
-            assert rc == 0, f"cpu adam_update failed rc={rc}"
+            if rc != 0:
+                raise RuntimeError(f"cpu adam_update failed rc={rc}")
             return self.steps
         # numpy fallback — bit-for-bit same math as the C++ loop
         b1, b2, eps, wd = self.beta1, self.beta2, self.eps, self.weight_decay
@@ -128,7 +133,8 @@ def cpu_adagrad_step(params, grads, exp_avg_sq, lr, eps=1e-8, weight_decay=0.0):
     if lib is not None:
         rc = lib.dstpu_adagrad_update(lr, eps, weight_decay, _fp(params),
                                       _fp(grads), _fp(exp_avg_sq), params.size)
-        assert rc == 0
+        if rc != 0:
+            raise RuntimeError(f"cpu adagrad_update failed rc={rc}")
         return
     g = grads + weight_decay * params if weight_decay else grads
     exp_avg_sq += np.square(g)
@@ -141,7 +147,8 @@ def cpu_lion_step(params, grads, exp_avg, lr, betas=(0.9, 0.99), weight_decay=0.
     if lib is not None:
         rc = lib.dstpu_lion_update(lr, betas[0], betas[1], weight_decay,
                                    _fp(params), _fp(grads), _fp(exp_avg), params.size)
-        assert rc == 0
+        if rc != 0:
+            raise RuntimeError(f"cpu lion_update failed rc={rc}")
         return
     c = betas[0] * exp_avg + (1.0 - betas[0]) * grads
     params -= lr * (np.sign(c) + weight_decay * params)

@@ -216,7 +216,8 @@ def fused_adam_transform(
         return FusedAdamState(m=z, v=jax.tree.map(jnp.copy, z), count=jnp.zeros((), jnp.int32))
 
     def update(grads, state, params=None, *, lr):
-        assert params is not None, "fused adam needs params"
+        if params is None:
+            raise ValueError("fused adam needs params")
         count = state.count + 1
         stepf = count.astype(jnp.float32)
 

@@ -56,9 +56,8 @@ def _native_lib():
 
 
 def _check(arr):
-    assert isinstance(arr, np.ndarray) and arr.flags["C_CONTIGUOUS"], (
-        "aio buffers must be C-contiguous numpy arrays"
-    )
+    if not (isinstance(arr, np.ndarray) and arr.flags["C_CONTIGUOUS"]):
+        raise ValueError("aio buffers must be C-contiguous numpy arrays")
     return arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes
 
 

@@ -172,7 +172,8 @@ def quantized_reduce_scatter_along(
     """
     W = jax.lax.axis_size(axis_name)
     D = x.shape[dim]
-    assert D % W == 0, f"dim {dim} of size {D} not divisible by axis {axis_name}={W}"
+    if D % W != 0:
+        raise ValueError(f"dim {dim} of size {D} not divisible by axis {axis_name}={W}")
     moved = jnp.moveaxis(x, dim, 0)
     rest_shape = moved.shape[1:]
     rows = moved.reshape(W, -1).astype(jnp.float32)  # [W, m] — row w goes to rank w
@@ -248,7 +249,8 @@ def loco_quantized_reduce_scatter_along(
     """
     W = jax.lax.axis_size(axis_name)
     D = x.shape[dim]
-    assert D % W == 0, f"dim {dim} of size {D} not divisible by axis {axis_name}={W}"
+    if D % W != 0:
+        raise ValueError(f"dim {dim} of size {D} not divisible by axis {axis_name}={W}")
     comp = x.astype(jnp.float32) + err.astype(jnp.float32)
     moved = jnp.moveaxis(comp, dim, 0)
     rest_shape = moved.shape[1:]

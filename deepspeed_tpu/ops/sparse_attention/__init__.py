@@ -52,7 +52,9 @@ class SparseSelfAttention:
 
     def __call__(self, query, key, value, rpe=None, key_padding_mask=None, attn_mask=None):
         b, h, s, d = query.shape
-        assert h == self.sparsity_config.num_heads, (h, self.sparsity_config.num_heads)
+        if h != self.sparsity_config.num_heads:
+            raise ValueError(f"query has {h} heads, sparsity config expects "
+                             f"{self.sparsity_config.num_heads}")
         h_kv = key.shape[1]
         if h_kv != h:
             rep = h // h_kv

@@ -176,8 +176,11 @@ def _sparse_core(q, k, v, layout, block, causal, scale, interpret):
 
 def _sparse_fwd(q, k, v, layout, block, causal, scale, interpret):
     b, h, s, d = q.shape
-    assert k.shape[1] == h, "sparse kernel expects matched head counts (expand GQA first)"
-    assert layout.shape == (h, s // block, s // block), layout.shape
+    if k.shape[1] != h:
+        raise ValueError("sparse kernel expects matched head counts (expand GQA first)")
+    if layout.shape != (h, s // block, s // block):
+        raise ValueError(f"layout shape {layout.shape} != expected "
+                         f"{(h, s // block, s // block)}")
     bq = bk = block
     scale_v = scale if scale is not None else d**-0.5
     kernel = functools.partial(_sparse_fwd_kernel, scale=scale_v, causal=causal, bq=bq, bk=bk)

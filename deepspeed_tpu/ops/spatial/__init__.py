@@ -24,7 +24,8 @@ def nhwc_group_norm(x: jax.Array, gamma: jax.Array, beta: jax.Array,
                     num_groups: int, eps: float = 1e-5) -> jax.Array:
     """GroupNorm over NHWC (channels last; diffusion UNet blocks)."""
     n, h, w, c = x.shape
-    assert c % num_groups == 0
+    if c % num_groups != 0:
+        raise ValueError(f"channels {c} not divisible by num_groups {num_groups}")
     g = x.astype(jnp.float32).reshape(n, h, w, num_groups, c // num_groups)
     mean = jnp.mean(g, axis=(1, 2, 4), keepdims=True)
     var = jnp.mean(jnp.square(g - mean), axis=(1, 2, 4), keepdims=True)

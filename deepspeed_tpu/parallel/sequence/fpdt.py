@@ -35,7 +35,8 @@ from deepspeed_tpu.parallel.sequence.ring import (
 
 def _chunk(x, n_chunks, axis):
     s = x.shape[axis]
-    assert s % n_chunks == 0, f"seq {s} not divisible by {n_chunks} chunks"
+    if s % n_chunks != 0:
+        raise ValueError(f"seq {s} not divisible by {n_chunks} chunks")
     moved = jnp.moveaxis(x, axis, 0)
     return moved.reshape((n_chunks, s // n_chunks) + moved.shape[1:])
 

@@ -158,7 +158,8 @@ def ring_attention(
         raise NotImplementedError("ring attention does not support segment_ids; use Ulysses")
     if window and not causal:
         raise ValueError("ring_attention: window > 0 requires causal=True")
-    assert q.shape[2] % sp == 0, f"seq {q.shape[2]} not divisible by sequence axis {sp}"
+    if q.shape[2] % sp != 0:
+        raise ValueError(f"seq {q.shape[2]} not divisible by sequence axis {sp}")
 
     # manual over `sequence` only: specs may not reference auto axes — the
     # batch dim stays under GSPMD (data/expert sharding preserved around the

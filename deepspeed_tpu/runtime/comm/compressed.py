@@ -36,7 +36,8 @@ def pack_signs(x: jax.Array) -> jax.Array:
     Bit=1 means non-negative. The packed array is what crosses the wire:
     1/8th the bytes of an int8 payload, 1/32nd of fp32.
     """
-    assert x.shape[-1] % 8 == 0, f"last dim {x.shape[-1]} not a multiple of 8"
+    if x.shape[-1] % 8 != 0:
+        raise ValueError(f"last dim {x.shape[-1]} not a multiple of 8")
     bits = (x >= 0).astype(jnp.uint8).reshape(x.shape[:-1] + (x.shape[-1] // 8, 8))
     weights = (jnp.uint8(1) << jnp.arange(8, dtype=jnp.uint8))
     return jnp.sum(bits * weights, axis=-1).astype(jnp.uint8)
@@ -93,7 +94,8 @@ def compressed_allreduce(
     W = jax.lax.axis_size(axis_name)
     n = x.shape[0]
     chunk = n // W
-    assert chunk * W == n and chunk % 8 == 0, f"bad padded length {n} for W={W}"
+    if chunk * W != n or chunk % 8 != 0:
+        raise ValueError(f"bad padded length {n} for W={W}")
 
     x = x.astype(jnp.float32)
     # ---- worker phase

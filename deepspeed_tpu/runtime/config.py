@@ -334,9 +334,12 @@ class DeepSpeedConfig(DSConfigModel):
         train_batch = self.train_batch_size
         micro_batch = self.train_micro_batch_size_per_gpu
         grad_acc = self.gradient_accumulation_steps
-        assert train_batch > 0, f"Train batch size: {train_batch} has to be greater than 0"
-        assert micro_batch > 0, f"Micro batch size per gpu: {micro_batch} has to be greater than 0"
-        assert grad_acc > 0, f"Gradient accumulation steps: {grad_acc} has to be greater than 0"
+        if train_batch <= 0:
+            raise ValueError(f"Train batch size: {train_batch} has to be greater than 0")
+        if micro_batch <= 0:
+            raise ValueError(f"Micro batch size per gpu: {micro_batch} has to be greater than 0")
+        if grad_acc <= 0:
+            raise ValueError(f"Gradient accumulation steps: {grad_acc} has to be greater than 0")
         if train_batch != micro_batch * grad_acc * dp_world_size:
             raise ConfigError(
                 f"Check batch related parameters. train_batch_size is not equal to "

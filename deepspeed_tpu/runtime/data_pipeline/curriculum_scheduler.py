@@ -23,7 +23,8 @@ CUSTOM = "custom"
 class CurriculumScheduler:
     def __init__(self, config: dict):
         for key in ("min_difficulty", "max_difficulty", "schedule_type"):
-            assert key in config, f"Curriculum learning requires the config '{key}'"
+            if key not in config:
+                raise ValueError(f"Curriculum learning requires the config '{key}'")
         self.state = {
             "min_difficulty": config["min_difficulty"],
             "max_difficulty": config["max_difficulty"],
@@ -34,14 +35,20 @@ class CurriculumScheduler:
         sched = config.get("schedule_config", {})
         stype = config["schedule_type"]
         if stype == FIXED_DISCRETE:
-            assert "difficulty" in sched and "max_step" in sched
-            assert len(sched["max_step"]) > 0
-            assert len(sched["difficulty"]) == len(sched["max_step"]) + 1
+            if "difficulty" not in sched or "max_step" not in sched:
+                raise ValueError(f"{stype} schedule_config needs 'difficulty' and 'max_step'")
+            if len(sched["max_step"]) == 0:
+                raise ValueError(f"{stype} schedule_config 'max_step' must be non-empty")
+            if len(sched["difficulty"]) != len(sched["max_step"]) + 1:
+                raise ValueError(
+                    f"{stype} schedule_config needs len(difficulty) == len(max_step) + 1, "
+                    f"got {len(sched['difficulty'])} and {len(sched['max_step'])}")
         elif stype in (FIXED_LINEAR, FIXED_ROOT):
-            assert "total_curriculum_step" in sched
-            assert "difficulty_step" in sched
-            if stype == FIXED_ROOT:
-                assert "root_degree" in sched
+            for key in ("total_curriculum_step", "difficulty_step"):
+                if key not in sched:
+                    raise ValueError(f"{stype} schedule_config needs '{key}'")
+            if stype == FIXED_ROOT and "root_degree" not in sched:
+                raise ValueError(f"{stype} schedule_config needs 'root_degree'")
         elif stype == CUSTOM:
             pass
         else:
@@ -91,9 +98,8 @@ class CurriculumScheduler:
             return self.__fixed_root_get_difficulty(global_steps, root_degree=1)
         if stype == FIXED_ROOT:
             return self.__fixed_root_get_difficulty(global_steps)
-        assert self.custom_get_difficulty is not None, (
-            "custom schedule requires set_custom_get_difficulty()"
-        )
+        if self.custom_get_difficulty is None:
+            raise RuntimeError("custom schedule requires set_custom_get_difficulty()")
         return self.custom_get_difficulty(global_steps)
 
     def update_difficulty(self, global_steps: int) -> int:

@@ -52,7 +52,9 @@ class CurriculumDataSampler:
         seed: int = 1234,
         drop_last: bool = True,
     ):
-        assert difficulty_type in ("value", "percentile")
+        if difficulty_type not in ("value", "percentile"):
+            raise ValueError(f"difficulty_type must be 'value' or 'percentile', "
+                             f"got {difficulty_type!r}")
         self.metric = np.asarray(metric_values)
         self.order = np.argsort(self.metric, kind="stable")  # easy → hard
         self.batch_size = batch_size
@@ -67,7 +69,8 @@ class CurriculumDataSampler:
         self._difficulty = difficulty
 
     def _admissible(self) -> np.ndarray:
-        assert self._difficulty is not None, "call set_difficulty() first"
+        if self._difficulty is None:
+            raise RuntimeError("call set_difficulty() first")
         if self.difficulty_type == "value":
             k = int(np.searchsorted(self.metric[self.order], self._difficulty, side="right"))
         else:

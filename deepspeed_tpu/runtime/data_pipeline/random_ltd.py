@@ -27,7 +27,11 @@ class RandomLTDScheduler:
     ``step_size`` (the recompile bucketer on TPU)."""
 
     def __init__(self, start: int, end: int, schedule_steps: int, step_size: int = 16):
-        assert start <= end and schedule_steps > 0 and step_size > 0
+        if start > end or schedule_steps <= 0 or step_size <= 0:
+            raise ValueError(
+                f"need start <= end and positive schedule_steps/step_size, got "
+                f"start={start} end={end} schedule_steps={schedule_steps} "
+                f"step_size={step_size}")
         self.start = start
         self.end = end
         self.schedule_steps = schedule_steps

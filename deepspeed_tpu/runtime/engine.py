@@ -848,8 +848,10 @@ class DeepSpeedEngine:
         ocfg = zcfg.offload_optimizer
         # capability checks already ran (with graceful fallback) in __init__;
         # these are defensive
-        assert self.optimizer.name in ("adam", "adamw"), self.optimizer.name
-        assert jax.process_count() == 1
+        if self.optimizer.name not in ("adam", "adamw"):
+            raise RuntimeError(f"superoffload requires adam/adamw, got {self.optimizer.name}")
+        if jax.process_count() != 1:
+            raise RuntimeError("superoffload is single-process only")
         d = self.optimizer.defaults
         kw = dict(
             lr=d.get("lr", 1e-3),
@@ -1755,7 +1757,8 @@ class DeepSpeedEngine:
     def train_batch(self, data_iter=None, batch=None):
         """Fused full step: gas micro-batches → grads → update. The hot path
         (reference PipelineEngine.train_batch :337 is the analogous fused API)."""
-        assert (data_iter is None) != (batch is None), "pass exactly one of data_iter/batch"
+        if (data_iter is None) == (batch is None):
+            raise ValueError("pass exactly one of data_iter/batch")
         stacked = self._stack_batch(data_iter if data_iter is not None else batch)
         stacked = self._apply_curriculum(stacked)
         if self._host_opt is not None:
@@ -1877,7 +1880,8 @@ class DeepSpeedEngine:
 
     def backward(self, loss=None, retain_graph=False, scale_wrt_gas=True):
         """Accumulate the cached grads (reference engine.backward :2436)."""
-        assert getattr(self, "_pending_grads", None) is not None, "call forward() before backward()"
+        if getattr(self, "_pending_grads", None) is None:
+            raise RuntimeError("call forward() before backward()")
         self.timers(BACKWARD_GLOBAL_TIMER).start()
         grads = self._pending_grads
         self._pending_grads = None
@@ -1901,7 +1905,8 @@ class DeepSpeedEngine:
         self.global_samples += self.config.train_micro_batch_size_per_gpu * self.topo.dp_world_size
         if not boundary:
             return
-        assert self._acc_grads is not None, "step() with no accumulated gradients"
+        if self._acc_grads is None:
+            raise RuntimeError("step() with no accumulated gradients")
         if self._host_opt is not None:
             raise NotImplementedError(
                 "the NVMe optimizer tier supports the fused train_batch() API "
@@ -2102,10 +2107,14 @@ class DeepSpeedEngine:
         order, so zip them back into the template's structure/shardings."""
         t_leaves, treedef = jax.tree_util.tree_flatten(template)
         l_leaves = jax.tree_util.tree_leaves(loaded)
-        assert len(t_leaves) == len(l_leaves), (len(t_leaves), len(l_leaves))
+        if len(t_leaves) != len(l_leaves):
+            raise ValueError(f"checkpoint leaf count {len(l_leaves)} != "
+                             f"template leaf count {len(t_leaves)}")
         out = []
         for t, l in zip(t_leaves, l_leaves):
-            assert tuple(t.shape) == tuple(l.shape), (t.shape, l.shape)
+            if tuple(t.shape) != tuple(l.shape):
+                raise ValueError(f"checkpoint leaf shape {tuple(l.shape)} != "
+                                 f"template shape {tuple(t.shape)}")
             out.append(jax.device_put(jnp.asarray(l, dtype=t.dtype), t.sharding))
         return jax.tree_util.tree_unflatten(treedef, out)
 

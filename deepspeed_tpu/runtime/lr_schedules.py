@@ -30,7 +30,8 @@ class _Schedule:
         raise NotImplementedError
 
     def get_last_lr(self):
-        assert getattr(self, "_last_lr", None) is not None, "need to call step() first"
+        if getattr(self, "_last_lr", None) is None:
+            raise RuntimeError("need to call step() first")
         return self._last_lr
 
     def step(self, last_batch_iteration=None):

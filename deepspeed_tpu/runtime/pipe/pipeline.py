@@ -125,7 +125,8 @@ def _stack_stages(layer_tree: Any, n_stages: int) -> Any:
 
     def reshape(l):
         L = l.shape[0]
-        assert L % n_stages == 0, f"layers {L} not divisible by stages {n_stages}"
+        if L % n_stages != 0:
+            raise ValueError(f"layers {L} not divisible by stages {n_stages}")
         return l.reshape((n_stages, L // n_stages) + l.shape[1:])
 
     return jax.tree.map(reshape, layer_tree)
@@ -167,7 +168,8 @@ def make_pipelined_loss_fn(config, micro_batches: int, topo: Topology = None):
     def loss_fn(params, batch):
         inputs, labels, mask, positions, segment_ids = T.split_lm_batch(batch)
         b, s = inputs.shape
-        assert b % micro_batches == 0, f"batch {b} not divisible by micro_batches {micro_batches}"
+        if b % micro_batches != 0:
+            raise ValueError(f"batch {b} not divisible by micro_batches {micro_batches}")
         if positions is None:
             positions = jnp.arange(s, dtype=jnp.int32)
 
@@ -304,7 +306,8 @@ class Pipelined1F1BLoss:
 
         inputs, labels, mask, positions, segment_ids = T.split_lm_batch(batch)
         b, s = inputs.shape
-        assert b % n_micro == 0, f"batch {b} not divisible by micro_batches {n_micro}"
+        if b % n_micro != 0:
+            raise ValueError(f"batch {b} not divisible by micro_batches {n_micro}")
         mb = b // n_micro
         if positions is None:
             positions = jnp.arange(s, dtype=jnp.int32)

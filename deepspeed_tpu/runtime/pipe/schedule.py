@@ -82,7 +82,8 @@ class PipeSchedule:
     """Schedule over micro_batches for one (stage_id of stages) rank."""
 
     def __init__(self, micro_batches: int, stages: int, stage_id: int):
-        assert 0 <= stage_id < stages
+        if not 0 <= stage_id < stages:
+            raise ValueError(f"stage_id {stage_id} out of range for {stages} stages")
         self.micro_batches = micro_batches
         self.stages = stages
         self.stage_id = stage_id

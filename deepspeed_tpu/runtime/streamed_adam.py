@@ -156,11 +156,16 @@ def streamed_adamw_leaf(
             (_to_dev(x) if _is_host(x) else x) for x in (g, m, mu, nu)
         )
         m2, mu2, nu2 = _adamw_math(gm, mm, mum, num, lr, b1, b2, eps, wd, c1, c2)
-        p2 = m2.astype(p.dtype)
+        p_new = m2.astype(p.dtype)
         if host:
             m2, mu2, nu2 = _to_host(m2), _to_host(mu2), _to_host(nu2)
         if _is_host(p):
-            p2 = _to_host(p2)
+            p_new = _to_host(p_new)
+        # write through the donated param buffer: only p.dtype is consumed
+        # above, and a p absent from the jaxpr gets dropped by jit, voiding
+        # its donation — the step then re-allocates the param every call
+        # instead of overwriting it in place
+        p2 = jax.lax.dynamic_update_slice(p, p_new, (0,) * p.ndim)
         return m2, mu2, nu2, p2
 
     dim0 = shape[0]
@@ -246,7 +251,7 @@ def streamed_adamw_leaf_q8(
         mu_f = deq(mu, _dq8_mu)
         nu_f = deq(nu, _dq8_nu)
         m2, mu2, nu2 = _adamw_math(gm, mm, mu_f, nu_f, lr, b1, b2, eps, wd, c1, c2)
-        p2 = m2.astype(p.dtype)
+        p_new = m2.astype(p.dtype)
         mu_q, mu_s = _q8_mu(mu2)
         nu_q, nu_s = _q8_nu(nu2)
         if host:
@@ -258,7 +263,9 @@ def streamed_adamw_leaf_q8(
         # their masters are host-offloaded (placement drift here recompiles
         # the grads program against new input shardings every step)
         if _is_host(p):
-            p2 = _to_host(p2)
+            p_new = _to_host(p_new)
+        # write through the donated param buffer (see streamed_adamw_leaf)
+        p2 = jax.lax.dynamic_update_slice(p, p_new, (0,) * p.ndim)
         return m2, {"q": mu_q, "s": mu_s}, {"q": nu_q, "s": nu_s}, p2
 
     dim0 = shape[0]

@@ -79,7 +79,9 @@ class SuperOffloadHostOptimizer:
                 nxt = self._pool.submit(pull, named_grads[i + 1][1])
             shape, out_dtype = self.leaves[name]
             master = self._state[f"{name}.master"]
-            assert g.size == master.size, f"grad size mismatch on {name}"
+            if g.size != master.size:
+                raise ValueError(f"grad size mismatch on {name}: "
+                                 f"{g.size} != {master.size}")
             self.cpu_adam.step(
                 master, g,
                 self._state[f"{name}.exp_avg"],

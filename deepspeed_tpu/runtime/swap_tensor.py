@@ -194,7 +194,8 @@ class NVMeOptimizerSwapper:
                 self._read_state(nxt, int(np.prod(self.leaves[nxt][0])),
                                  readsets[(i + 1) % 2], asynchronous=True)
             g = np.ascontiguousarray(np.asarray(grad, dtype=np.float32).reshape(-1))
-            assert g.size == n, f"grad size {g.size} != leaf {name} size {n}"
+            if g.size != n:
+                raise ValueError(f"grad size {g.size} != leaf {name} size {n}")
             master = cur["master"][:n]
             m = cur["exp_avg"][:n]
             v = cur["exp_avg_sq"][:n]

@@ -267,7 +267,8 @@ def bucketed_all_gather(
         flats.append(moved.reshape(1, -1))
         metas.append((moved.shape, moved.size))
     widths = {f.dtype for f in flats}
-    assert len(widths) == 1, "bucket leaves must share a dtype"
+    if len(widths) != 1:
+        raise ValueError("bucket leaves must share a dtype")
     gathered = jax.lax.all_gather(
         jnp.concatenate(flats, axis=1), axis_name, axis=0, tiled=True
     )  # [W, sum_m]

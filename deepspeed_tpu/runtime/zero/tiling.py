@@ -24,7 +24,10 @@ def init_tiled_linear(
     dtype=jnp.float32,
     weight: Optional[jax.Array] = None,
 ) -> Dict[str, Any]:
-    assert in_features % in_splits == 0 and out_features % out_splits == 0
+    if in_features % in_splits != 0 or out_features % out_splits != 0:
+        raise ValueError(
+            f"tiled linear needs divisible splits: in {in_features}/{in_splits}, "
+            f"out {out_features}/{out_splits}")
     ti, to = in_features // in_splits, out_features // out_splits
     if weight is None:
         weight = jax.random.normal(key, (in_features, out_features), jnp.float32) * (

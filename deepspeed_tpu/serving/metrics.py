@@ -67,7 +67,8 @@ class Histogram:
         cum = 0
         for i, b in enumerate(self.buckets):
             cum += self.counts[i]
-            out.append((f"{name}_bucket", {"le": repr(float(b))}, cum, "histogram"))
+            # bucket bounds are python floats, no device sync
+            out.append((f"{name}_bucket", {"le": repr(float(b))}, cum, "histogram"))  # dstpu: noqa[host-sync-in-loop]
         out.append((f"{name}_bucket", {"le": "+Inf"}, self.count, "histogram"))
         out.append((f"{name}_sum", None, self.total, None))
         out.append((f"{name}_count", None, self.count, None))

@@ -44,13 +44,15 @@ class SynchronizedWallClockTimer:
             self.elapsed_records = []
 
         def start(self):
-            assert not self.started_, f"{self.name_} timer has already been started"
+            if self.started_:
+                raise RuntimeError(f"{self.name_} timer has already been started")
             _device_synchronize()
             self.start_time = time.time()
             self.started_ = True
 
         def stop(self, reset=False, record=True):
-            assert self.started_, "timer is not started"
+            if not self.started_:
+                raise RuntimeError("timer is not started")
             _device_synchronize()
             elapsed = time.time() - self.start_time
             if record:
@@ -105,7 +107,8 @@ class SynchronizedWallClockTimer:
             return "DeviceMem stats unavailable"
 
     def log(self, names, normalizer=1.0, reset=True, memory_breakdown=False, ranks=None):
-        assert normalizer > 0.0
+        if normalizer <= 0.0:
+            raise ValueError(f"normalizer must be positive, got {normalizer}")
         string = "time (ms)"
         for name in names:
             if name in self.timers:
@@ -233,7 +236,8 @@ class ThroughputTimer:
 
 def trim_mean(data, trim_percent):
     """Compute the trimmed mean of a list of numbers (reference utils/timer.py tail)."""
-    assert 0.0 <= trim_percent <= 1.0
+    if not 0.0 <= trim_percent <= 1.0:
+        raise ValueError(f"trim_percent must be in [0, 1], got {trim_percent}")
     n = len(data)
     if n == 0:
         return 0

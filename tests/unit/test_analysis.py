@@ -1,0 +1,420 @@
+"""Tier-A lint: per-rule fixture snippets (positive / negative / noqa),
+the JSON output contract, CLI exit codes, and the tree meta-test that the
+shipped package itself lints clean."""
+
+import json
+import os
+import textwrap
+
+from deepspeed_tpu.analysis import framework
+from deepspeed_tpu.analysis.cli import lint_main
+
+
+def _lint(tmp_path, code, rule, subdir=""):
+    d = tmp_path / subdir if subdir else tmp_path
+    d.mkdir(parents=True, exist_ok=True)
+    p = d / "snippet.py"
+    p.write_text(textwrap.dedent(code))
+    return framework.run_lint([str(p)], select=[rule])
+
+
+# ---------------------------------------------------------------------------
+# donate-arity
+# ---------------------------------------------------------------------------
+class TestDonateArity:
+    def test_out_of_range_index(self, tmp_path):
+        found = _lint(tmp_path, """
+            import jax
+
+            def step(a, b):
+                return a + b
+
+            step_jit = jax.jit(step, donate_argnums=(2,))
+        """, "donate-arity")
+        assert len(found) == 1
+        assert "out of range" in found[0].message
+        assert found[0].severity == "error"
+
+    def test_donate_static_overlap(self, tmp_path):
+        found = _lint(tmp_path, """
+            import jax
+
+            def step(a, b):
+                return a + b
+
+            step_jit = jax.jit(step, donate_argnums=(0,), static_argnums=(0,))
+        """, "donate-arity")
+        assert any("both donate_argnums and static_argnums" in f.message for f in found)
+
+    def test_partial_decorator_form(self, tmp_path):
+        found = _lint(tmp_path, """
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, donate_argnums=(5,))
+            def step(x):
+                return x
+        """, "donate-arity")
+        assert len(found) == 1 and "out of range" in found[0].message
+
+    def test_valid_indices_clean(self, tmp_path):
+        found = _lint(tmp_path, """
+            import jax
+
+            def step(a, b, c):
+                return a + b + c
+
+            step_jit = jax.jit(step, donate_argnums=(0, 1), static_argnums=(2,))
+        """, "donate-arity")
+        assert found == []
+
+    def test_noqa_suppresses(self, tmp_path):
+        found = _lint(tmp_path, """
+            import jax
+
+            def step(a, b):
+                return a + b
+
+            step_jit = jax.jit(step, donate_argnums=(2,))  # dstpu: noqa[donate-arity]
+        """, "donate-arity")
+        assert found == []
+
+
+# ---------------------------------------------------------------------------
+# bare-assert
+# ---------------------------------------------------------------------------
+class TestBareAssert:
+    def test_flags_assert(self, tmp_path):
+        found = _lint(tmp_path, """
+            def f(x):
+                assert x > 0, "x must be positive"
+                return x
+        """, "bare-assert")
+        assert len(found) == 1 and found[0].severity == "error"
+
+    def test_explicit_raise_clean(self, tmp_path):
+        found = _lint(tmp_path, """
+            def f(x):
+                if x <= 0:
+                    raise ValueError("x must be positive")
+                return x
+        """, "bare-assert")
+        assert found == []
+
+    def test_noqa_suppresses(self, tmp_path):
+        found = _lint(tmp_path, """
+            def f(x):
+                assert x > 0  # dstpu: noqa[bare-assert]
+                return x
+        """, "bare-assert")
+        assert found == []
+
+
+# ---------------------------------------------------------------------------
+# host-sync-in-loop (hot modules only)
+# ---------------------------------------------------------------------------
+_HOT_SYNC = """
+    import numpy as np
+
+    def drain(rows):
+        out = []
+        for r in rows:
+            out.append(np.asarray(r))
+        return out
+"""
+
+
+class TestHostSyncInLoop:
+    def test_flags_in_hot_module(self, tmp_path):
+        found = _lint(tmp_path, _HOT_SYNC, "host-sync-in-loop", subdir="serving")
+        assert len(found) == 1 and found[0].severity == "warning"
+
+    def test_cold_module_clean(self, tmp_path):
+        found = _lint(tmp_path, _HOT_SYNC, "host-sync-in-loop", subdir="models")
+        assert found == []
+
+    def test_hoisted_call_clean(self, tmp_path):
+        found = _lint(tmp_path, """
+            import numpy as np
+
+            def drain(rows):
+                host = np.asarray(rows)
+                return [r * 2 for r in host]
+        """, "host-sync-in-loop", subdir="serving")
+        assert found == []
+
+    def test_item_and_float_in_loop(self, tmp_path):
+        found = _lint(tmp_path, """
+            def spin(xs, stop):
+                total = 0.0
+                while not stop():
+                    total += xs[0].item()
+                for x in xs:
+                    total += float(x)
+                return total
+        """, "host-sync-in-loop", subdir="runtime/zero")
+        assert len(found) == 2
+
+    def test_noqa_suppresses(self, tmp_path):
+        found = _lint(tmp_path, """
+            import numpy as np
+
+            def drain(rows):
+                out = []
+                for r in rows:
+                    out.append(np.asarray(r))  # dstpu: noqa[host-sync-in-loop]
+                return out
+        """, "host-sync-in-loop", subdir="serving")
+        assert found == []
+
+
+# ---------------------------------------------------------------------------
+# impure-jit
+# ---------------------------------------------------------------------------
+class TestImpureJit:
+    def test_print_in_decorated_jit(self, tmp_path):
+        found = _lint(tmp_path, """
+            import jax
+
+            @jax.jit
+            def f(x):
+                print(x)
+                return x
+        """, "impure-jit")
+        assert len(found) == 1 and "trace time" in found[0].message
+
+    def test_np_random_in_jit_call_form(self, tmp_path):
+        found = _lint(tmp_path, """
+            import jax
+            import numpy as np
+
+            def g(x):
+                return x * np.random.rand()
+
+            g_jit = jax.jit(g)
+        """, "impure-jit")
+        assert len(found) == 1 and "jax.random" in found[0].message
+
+    def test_jax_random_clean(self, tmp_path):
+        found = _lint(tmp_path, """
+            import jax
+
+            @jax.jit
+            def f(key, x):
+                return x + jax.random.normal(key, x.shape)
+        """, "impure-jit")
+        assert found == []
+
+    def test_print_outside_jit_clean(self, tmp_path):
+        found = _lint(tmp_path, """
+            def f(x):
+                print(x)
+                return x
+        """, "impure-jit")
+        assert found == []
+
+    def test_noqa_suppresses(self, tmp_path):
+        found = _lint(tmp_path, """
+            import jax
+
+            @jax.jit
+            def f(x):
+                print(x)  # dstpu: noqa[impure-jit]
+                return x
+        """, "impure-jit")
+        assert found == []
+
+
+# ---------------------------------------------------------------------------
+# cond-wait-no-predicate
+# ---------------------------------------------------------------------------
+class TestCondWaitNoPredicate:
+    def test_wait_without_loop(self, tmp_path):
+        found = _lint(tmp_path, """
+            class Worker:
+                def run(self):
+                    with self._cond:
+                        self._cond.wait()
+        """, "cond-wait-no-predicate")
+        assert len(found) == 1 and "spurious" in found[0].message
+
+    def test_wait_in_predicate_loop_clean(self, tmp_path):
+        found = _lint(tmp_path, """
+            class Worker:
+                def run(self):
+                    with self._cond:
+                        while not self.ready:
+                            self._cond.wait()
+        """, "cond-wait-no-predicate")
+        assert found == []
+
+    def test_wait_for_clean(self, tmp_path):
+        found = _lint(tmp_path, """
+            class Worker:
+                def run(self):
+                    with self._cond:
+                        self._cond.wait_for(lambda: self.ready)
+        """, "cond-wait-no-predicate")
+        assert found == []
+
+    def test_noqa_suppresses(self, tmp_path):
+        found = _lint(tmp_path, """
+            class Worker:
+                def run(self):
+                    with self._cond:
+                        self._cond.wait()  # dstpu: noqa[cond-wait-no-predicate]
+        """, "cond-wait-no-predicate")
+        assert found == []
+
+
+# ---------------------------------------------------------------------------
+# unlocked-shared-mutation
+# ---------------------------------------------------------------------------
+class TestUnlockedSharedMutation:
+    def test_unguarded_write_of_guarded_attr(self, tmp_path):
+        found = _lint(tmp_path, """
+            import threading
+
+            class Stats:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def add(self):
+                    with self._lock:
+                        self.n += 1
+
+                def reset(self):
+                    self.n = 0
+        """, "unlocked-shared-mutation")
+        assert len(found) == 1 and "without" in found[0].message
+
+    def test_all_writes_locked_clean(self, tmp_path):
+        found = _lint(tmp_path, """
+            import threading
+
+            class Stats:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def add(self):
+                    with self._lock:
+                        self.n += 1
+
+                def reset(self):
+                    with self._lock:
+                        self.n = 0
+        """, "unlocked-shared-mutation")
+        assert found == []
+
+    def test_locked_suffix_convention_clean(self, tmp_path):
+        found = _lint(tmp_path, """
+            import threading
+
+            class Stats:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def add(self):
+                    with self._lock:
+                        self.n += 1
+
+                def reset_locked(self):
+                    self.n = 0
+        """, "unlocked-shared-mutation")
+        assert found == []
+
+    def test_noqa_suppresses(self, tmp_path):
+        found = _lint(tmp_path, """
+            import threading
+
+            class Stats:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def add(self):
+                    with self._lock:
+                        self.n += 1
+
+                def reset(self):
+                    self.n = 0  # dstpu: noqa[unlocked-shared-mutation]
+        """, "unlocked-shared-mutation")
+        assert found == []
+
+
+# ---------------------------------------------------------------------------
+# framework mechanics
+# ---------------------------------------------------------------------------
+class TestFramework:
+    def test_parse_error_surfaces_as_finding(self, tmp_path):
+        p = tmp_path / "broken.py"
+        p.write_text("def f(:\n")
+        found = framework.run_lint([str(p)], select=["bare-assert"])
+        assert len(found) == 1 and found[0].rule == "parse-error"
+
+    def test_bare_noqa_suppresses_all_rules(self, tmp_path):
+        found = _lint(tmp_path, """
+            def f(x):
+                assert x  # dstpu: noqa
+        """, "bare-assert")
+        assert found == []
+
+    def test_json_schema(self, tmp_path):
+        p = tmp_path / "s.py"
+        p.write_text("assert True\n")
+        findings = framework.run_lint([str(p)], select=["bare-assert"])
+        doc = json.loads(framework.render_json(findings))
+        assert doc["version"] == 1
+        assert set(doc["counts"]) == {"info", "warning", "error"}
+        assert doc["counts"]["error"] == 1
+        (f,) = doc["findings"]
+        assert set(f) == {"rule", "severity", "path", "line", "col", "message"}
+        assert f["rule"] == "bare-assert" and f["line"] == 1
+
+    def test_rule_catalog_complete(self):
+        names = {r.name for r in framework.resolve_rules()}
+        assert names == {
+            "bare-assert",
+            "cond-wait-no-predicate",
+            "donate-arity",
+            "host-sync-in-loop",
+            "impure-jit",
+            "unlocked-shared-mutation",
+        }
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+class TestCli:
+    def test_exit_one_on_error_finding(self, tmp_path, capsys):
+        p = tmp_path / "s.py"
+        p.write_text("assert True\n")
+        assert lint_main([str(p)]) == 1
+        assert lint_main([str(p), "--fail-on", "never"]) == 0
+        capsys.readouterr()
+
+    def test_unknown_rule_exits_two(self, tmp_path, capsys):
+        p = tmp_path / "s.py"
+        p.write_text("x = 1\n")
+        assert lint_main([str(p), "--select", "no-such-rule"]) == 2
+        capsys.readouterr()
+
+    def test_json_output_parses(self, tmp_path, capsys):
+        p = tmp_path / "s.py"
+        p.write_text("assert True\n")
+        lint_main([str(p), "--format", "json", "--fail-on", "never"])
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["counts"]["error"] == 1
+
+    def test_package_tree_lints_clean(self, capsys):
+        import deepspeed_tpu
+
+        pkg = os.path.dirname(os.path.abspath(deepspeed_tpu.__file__))
+        # warnings included: every intentional hot-path sync must carry a
+        # justified noqa, not rely on the error-only CI threshold
+        assert lint_main([pkg, "--fail-on", "warning"]) == 0
+        capsys.readouterr()

@@ -13,10 +13,16 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 
+echo "== static analysis (Tier A rules) =="
+./bin/dstpu lint deepspeed_tpu --fail-on error
+
 echo "== smoke tier (one test per subsystem) =="
 python -m pytest tests/ -q -m smoke -p no:cacheprovider
 
 echo "== prefix-cache suite =="
 python -m pytest tests/unit/test_prefix_cache.py -q -p no:cacheprovider
+
+echo "== donation/recompile verifier (Tier B) =="
+./bin/dstpu lint --verify
 
 echo "run_smoke: all gates passed"
