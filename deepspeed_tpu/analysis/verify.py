@@ -256,6 +256,28 @@ def verify_engine_v2() -> List[CheckResult]:
         eng._v_cache,
     )
     results.append(check_donation("engine_v2.row_step", fn, row_args))
+
+    # speculative verify step (serving/spec): the K+1-token draft-and-verify
+    # program declares both KV pools donated — without aliasing, every spec
+    # round would copy the whole paged pool, erasing the subsystem's win.
+    # Lowering reads shapes only, so passing the live pools is safe (same
+    # precedent as row_step above).
+    R = eng.config.state_manager.max_ragged_sequence_count
+    fn = eng._build_verify_step(4)
+    verify_args = (
+        eng.params,
+        jnp.zeros((R, 5), jnp.int32),
+        jnp.zeros((R,), jnp.int32),
+        jnp.zeros((R, kv.max_blocks_per_seq), jnp.int32),
+        jnp.zeros((R,), jnp.int32),
+        jnp.zeros((R,), jnp.bool_),
+        jnp.ones((R,), jnp.int32),
+        eng._rng,
+        jnp.float32(1.0),
+        eng._k_cache,
+        eng._v_cache,
+    )
+    results.append(check_donation("engine_v2.verify_step", fn, verify_args))
     return results
 
 

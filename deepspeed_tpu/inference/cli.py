@@ -174,6 +174,12 @@ def serve_parse_args(argv=None):
                    help="default per-request timeout in seconds")
     p.add_argument("--decode-steps", type=int, default=1,
                    help="fuse this many decode iterations per device call")
+    p.add_argument("--spec-k", type=int, default=0,
+                   help="speculative decoding: verify up to this many "
+                   "n-gram-drafted tokens per sequence per step (0 = off; "
+                   "output stays bit-identical to spec-off)")
+    p.add_argument("--spec-ngram", type=int, default=3,
+                   help="max n-gram order for the prompt-lookup draft proposer")
     p.add_argument("--no-prefix-cache", action="store_true",
                    help="disable automatic prefix caching (on by default "
                    "when serving: repeated prompt prefixes share KV blocks "
@@ -213,6 +219,8 @@ def build_serving_stack(args, cfg=None, params=None, tok=None):
         "decode_steps": args.decode_steps,
         "greedy": not args.sample, "temperature": args.temperature,
         "top_k": args.top_k, "top_p": args.top_p, "seed": args.seed,
+        "spec_k": getattr(args, "spec_k", 0),
+        "spec_ngram": getattr(args, "spec_ngram", 3),
         "kv_cache": {
             "block_size": args.block_size,
             "num_blocks": args.num_blocks,
@@ -235,6 +243,7 @@ def build_serving_stack(args, cfg=None, params=None, tok=None):
         kv_headroom=args.kv_headroom,
         default_timeout_s=args.timeout,
         decode_steps=args.decode_steps,
+        spec_ngram=getattr(args, "spec_ngram", 3),
     )
     return driver, tok
 

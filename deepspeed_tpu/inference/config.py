@@ -116,6 +116,13 @@ class RaggedInferenceEngineConfig(DSConfigModel):
     top_k: int = 0
     top_p: float = 0.0
     seed: int = 0
+    # speculative decoding (serving/spec/): > 0 enables draft-and-verify
+    # decode rounds of up to spec_k draft tokens per sequence per step.
+    # spec_k is static per compiled verify program (one program per K);
+    # output is bit-identical to spec_k=0 — this is purely a latency knob.
+    spec_k: int = 0
+    # n-gram order cap for the default model-free draft proposer
+    spec_ngram: int = 3
     quant: QuantConfig = submodel(QuantConfig)
     kv_cache: Optional[KVCacheConfig] = submodel(KVCacheConfig)
     state_manager: Optional[StateManagerConfig] = submodel(StateManagerConfig)

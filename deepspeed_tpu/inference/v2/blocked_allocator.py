@@ -53,6 +53,12 @@ class BlockedAllocator:
     def refcounts(self, blocks) -> np.ndarray:
         return self._refcount[np.atleast_1d(np.asarray(blocks, np.int64))].copy()
 
+    def is_shared(self, block: int) -> bool:
+        """More than one holder (e.g. a prefix-cache block a live sequence
+        also references). Shared blocks must never be written by decode or
+        speculative-verify steps, and spec rollback refuses to drop them."""
+        return int(self._refcount[int(block)]) > 1
+
     @property
     def allocated_blocks(self) -> np.ndarray:
         """Ids of all blocks with at least one holder (sorted)."""

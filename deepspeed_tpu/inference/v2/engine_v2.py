@@ -173,6 +173,9 @@ class InferenceEngineV2:
         self._split_jit = {}  # (tq bucket,) -> compiled split-phase step
         self._multistep_jit = None
         self._multistep_n = 0
+        self._verify_jit = {}  # k -> compiled speculative verify step
+        self._spec_rr = 0  # rotation cursor for budget-capped spec rounds
+        self.last_spec = {"drafted": 0, "accepted": 0, "per_uid": {}}
         self.last_scheduled_tokens = 0
         self.last_capped = set()
         # sampling state: one base key; programs fold in each row's (uid,
@@ -217,6 +220,7 @@ class InferenceEngineV2:
             self._rng = jax.random.key(int(seed))
         self._split_jit = {}
         self._multistep_jit = None
+        self._verify_jit = {}
 
     def _sampling_kw(self):
         cfg = self.config
@@ -812,6 +816,224 @@ class InferenceEngineV2:
             sched.apply_decode_round(uid, gen)
             results[uid] = gen
             self.last_logprobs[uid] = logps_out[:, i]
+        return results
+
+    # ------------------------------------------------------------------
+    def _build_verify_step(self, k: int):
+        """ONE compiled speculative verify step: every active row scores its
+        pending token plus up to ``k`` draft tokens in a single (k+1)-token
+        forward pass — the chunk-attention shape the split step already
+        serves, so no new attention kernel. Per row the program
+
+          * feeds tokens x_0..x_K at positions p..p+K (x_0 = the pending
+            sampled token; rows with fewer drafts pad, and padded positions
+            carry qpos -1 so attention masks them and their KV scatters to
+            the trash block);
+          * samples the TARGET token for every position with the same
+            content-addressed key plain decode would use —
+            ``row_keys(rng, uid, position)`` — so target t_i is exactly the
+            token plain decode emits at p+i given the same history;
+          * accepts the longest draft prefix matching those targets
+            (in-program cumprod) and returns n_emit = accepted + 1 tokens
+            t_0..t_a per row (n_emit ∈ [1, k+1]: a fully rejected draft
+            still yields the one token plain decode would have).
+
+        Exact-match acceptance against the deterministic sampler is what
+        makes spec-on output BIT-IDENTICAL to spec-off for greedy and
+        sampled streams alike — speculation changes how many serialized
+        passes the stream costs, never its contents. Both KV pools are
+        donated; rejected drafts leave stale KV only at positions past the
+        new write cursor (masked by position on every later read, and
+        overwritten before they re-enter any pool window)."""
+        c = self._mc
+        kv = self.config.kv_cache
+        bs = kv.block_size
+        B = kv.max_blocks_per_seq
+        trash = kv.num_blocks
+        NBp = kv.num_blocks + 1
+        R = self.config.state_manager.max_ragged_sequence_count
+        dtype = T.DTYPES[c.dtype]
+        K1 = k + 1
+
+        def verify(params, tokens, positions0, tables, uids, active, n_input,
+                   rng, temperature, k_cache, v_cache):
+            nh, nkv, d = c.n_heads, c.kv_heads, c.head_dim
+            tok_tables = jnp.where(active[:, None], tables, trash)
+            j = jnp.arange(K1, dtype=jnp.int32)
+            pos = positions0[:, None] + j[None]  # [R, K1]
+            valid = (j[None] < n_input[:, None]) & active[:, None]
+            qpos = jnp.where(valid, pos, -1)  # -1: padded query/key slot
+            flat_pos = pos.reshape(R * K1)
+            x = T._scale_embed(
+                params["embed"].astype(dtype)[tokens.reshape(R * K1)][None], c, dtype
+            )
+            if c.position == "learned":
+                x = x + params["pos_embed"][jnp.clip(flat_pos, 0, c.max_seq_len - 1)][None]
+            if c.embed_norm:
+                x = T._embed_norm(params, c, x, stream=False)
+            # rope live length from VALID positions only (padded slots would
+            # flip a longrope factor switch early)
+            live = jnp.max(jnp.where(valid, pos, 0)) + 1
+            blk = jnp.take_along_axis(tok_tables, jnp.clip(pos // bs, 0, B - 1), axis=1)
+            blk = jnp.where(valid, blk, trash).reshape(R * K1)
+            row = flat_pos % bs
+            # round-start pool views: reads below each row's write cursor
+            # only (pool_limit), writes go through the donated carry —
+            # the same write-after-read protocol as the split step
+            k_pool0, v_pool0 = self._pool_views(k_cache, v_cache)
+            pool_lim = jnp.where(active, positions0, 0)
+            from deepspeed_tpu.ops.attention.paged_pallas import paged_chunk_attention
+
+            def layer_fn(lp, x, li, carry, window=None):
+                kc, vc = carry
+                w = c.sliding_window if window is None else window
+                lp = T._dequant_tree(lp, dtype)
+                _, q, k_, v_ = self._layer_qkv(lp, x, flat_pos, live)
+                out = paged_chunk_attention(
+                    q.reshape(R, K1, nh, d), k_pool0, v_pool0,
+                    li * NBp + tok_tables, qpos, li * NBp + trash,
+                    window=int(w), scale=c.attn_scale,
+                    new_kv=(k_.reshape(R, K1, nkv, d), v_.reshape(R, K1, nkv, d)),
+                    pool_limit=pool_lim,
+                )
+                kc, vc = self._scatter_kv(kc, vc, li, blk, row, k_, v_)
+                return self._layer_tail(lp, x, out.reshape(R * K1, nh, d)), (kc, vc)
+
+            x, (k_new, v_new) = self._drive_layers(
+                layer_fn, params, x, (k_cache, v_cache)
+            )
+            x = T._norm(x, params["final_norm"], params.get("final_norm_b"), c.norm, c.norm_eps)
+            logits = T._apply_lm_head(params, x[0], c)  # [R*K1, vocab]
+            from deepspeed_tpu.inference.sampling import row_keys, sample_tokens
+
+            kw = self._sampling_kw()
+            tgt, logp = sample_tokens(
+                logits.astype(jnp.float32),
+                row_keys(rng, jnp.repeat(uids, K1), qpos.reshape(R * K1)),
+                temperature=temperature, return_logprobs=True, **kw,
+            )
+            tgt = tgt.reshape(R, K1)
+            logp = logp.reshape(R, K1)
+            jj = jnp.arange(k, dtype=jnp.int32)
+            match = (tokens[:, 1:] == tgt[:, :k]) & (jj[None] < (n_input - 1)[:, None])
+            n_acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+            n_emit = jnp.where(active, n_acc + 1, 0)
+            return tgt, n_emit, logp, k_new, v_new
+
+        # donate BOTH cache pools (args 9 and 10 — k_cache, v_cache) so the
+        # verify scatter aliases in place like every other serving step
+        return jax.jit(verify, donate_argnums=(9, 10))
+
+    def spec_round(self, k: Optional[int] = None, drafts=None) -> Dict[int, np.ndarray]:
+        """One speculative draft-and-verify round over eligible RUNNING
+        rows. ``drafts``: {uid: proposed next tokens (≤ k)}; rows without an
+        entry verify zero drafts — a plain one-token decode riding the same
+        program, so undrafted requests never starve behind spec rounds.
+        Returns {uid: emitted tokens (1..k+1, bit-identical to the plain
+        decode stream)}; per-round draft/accept counts land in
+        ``self.last_spec`` for the driver's metrics and adaptive-K control.
+
+        Eligibility mirrors ``decode_round`` (rows near max_context / the
+        block cap / out of pool blocks fall back to the per-step path), with
+        each row extended by only the blocks ITS draft needs; rejected
+        drafts' blocks are rolled back via ``scheduler.apply_spec_round``.
+        Rows are capped so rows x (k+1) fits the step token budget, with a
+        rotating start so a capped round cannot starve later uids."""
+        k = int(k if k is not None else getattr(self.config, "spec_k", 0) or 0)
+        if k < 1:
+            raise ValueError(f"spec_round needs k >= 1 draft slots, got {k}")
+        drafts = drafts or {}
+        sched = self.scheduler
+        if sched.has_pending():
+            raise RuntimeError(
+                "spec_round: prompt chunks are still pending — drive step() "
+                "until prefill completes before speculative decode"
+            )
+        max_context = self.config.state_manager.max_context
+        R = self.config.state_manager.max_ragged_sequence_count
+        budget = self.config.state_manager.max_ragged_batch_size
+        K1 = k + 1
+        max_rows = min(R, max(1, budget // K1))
+        run = sched.running_uids()
+        if len(run) > max_rows:
+            off = self._spec_rr % len(run)
+            run = run[off:] + run[:off]
+            self._spec_rr += max_rows
+        uids, row_drafts = [], []
+        pre_blocks: Dict[int, int] = {}
+        for uid in run:
+            if len(uids) >= max_rows:
+                break
+            seq = self.state_manager.get_sequence(uid)
+            d = [int(t) for t in drafts.get(uid, ())][:k]
+            n = len(d) + 1
+            if seq.seen_tokens + n > max_context:
+                continue  # near the context limit: per-step path stops it
+            if self.state_manager.seq_capped(seq, n):
+                continue  # near the block cap: per-step path caps it
+            pre = len(seq.block_table)
+            if not self.state_manager.extend(seq, n):
+                continue  # pool momentarily exhausted: sequence waits
+            uids.append(uid)
+            row_drafts.append(d)
+            pre_blocks[uid] = pre
+        if not uids:
+            return {}
+        kv = self.config.kv_cache
+        B = kv.max_blocks_per_seq
+        trash = kv.num_blocks
+        tokens = np.zeros((R, K1), np.int32)
+        positions = np.zeros(R, np.int32)
+        tables = np.full((R, B), trash, np.int32)
+        uid_arr = np.zeros(R, np.int32)
+        active = np.zeros(R, bool)
+        n_input = np.ones(R, np.int32)
+        for i, (uid, d) in enumerate(zip(uids, row_drafts)):
+            seq = self.state_manager.get_sequence(uid)
+            tokens[i, 0] = sched.peek_next_token(uid)
+            if d:
+                tokens[i, 1 : 1 + len(d)] = d
+            positions[i] = seq.seen_tokens
+            tables[i, : len(seq.block_table)] = seq.block_table
+            uid_arr[i] = uid
+            active[i] = True
+            n_input[i] = 1 + len(d)
+        if k not in self._verify_jit:
+            self._verify_jit[k] = self._build_verify_step(k)
+        tgt, n_emit, logp, self._k_cache, self._v_cache = self._verify_jit[k](
+            self.params,
+            jnp.asarray(tokens),
+            jnp.asarray(positions),
+            jnp.asarray(tables),
+            jnp.asarray(uid_arr),
+            jnp.asarray(active),
+            jnp.asarray(n_input),
+            self._rng,
+            jnp.float32(getattr(self.config, "temperature", 1.0) or 1.0),
+            self._k_cache,
+            self._v_cache,
+        )
+        tgt = np.asarray(tgt)
+        n_emit = np.asarray(n_emit)
+        logp = np.asarray(logp)
+        results: Dict[int, np.ndarray] = {}
+        self.last_logprobs = {}
+        drafted_total = accepted_total = 0
+        per_uid: Dict[int, Tuple[int, int]] = {}
+        for i, uid in enumerate(uids):
+            n = int(n_emit[i])
+            gen = tgt[i, :n].astype(np.int32)
+            sched.apply_spec_round(uid, gen, pre_blocks[uid])
+            results[uid] = gen
+            self.last_logprobs[uid] = logp[i, :n]
+            d, a = int(n_input[i]) - 1, n - 1
+            drafted_total += d
+            accepted_total += a
+            per_uid[uid] = (d, a)
+        self.last_spec = {
+            "drafted": drafted_total, "accepted": accepted_total,
+            "per_uid": per_uid,
+        }
         return results
 
     def put(self, batch_uids, batch_tokens) -> Dict[int, np.ndarray]:

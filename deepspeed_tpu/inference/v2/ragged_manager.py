@@ -144,6 +144,38 @@ class DSStateManager:
             seq.tokens[: n_full * self._kv.block_size], seq.block_table[:n_full]
         )
 
+    def truncate_blocks(
+        self, seq: DSSequenceDescriptor, keep_tokens: int, min_keep_blocks: int = 0
+    ) -> int:
+        """Roll a sequence's KV block cursor BACK: release table blocks past
+        those needed to hold ``keep_tokens`` (speculative-decode rejection
+        rollback). ``min_keep_blocks`` floors the cut at the pre-round table
+        length, so only blocks allocated for the rolled-back tokens are ever
+        candidates — in particular, prefix-cache-seeded shared blocks always
+        sit below the floor and are never touched. Returns the number of
+        blocks released.
+
+        Freeing goes through the refcount-aware ``allocator.free``, but a
+        dropped block being shared would still be a protocol violation
+        (verify-round writes must never land in shared blocks: the cache
+        would keep serving KV for tokens that were rolled back), so shared
+        blocks in the drop set raise instead of silently decrementing."""
+        bs = self._kv.block_size
+        keep = max((keep_tokens + bs - 1) // bs, int(min_keep_blocks), 0)
+        if keep >= len(seq.block_table):
+            return 0
+        drop = [int(b) for b in seq.block_table[keep:]]
+        shared = [b for b in drop if self._alloc.refcount(b) > 1]
+        if shared:
+            raise RuntimeError(
+                f"spec rollback would free shared KV block(s) {shared} of "
+                f"uid={seq.uid}: rejected-draft blocks must be private "
+                "(prefix-cache corruption guard)"
+            )
+        del seq.block_table[keep:]
+        self._alloc.free(drop)
+        return len(drop)
+
     def kv_block_accounting(self) -> Dict[str, int]:
         """The pool conservation law, for invariant checks: every block is
         exactly one of free / referenced by a live block table (deduped) /

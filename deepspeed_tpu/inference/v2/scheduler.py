@@ -161,6 +161,21 @@ class RaggedScheduler:
         seq.seen_tokens += len(gen_tokens)
         self._next_token[uid] = int(gen_tokens[-1])
 
+    def apply_spec_round(self, uid: int, gen_tokens, pre_blocks: int) -> None:
+        """Record a speculative verify round's ACCEPTED tokens for a RUNNING
+        uid and roll its KV write cursor back past the rejected draft:
+        history/seen/pending advance by the emitted tokens exactly as in a
+        fused decode round, then table blocks the round allocated beyond the
+        new cursor are truncated and returned to the pool. ``pre_blocks`` is
+        the row's table length BEFORE the round's extend — the truncation
+        floor that keeps prefix-cache-shared (and any other pre-round)
+        blocks out of the drop set."""
+        seq = self._mgr.get_sequence(uid)
+        if seq is None or seq.finished:
+            return
+        self.apply_decode_round(uid, gen_tokens)
+        self._mgr.truncate_blocks(seq, seq.seen_tokens, min_keep_blocks=pre_blocks)
+
     def next_batch(self) -> Optional[RaggedBatch]:
         budget = self._config.max_ragged_batch_size
         max_rows = self._config.max_ragged_sequence_count

@@ -12,20 +12,32 @@ ragged batches. This package is that layer for ``InferenceEngineV2``:
   * ``metrics``    — TTFT/TPOT/e2e histograms, queue/KV gauges, Prometheus
                      text exposition, Monitor-writer bridge
   * ``server``     — stdlib-only HTTP front end (/generate, /health, /metrics)
+  * ``spec``       — speculative decoding: draft proposers + adaptive draft
+                     length over the engine's K+1-token verify rounds
 """
 
 from deepspeed_tpu.serving.driver import RequestRejected, ServingDriver
 from deepspeed_tpu.serving.metrics import ServingMetrics
 from deepspeed_tpu.serving.request import Request, RequestState, SamplingParams
+from deepspeed_tpu.serving.spec import (
+    AdaptiveSpecController,
+    DraftProposer,
+    NgramProposer,
+    SpecParams,
+)
 from deepspeed_tpu.serving.streaming import IncrementalDetokenizer, TokenStream
 
 __all__ = [
+    "AdaptiveSpecController",
+    "DraftProposer",
     "IncrementalDetokenizer",
+    "NgramProposer",
     "Request",
     "RequestRejected",
     "RequestState",
     "SamplingParams",
     "ServingDriver",
     "ServingMetrics",
+    "SpecParams",
     "TokenStream",
 ]

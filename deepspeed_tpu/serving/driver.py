@@ -60,6 +60,9 @@ class ServingDriver:
         decode_steps: int = 1,
         poll_interval_s: float = 0.02,
         monitor=None,
+        spec_k: Optional[int] = None,
+        spec_ngram: int = 3,
+        proposer=None,
     ):
         self.engine = engine
         self.eos_token_id = eos_token_id
@@ -70,6 +73,21 @@ class ServingDriver:
         self.poll_interval_s = float(poll_interval_s)
         self.monitor = monitor
         self.metrics = ServingMetrics()
+        # speculative decoding: spec_k=None inherits the engine config's
+        # spec_k; 0 disables. The proposer is injectable (a small-model
+        # drafter satisfies the same protocol); default is the model-free
+        # n-gram prompt-lookup drafter.
+        if spec_k is None:
+            spec_k = int(getattr(getattr(engine, "config", None), "spec_k", 0) or 0)
+        self.spec_k = int(spec_k)
+        self._spec_ctl = None
+        self.proposer = proposer
+        if self.spec_k > 0 and hasattr(engine, "spec_round"):
+            from deepspeed_tpu.serving.spec import AdaptiveSpecController, NgramProposer
+
+            if self.proposer is None:
+                self.proposer = NgramProposer(max_ngram=max(1, int(spec_ngram)))
+            self._spec_ctl = AdaptiveSpecController(self.spec_k)
 
         self._cond = threading.Condition()
         self._queue: deque = deque()  # Requests awaiting admission
@@ -217,12 +235,21 @@ class ServingDriver:
 
     def health(self) -> Dict:
         with self._cond:
+            snap = self.metrics.snapshot()
             return {
                 "status": "draining" if self._draining else "ok",
                 "queue_depth": len(self._queue),
                 "active_requests": len(self._active),
                 "kv_free_blocks": self._free_blocks(),
                 "kv_total_blocks": self._kv_total,
+                "spec": {
+                    "enabled": self._spec_ctl is not None,
+                    "k": self.spec_k,
+                    "rounds": int(snap["spec_rounds_total"]),
+                    "draft_tokens": int(snap["spec_draft_tokens_total"]),
+                    "accepted_tokens": int(snap["spec_accepted_tokens_total"]),
+                    "acceptance_rate": snap["spec_acceptance_rate"],
+                },
             }
 
     # -- internals -------------------------------------------------------
@@ -261,6 +288,8 @@ class ServingDriver:
                 logger.warning(f"serving: finish({req.uid}) raised: {e}")
         self._active.pop(req.uid, None)
         self._cancel_uids.discard(req.uid)
+        if self._spec_ctl is not None:
+            self._spec_ctl.forget(req.uid)
         self._terminate(req, state, reason, error)
 
     # admission ---------------------------------------------------------
@@ -394,10 +423,73 @@ class ServingDriver:
                 self._finish_active(req, RequestState.FINISHED, "length_cap",
                                     scheduler_done=True)
 
+    # speculative decoding -----------------------------------------------
+    def _build_drafts(self) -> Dict[int, list]:
+        """Per-uid draft tokens for the next verify round. Resolves the
+        per-request SpecParams against the driver's spec_k, asks the
+        adaptive controller for this round's draft length (0 during
+        fallback cooldown), and caps drafts by the request's remaining
+        token budget — a draft past max_new_tokens could only be cut."""
+        drafts: Dict[int, list] = {}
+        for uid in self.engine.scheduler.running_uids():
+            req = self._active.get(uid)
+            k_cap = self.spec_k
+            if req is not None and req.params.spec is not None:
+                if not req.params.spec.enabled:
+                    drafts[uid] = []
+                    continue
+                k_cap = min(k_cap, req.params.spec.k)
+            k = self._spec_ctl.current_k(uid, k_cap)
+            if req is not None:
+                k = min(k, max(0, req.remaining_tokens - 1))
+            if k < 1:
+                drafts[uid] = []
+                continue
+            seq = self.engine.state_manager.get_sequence(uid)
+            hist = seq.tokens if seq is not None else []
+            drafts[uid] = list(self.proposer.propose(hist, k))
+        return drafts
+
+    def _spec_step(self, sched) -> bool:
+        """One speculative verify round: propose drafts, verify K+1 tokens
+        per row in one program, deliver the accepted burst. Returns True
+        when the round ran (progress or not); the caller falls through to
+        plain stepping when no row drafted anything."""
+        drafts = self._build_drafts()
+        if not any(drafts.values()):
+            return False  # nothing to verify: fused decode round is cheaper
+        round_res = self.engine.spec_round(self.spec_k, drafts=drafts)
+        if not round_res:
+            # every row was skipped (context/block caps, pool exhaustion):
+            # the per-step path knows how to cap/stall them
+            return False
+        self.metrics.inc("engine_steps_total")
+        per_uid = dict(self.engine.last_spec.get("per_uid", {}))
+        self.metrics.observe_spec_round(per_uid)
+        for uid, (drafted, accepted) in per_uid.items():
+            self._spec_ctl.update(uid, drafted, accepted)
+        for uid, toks in round_res.items():
+            req = self._active.get(uid)
+            if req is None:
+                sched.finish(uid)
+                continue
+            for tok in toks:
+                # apply_spec_round already advanced the scheduler: deliver
+                # without feedback, exactly like fused decode rounds
+                if not self._deliver_or_fail(req, int(tok), feedback=False):
+                    break
+        self._reap_capped()
+        return True
+
     def _step_once(self) -> bool:
-        """One engine step (or fused decode round). Returns True if any
-        token landed / request advanced (progress)."""
+        """One engine step (or fused decode / speculative verify round).
+        Returns True if any token landed / request advanced (progress)."""
         sched = self.engine.scheduler
+        use_spec = (
+            self._spec_ctl is not None
+            and not sched.has_pending()
+            and bool(sched.running_uids())
+        )
         use_round = (
             self.decode_steps > 1
             and hasattr(self.engine, "decode_round")
@@ -406,6 +498,8 @@ class ServingDriver:
         )
         progress = False
         try:
+            if use_spec and self._spec_step(sched):
+                return True
             if use_round:
                 round_res = self.engine.decode_round(self.decode_steps)
                 if round_res:

@@ -26,6 +26,10 @@ DEFAULT_BUCKETS = (
     0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
 )
 
+# Accepted-draft-tokens-per-verify-round buckets: small integers (a round
+# accepts 0..K drafts; K is single digits in practice).
+SPEC_ACCEPT_BUCKETS = (0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0)
+
 
 class Histogram:
     """Prometheus-style cumulative histogram (counts per le-bucket + sum)."""
@@ -85,6 +89,8 @@ class ServingMetrics:
         self.ttft = Histogram(buckets)
         self.tpot = Histogram(buckets)
         self.e2e = Histogram(buckets)
+        # accepted draft tokens per sequence per verify round (spec decode)
+        self.spec_accepted = Histogram(SPEC_ACCEPT_BUCKETS)
         self.counters: Dict[str, float] = {
             "requests_submitted_total": 0,
             "requests_rejected_total": 0,
@@ -102,6 +108,10 @@ class ServingMetrics:
             "prefix_hit_tokens_total": 0,
             "prefix_inserted_blocks_total": 0,
             "prefix_evictions_total": 0,
+            # speculative decoding
+            "spec_rounds_total": 0,
+            "spec_draft_tokens_total": 0,
+            "spec_accepted_tokens_total": 0,
         }
         self.gauges: Dict[str, float] = {
             "queue_depth": 0,
@@ -112,6 +122,8 @@ class ServingMetrics:
             "prefix_cached_blocks": 0,
             "prefix_cached_blocks_idle": 0,
             "prefix_hit_rate": 0.0,
+            "spec_acceptance_rate": 0.0,
+            "spec_mean_accepted_per_round": 0.0,
         }
 
     # -- writers ---------------------------------------------------------
@@ -154,6 +166,22 @@ class ServingMetrics:
             self.gauges["prefix_cached_blocks_idle"] = stats["cached_blocks_idle"]
             self.gauges["prefix_hit_rate"] = stats["hit_rate"]
 
+    def observe_spec_round(self, per_uid: Dict[int, Tuple[int, int]]) -> None:
+        """Fold one verify round's (drafted, accepted) per sequence into the
+        spec counters/histogram and refresh the derived gauges."""
+        with self._lock:
+            for drafted, accepted in per_uid.values():
+                self.counters["spec_draft_tokens_total"] += drafted
+                self.counters["spec_accepted_tokens_total"] += accepted
+                self.spec_accepted.observe(float(accepted))  # dstpu: noqa[host-sync-in-loop] host int, not a device scalar
+            self.counters["spec_rounds_total"] += 1
+            drafted_total = self.counters["spec_draft_tokens_total"]
+            if drafted_total:
+                self.gauges["spec_acceptance_rate"] = (
+                    self.counters["spec_accepted_tokens_total"] / drafted_total
+                )
+            self.gauges["spec_mean_accepted_per_round"] = self.spec_accepted.mean
+
     # -- readers ---------------------------------------------------------
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
@@ -176,6 +204,7 @@ class ServingMetrics:
                 ("ttft_seconds", self.ttft),
                 ("tpot_seconds", self.tpot),
                 ("e2e_latency_seconds", self.e2e),
+                ("spec_accepted_per_round", self.spec_accepted),
             ):
                 samples.extend(hist.prom_samples(f"{p}_{hname}"))
         return render_prometheus_text(samples)
@@ -193,6 +222,7 @@ class ServingMetrics:
                 ("ttft_s", self.ttft),
                 ("tpot_s", self.tpot),
                 ("e2e_s", self.e2e),
+                ("spec_accepted_per_round", self.spec_accepted),
             ):
                 if hist.count:
                     events.append((f"Serving/{hname}_mean", hist.mean, step))
@@ -203,6 +233,7 @@ class ServingMetrics:
 # re-export for callers that want consistent naming with the monitor sink
 __all__ = [
     "DEFAULT_BUCKETS",
+    "SPEC_ACCEPT_BUCKETS",
     "Histogram",
     "ServingMetrics",
     "prometheus_metric_name",

@@ -15,6 +15,8 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from deepspeed_tpu.serving.spec import SpecParams
+
 
 class RequestState:
     """Lifecycle states (string constants — cheap to compare and to export
@@ -53,11 +55,18 @@ class SamplingParams:
     eos_token_id: Optional[int] = None  # None = use the driver's default
     ignore_eos: bool = False
     stop_token_ids: Tuple[int, ...] = ()
+    # speculative decoding override: None = inherit the driver's setting;
+    # SpecParams(enabled=False) opts this request out; SpecParams(k=N) caps
+    # its draft length. Never changes WHAT the request generates (verify
+    # rounds are bit-identical to plain decode), only how fast.
+    spec: Optional[SpecParams] = None
 
     def __post_init__(self):
         if self.max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got {self.max_new_tokens}")
         self.stop_token_ids = tuple(int(t) for t in self.stop_token_ids)
+        if isinstance(self.spec, dict):  # JSON bodies arrive as dicts
+            self.spec = SpecParams(**self.spec)
 
 
 @dataclass

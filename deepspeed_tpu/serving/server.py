@@ -44,12 +44,19 @@ def parse_generate(body: dict, tokenizer=None) -> Tuple[np.ndarray, SamplingPara
         raise ValueError("body needs \"prompt\" (text) or \"tokens\" (ids)")
     if len(prompt) == 0:
         raise ValueError("empty prompt")
-    params = SamplingParams(
-        max_new_tokens=int(body.get("max_new_tokens", 64)),
-        eos_token_id=body.get("eos_token_id"),
-        ignore_eos=bool(body.get("ignore_eos", False)),
-        stop_token_ids=tuple(body.get("stop_token_ids", ())),
-    )
+    spec = body.get("spec")
+    if spec is not None and not isinstance(spec, dict):
+        raise ValueError('"spec" must be an object, e.g. {"enabled": true, "k": 4}')
+    try:
+        params = SamplingParams(
+            max_new_tokens=int(body.get("max_new_tokens", 64)),
+            eos_token_id=body.get("eos_token_id"),
+            ignore_eos=bool(body.get("ignore_eos", False)),
+            stop_token_ids=tuple(body.get("stop_token_ids", ())),
+            spec=spec,
+        )
+    except TypeError as e:  # unknown spec key → client error, not a 500
+        raise ValueError(f"bad spec params: {e}")
     stream = bool(body.get("stream", False))
     timeout_s = body.get("timeout_s")
     if timeout_s is not None:

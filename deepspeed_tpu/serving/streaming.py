@@ -42,6 +42,16 @@ class TokenStream:
             self._q.append(int(token))
             self._cond.notify_all()
 
+    def put_many(self, tokens) -> None:
+        """Append a burst (e.g. one speculative verify round's accepted run)
+        under ONE lock acquisition/notify — consumers wake once per burst,
+        not once per token."""
+        with self._cond:
+            if self._closed:
+                return
+            self._q.extend(int(t) for t in tokens)
+            self._cond.notify_all()
+
     def close(self, finish_reason: str, error: Optional[str] = None) -> None:
         with self._cond:
             if self._closed:
@@ -79,22 +89,38 @@ class TokenStream:
 class IncrementalDetokenizer:
     """Turn a token-id stream into text pieces, emitting only complete
     codepoints: decode the full generated prefix each push and emit the
-    suffix past what was already emitted, holding back while the decode
-    ends in U+FFFD (a partial UTF-8 sequence awaiting its next token)."""
+    STABLE suffix past what was already emitted — everything up to (but not
+    including) any trailing U+FFFD run, which marks a partial UTF-8
+    sequence awaiting its next token. Emitting the stable prefix rather
+    than withholding the whole piece matters for multi-token bursts
+    (speculative decoding delivers several tokens per round): one
+    incomplete trailing codepoint must not hold back the completed text in
+    front of it."""
 
     def __init__(self, tokenizer):
         self._tok = tokenizer
         self._ids = []
         self._emitted = 0  # chars already handed out
 
+    def _emit_stable(self) -> str:
+        text = self._tok.decode(self._ids)
+        stable = len(text)
+        while stable > self._emitted and text[stable - 1] == "�":
+            stable -= 1  # mid-codepoint tail: wait for the completing token
+        piece = text[self._emitted:stable]
+        self._emitted = stable
+        return piece
+
     def push(self, token_id: int) -> str:
         self._ids.append(int(token_id))
-        text = self._tok.decode(self._ids)
-        if text.endswith("�"):
-            return ""  # mid-codepoint: wait for the completing token
-        piece = text[self._emitted:]
-        self._emitted = len(text)
-        return piece
+        return self._emit_stable()
+
+    def push_many(self, token_ids) -> str:
+        """Burst entry point: fold several tokens, ONE decode of the prefix
+        (vs one per token via repeated push) — the streaming-side analogue
+        of the engine's multi-token verify rounds."""
+        self._ids.extend(int(t) for t in token_ids)
+        return self._emit_stable()
 
     def flush(self) -> str:
         """Emit whatever remains (end of stream: a trailing U+FFFD is real)."""

@@ -1,0 +1,583 @@
+"""Speculative decoding tests: proposer, adaptive controller, KV rollback,
+bit-identical verify parity, and the serving driver's spec path.
+
+Layering mirrors the subsystem:
+
+  * proposer/controller — pure host logic, no jax.
+  * rollback            — ``DSStateManager.truncate_blocks`` invariants over
+                          the real allocator, including the shared-block
+                          (prefix-cache) corruption guard. No jax.
+  * driver spec path    — a compute-free ``FakeSpecEngine`` implements the
+                          engine's ``spec_round`` contract (accept drafts
+                          matching the deterministic last+1 chain) over the
+                          REAL scheduler/manager stack, so draft building,
+                          adaptive fallback, metrics, and burst delivery are
+                          exercised without compiling anything.
+  * engine parity       — the real ``InferenceEngineV2`` on CPU: spec-on
+                          output must equal spec-off output TOKEN FOR TOKEN,
+                          greedy and sampled alike, with the KV pool fully
+                          conserved after heavy rejection/rollback traffic.
+"""
+
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.config import KVCacheConfig, StateManagerConfig
+from deepspeed_tpu.inference.v2.ragged_manager import DSStateManager
+from deepspeed_tpu.inference.v2.scheduler import RaggedScheduler
+from deepspeed_tpu.serving.driver import ServingDriver
+from deepspeed_tpu.serving.request import SamplingParams
+from deepspeed_tpu.serving.spec import (
+    AdaptiveSpecController,
+    DraftProposer,
+    NgramProposer,
+    SpecParams,
+)
+from deepspeed_tpu.serving.streaming import IncrementalDetokenizer, TokenStream
+
+
+# ---------------------------------------------------------------------------
+# proposer
+# ---------------------------------------------------------------------------
+class TestNgramProposer:
+    def test_protocol(self):
+        assert isinstance(NgramProposer(), DraftProposer)
+
+    def test_longest_ngram_wins(self):
+        # suffix [7, 8] occurs earlier followed by [9, 10]; suffix [8] alone
+        # also occurs elsewhere followed by junk — order 2 must win
+        hist = [1, 8, 99, 99, 7, 8, 9, 10, 5, 7, 8]
+        assert NgramProposer(max_ngram=3).propose(hist, 2) == [9, 10]
+
+    def test_most_recent_match_wins(self):
+        # suffix [3] appears twice; the LATER occurrence's continuation wins
+        hist = [3, 4, 0, 3, 5, 0, 3]
+        assert NgramProposer(max_ngram=1).propose(hist, 1) == [5]
+
+    def test_draft_capped_at_k(self):
+        hist = [1, 2, 3, 4, 5, 1, 2]
+        assert NgramProposer().propose(hist, 2) == [3, 4]
+
+    def test_no_match_returns_empty(self):
+        assert NgramProposer().propose([1, 2, 3, 4], 4) == []
+
+    def test_short_history_and_zero_k(self):
+        p = NgramProposer()
+        assert p.propose([], 4) == []
+        assert p.propose([1], 4) == []
+        assert p.propose([1, 2, 1], 0) == []
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            NgramProposer(max_ngram=0)
+        with pytest.raises(ValueError):
+            NgramProposer(max_ngram=2, min_ngram=3)
+
+
+# ---------------------------------------------------------------------------
+# adaptive controller
+# ---------------------------------------------------------------------------
+class TestAdaptiveSpecController:
+    def test_full_k_while_healthy(self):
+        ctl = AdaptiveSpecController(k=4)
+        for _ in range(5):
+            assert ctl.current_k(1) == 4
+            ctl.update(1, drafted=4, accepted=4)
+        assert ctl.acceptance_rate(1) == pytest.approx(1.0)
+        assert not ctl.is_fallback(1)
+
+    def test_collapse_starts_cooldown_then_probe(self):
+        ctl = AdaptiveSpecController(k=4, min_accept=0.3, ema=0.5, probe_interval=3)
+        ctl.current_k(1)
+        ctl.update(1, drafted=4, accepted=0)  # EMA 0.5 -> healthy
+        ctl.current_k(1)
+        ctl.update(1, drafted=4, accepted=0)  # EMA 0.25 -> cooldown
+        assert ctl.is_fallback(1)
+        assert ctl.current_k(1) == 0
+        assert ctl.current_k(1) == 0
+        # cooldown expires: one full-length probe draft
+        assert ctl.current_k(1) == 4
+        assert not ctl.is_fallback(1)
+        # a good probe re-enables speculation
+        ctl.update(1, drafted=4, accepted=4)
+        assert ctl.current_k(1) == 4
+
+    def test_k_cap_and_per_uid_isolation(self):
+        ctl = AdaptiveSpecController(k=4)
+        assert ctl.current_k(1, k_cap=2) == 2
+        assert ctl.current_k(1, k_cap=0) == 0
+        ctl.update(1, drafted=4, accepted=0)
+        ctl.update(1, drafted=4, accepted=0)
+        assert ctl.is_fallback(1) and not ctl.is_fallback(2)
+        assert ctl.current_k(2) == 4
+
+    def test_forget(self):
+        ctl = AdaptiveSpecController(k=4)
+        ctl.update(1, drafted=4, accepted=0)
+        ctl.update(1, drafted=4, accepted=0)
+        ctl.forget(1)
+        assert not ctl.is_fallback(1)
+        assert ctl.acceptance_rate(1) == 1.0
+
+    def test_zero_drafted_is_noop(self):
+        ctl = AdaptiveSpecController(k=4)
+        ctl.update(1, drafted=0, accepted=0)
+        assert ctl.acceptance_rate(1) == 1.0
+
+
+# ---------------------------------------------------------------------------
+# KV rollback (manager-level)
+# ---------------------------------------------------------------------------
+def _manager(block_size=4, num_blocks=32, max_blocks_per_seq=16, prefix_cache=False):
+    kv = KVCacheConfig(block_size=block_size, num_blocks=num_blocks,
+                       max_blocks_per_seq=max_blocks_per_seq,
+                       prefix_cache=prefix_cache)
+    sm = StateManagerConfig(max_tracked_sequences=8, max_ragged_batch_size=64,
+                            max_ragged_sequence_count=8, max_context=4096)
+    return DSStateManager(sm, kv), kv
+
+
+class TestTruncateBlocks:
+    def test_rollback_frees_only_rolled_back_blocks(self):
+        mgr, kv = _manager()
+        seq = mgr.get_or_create_sequence(1)
+        assert mgr.extend(seq, 10)  # 3 blocks for 10 tokens @ bs=4
+        seq.seen_tokens = 10
+        pre = len(seq.block_table)
+        assert mgr.extend(seq, 7)  # verify round: tokens 11..17 -> +2 blocks
+        assert len(seq.block_table) == pre + 2
+        # round accepted 1 of 6 drafts: cursor lands at 12 -> 3 blocks keep
+        freed = mgr.truncate_blocks(seq, 12, min_keep_blocks=pre)
+        assert freed == 2
+        assert len(seq.block_table) == 3
+        assert mgr.free_blocks == kv.num_blocks - 3
+
+    def test_floor_keeps_pre_round_blocks(self):
+        mgr, _ = _manager()
+        seq = mgr.get_or_create_sequence(1)
+        assert mgr.extend(seq, 4)
+        seq.seen_tokens = 2  # partially-filled block
+        pre = len(seq.block_table)
+        # keep_tokens alone would keep ceil(2/4)=1 block, but the pre-round
+        # floor protects the whole pre-round table
+        assert mgr.truncate_blocks(seq, 2, min_keep_blocks=pre) == 0
+        assert len(seq.block_table) == pre
+
+    def test_shared_block_in_drop_set_raises(self):
+        mgr, _ = _manager()
+        seq = mgr.get_or_create_sequence(1)
+        assert mgr.extend(seq, 8)
+        seq.seen_tokens = 8
+        assert mgr.extend(seq, 4)  # the block a spec round would drop
+        mgr._alloc.share([seq.block_table[-1]])  # simulate cache sharing it
+        with pytest.raises(RuntimeError, match="shared KV block"):
+            mgr.truncate_blocks(seq, 8, min_keep_blocks=0)
+
+    def test_prefix_cache_seeded_blocks_survive_rollback(self):
+        mgr, kv = _manager(prefix_cache=True)
+        # writer registers three full blocks in the trie
+        w = mgr.get_or_create_sequence(1)
+        assert mgr.extend(w, 12)
+        w.tokens = list(range(12))
+        w.seen_tokens = 12
+        mgr.cache_prefill_blocks(w, 12)
+        # reader seeds from cache (the cache leaves >= 1 token to prefill,
+        # so a 12-token prompt matches 2 of the 3 blocks), then runs a spec
+        # round that rolls back
+        r = mgr.get_or_create_sequence(2)
+        n_cached = mgr.seed_from_cache(r, list(range(12)))
+        assert n_cached == 8
+        shared = list(r.block_table)
+        pre = len(r.block_table)
+        assert mgr.extend(r, 5)
+        r.seen_tokens = 9  # accepted 1 token of the round
+        freed = mgr.truncate_blocks(r, 9, min_keep_blocks=pre)
+        assert freed >= 1
+        assert r.block_table[:pre] == shared  # cache-shared blocks untouched
+        for b in shared:
+            assert mgr._alloc.refcount(b) >= 2
+
+
+# ---------------------------------------------------------------------------
+# driver spec path over a compute-free engine
+# ---------------------------------------------------------------------------
+class FakeSpecEngine:
+    """Driver engine protocol + the ``spec_round`` contract over the REAL
+    scheduler/manager stack. Deterministic chain generation (next = last+1)
+    makes acceptance checkable: a draft token is accepted iff it equals the
+    target the chain would emit at its position."""
+
+    def __init__(self, block_size=4, num_blocks=256, max_blocks_per_seq=16,
+                 max_tracked=32, batch_budget=64, max_rows=16, max_context=4096):
+        kv = KVCacheConfig(block_size=block_size, num_blocks=num_blocks,
+                           max_blocks_per_seq=max_blocks_per_seq)
+        sm = StateManagerConfig(
+            max_tracked_sequences=max_tracked,
+            max_ragged_batch_size=batch_budget,
+            max_ragged_sequence_count=max_rows,
+            max_context=max_context,
+        )
+        self.config = SimpleNamespace(kv_cache=kv, state_manager=sm, spec_k=0)
+        self.state_manager = DSStateManager(sm, kv)
+        self.scheduler = RaggedScheduler(sm, self.state_manager)
+        self.last_capped = set()
+        self.last_spec = {"drafted": 0, "accepted": 0, "per_uid": {}}
+        self.spec_rounds = 0
+        self.plain_steps = 0
+
+    def step_tokens(self):
+        self.plain_steps += 1
+        batch = self.scheduler.next_batch()
+        self.last_capped |= self.scheduler.drain_capped()
+        if batch is None:
+            return {}
+        out = {}
+        for uid, toks, chunked in zip(batch.uids, batch.tokens, batch.is_prompt_chunk):
+            seq = self.state_manager.get_sequence(uid)
+            seq.seen_tokens += len(toks)
+            if not chunked:
+                out[uid] = int(toks[-1]) + 1
+        return out
+
+    def spec_round(self, k, drafts=None):
+        drafts = drafts or {}
+        sched = self.scheduler
+        assert not sched.has_pending(), "spec_round during prefill"
+        out, per_uid = {}, {}
+        drafted_total = accepted_total = 0
+        for uid in sched.running_uids():
+            seq = self.state_manager.get_sequence(uid)
+            pend = sched.peek_next_token(uid)
+            d = [int(t) for t in drafts.get(uid, ())][:k]
+            n = len(d) + 1
+            if seq.seen_tokens + n > self.config.state_manager.max_context:
+                continue
+            if self.state_manager.seq_capped(seq, n):
+                continue
+            pre = len(seq.block_table)
+            if not self.state_manager.extend(seq, n):
+                continue
+            gen = [int(pend) + 1]
+            acc = 0
+            for dj in d:  # draft j guesses the target just emitted
+                if dj == gen[-1]:
+                    gen.append(dj + 1)
+                    acc += 1
+                else:
+                    break
+            sched.apply_spec_round(uid, gen, pre)
+            out[uid] = np.asarray(gen, np.int32)
+            per_uid[uid] = (len(d), acc)
+            drafted_total += len(d)
+            accepted_total += acc
+        self.spec_rounds += 1 if out else 0
+        self.last_spec = {"drafted": drafted_total, "accepted": accepted_total,
+                          "per_uid": per_uid}
+        return out
+
+
+class ChainProposer:
+    """Oracle for the fake engine: drafts the last+1 continuation."""
+
+    def __init__(self):
+        self.seen_k = []
+
+    def propose(self, history, k):
+        self.seen_k.append(k)
+        last = int(history[-1])
+        return [last + 1 + i for i in range(k)]
+
+
+class JunkProposer:
+    """Never-accepted drafts (tokens far outside any chain)."""
+
+    def propose(self, history, k):
+        return [10**9 + i for i in range(k)]
+
+
+def _run_driver(engine, proposer, n_req=3, max_new=24, spec_k=4, spec=None):
+    driver = ServingDriver(engine, spec_k=spec_k, proposer=proposer).start()
+    prompts = [np.arange(1 + 100 * i, 5 + 100 * i, dtype=np.int32)
+               for i in range(n_req)]
+    reqs = [driver.submit(p, params=SamplingParams(
+        max_new_tokens=max_new, ignore_eos=True, spec=spec)) for p in prompts]
+    for r in reqs:
+        assert r.wait(30), f"request {r.uid} did not finish"
+    metrics = driver.metrics.snapshot()
+    health = driver.health()
+    driver.shutdown()
+    for r, p in zip(reqs, prompts):
+        expect = [int(p[-1]) + 1 + i for i in range(max_new)]
+        assert r.generated == expect, f"uid {r.uid} stream corrupted"
+    return reqs, metrics, health
+
+
+class TestDriverSpecPath:
+    def test_oracle_drafts_accepted_and_metered(self):
+        eng = FakeSpecEngine()
+        prop = ChainProposer()
+        reqs, metrics, health = _run_driver(eng, prop, max_new=24, spec_k=4)
+        # near-perfect acceptance: far fewer verify rounds than tokens
+        assert eng.spec_rounds > 0
+        assert eng.spec_rounds * 5 <= 24 * 3 + 15
+        assert metrics["spec_accepted_tokens_total"] > 0
+        assert metrics["spec_draft_tokens_total"] >= metrics["spec_accepted_tokens_total"]
+        assert health["spec"]["enabled"] and health["spec"]["k"] == 4
+        assert health["spec"]["acceptance_rate"] > 0.8
+        # KV fully released after all requests finished
+        acct = eng.state_manager.kv_block_accounting()
+        assert acct["free"] == acct["total"]
+
+    def test_junk_drafts_fall_back_to_plain_decode(self):
+        eng = FakeSpecEngine()
+        reqs, metrics, health = _run_driver(eng, JunkProposer(), max_new=40, spec_k=4)
+        # the controller's cooldown must suppress most verify rounds:
+        # without fallback there would be ~40 all-rejected rounds
+        assert eng.spec_rounds < 20
+        assert eng.plain_steps > 0
+        assert metrics["spec_accepted_tokens_total"] == 0
+        acct = eng.state_manager.kv_block_accounting()
+        assert acct["free"] == acct["total"]
+
+    def test_per_request_opt_out(self):
+        eng = FakeSpecEngine()
+        _run_driver(eng, ChainProposer(), max_new=16, spec_k=4,
+                    spec=SpecParams(enabled=False))
+        assert eng.spec_rounds == 0
+
+    def test_per_request_k_cap(self):
+        eng = FakeSpecEngine()
+        prop = ChainProposer()
+        _run_driver(eng, prop, max_new=16, spec_k=4, spec=SpecParams(k=2))
+        assert prop.seen_k and max(prop.seen_k) <= 2
+
+    def test_spec_dict_coercion_and_validation(self):
+        p = SamplingParams(max_new_tokens=4, spec={"enabled": True, "k": 3})
+        assert isinstance(p.spec, SpecParams) and p.spec.k == 3
+        with pytest.raises(ValueError):
+            SamplingParams(max_new_tokens=4, spec={"k": -1})
+
+
+# ---------------------------------------------------------------------------
+# streaming: bursts + stable-prefix incremental detokenization
+# ---------------------------------------------------------------------------
+class ByteTokenizer:
+    """Token id == byte value; decode is UTF-8 with replacement — the
+    byte-level-BPE shape that makes naive streaming emit U+FFFD."""
+
+    def decode(self, ids):
+        return bytes(int(i) for i in ids).decode("utf-8", errors="replace")
+
+
+class TestStreamingBursts:
+    def test_put_many_delivers_in_order(self):
+        s = TokenStream(uid=0)
+        got = []
+        t = threading.Thread(target=lambda: got.extend(s))
+        t.start()
+        s.put(1)
+        s.put_many([2, 3, 4])
+        s.close("done")
+        t.join(5)
+        assert got == [1, 2, 3, 4]
+
+    def test_put_many_after_close_dropped(self):
+        s = TokenStream(uid=0)
+        s.close("done")
+        s.put_many([1, 2])
+        assert list(s) == []
+
+    def test_stable_prefix_not_withheld(self):
+        # burst completes "ab" then starts a 2-byte char: the completed text
+        # must stream NOW, only the partial tail is held back
+        d = IncrementalDetokenizer(ByteTokenizer())
+        assert d.push_many([ord("a"), ord("b"), 0xC3]) == "ab"
+        assert d.push(0xA9) == "é"
+
+    def test_split_codepoint_across_pushes(self):
+        d = IncrementalDetokenizer(ByteTokenizer())
+        assert d.push(0xE2) == ""  # first byte of "€" (E2 82 AC)
+        assert d.push(0x82) == ""
+        assert d.push(0xAC) == "€"
+
+    def test_flush_emits_trailing_replacement(self):
+        d = IncrementalDetokenizer(ByteTokenizer())
+        assert d.push(ord("x")) == "x"
+        assert d.push(0xC3) == ""  # dangling lead byte at end of stream
+        assert d.flush() == "�"
+
+    def test_burst_multiple_codepoints(self):
+        d = IncrementalDetokenizer(ByteTokenizer())
+        piece = d.push_many(list("héllo".encode("utf-8")))
+        assert piece == "héllo"
+
+
+# ---------------------------------------------------------------------------
+# real-engine parity: spec-on output is bit-identical to spec-off
+# ---------------------------------------------------------------------------
+jax = pytest.importorskip("jax")
+
+
+def _tiny_engine(greedy=True, vocab=64, seed=7):
+    from deepspeed_tpu.inference.config import RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.models import TransformerConfig, init_params
+
+    cfg = TransformerConfig(vocab_size=vocab, hidden_size=128, n_layers=2,
+                            n_heads=4, max_seq_len=512, dtype="float32")
+    params = init_params(cfg, jax.random.key(0))
+    rc = RaggedInferenceEngineConfig.from_dict({
+        "dtype": "float32",
+        "greedy": greedy, "temperature": 0.9, "top_k": 0, "top_p": 0.0,
+        "seed": seed,
+        "kv_cache": {"block_size": 4, "num_blocks": 128, "max_blocks_per_seq": 32},
+        "state_manager": {"max_tracked_sequences": 16,
+                          "max_ragged_batch_size": 256,
+                          "max_ragged_sequence_count": 4, "max_context": 256},
+    })
+    return cfg, InferenceEngineV2(cfg, params, rc)
+
+
+def _spec_generate(eng, prompts, max_new, k=4):
+    """Drive prefill per-step, then decode exclusively via spec_round with
+    n-gram drafts; returns (outputs incl. prompt, stats)."""
+    prop = NgramProposer(max_ngram=3, min_ngram=1)
+    sched = eng.scheduler
+    uids = list(range(len(prompts)))
+    for uid, p in zip(uids, prompts):
+        sched.submit(uid, p)
+    outputs = {u: [] for u in uids}
+    remaining = {u: max_new for u in uids}
+
+    def land(uid, tok):
+        outputs[uid].append(int(tok))
+        remaining[uid] -= 1
+        if remaining[uid] <= 0:
+            sched.finish(uid)
+        else:
+            sched.feedback(uid, int(tok))
+
+    while sched.has_pending():
+        for uid, tok in eng.step_tokens().items():
+            land(uid, tok)
+    stats = {"drafted": 0, "accepted": 0, "rounds": 0}
+    while sched.has_work():
+        drafts = {}
+        for uid in sched.running_uids():
+            seq = eng.state_manager.get_sequence(uid)
+            drafts[uid] = prop.propose(seq.tokens, k)
+        res = eng.spec_round(k, drafts=drafts)
+        if not res:
+            for uid, tok in eng.step_tokens().items():
+                land(uid, tok)
+            continue
+        stats["rounds"] += 1
+        stats["drafted"] += eng.last_spec["drafted"]
+        stats["accepted"] += eng.last_spec["accepted"]
+        for uid, gen in res.items():
+            take = [int(t) for t in gen][: remaining[uid]]
+            outputs[uid].extend(take)
+            remaining[uid] -= len(take)
+            if remaining[uid] <= 0:
+                sched.finish(uid)
+    outs = [np.asarray(list(np.asarray(p, np.int32)) + outputs[u], np.int32)
+            for u, p in zip(uids, prompts)]
+    return outs, stats
+
+
+def _parity_prompts(vocab):
+    rng = np.random.default_rng(3)
+    motif = rng.integers(1, vocab, size=(6,)).astype(np.int32)
+    return [
+        np.tile(motif, 5),  # repetitive: the n-gram drafter scores here
+        rng.integers(1, vocab, size=(17,)).astype(np.int32),
+        np.concatenate([rng.integers(1, vocab, size=(8,)).astype(np.int32),
+                        motif, motif]),
+    ]
+
+
+class TestEngineVerifyParity:
+    def test_greedy_bit_identical_with_acceptances(self):
+        cfg, eng = _tiny_engine(greedy=True)
+        prompts = _parity_prompts(cfg.vocab_size)
+        ref = eng.generate(prompts, max_new_tokens=24)
+        spec, stats = _spec_generate(eng, prompts, 24, k=4)
+        for i, (a, b) in enumerate(zip(ref, spec)):
+            assert np.array_equal(a, b), f"row {i}: spec diverged from plain decode"
+        assert stats["accepted"] > 0, "workload produced no acceptances"
+        assert stats["drafted"] > stats["accepted"], "rollback never exercised"
+        acct = eng.state_manager.kv_block_accounting()
+        assert acct["free"] == acct["total"], f"leaked KV blocks: {acct}"
+
+    def test_sampled_bit_identical_under_heavy_rejection(self):
+        # temperature sampling on a random model rejects nearly every n-gram
+        # draft — the heaviest possible rollback traffic. Parity + pool
+        # conservation are the assertions; acceptance is not required.
+        cfg, eng = _tiny_engine(greedy=False)
+        prompts = _parity_prompts(cfg.vocab_size)
+        ref = eng.generate(prompts, max_new_tokens=24)
+        spec, stats = _spec_generate(eng, prompts, 24, k=4)
+        for i, (a, b) in enumerate(zip(ref, spec)):
+            assert np.array_equal(a, b), f"row {i}: sampled spec diverged"
+        assert stats["drafted"] > 0
+        acct = eng.state_manager.kv_block_accounting()
+        assert acct["free"] == acct["total"], f"leaked KV blocks: {acct}"
+
+    def test_spec_round_rejects_pending_prefill(self):
+        cfg, eng = _tiny_engine()
+        eng.scheduler.submit(0, np.arange(1, 9, dtype=np.int32))
+        with pytest.raises(RuntimeError, match="pending"):
+            eng.spec_round(4, drafts={})
+
+    def test_spec_round_requires_positive_k(self):
+        cfg, eng = _tiny_engine()
+        with pytest.raises(ValueError):
+            eng.spec_round(0, drafts={})
+
+
+class TestDriverRealEngineSpec:
+    def test_streams_identical_with_and_without_spec(self):
+        from deepspeed_tpu.inference.config import RaggedInferenceEngineConfig
+        from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+        from deepspeed_tpu.models import TransformerConfig, init_params
+
+        cfg = TransformerConfig(vocab_size=64, hidden_size=128, n_layers=2,
+                                n_heads=4, max_seq_len=512, dtype="float32")
+        params = init_params(cfg, jax.random.key(0))
+
+        def run(spec_k):
+            rc = RaggedInferenceEngineConfig.from_dict({
+                "dtype": "float32", "spec_k": spec_k,
+                "kv_cache": {"block_size": 16, "num_blocks": 128,
+                             "max_blocks_per_seq": 16},
+                "state_manager": {"max_tracked_sequences": 16,
+                                  "max_ragged_batch_size": 96,
+                                  "max_ragged_sequence_count": 8,
+                                  "max_context": 256},
+            })
+            eng = InferenceEngineV2(cfg, params, rc)
+            # driver inherits spec_k from the engine config
+            driver = ServingDriver(eng).start()
+            rng = np.random.default_rng(0)
+            prompts = [rng.integers(0, 64, size=(10,)).astype(np.int32)
+                       for _ in range(3)]
+            reqs = [driver.submit(p, SamplingParams(max_new_tokens=32,
+                                                    ignore_eos=True))
+                    for p in prompts]
+            for r in reqs:
+                assert r.wait(120)
+            health = driver.health()
+            driver.shutdown()
+            return [list(r.generated) for r in reqs], health
+
+        off, h_off = run(0)
+        on, h_on = run(4)
+        assert off == on, "spec-on serving stream differs from spec-off"
+        assert not h_off["spec"]["enabled"]
+        assert h_on["spec"]["enabled"]
+        assert h_on["spec"]["rounds"] > 0
+        assert h_on["spec"]["draft_tokens"] > 0

@@ -22,6 +22,10 @@ python -m pytest tests/ -q -m smoke -p no:cacheprovider
 echo "== prefix-cache suite =="
 python -m pytest tests/unit/test_prefix_cache.py -q -p no:cacheprovider
 
+echo "== speculative-decode parity gate =="
+# bit-identical spec-on vs spec-off (greedy + sampled) and KV rollback
+python -m pytest tests/unit/test_spec_decode.py -q -p no:cacheprovider
+
 echo "== donation/recompile verifier (Tier B) =="
 ./bin/dstpu lint --verify
 
