@@ -509,6 +509,100 @@ def bench_spec_ab(spec_k=None, cfg=None, params=None, seed=0):
     }
 
 
+def bench_kv_dtype_ab(cfg=None, params=None, seed=0):
+    """Int8-KV A/B (riding ``--serving-load`` via the DSTPU_KV_DTYPE=int8
+    env knob): two identical serving stacks sized from the SAME KV byte
+    budget — once with bf16 payload blocks, once with int8 payloads +
+    per-vector fp32 scale planes (``kv_cache_dtype: int8``). The budget is
+    held fixed, so the int8 stack admits ~2x the blocks (2d/(d+4) of the
+    head dim); the report carries the realized block counts, decode tok/s,
+    and an output-closeness check: per-token agreement between the two
+    greedy streams must stay above 0.8 (a broken dequant produces garbage
+    and trips it; genuine int8 rounding on these tiny models measures at
+    or near 1.0). Knobs: DSTPU_KV_DTYPE (int8 enables), DSTPU_KV_N
+    (requests), DSTPU_KV_MAX_NEW (tokens per request)."""
+    from deepspeed_tpu.inference.config import RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.inference.v2.kv_pool import blocks_for_budget, bytes_per_block
+    from deepspeed_tpu.models import TransformerConfig, init_params
+    from deepspeed_tpu.serving.driver import ServingDriver
+    from deepspeed_tpu.serving.request import SamplingParams
+
+    n_requests = int(os.environ.get("DSTPU_KV_N", 4))
+    max_new = int(os.environ.get("DSTPU_KV_MAX_NEW", 48))
+    if cfg is None:
+        cfg = TransformerConfig(
+            vocab_size=256, hidden_size=256, n_layers=2, n_heads=4,
+            n_kv_heads=2, max_seq_len=512, dtype="float32",
+        )
+        params = init_params(cfg, jax.random.key(0))
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, cfg.vocab_size,
+                            size=(int(rng.integers(8, 24)),)).astype(np.int32)
+               for _ in range(n_requests)]
+    # the shared budget: what a 256-block bf16 pool costs at this shape
+    block_size = 16
+    per_bf16 = bytes_per_block(block_size, cfg.kv_heads, cfg.head_dim,
+                               cfg.n_layers, "bf16")
+    budget = (256 + 1) * per_bf16
+
+    def run(kv_dtype):
+        nb = blocks_for_budget(budget, block_size, cfg.kv_heads, cfg.head_dim,
+                               cfg.n_layers, kv_dtype)
+        rc = RaggedInferenceEngineConfig.from_dict({
+            "dtype": cfg.dtype,
+            "kv_cache": {"block_size": block_size, "num_blocks": nb,
+                         "max_blocks_per_seq": 16, "kv_cache_dtype": kv_dtype},
+            "state_manager": {"max_tracked_sequences": 64,
+                              "max_ragged_batch_size": 96,
+                              "max_ragged_sequence_count": 16,
+                              "max_context": 256},
+        })
+        engine = InferenceEngineV2(cfg, params, rc)
+        driver = ServingDriver(engine, max_queue=n_requests + 1).start()
+        warm = driver.submit(prompts[0], params=SamplingParams(
+            max_new_tokens=8, ignore_eos=True))
+        warm.wait(300)
+        t0 = time.perf_counter()
+        reqs = [driver.submit(p, params=SamplingParams(
+            max_new_tokens=max_new, ignore_eos=True)) for p in prompts]
+        for r in reqs:
+            r.wait(600)
+        wall = time.perf_counter() - t0
+        info = engine.kv_pool_info()
+        driver.shutdown(drain=True, timeout=60)
+        toks = sum(len(r.generated) for r in reqs if r.state == "finished")
+        return {
+            "num_blocks": nb,
+            "kv_pool_bytes": info["kv_pool_bytes"],
+            "tok_s": toks / wall if wall > 0 else 0.0,
+            "outputs": [list(r.generated) for r in reqs],
+        }
+
+    base = run("bf16")
+    quant = run("int8")
+    agree = [
+        float(np.mean([a == b for a, b in zip(x, y)])) if x and y else 0.0
+        for x, y in zip(base["outputs"], quant["outputs"])
+    ]
+    agreement = float(np.mean(agree)) if agree else 0.0
+    if agreement < 0.8:
+        raise RuntimeError(
+            f"int8-KV A/B output agreement {agreement:.2f} < 0.8: dequant is "
+            "broken, not merely rounding"
+        )
+    return {
+        "budget_bytes": budget,
+        "bf16_blocks": base["num_blocks"],
+        "int8_blocks": quant["num_blocks"],
+        "capacity_multiplier": round(quant["num_blocks"] / base["num_blocks"], 3),
+        "bf16_tok_s": round(base["tok_s"], 1),
+        "int8_tok_s": round(quant["tok_s"], 1),
+        "output_agreement": round(agreement, 4),
+        "outputs_identical": base["outputs"] == quant["outputs"],
+    }
+
+
 def bench_serving_load(
     n_requests=None, rate_rps=None, max_new=None, slo_e2e_s=None,
     cfg=None, params=None, seed=0,
@@ -644,6 +738,11 @@ def bench_serving_load(
     spec_k_env = int(os.environ.get("DSTPU_SPEC_K", 0))
     if spec_k_env > 0:
         spec_report = {"spec": bench_spec_ab(spec_k=spec_k_env, seed=seed)}
+    # int8-KV A/B rider: DSTPU_KV_DTYPE=int8 appends a fixed-byte-budget
+    # capacity + throughput + output-closeness comparison vs bf16 pools
+    kv_report = {}
+    if os.environ.get("DSTPU_KV_DTYPE", "") == "int8":
+        kv_report = {"kv_int8": bench_kv_dtype_ab(seed=seed)}
     return {
         "mode": "serving_load",
         "n_requests": n_requests,
@@ -660,6 +759,7 @@ def bench_serving_load(
         "throughput_tok_s": round(sum(len(r.generated) for r in done) / wall, 1),
         **prefix_report,
         **spec_report,
+        **kv_report,
     }
 
 

@@ -194,7 +194,7 @@ def _capture_builder(obj, attr: str, store: dict, key: str):
     setattr(obj, attr, build)
 
 
-def _tiny_v2_engine(decode_steps: int = 2):
+def _tiny_v2_engine(decode_steps: int = 2, kv_dtype: str = "bf16"):
     import jax
 
     from deepspeed_tpu.inference.config import RaggedInferenceEngineConfig
@@ -206,7 +206,8 @@ def _tiny_v2_engine(decode_steps: int = 2):
     rc = RaggedInferenceEngineConfig.from_dict({
         "dtype": "float32",
         "decode_steps": decode_steps,
-        "kv_cache": {"block_size": 4, "num_blocks": 128, "max_blocks_per_seq": 32},
+        "kv_cache": {"block_size": 4, "num_blocks": 128, "max_blocks_per_seq": 32,
+                     "kv_cache_dtype": kv_dtype},
         "state_manager": {"max_tracked_sequences": 16,
                           "max_ragged_batch_size": 256,
                           "max_ragged_sequence_count": 4, "max_context": 256},
@@ -214,12 +215,18 @@ def _tiny_v2_engine(decode_steps: int = 2):
     return cfg, InferenceEngineV2(cfg, params, rc)
 
 
-def verify_engine_v2() -> List[CheckResult]:
+def _engine_v2_pass(kv_dtype: str) -> List[CheckResult]:
+    """One donation/recompile sweep over the v2 serving programs for a pool
+    payload dtype. int8 mode appends the fp32 scale planes as donated
+    trailing args on every step — the exact new-leaf case where a wrong
+    variadic index would silently copy a full plane per step, so both
+    dtypes get the full sweep."""
     import jax.numpy as jnp
     import numpy as np
 
+    tag = "" if kv_dtype == "bf16" else f"[{kv_dtype}]"
     results: List[CheckResult] = []
-    cfg, eng = _tiny_v2_engine()
+    cfg, eng = _tiny_v2_engine(kv_dtype=kv_dtype)
     captured: dict = {}
     _capture_builder(eng, "_build_split_step", captured, "split_step")
     _capture_builder(eng, "_build_multistep_decode", captured, "multistep_decode")
@@ -233,8 +240,8 @@ def verify_engine_v2() -> List[CheckResult]:
     eng.generate(prompts(0), max_new_tokens=6)
     eng.generate(prompts(1), max_new_tokens=6)
 
-    for key, label in (("split_step", "engine_v2.split_step"),
-                       ("multistep_decode", "engine_v2.multistep_decode")):
+    for key, label in (("split_step", f"engine_v2.split_step{tag}"),
+                       ("multistep_decode", f"engine_v2.multistep_decode{tag}")):
         if key not in captured:
             results.append(CheckResult(label, "donation", False,
                                        "entry point never executed in harness"))
@@ -243,19 +250,21 @@ def verify_engine_v2() -> List[CheckResult]:
         results.append(check_donation(label, fn, args))
         results.append(check_recompile(label, fn))
 
-    # row step (per-row baseline path): lower directly with config shapes
+    # row step (per-row baseline path): lower directly with config shapes.
+    # int8 pools have no per-row path (it raises), so bf16 only.
     kv = eng.config.kv_cache
-    fn = eng._build_row_step(8)
-    row_args = (
-        eng.params,
-        jnp.zeros((1, 8), jnp.int32),
-        jnp.int32(0),
-        jnp.int32(8),
-        jnp.zeros((kv.max_blocks_per_seq,), jnp.int32),
-        eng._k_cache,
-        eng._v_cache,
-    )
-    results.append(check_donation("engine_v2.row_step", fn, row_args))
+    if kv_dtype == "bf16":
+        fn = eng._build_row_step(8)
+        row_args = (
+            eng.params,
+            jnp.zeros((1, 8), jnp.int32),
+            jnp.int32(0),
+            jnp.int32(8),
+            jnp.zeros((kv.max_blocks_per_seq,), jnp.int32),
+            eng._k_cache,
+            eng._v_cache,
+        )
+        results.append(check_donation("engine_v2.row_step", fn, row_args))
 
     # speculative verify step (serving/spec): the K+1-token draft-and-verify
     # program declares both KV pools donated — without aliasing, every spec
@@ -276,9 +285,15 @@ def verify_engine_v2() -> List[CheckResult]:
         jnp.float32(1.0),
         eng._k_cache,
         eng._v_cache,
-    )
-    results.append(check_donation("engine_v2.verify_step", fn, verify_args))
+    ) + eng._scale_args()
+    results.append(check_donation(f"engine_v2.verify_step{tag}", fn, verify_args))
     return results
+
+
+def verify_engine_v2() -> List[CheckResult]:
+    # both pool payload dtypes: int8 adds donated scale-plane leaves to
+    # every serving program (split, multistep, verify)
+    return _engine_v2_pass("bf16") + _engine_v2_pass("int8")
 
 
 def verify_streamed_adam() -> List[CheckResult]:

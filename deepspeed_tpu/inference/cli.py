@@ -162,6 +162,18 @@ def serve_parse_args(argv=None):
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel ways")
     p.add_argument("--block-size", type=int, default=128)
     p.add_argument("--num-blocks", type=int, default=512, help="KV pool size")
+    p.add_argument("--kv-cache-dtype", default="bf16", choices=("bf16", "int8"),
+                   help="KV pool payload dtype: int8 quantizes blocks on "
+                   "write (per-vector scales, in-kernel dequant) — about "
+                   "half the HBM per block, so ~2x blocks per byte budget")
+    p.add_argument("--kv-pool-bytes", type=int, default=0,
+                   help="size the KV pool from an HBM byte budget instead "
+                   "of --num-blocks (the dtype-aware capacity lever: the "
+                   "same budget holds ~2x blocks under int8)")
+    p.add_argument("--paged-attention-impl", default="auto",
+                   choices=("auto", "kernel", "dense"),
+                   help="decode attention path: auto = Pallas kernel on "
+                   "TPU, dense XLA gather elsewhere")
     p.add_argument("--max-blocks-per-seq", type=int, default=32)
     p.add_argument("--max-context", type=int, default=4096)
     p.add_argument("--max-concurrent", type=int, default=64,
@@ -214,6 +226,18 @@ def build_serving_stack(args, cfg=None, params=None, tok=None):
         from deepspeed_tpu.parallel.topology import Topology, set_topology
 
         set_topology(Topology(model=args.tp, data=0))
+    kv_dtype = getattr(args, "kv_cache_dtype", "bf16")
+    num_blocks = args.num_blocks
+    if int(getattr(args, "kv_pool_bytes", 0) or 0):
+        # size the pool from a byte budget: under int8 the same budget
+        # holds ~2x blocks (kv_pool.bytes_per_block) — this is where the
+        # capacity multiplier reaches admission
+        from deepspeed_tpu.inference.v2.kv_pool import blocks_for_budget
+
+        num_blocks = blocks_for_budget(
+            int(args.kv_pool_bytes), args.block_size, cfg.kv_heads,
+            cfg.head_dim, cfg.n_layers, kv_dtype,
+        )
     rc = RaggedInferenceEngineConfig.from_dict({
         "dtype": args.dtype, "tp_size": args.tp,
         "decode_steps": args.decode_steps,
@@ -221,12 +245,14 @@ def build_serving_stack(args, cfg=None, params=None, tok=None):
         "top_k": args.top_k, "top_p": args.top_p, "seed": args.seed,
         "spec_k": getattr(args, "spec_k", 0),
         "spec_ngram": getattr(args, "spec_ngram", 3),
+        "paged_attention_impl": getattr(args, "paged_attention_impl", "auto"),
         "kv_cache": {
             "block_size": args.block_size,
-            "num_blocks": args.num_blocks,
+            "num_blocks": num_blocks,
             "max_blocks_per_seq": args.max_blocks_per_seq,
             "prefix_cache": not getattr(args, "no_prefix_cache", False),
             "prefix_cache_blocks": getattr(args, "prefix_cache_blocks", 0),
+            "kv_cache_dtype": kv_dtype,
         },
         "state_manager": {
             "max_tracked_sequences": args.max_concurrent,

@@ -76,6 +76,12 @@ class KVCacheConfig(DSConfigModel):
     # cap on trie-held blocks (0 = bounded only by the pool); evicting is
     # LRU over cached blocks no live sequence shares
     prefix_cache_blocks: int = 0
+    # pool payload dtype: "bf16" stores blocks in the engine compute dtype;
+    # "int8" stores quantized payloads + a per-vector fp32 scale plane
+    # (block_quant.quantize_kv) — roughly half the HBM per block, so ~2x
+    # blocks (admission / prefix-cache capacity) at a fixed byte budget.
+    # Dequantization happens inside the attention read (in-kernel on TPU).
+    kv_cache_dtype: str = "bf16"
 
 
 @dataclass
@@ -123,6 +129,11 @@ class RaggedInferenceEngineConfig(DSConfigModel):
     spec_k: int = 0
     # n-gram order cap for the default model-free draft proposer
     spec_ngram: int = 3
+    # decode-attention implementation: "auto" resolves to the Pallas paged
+    # kernel on TPU (kernel-tiled head dims, tp_size=1) and the dense XLA
+    # gather elsewhere; "kernel"/"dense" force a path; anything else raises
+    # at engine construction (no silent fallback)
+    paged_attention_impl: str = "auto"
     quant: QuantConfig = submodel(QuantConfig)
     kv_cache: Optional[KVCacheConfig] = submodel(KVCacheConfig)
     state_manager: Optional[StateManagerConfig] = submodel(StateManagerConfig)

@@ -159,16 +159,67 @@ class InferenceEngineV2:
             self._kv_sharding = NamedSharding(
                 self._mesh, P(None, None, None, MODEL_AXIS, None)
             )
+        # --- KV payload dtype + decode-attention impl (ISSUE 6): int8 pools
+        # store quantize_kv payloads + per-vector fp32 scale planes (half
+        # the HBM per block → ~2x blocks per byte budget, kv_pool.py);
+        # decode attention dispatches through paged_attention, with the
+        # Pallas kernel resolved on TPU and the dense gather elsewhere.
+        from deepspeed_tpu.inference.v2.kv_pool import _check_dtype
+
+        self._kv_dtype = _check_dtype(
+            str(getattr(kv, "kv_cache_dtype", "bf16") or "bf16")
+        )
+        self._kv_int8 = self._kv_dtype == "int8"
+        impl = str(getattr(self.config, "paged_attention_impl", "auto") or "auto")
+        if impl not in ("auto", "kernel", "dense"):
+            raise ValueError(
+                f"paged_attention_impl={impl!r}: expected 'auto', 'kernel' or "
+                "'dense' (a typo must not silently fall back to the gather "
+                "path — the seam that kept the kernel unreachable)"
+            )
+        backend = jax.default_backend()
+        if impl == "auto":
+            # tp>1 stays dense: the Pallas kernel is opaque to GSPMD and
+            # has no shard_map island; the gather shards on the kv-head dim
+            impl = "kernel" if (
+                backend == "tpu" and c.head_dim in (64, 128, 256)
+                and self._tp == 1
+            ) else "dense"
+        elif impl == "kernel" and self._tp > 1:
+            raise NotImplementedError(
+                "paged_attention_impl='kernel' with tp_size>1: the paged "
+                "kernel has no shard_map island yet — use 'auto' or 'dense'"
+            )
+        self._attn_impl = impl
         # +1 trash block: padded tail tokens of bucketed chunks scatter there
         # instead of corrupting block 0 (which belongs to a live sequence)
+        pool_dtype = jnp.int8 if self._kv_int8 else dtype
         shape = (c.n_layers, kv.num_blocks + 1, kv.block_size, c.kv_heads, c.head_dim)
+        sshape = shape[:-1]  # fp32 scale planes: one scalar per head vector
+        self._ks_cache = self._vs_cache = None
         if self._tp > 1:
-            zeros = jax.jit(lambda: jnp.zeros(shape, dtype), out_shardings=self._kv_sharding)
+            zeros = jax.jit(lambda: jnp.zeros(shape, pool_dtype), out_shardings=self._kv_sharding)
             self._k_cache = zeros()
             self._v_cache = zeros()
+            if self._kv_int8:
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as P
+                from deepspeed_tpu.parallel.topology import MODEL_AXIS
+
+                zeros_s = jax.jit(
+                    lambda: jnp.zeros(sshape, jnp.float32),
+                    out_shardings=NamedSharding(
+                        self._mesh, P(None, None, None, MODEL_AXIS)
+                    ),
+                )
+                self._ks_cache = zeros_s()
+                self._vs_cache = zeros_s()
         else:
-            self._k_cache = jnp.zeros(shape, dtype)
-            self._v_cache = jnp.zeros(shape, dtype)
+            self._k_cache = jnp.zeros(shape, pool_dtype)
+            self._v_cache = jnp.zeros(shape, pool_dtype)
+            if self._kv_int8:
+                self._ks_cache = jnp.zeros(sshape, jnp.float32)
+                self._vs_cache = jnp.zeros(sshape, jnp.float32)
         self._row_jit = {}
         self._split_jit = {}  # (tq bucket,) -> compiled split-phase step
         self._multistep_jit = None
@@ -186,7 +237,8 @@ class InferenceEngineV2:
         self.last_logprobs: Dict[int, np.ndarray] = {}
         log_dist(
             f"InferenceEngineV2: {kv.num_blocks} KV blocks × {kv.block_size} tokens, "
-            f"budget {self.config.state_manager.max_ragged_batch_size} tok/step"
+            f"budget {self.config.state_manager.max_ragged_batch_size} tok/step, "
+            f"kv={self._kv_dtype}, attn={self._attn_impl}"
             + (f", tp={self._tp}" if self._tp > 1 else "")
             + (", prefix_cache=on" if self.state_manager.prefix_cache is not None else ""),
             ranks=[0],
@@ -202,6 +254,30 @@ class InferenceEngineV2:
         program's pool gather (``pool_limit=chk_start``) reads the shared
         blocks' KV like any other context below the chunk."""
         return self.state_manager.prefix_cache
+
+    @property
+    def kv_cache_dtype(self) -> str:
+        """Pool payload dtype knob value: "bf16" (compute dtype) or "int8"."""
+        return self._kv_dtype
+
+    @property
+    def paged_attention_impl(self) -> str:
+        """The RESOLVED decode-attention impl ("kernel" or "dense")."""
+        return self._attn_impl
+
+    def kv_pool_info(self) -> Dict:
+        """Byte-accounting snapshot for health()/metrics: pool bytes,
+        bytes/block, dtype, capacity multiplier vs bf16 (kv_pool.describe),
+        plus the resolved attention impl."""
+        from deepspeed_tpu.inference.v2.kv_pool import describe
+
+        c, kv = self._mc, self.config.kv_cache
+        info = describe(
+            kv.num_blocks, kv.block_size, c.kv_heads, c.head_dim,
+            c.n_layers, self._kv_dtype,
+        )
+        info["paged_attention_impl"] = self._attn_impl
+        return info
 
     def set_sampling(self, greedy=None, temperature=None, top_k=None,
                      top_p=None, seed=None):
@@ -249,6 +325,12 @@ class InferenceEngineV2:
 
     # ------------------------------------------------------------------
     def _build_row_step(self, t_bucket: int):
+        if self._kv_int8:
+            raise NotImplementedError(
+                "_step_per_row: int8 KV pools run only through the batched "
+                "step — the legacy per-row step gathers raw pool payloads "
+                "and would attend over quantized integers"
+            )
         c = self._mc
         kv = self.config.kv_cache
         bs = kv.block_size
@@ -356,41 +438,78 @@ class InferenceEngineV2:
         shape = (L * NBp, kv.block_size, c.kv_heads, c.head_dim)
         return k_cache.reshape(shape), v_cache.reshape(shape)
 
+    def _scale_views(self, ks_cache, vs_cache):
+        """Flat views [L*NBp, bs, nkv] of the int8 pools' fp32 scale planes
+        (same layer-offset indexing as _pool_views)."""
+        c = self._mc
+        kv = self.config.kv_cache
+        L, NBp = c.n_layers, kv.num_blocks + 1
+        shape = (L * NBp, kv.block_size, c.kv_heads)
+        return ks_cache.reshape(shape), vs_cache.reshape(shape)
+
+    def _scale_args(self):
+        """Variadic trailing scale-plane args for the serving jits: the
+        int8 planes, or nothing in bf16 mode — bf16 signatures and
+        donation indices stay exactly as before."""
+        return (self._ks_cache, self._vs_cache) if self._kv_int8 else ()
+
     def _attn_decode(self, q, k_pool, v_pool, tables_l, positions, window,
-                     trash_l, extra_kv=None, pool_limit=None):
+                     trash_l, extra_kv=None, pool_limit=None, k_scale=None,
+                     v_scale=None):
         """Decode attention: one token per row, per-ROW layer-offset tables
-        [R, B] into the flat pools — the dense XLA gather+einsum form (the
-        grid kernels lost the in-engine A/B: ~9 us/program launch overhead;
-        PERF.md serving roofline). GSPMD shards it (pool on the kv-head
-        dim) without a shard_map island. ``extra_kv``/``pool_limit``: the
-        write-after-read protocol (this step's K/V ride alongside instead
-        of a scatter-then-gather that copies the pool)."""
-        from deepspeed_tpu.ops.attention.paged_pallas import (
-            paged_decode_attention_dense,
-        )
+        [R, B] into the flat pools, dispatched through ``paged_attention``
+        with the impl resolved at engine init — the (T, B)-grid Pallas
+        kernel on TPU (scalar-prefetched block DMA; int8 pools dequantize
+        in-VMEM behind the halved HBM reads), the dense XLA gather+einsum
+        as ``impl="dense"`` (GSPMD shards it on the kv-head dim without a
+        shard_map island, and it wins at CPU/tp shapes).
+        ``extra_kv``/``pool_limit``: the write-after-read protocol (this
+        step's K/V ride alongside instead of a scatter-then-gather that
+        copies the pool). ``k_scale``/``v_scale``: flat int8 dequant
+        planes (_scale_views)."""
+        from deepspeed_tpu.ops.attention.paged_pallas import paged_attention
 
         c = self._mc
-        return paged_decode_attention_dense(
+        return paged_attention(
             q, k_pool, v_pool, tables_l, positions, trash_l,
+            impl=self._attn_impl,
             window=int(window), scale=c.attn_scale,
+            k_scale=k_scale, v_scale=v_scale,
             extra_kv=extra_kv, pool_limit=pool_limit,
         )
 
-    def _scatter_kv(self, k_cache, v_cache, li, blk, row, k, v):
+    def _scatter_kv(self, k_cache, v_cache, li, blk, row, k, v, scales=None):
         """Write the new tokens' K/V into the carried caches via ONE
         single-dimension scatter on a flat slot view [L*NBp*bs, nkv, d] —
         XLA applies it in place on the donated carry. The earlier
         scan-over-layers form (caches as scan xs/ys, per-layer
         advanced-index scatter) copied the 200 MB layer slice per
-        layer-step and dominated the decode round (PERF.md)."""
+        layer-step and dominated the decode round (PERF.md).
+
+        ``scales`` = (ks_cache, vs_cache) in int8 mode: the new K/V
+        quantize on write (block_quant.quantize_kv, per head vector — the
+        granularity that needs no read-modify-write of neighbor slots) and
+        the fp32 scales scatter through the same slot ids. Returns the
+        carry-shaped cache tuple (2 or 4 leaves)."""
         c = self._mc
         kv = self.config.kv_cache
         L, NBp, bs = c.n_layers, kv.num_blocks + 1, kv.block_size
         nkv, d = c.kv_heads, c.head_dim
         shape = k_cache.shape
         slot = (li * NBp + blk) * bs + row
+        if scales:
+            from deepspeed_tpu.ops.quantizer.block_quant import quantize_kv
+
+            k, sk = quantize_kv(k)
+            v, sv = quantize_kv(v)
+            ks_cache, vs_cache = scales
+            sshape = ks_cache.shape
+            ks_cache = ks_cache.reshape(L * NBp * bs, nkv).at[slot].set(sk).reshape(sshape)
+            vs_cache = vs_cache.reshape(L * NBp * bs, nkv).at[slot].set(sv).reshape(sshape)
         k_cache = k_cache.reshape(L * NBp * bs, nkv, d).at[slot].set(k).reshape(shape)
         v_cache = v_cache.reshape(L * NBp * bs, nkv, d).at[slot].set(v).reshape(shape)
+        if scales:
+            return k_cache, v_cache, ks_cache, vs_cache
         return k_cache, v_cache
 
     def _layer_windows(self):
@@ -485,7 +604,7 @@ class InferenceEngineV2:
         the chunk start). The pool is gathered BEFORE the write and the
         scatter is write-only — a scatter-then-gather made XLA copy the
         full cache per layer-step (PERF.md serving roofline)."""
-        k_cache, v_cache = carry
+        k_cache, v_cache = carry[0], carry[1]
         c = self._mc
         kv = self.config.kv_cache
         NBp = kv.num_blocks + 1
@@ -499,6 +618,8 @@ class InferenceEngineV2:
         # after any layer's scatter would force XLA to copy the pool per
         # layer (cross-layer read-after-write on one buffer)
         k_pool, v_pool = meta["k_pool0"], meta["v_pool0"]
+        ks_pool = meta.get("ks_pool0")
+        vs_pool = meta.get("vs_pool0")
         from deepspeed_tpu.ops.attention.paged_pallas import paged_chunk_attention
 
         out_d = self._attn_decode(
@@ -506,6 +627,7 @@ class InferenceEngineV2:
             meta["dec_pos"], w, li * NBp + kv.num_blocks,
             extra_kv=(k[:R, None], v[:R, None], meta["dec_pos"][:, None]),
             pool_limit=meta["dec_pos"],
+            k_scale=ks_pool, v_scale=vs_pool,
         )
         out_c = paged_chunk_attention(
             q[R:].reshape(Rc, tq, nh, d), k_pool, v_pool,
@@ -514,12 +636,14 @@ class InferenceEngineV2:
             window=int(w), scale=c.attn_scale,
             new_kv=(k[R:].reshape(Rc, tq, nkv, d), v[R:].reshape(Rc, tq, nkv, d)),
             pool_limit=meta["chk_start"],
+            k_scale=ks_pool, v_scale=vs_pool,
         )
-        k_cache, v_cache = self._scatter_kv(
-            k_cache, v_cache, li, meta["blk"], meta["row"], k, v
+        caches = self._scatter_kv(
+            k_cache, v_cache, li, meta["blk"], meta["row"], k, v,
+            scales=carry[2:] or None,
         )
         out = jnp.concatenate([out_d, out_c.reshape(Rc * tq, nh, d)], axis=0)
-        return self._layer_tail(lp, x, out), (k_cache, v_cache)
+        return self._layer_tail(lp, x, out), caches
 
     def _build_split_step(self, tq: int):
         """ONE compiled step over the split-phase batch: R decode slots +
@@ -534,7 +658,7 @@ class InferenceEngineV2:
 
         def step(params, tokens, positions, blk, row, dec_tables, dec_pos,
                  dec_uids, chk_tables, chk_pos, chk_start, chk_last, chk_uids,
-                 rng, temperature, k_cache, v_cache):
+                 rng, temperature, k_cache, v_cache, *scales):
             x = T._scale_embed(params["embed"].astype(dtype)[tokens][None], c, dtype)
             if c.position == "learned":
                 x = x + params["pos_embed"][jnp.clip(positions, 0, c.max_seq_len - 1)][None]
@@ -552,12 +676,14 @@ class InferenceEngineV2:
                 "chk_start": chk_start,
                 "k_pool0": k_pool0, "v_pool0": v_pool0,
             }
+            if scales:
+                meta["ks_pool0"], meta["vs_pool0"] = self._scale_views(*scales)
 
             def layer_fn(lp, x, li, carry, window=None):
                 return self._split_layer(lp, x, li, meta, carry, window=window)
 
-            x, (k_new, v_new) = self._drive_layers(
-                layer_fn, params, x, (k_cache, v_cache)
+            x, caches = self._drive_layers(
+                layer_fn, params, x, (k_cache, v_cache) + tuple(scales)
             )
             x = T._norm(x, params["final_norm"], params.get("final_norm_b"), c.norm, c.norm_eps)
             dec_h = x[0, :R]  # [R, h]
@@ -588,13 +714,18 @@ class InferenceEngineV2:
             )
             return (
                 logits_dec.astype(jnp.float32), logits_chk.astype(jnp.float32),
-                toks_dec, toks_chk, k_new, v_new,
-            )
+                toks_dec, toks_chk,
+            ) + tuple(caches)
 
         # donate BOTH cache pools (args 15 and 16 — k_cache, v_cache) so the
         # scatter updates alias in place; donating 14 would hand XLA the
-        # scalar `temperature` instead of v_cache and copy a full V pool
-        return jax.jit(step, donate_argnums=(15, 16))
+        # scalar `temperature` instead of v_cache and copy a full V pool.
+        # int8 mode appends the scale planes (16 + 17/18) as variadic
+        # trailing args — bf16 signatures and donation indices stay
+        # unchanged, and no always-present-but-unused arg gets dropped
+        # (the Tier-B donation verifier flags dropped donated inputs).
+        donate = (15, 16, 17, 18) if self._kv_int8 else (15, 16)
+        return jax.jit(step, donate_argnums=donate)
 
     def _round_layer(self, lp, x, li, meta, carry, window=None):
         """One layer of one step of a fused decode ROUND: queries are the
@@ -603,7 +734,7 @@ class InferenceEngineV2:
         carried side buffers [L, R, n, nkv, d]. The pool scatter is
         write-only within the round, so XLA keeps the 2 GB carry in place;
         the side buffers are the (40 MB) read-write surface."""
-        side_k, side_v, k_cache, v_cache = carry
+        side_k, side_v, k_cache, v_cache = carry[:4]
         c = self._mc
         kv = self.config.kv_cache
         NBp = kv.num_blocks + 1
@@ -628,11 +759,13 @@ class InferenceEngineV2:
             meta["pos"], w, li * NBp + kv.num_blocks,
             extra_kv=(sk, sv, meta["epos"]),
             pool_limit=meta["pos0"],
+            k_scale=meta.get("ks_pool0"), v_scale=meta.get("vs_pool0"),
         )
-        k_cache, v_cache = self._scatter_kv(
-            k_cache, v_cache, li, meta["blk"], meta["row"], k, v
+        caches = self._scatter_kv(
+            k_cache, v_cache, li, meta["blk"], meta["row"], k, v,
+            scales=carry[4:] or None,
         )
-        return self._layer_tail(lp, x, out), (side_k, side_v, k_cache, v_cache)
+        return self._layer_tail(lp, x, out), (side_k, side_v) + caches
 
     def _build_multistep_decode(self, n_steps: int):
         """``n_steps`` greedy decode iterations in ONE device program, the
@@ -658,7 +791,7 @@ class InferenceEngineV2:
         L = c.n_layers
 
         def fused(params, tokens, positions, tables, uids, active, rng,
-                  temperature, k_cache, v_cache):
+                  temperature, k_cache, v_cache, *scales):
             tok_tables = jnp.where(active[:, None], tables, trash)
             pos0 = positions  # round-start positions (pool validity limit)
             nkv, d = c.kv_heads, c.head_dim
@@ -671,12 +804,15 @@ class InferenceEngineV2:
             # pool copy for the round's write chain instead of one per
             # layer-step
             k_pool0, v_pool0 = self._pool_views(k_cache, v_cache)
+            ks_pool0 = vs_pool0 = None
+            if scales:
+                ks_pool0, vs_pool0 = self._scale_views(*scales)
 
             from deepspeed_tpu.inference.sampling import row_keys, sample_tokens
 
             kw = self._sampling_kw()
 
-            def one_token(params, toks, pos, s, side_k, side_v, kc, vc):
+            def one_token(params, toks, pos, s, side_k, side_v, caches):
                 x = T._scale_embed(params["embed"].astype(dtype)[toks][None], c, dtype)
                 if c.position == "learned":
                     x = x + params["pos_embed"][jnp.clip(pos, 0, c.max_seq_len - 1)][None]
@@ -697,6 +833,7 @@ class InferenceEngineV2:
                     "pos0": jnp.where(active, pos0, 0),
                     "s": s, "epos": epos, "blk": blk, "row": row,
                     "k_pool0": k_pool0, "v_pool0": v_pool0,
+                    "ks_pool0": ks_pool0, "vs_pool0": vs_pool0,
                     # inactive rows carry position 0: exclude them from the
                     # rope live-length switch
                     "live": jnp.max(jnp.where(active, pos, 0)) + 1,
@@ -705,9 +842,10 @@ class InferenceEngineV2:
                 def layer_fn(lp, x, li, carry, window=None):
                     return self._round_layer(lp, x, li, meta, carry, window=window)
 
-                x, (side_k, side_v, kc, vc) = self._drive_layers(
-                    layer_fn, params, x, (side_k, side_v, kc, vc)
+                x, st = self._drive_layers(
+                    layer_fn, params, x, (side_k, side_v) + tuple(caches)
                 )
+                side_k, side_v, caches = st[0], st[1], st[2:]
                 x = T._norm(x, params["final_norm"], params.get("final_norm_b"), c.norm, c.norm_eps)
                 logits = T._apply_lm_head(params, x[0], c)  # [R, vocab]
                 # content-addressed per-row keys on (uid, source position):
@@ -719,27 +857,31 @@ class InferenceEngineV2:
                     row_keys(rng, uids, jnp.where(active, pos, -1)),
                     temperature=temperature, return_logprobs=True, **kw,
                 )
-                return nxt, logp, side_k, side_v, kc, vc
+                return nxt, logp, side_k, side_v, caches
 
             def step_fn(carry, s):
-                toks, pos, side_k, side_v, kc, vc = carry
-                nxt, logp, side_k, side_v, kc, vc = one_token(
-                    params, toks, pos, s, side_k, side_v, kc, vc
+                toks, pos, side_k, side_v = carry[:4]
+                nxt, logp, side_k, side_v, caches = one_token(
+                    params, toks, pos, s, side_k, side_v, carry[4:]
                 )
                 nxt = jnp.where(active, nxt, toks)  # inactive rows freeze
                 return (
-                    (nxt, pos + active.astype(jnp.int32), side_k, side_v, kc, vc),
+                    (nxt, pos + active.astype(jnp.int32), side_k, side_v)
+                    + tuple(caches),
                     (nxt, logp),
                 )
 
-            (_, _, _, _, kc, vc), (toks_out, logps_out) = jax.lax.scan(
+            final, (toks_out, logps_out) = jax.lax.scan(
                 step_fn,
-                (tokens, positions, side_k0, side_v0, k_cache, v_cache),
+                (tokens, positions, side_k0, side_v0, k_cache, v_cache)
+                + tuple(scales),
                 jnp.arange(n_steps, dtype=jnp.int32),
             )
-            return toks_out, logps_out, kc, vc  # [n_steps, R] each
+            # toks_out/logps_out: [n_steps, R]; tail = carried cache pools
+            return (toks_out, logps_out) + tuple(final[4:])
 
-        return jax.jit(fused, donate_argnums=(8, 9))
+        donate = (8, 9, 10, 11) if self._kv_int8 else (8, 9)
+        return jax.jit(fused, donate_argnums=donate)
 
     def decode_round(self, n_steps: Optional[int] = None) -> Dict[int, np.ndarray]:
         """One fused decode round: ``n_steps`` greedy tokens for every
@@ -795,7 +937,7 @@ class InferenceEngineV2:
         if self._multistep_jit is None or self._multistep_n != n:
             self._multistep_jit = self._build_multistep_decode(n)
             self._multistep_n = n
-        toks_out, logps_out, self._k_cache, self._v_cache = self._multistep_jit(
+        outs = self._multistep_jit(
             self.params,
             jnp.asarray(tokens),
             jnp.asarray(positions),
@@ -806,7 +948,11 @@ class InferenceEngineV2:
             jnp.float32(getattr(self.config, "temperature", 1.0) or 1.0),
             self._k_cache,
             self._v_cache,
+            *self._scale_args(),
         )
+        toks_out, logps_out, self._k_cache, self._v_cache = outs[:4]
+        if self._kv_int8:
+            self._ks_cache, self._vs_cache = outs[4], outs[5]
         toks_out = np.asarray(toks_out)  # [n, R]
         logps_out = np.asarray(logps_out)
         results: Dict[int, np.ndarray] = {}
@@ -856,7 +1002,7 @@ class InferenceEngineV2:
         K1 = k + 1
 
         def verify(params, tokens, positions0, tables, uids, active, n_input,
-                   rng, temperature, k_cache, v_cache):
+                   rng, temperature, k_cache, v_cache, *scales):
             nh, nkv, d = c.n_heads, c.kv_heads, c.head_dim
             tok_tables = jnp.where(active[:, None], tables, trash)
             j = jnp.arange(K1, dtype=jnp.int32)
@@ -881,26 +1027,61 @@ class InferenceEngineV2:
             # only (pool_limit), writes go through the donated carry —
             # the same write-after-read protocol as the split step
             k_pool0, v_pool0 = self._pool_views(k_cache, v_cache)
+            ks_pool0 = vs_pool0 = None
+            if scales:
+                ks_pool0, vs_pool0 = self._scale_views(*scales)
             pool_lim = jnp.where(active, positions0, 0)
             from deepspeed_tpu.ops.attention.paged_pallas import paged_chunk_attention
 
+            use_kernel = self._attn_impl == "kernel"
+            if use_kernel:
+                # flattened per-token form for paged_attention: every one of
+                # the row's K1 tokens carries the row's table/pool window,
+                # and the row's K1 fresh K/V ride as shared extra columns —
+                # the extras mask (epos >= 0) & (epos <= qpos) IS the
+                # in-chunk causal mask, so padded slots (qpos -1) see
+                # nothing and emit 0 like the chunk form
+                rep_tables = jnp.repeat(tok_tables, K1, axis=0)  # [R*K1, B]
+                rep_lim = jnp.repeat(pool_lim, K1)
+                qpos_flat = qpos.reshape(R * K1)
+                epos_flat = jnp.broadcast_to(
+                    qpos[:, None, :], (R, K1, K1)
+                ).reshape(R * K1, K1)
+
             def layer_fn(lp, x, li, carry, window=None):
-                kc, vc = carry
+                kc, vc = carry[0], carry[1]
                 w = c.sliding_window if window is None else window
                 lp = T._dequant_tree(lp, dtype)
                 _, q, k_, v_ = self._layer_qkv(lp, x, flat_pos, live)
-                out = paged_chunk_attention(
-                    q.reshape(R, K1, nh, d), k_pool0, v_pool0,
-                    li * NBp + tok_tables, qpos, li * NBp + trash,
-                    window=int(w), scale=c.attn_scale,
-                    new_kv=(k_.reshape(R, K1, nkv, d), v_.reshape(R, K1, nkv, d)),
-                    pool_limit=pool_lim,
+                if use_kernel:
+                    ke = jnp.broadcast_to(
+                        k_.reshape(R, 1, K1, nkv, d), (R, K1, K1, nkv, d)
+                    ).reshape(R * K1, K1, nkv, d)
+                    ve = jnp.broadcast_to(
+                        v_.reshape(R, 1, K1, nkv, d), (R, K1, K1, nkv, d)
+                    ).reshape(R * K1, K1, nkv, d)
+                    out = self._attn_decode(
+                        q, k_pool0, v_pool0, li * NBp + rep_tables,
+                        qpos_flat, w, li * NBp + trash,
+                        extra_kv=(ke, ve, epos_flat), pool_limit=rep_lim,
+                        k_scale=ks_pool0, v_scale=vs_pool0,
+                    )
+                else:
+                    out = paged_chunk_attention(
+                        q.reshape(R, K1, nh, d), k_pool0, v_pool0,
+                        li * NBp + tok_tables, qpos, li * NBp + trash,
+                        window=int(w), scale=c.attn_scale,
+                        new_kv=(k_.reshape(R, K1, nkv, d), v_.reshape(R, K1, nkv, d)),
+                        pool_limit=pool_lim,
+                        k_scale=ks_pool0, v_scale=vs_pool0,
+                    ).reshape(R * K1, nh, d)
+                caches = self._scatter_kv(
+                    kc, vc, li, blk, row, k_, v_, scales=carry[2:] or None
                 )
-                kc, vc = self._scatter_kv(kc, vc, li, blk, row, k_, v_)
-                return self._layer_tail(lp, x, out.reshape(R * K1, nh, d)), (kc, vc)
+                return self._layer_tail(lp, x, out.reshape(R * K1, nh, d)), caches
 
-            x, (k_new, v_new) = self._drive_layers(
-                layer_fn, params, x, (k_cache, v_cache)
+            x, caches = self._drive_layers(
+                layer_fn, params, x, (k_cache, v_cache) + tuple(scales)
             )
             x = T._norm(x, params["final_norm"], params.get("final_norm_b"), c.norm, c.norm_eps)
             logits = T._apply_lm_head(params, x[0], c)  # [R*K1, vocab]
@@ -918,11 +1099,13 @@ class InferenceEngineV2:
             match = (tokens[:, 1:] == tgt[:, :k]) & (jj[None] < (n_input - 1)[:, None])
             n_acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
             n_emit = jnp.where(active, n_acc + 1, 0)
-            return tgt, n_emit, logp, k_new, v_new
+            return (tgt, n_emit, logp) + tuple(caches)
 
         # donate BOTH cache pools (args 9 and 10 — k_cache, v_cache) so the
-        # verify scatter aliases in place like every other serving step
-        return jax.jit(verify, donate_argnums=(9, 10))
+        # verify scatter aliases in place like every other serving step;
+        # int8 appends the scale planes (11/12) variadically
+        donate = (9, 10, 11, 12) if self._kv_int8 else (9, 10)
+        return jax.jit(verify, donate_argnums=donate)
 
     def spec_round(self, k: Optional[int] = None, drafts=None) -> Dict[int, np.ndarray]:
         """One speculative draft-and-verify round over eligible RUNNING
@@ -1000,7 +1183,7 @@ class InferenceEngineV2:
             n_input[i] = 1 + len(d)
         if k not in self._verify_jit:
             self._verify_jit[k] = self._build_verify_step(k)
-        tgt, n_emit, logp, self._k_cache, self._v_cache = self._verify_jit[k](
+        outs = self._verify_jit[k](
             self.params,
             jnp.asarray(tokens),
             jnp.asarray(positions),
@@ -1012,7 +1195,11 @@ class InferenceEngineV2:
             jnp.float32(getattr(self.config, "temperature", 1.0) or 1.0),
             self._k_cache,
             self._v_cache,
+            *self._scale_args(),
         )
+        tgt, n_emit, logp, self._k_cache, self._v_cache = outs[:5]
+        if self._kv_int8:
+            self._ks_cache, self._vs_cache = outs[5], outs[6]
         tgt = np.asarray(tgt)
         n_emit = np.asarray(n_emit)
         logp = np.asarray(logp)
@@ -1153,8 +1340,7 @@ class InferenceEngineV2:
 
         if tq not in self._split_jit:
             self._split_jit[tq] = self._build_split_step(tq)
-        (logits_dec, logits_chk, toks_dec, toks_chk,
-         self._k_cache, self._v_cache) = self._split_jit[tq](
+        outs = self._split_jit[tq](
             self.params,
             jnp.asarray(tokens),
             jnp.asarray(positions),
@@ -1172,7 +1358,12 @@ class InferenceEngineV2:
             jnp.float32(getattr(self.config, "temperature", 1.0) or 1.0),
             self._k_cache,
             self._v_cache,
+            *self._scale_args(),
         )
+        (logits_dec, logits_chk, toks_dec, toks_chk,
+         self._k_cache, self._v_cache) = outs[:6]
+        if self._kv_int8:
+            self._ks_cache, self._vs_cache = outs[6], outs[7]
         # rows are referenced as (logits array, row index, greedy-token
         # array): slicing logits_dec[i] here would issue one tiny device op
         # per completed row per step — through a remote tunnel those

@@ -39,12 +39,15 @@ NEG_INF = -1e30
 
 
 def paged_attention_reference(q, k_cache, v_cache, block_tables, q_pos, trash_block,
-                              window: int = 0, scale=None):
+                              window: int = 0, scale=None, k_scale=None,
+                              v_scale=None):
     """jnp reference: per-token context gather + masked softmax, mapped over
     tokens so peak memory is one context window ([S, nkv, d]) rather than T
     of them. Shapes as module docstring; returns [T, nh, d]. ``window``:
     static sliding-window band over sequence positions (mistral/starcoder2;
-    band convention shared via core.window_too_far)."""
+    band convention shared via core.window_too_far). ``k_scale``/``v_scale``
+    [NB, bs, nkv]: per-vector fp32 dequant planes for an int8 pool
+    (block_quant.quantize_kv)."""
     T, nh, d = q.shape
     NB, bs, nkv, _ = k_cache.shape
     B = block_tables.shape[1]
@@ -56,6 +59,9 @@ def paged_attention_reference(q, k_cache, v_cache, block_tables, q_pos, trash_bl
         qt, bt, pos = args  # [nh, d], [B], scalar
         k_ctx = k_cache[bt].reshape(S, nkv, d).astype(jnp.float32)
         v_ctx = v_cache[bt].reshape(S, nkv, d).astype(jnp.float32)
+        if k_scale is not None:
+            k_ctx = k_ctx * k_scale[bt].reshape(S, nkv)[..., None]
+            v_ctx = v_ctx * v_scale[bt].reshape(S, nkv)[..., None]
         blk_valid = jnp.repeat(bt != trash_block, bs)
         mask = (kpos <= pos) & blk_valid  # [S]
         if window:
@@ -76,14 +82,42 @@ def paged_attention_reference(q, k_cache, v_cache, block_tables, q_pos, trash_bl
 
 
 def _paged_kernel(
-    bt_ref, qpos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, bs, nh, nkv, d,
-    trash, window=0, scale=None
+    *refs, bs, nh, nkv, d, B, E=0, window=0, scale=None, int8=False,
+    has_limit=False
 ):
+    """(T, B [+1])-grid kernel body. ``refs`` layout — scalar prefetch
+    (SMEM): bt [T, B], qpos [T], trash [1], limit [T] if ``has_limit`` —
+    then tensor blocks (VMEM): epos (1, E) if ``E``, q (1, nh, d),
+    k (1, bs, nkv, d), v, ks/vs scale planes (1, bs, nkv) if ``int8``,
+    ke/ve (1, E, nkv, d) if ``E`` — then o (1, nh, d) and the m/l/acc
+    flash scratch.
+
+    ``trash`` rides as a prefetch operand (not a static kwarg) because the
+    engine's flat multi-layer views use layer-offset trash ids — traced
+    values inside the fori_loop layer driver. ``E`` extra columns are this
+    step/round's NOT-YET-CACHED K/V (the write-after-read protocol), kept
+    in compute dtype — only the pool payload is int8; dequant happens here
+    in fp32 right after the halved-HBM block DMA, so the VPU multiply
+    hides under the transfer (the EQuARX argument applied to HBM)."""
+    it = iter(refs)
+    bt_ref, qpos_ref, trash_ref = next(it), next(it), next(it)
+    limit_ref = next(it) if has_limit else None
+    epos_ref = next(it) if E else None
+    q_ref, k_ref, v_ref = next(it), next(it), next(it)
+    ks_ref = next(it) if int8 else None
+    vs_ref = next(it) if int8 else None
+    ke_ref = next(it) if E else None
+    ve_ref = next(it) if E else None
+    o_ref = next(it)
+    m_scr, l_scr, acc_scr = next(it), next(it), next(it)
+
     t = pl.program_id(0)
     j = pl.program_id(1)
-    B = pl.num_programs(1)
+    nj = pl.num_programs(1)
     group = nh // nkv
     scale = scale if scale is not None else d**-0.5
+    trash = trash_ref[0]
+    qpos = qpos_ref[t]
 
     @pl.when(j == 0)
     def _init():
@@ -91,44 +125,78 @@ def _paged_kernel(
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    blk = bt_ref[t, j]
-    qpos = qpos_ref[t]
-    base = j * bs
-    kpos = base + jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1)  # [1, bs]
-    valid = (kpos <= qpos) & (blk != trash)  # [1, bs]
-    if window:
-        from deepspeed_tpu.ops.attention.core import window_too_far
-
-        valid = valid & jnp.logical_not(window_too_far(qpos, kpos, window))
-
     q = q_ref[0].astype(jnp.float32) * scale  # [nh, d]
-    k = k_ref[0].astype(jnp.float32)  # [bs, nkv, d]
-    v = v_ref[0].astype(jnp.float32)
 
-    m_prev = m_scr[...]  # [nh, 128] (col 0 meaningful)
-    l_prev = l_scr[...]
-    for n in range(nkv):
-        qn = q[n * group : (n + 1) * group]  # [group, d]
-        kn = k[:, n, :]  # [bs, d]
-        vn = v[:, n, :]
-        s = jax.lax.dot_general(
-            qn, kn, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [group, bs]
-        s = jnp.where(valid, s, NEG_INF)
-        m_p = m_prev[n * group : (n + 1) * group, :1]  # [group, 1]
-        m_new = jnp.maximum(m_p, jnp.max(s, axis=1, keepdims=True))
-        alpha = jnp.exp(m_p - m_new)
-        p = jnp.exp(s - m_new)  # [group, bs]
-        l_p = l_prev[n * group : (n + 1) * group, :1]
-        l_new = l_p * alpha + jnp.sum(p, axis=1, keepdims=True)
-        acc = acc_scr[n * group : (n + 1) * group, :]  # [group, d]
-        acc_scr[n * group : (n + 1) * group, :] = acc * alpha + jax.lax.dot_general(
-            p, vn, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        m_scr[n * group : (n + 1) * group, :1] = m_new
-        l_scr[n * group : (n + 1) * group, :1] = l_new
+    def flash_accum(k, v, valid):
+        """One online-softmax accumulation sweep: k/v [nk, nkv, d] fp32,
+        valid [1, nk]. Disjoint per-kv-head scratch slices, so reading
+        m/l once up front is safe."""
+        m_prev = m_scr[...]  # [nh, 128] (col 0 meaningful)
+        l_prev = l_scr[...]
+        for n in range(nkv):
+            qn = q[n * group : (n + 1) * group]  # [group, d]
+            kn = k[:, n, :]  # [nk, d]
+            vn = v[:, n, :]
+            s = jax.lax.dot_general(
+                qn, kn, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )  # [group, nk]
+            s = jnp.where(valid, s, NEG_INF)
+            m_p = m_prev[n * group : (n + 1) * group, :1]  # [group, 1]
+            m_new = jnp.maximum(m_p, jnp.max(s, axis=1, keepdims=True))
+            alpha = jnp.exp(m_p - m_new)
+            p = jnp.exp(s - m_new)  # [group, nk]
+            l_p = l_prev[n * group : (n + 1) * group, :1]
+            l_new = l_p * alpha + jnp.sum(p, axis=1, keepdims=True)
+            acc = acc_scr[n * group : (n + 1) * group, :]  # [group, d]
+            acc_scr[n * group : (n + 1) * group, :] = acc * alpha + jax.lax.dot_general(
+                p, vn, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            m_scr[n * group : (n + 1) * group, :1] = m_new
+            l_scr[n * group : (n + 1) * group, :1] = l_new
 
-    @pl.when(j == B - 1)
+    def pool_block():
+        jb = jnp.minimum(j, B - 1)  # clamped: the j == B step is the extras
+        blk = bt_ref[t, jb]
+        kpos = jb * bs + jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1)  # [1, bs]
+        if has_limit:
+            # explicit pool window (write-after-read: the pool holds only
+            # positions below the step/round start); qpos < 0 marks padded
+            # query slots that must see nothing
+            valid = (kpos < limit_ref[t]) & (qpos >= 0)
+        else:
+            valid = kpos <= qpos
+        valid = valid & (blk != trash)
+        if window:
+            from deepspeed_tpu.ops.attention.core import window_too_far
+
+            valid = valid & jnp.logical_not(window_too_far(qpos, kpos, window))
+        k = k_ref[0].astype(jnp.float32)  # [bs, nkv, d]
+        v = v_ref[0].astype(jnp.float32)
+        if int8:
+            k = k * ks_ref[0][..., None]
+            v = v * vs_ref[0][..., None]
+        flash_accum(k, v, valid)
+
+    if E:
+        @pl.when(j < B)
+        def _pool():
+            pool_block()
+
+        @pl.when(j == B)
+        def _extra():
+            epos = epos_ref[...]  # [1, E]
+            valid = (epos >= 0) & (epos <= qpos)
+            if window:
+                from deepspeed_tpu.ops.attention.core import window_too_far
+
+                valid = valid & jnp.logical_not(window_too_far(qpos, epos, window))
+            flash_accum(
+                ke_ref[0].astype(jnp.float32), ve_ref[0].astype(jnp.float32), valid
+            )
+    else:
+        pool_block()
+
+    @pl.when(j == nj - 1)
     def _finish():
         l = l_scr[:, :1]
         # fully-masked token (all-trash padding): m never left NEG_INF and
@@ -138,42 +206,108 @@ def _paged_kernel(
         o_ref[0] = out.astype(o_ref.dtype)
 
 
+PAGED_ATTENTION_IMPLS = ("auto", "kernel", "dense", "reference")
+
+
 def paged_attention(
     q: jax.Array,
     k_cache: jax.Array,
     v_cache: jax.Array,
     block_tables: jax.Array,
     q_pos: jax.Array,
-    trash_block: int,
+    trash_block,
     impl: Optional[str] = None,
     interpret: bool = False,
     window: int = 0,
     scale: Optional[float] = None,
+    k_scale=None,
+    v_scale=None,
+    extra_kv=None,
+    pool_limit=None,
 ) -> jax.Array:
-    """Dispatching entry point (kernel on TPU, reference otherwise).
-    ``window``: static sliding-window band (uniform across layers);
+    """Dispatching entry point for paged decode attention — the engine's
+    decode hot paths (single-step, fused rounds, spec verify) all call this.
+
+    ``impl``: "auto" (None) resolves to the Pallas kernel on TPU for
+    kernel-tiled head dims and the dense XLA gather elsewhere; "kernel",
+    "dense", "reference" force a path; anything else raises (a typo must
+    not silently fall back — the seam that kept the kernel unreachable).
+    ``trash_block`` may be a traced scalar (layer-offset trash ids).
+    ``k_scale``/``v_scale`` [NB, bs, nkv] fp32: dequant planes, required
+    iff the pool payload is int8 (block_quant.quantize_kv) — the kernel
+    dequantizes in-VMEM after the halved block DMA. ``extra_kv`` =
+    (ke [T, E, nkv, d], ve, epos [T, E]) and ``pool_limit`` [T]: the
+    write-after-read protocol (see paged_decode_attention_dense); extras
+    stay in compute dtype. ``window``: static sliding-window band;
     ``scale``: softmax scale override (gpt_neo's unscaled logits)."""
     T, nh, d = q.shape
     NB, bs, nkv, _ = k_cache.shape
-    use_kernel = impl == "kernel" or (
-        impl is None and jax.default_backend() == "tpu" and d in (64, 128, 256)
-    )
-    if not use_kernel and not interpret:
+    int8_pool = k_cache.dtype == jnp.int8
+    if int8_pool and (k_scale is None or v_scale is None):
+        raise ValueError(
+            "paged_attention: int8 k/v pools need k_scale and v_scale planes"
+        )
+    if not int8_pool and (k_scale is not None or v_scale is not None):
+        raise ValueError(
+            "paged_attention: k_scale/v_scale given but the pool payload is "
+            f"{k_cache.dtype}, not int8"
+        )
+    if impl is None or impl == "auto":
+        impl = "kernel" if (
+            jax.default_backend() == "tpu" and d in (64, 128, 256)
+        ) else "dense"
+    if impl == "reference":
+        if extra_kv is not None or pool_limit is not None:
+            raise ValueError(
+                "paged_attention: impl='reference' serves the plain parity "
+                "form only (no extra_kv/pool_limit)"
+            )
         return paged_attention_reference(
-            q, k_cache, v_cache, block_tables, q_pos, trash_block, window=window,
-            scale=scale,
+            q, k_cache, v_cache, block_tables, q_pos, trash_block,
+            window=window, scale=scale, k_scale=k_scale, v_scale=v_scale,
+        )
+    if impl == "dense":
+        return paged_decode_attention_dense(
+            q, k_cache, v_cache, block_tables, q_pos, trash_block,
+            window=window, scale=scale, extra_kv=extra_kv,
+            pool_limit=pool_limit, k_scale=k_scale, v_scale=v_scale,
+        )
+    if impl != "kernel":
+        raise ValueError(
+            f"paged_attention: unknown impl {impl!r} "
+            f"(expected one of {PAGED_ATTENTION_IMPLS})"
         )
 
+    # kernel path; off-TPU it only runs interpreted (CPU tests)
+    interpret = bool(interpret) or jax.default_backend() != "tpu"
     B = block_tables.shape[1]
+    has_limit = pool_limit is not None
+    E = 0 if extra_kv is None else int(extra_kv[0].shape[1])
+    num_scalar = 3 + (1 if has_limit else 0)
+
+    if E:
+        blk_idx = lambda t, j, *s: (s[0][t, jnp.minimum(j, B - 1)], 0, 0, 0)
+    else:
+        blk_idx = lambda t, j, *s: (s[0][t, j], 0, 0, 0)
+    in_specs = []
+    if E:
+        in_specs.append(pl.BlockSpec((1, E), lambda t, j, *s: (t, 0)))
+    in_specs.append(pl.BlockSpec((1, nh, d), lambda t, j, *s: (t, 0, 0)))
+    in_specs.append(pl.BlockSpec((1, bs, nkv, d), blk_idx))
+    in_specs.append(pl.BlockSpec((1, bs, nkv, d), blk_idx))
+    if int8_pool:
+        scale_idx = lambda t, j, *s: blk_idx(t, j, *s)[:3]
+        in_specs.append(pl.BlockSpec((1, bs, nkv), scale_idx))
+        in_specs.append(pl.BlockSpec((1, bs, nkv), scale_idx))
+    if E:
+        in_specs.append(pl.BlockSpec((1, E, nkv, d), lambda t, j, *s: (t, 0, 0, 0)))
+        in_specs.append(pl.BlockSpec((1, E, nkv, d), lambda t, j, *s: (t, 0, 0, 0)))
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(T, B),
-        in_specs=[
-            pl.BlockSpec((1, nh, d), lambda t, j, bt, qp: (t, 0, 0)),
-            pl.BlockSpec((1, bs, nkv, d), lambda t, j, bt, qp: (bt[t, j], 0, 0, 0)),
-            pl.BlockSpec((1, bs, nkv, d), lambda t, j, bt, qp: (bt[t, j], 0, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, nh, d), lambda t, j, bt, qp: (t, 0, 0)),
+        num_scalar_prefetch=num_scalar,
+        grid=(T, B + (1 if E else 0)),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, nh, d), lambda t, j, *s: (t, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((nh, 128), jnp.float32),
             pltpu.VMEM((nh, 128), jnp.float32),
@@ -181,9 +315,24 @@ def paged_attention(
         ],
     )
     kernel = functools.partial(
-        _paged_kernel, bs=bs, nh=nh, nkv=nkv, d=d, trash=trash_block,
-        window=int(window), scale=scale,
+        _paged_kernel, bs=bs, nh=nh, nkv=nkv, d=d, B=B, E=E,
+        window=int(window), scale=scale, int8=int8_pool, has_limit=has_limit,
     )
+    operands = [
+        block_tables.astype(jnp.int32),
+        q_pos.astype(jnp.int32),
+        jnp.asarray(trash_block, jnp.int32).reshape(1),
+    ]
+    if has_limit:
+        operands.append(jnp.asarray(pool_limit, jnp.int32).reshape(T))
+    if E:
+        operands.append(jnp.asarray(extra_kv[2], jnp.int32).reshape(T, E))
+    operands.append(q)
+    operands.extend([k_cache, v_cache])
+    if int8_pool:
+        operands.extend([k_scale, v_scale])
+    if E:
+        operands.extend([extra_kv[0], extra_kv[1]])
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -197,7 +346,7 @@ def paged_attention(
             dimension_semantics=("parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(block_tables.astype(jnp.int32), q_pos.astype(jnp.int32), q, k_cache, v_cache)
+    )(*operands)
 
 
 def paged_decode_attention_dense(
@@ -211,6 +360,8 @@ def paged_decode_attention_dense(
     scale: Optional[float] = None,
     extra_kv=None,
     pool_limit=None,
+    k_scale=None,
+    v_scale=None,
 ) -> jax.Array:
     """Decode attention as plain XLA (block gather + masked einsum) — no
     Pallas. On the profile (PERF.md serving roofline) per-program launch
@@ -228,6 +379,8 @@ def paged_decode_attention_dense(
     the causal <=). The pool is gathered BEFORE this step's writes — a
     scatter-then-gather of the same pool made XLA materialize a full cache
     copy per layer-step (PERF.md serving roofline, the round-4 bottleneck).
+    ``k_scale``/``v_scale`` [NB, bs, nkv] fp32: int8-pool dequant planes
+    (extras stay in compute dtype — only the pool payload is quantized).
     """
     R, nh, d = q.shape
     NB, bs, nkv, _ = k_cache.shape
@@ -240,6 +393,13 @@ def paged_decode_attention_dense(
     v_ctx = (
         v_cache[block_tables].transpose(0, 3, 1, 2, 4).reshape(R, nkv, S, d)
     ).astype(jnp.float32)
+    if k_scale is not None:
+        k_ctx = k_ctx * k_scale[block_tables].transpose(0, 3, 1, 2).reshape(
+            R, nkv, S
+        )[..., None]
+        v_ctx = v_ctx * v_scale[block_tables].transpose(0, 3, 1, 2).reshape(
+            R, nkv, S
+        )[..., None]
     kpos = jnp.arange(S, dtype=jnp.int32)
     limit = (q_pos + 1) if pool_limit is None else pool_limit
     mask = (kpos[None] < limit[:, None]) & jnp.repeat(
@@ -295,6 +455,8 @@ def paged_chunk_attention(
     scale: Optional[float] = None,
     new_kv=None,
     pool_limit=None,
+    k_scale=None,
+    v_scale=None,
 ) -> jax.Array:
     """Prefill-chunk attention: Rc rows x tq new tokens each, every row's
     tokens sharing that ROW's block table (q [Rc, tq, nh, d],
@@ -308,7 +470,9 @@ def paged_chunk_attention(
     K/V — in-chunk attention runs causally over them while the pool covers
     only positions < ``pool_limit`` [Rc] (the chunk's start). Without
     new_kv the pool is assumed to already hold the chunk (legacy form) and
-    pool_limit defaults to the causal <=."""
+    pool_limit defaults to the causal <=. ``k_scale``/``v_scale``
+    [NB, bs, nkv] fp32: int8-pool dequant planes (new_kv stays in compute
+    dtype)."""
     Rc, tq, nh, d = q.shape
     NB, bs, nkv, _ = k_cache.shape
     B = row_tables.shape[1]
@@ -320,6 +484,13 @@ def paged_chunk_attention(
     v_ctx = (
         v_cache[row_tables].transpose(0, 3, 1, 2, 4).reshape(Rc, nkv, S, d)
     ).astype(jnp.float32)
+    if k_scale is not None:
+        k_ctx = k_ctx * k_scale[row_tables].transpose(0, 3, 1, 2).reshape(
+            Rc, nkv, S
+        )[..., None]
+        v_ctx = v_ctx * v_scale[row_tables].transpose(0, 3, 1, 2).reshape(
+            Rc, nkv, S
+        )[..., None]
     kpos = jnp.arange(S, dtype=jnp.int32)
     blk_valid = jnp.repeat(row_tables != trash_block, bs, axis=1)  # [Rc, S]
     if pool_limit is None:

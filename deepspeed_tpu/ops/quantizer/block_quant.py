@@ -156,6 +156,31 @@ def _dequantize_rows(payload: jax.Array, scales: jax.Array, bits: int, block_siz
     return (vals * scales).reshape(R, nb * block_size)
 
 
+def quantize_kv(x: jax.Array):
+    """Symmetric per-head-vector int8 quantization for paged KV-cache
+    payloads: ``x`` [..., d] → (int8 payload [..., d], fp32 scales [...]),
+    scale = absmax/127 over each head vector's d components, dequant
+    ``q * scale`` (the ds_quantize symmetric convention above).
+
+    Per-VECTOR (not per-block) granularity is what makes quantize-on-write
+    compatible with the engine's write-only scatter protocol: a new token's
+    row never changes an already-written row's scale, so incremental
+    appends need no read-modify-write of neighbouring pool slots."""
+    qmax = _QMAX[8]
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=-1)
+    scales = absmax / qmax
+    inv = jnp.where(scales > 0, 1.0 / jnp.maximum(scales, 1e-30), 0.0)
+    q = jnp.clip(jnp.round(xf * inv[..., None]), -qmax, qmax).astype(jnp.int8)
+    return q, scales
+
+
+def dequantize_kv(values: jax.Array, scales: jax.Array, dtype=jnp.float32):
+    """Inverse of :func:`quantize_kv`: int8 payload [..., d] + fp32 scales
+    [...] → dense [..., d] in ``dtype``."""
+    return (values.astype(jnp.float32) * scales[..., None].astype(jnp.float32)).astype(dtype)
+
+
 def quantized_reduce_scatter_along(
     x: jax.Array,
     axis_name: str,

@@ -101,6 +101,12 @@ class ServingDriver:
         self._thread: Optional[threading.Thread] = None
         self._kv_total = int(self._kv_cfg("num_blocks", 0))
         self.metrics.update_kv(self._free_blocks(), self._kv_total)
+        # static pool byte accounting (int8 capacity multiplier etc.) —
+        # getattr-guarded so minimal fake engines in tests stay minimal
+        self._kv_info = {}
+        if hasattr(self.engine, "kv_pool_info"):
+            self._kv_info = dict(self.engine.kv_pool_info())
+            self.metrics.update_kv_pool_info(self._kv_info)
 
     # -- engine accessors (guarded so fakes stay minimal) ----------------
     def _kv_cfg(self, name, default):
@@ -242,6 +248,11 @@ class ServingDriver:
                 "active_requests": len(self._active),
                 "kv_free_blocks": self._free_blocks(),
                 "kv_total_blocks": self._kv_total,
+                "kv_cache_dtype": self._kv_info.get("kv_cache_dtype", "bf16"),
+                "kv_pool_bytes": self._kv_info.get("kv_pool_bytes", 0),
+                "kv_capacity_multiplier": self._kv_info.get(
+                    "kv_capacity_multiplier", 1.0
+                ),
                 "spec": {
                     "enabled": self._spec_ctl is not None,
                     "k": self.spec_k,

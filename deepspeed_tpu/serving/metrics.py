@@ -118,7 +118,14 @@ class ServingMetrics:
             "active_requests": 0,
             "kv_free_blocks": 0,
             "kv_total_blocks": 0,
+            "kv_blocks_in_use": 0,
             "kv_occupancy": 0.0,
+            # KV-pool byte accounting (engine.kv_pool_info): payload dtype
+            # as a 0/1 int8 flag, allocated HBM bytes, and the effective
+            # block-capacity multiplier vs a bf16 pool at the same budget
+            "kv_cache_int8": 0,
+            "kv_pool_bytes": 0,
+            "kv_capacity_multiplier": 1.0,
             "prefix_cached_blocks": 0,
             "prefix_cached_blocks_idle": 0,
             "prefix_hit_rate": 0.0,
@@ -149,8 +156,21 @@ class ServingMetrics:
         with self._lock:
             self.gauges["kv_free_blocks"] = free_blocks
             self.gauges["kv_total_blocks"] = total_blocks
+            self.gauges["kv_blocks_in_use"] = max(0, total_blocks - free_blocks)
             if total_blocks:
                 self.gauges["kv_occupancy"] = 1.0 - free_blocks / total_blocks
+
+    def update_kv_pool_info(self, info: Dict[str, float]) -> None:
+        """Mirror an ``engine.kv_pool_info()`` snapshot (static per engine,
+        set once at driver start)."""
+        with self._lock:
+            self.gauges["kv_cache_int8"] = int(
+                info.get("kv_cache_dtype") == "int8"
+            )
+            self.gauges["kv_pool_bytes"] = info.get("kv_pool_bytes", 0)
+            self.gauges["kv_capacity_multiplier"] = info.get(
+                "kv_capacity_multiplier", 1.0
+            )
 
     def update_prefix_cache(self, stats: Dict[str, float]) -> None:
         """Mirror a ``PrefixCache.stats()`` snapshot. The source counters

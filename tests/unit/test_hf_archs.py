@@ -13,6 +13,7 @@ import json
 import numpy as np
 import pytest
 
+import jax
 import jax.numpy as jnp
 
 import deepspeed_tpu
@@ -833,8 +834,10 @@ def test_greedy_decode_parity(arch, request):
             torch.tensor(prompt, dtype=torch.long), max_new_tokens=8, do_sample=False
         ).numpy()[0]
     toks = prompt.copy()
+    # jitted: eight eager full forwards per arch dominated this test's time
+    fwd = jax.jit(forward, static_argnames=("config",))
     for _ in range(8):
-        logits, _ = forward(params, jnp.asarray(toks), cfg)
+        logits, _ = fwd(params, jnp.asarray(toks), cfg)
         nxt = int(jnp.argmax(logits[0, -1]))
         toks = np.concatenate([toks, [[nxt]]], axis=1)
     np.testing.assert_array_equal(toks[0], hf_out)

@@ -18,11 +18,17 @@ from deepspeed_tpu.models import forward, get_config, init_params
 from deepspeed_tpu.parallel.topology import Topology, reset_topology, set_topology
 
 
+# jitted once, shape-keyed: the eager per-token full forward dominated the
+# V1 suite's runtime, and the module-scoped tiny_model means compiled shapes
+# are shared across tests
+_jit_forward = jax.jit(forward, static_argnames=("config",))
+
+
 def _greedy_reference(cfg, params, prompt, n_new):
     """No-cache greedy loop: full forward each step."""
     toks = list(np.asarray(prompt, np.int32).reshape(-1))
     for _ in range(n_new):
-        logits, _ = forward(params, jnp.asarray([toks]), cfg)
+        logits, _ = _jit_forward(params, jnp.asarray([toks]), cfg)
         toks.append(int(jnp.argmax(logits[0, -1])))
     return np.asarray(toks, np.int32)
 
@@ -324,12 +330,11 @@ class TestInferenceV2:
 
         cfg, params = tiny_model
         wcfg = dataclasses.replace(cfg, sliding_window=24)
-        from deepspeed_tpu.models.transformer import forward
 
         prompt = np.arange(1, 33, dtype=np.int32)  # 32 tokens > window 24
         toks = list(prompt)
         for _ in range(6):
-            lg, _ = forward(params, jnp.asarray([toks]), wcfg)
+            lg, _ = _jit_forward(params, jnp.asarray([toks]), wcfg)
             toks.append(int(jnp.argmax(lg[0, -1])))
         rc = RaggedInferenceEngineConfig.from_dict(
             {

@@ -146,9 +146,8 @@ class TestPLD:
         # keep_prob 1 → always the (unscaled) layer output
         y = apply_layer_drop(layer, x, 1.0, jax.random.key(0))
         np.testing.assert_allclose(np.asarray(y), 2.0)
-        # expectation over many keys ≈ full-model output (inverse scaling)
-        outs = [
-            np.asarray(apply_layer_drop(layer, x, 0.5, jax.random.key(i)))
-            for i in range(200)
-        ]
+        # expectation over many keys ≈ full-model output (inverse scaling);
+        # jit once — 200 eager calls re-trace the lax.cond every time
+        dropped = jax.jit(lambda key: apply_layer_drop(layer, x, 0.5, key))
+        outs = [np.asarray(dropped(jax.random.key(i))) for i in range(200)]
         np.testing.assert_allclose(np.mean(outs), 2.0, rtol=0.15)

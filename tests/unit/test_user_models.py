@@ -191,8 +191,10 @@ def test_hf_llama_greedy_decode_parity(tiny_hf_llama):
         ).numpy()[0]
 
     toks = prompt.copy()
+    # jitted: eight eager full forwards dominated this test's time
+    fwd = jax.jit(forward, static_argnames=("config",))
     for _ in range(8):
-        logits, _ = forward(params, jnp.asarray(toks), cfg)
+        logits, _ = fwd(params, jnp.asarray(toks), cfg)
         nxt = int(jnp.argmax(logits[0, -1]))
         toks = np.concatenate([toks, [[nxt]]], axis=1)
     np.testing.assert_array_equal(toks[0], hf_out)

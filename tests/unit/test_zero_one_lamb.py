@@ -125,23 +125,27 @@ def test_zero_one_adam_skips_and_reconverges(devices8):
     rng = np.random.default_rng(0)
     grads_all = jnp.asarray(rng.normal(size=(20, 8, n)).astype(np.float32))
 
-    def one_step(state, g):
-        def inner(state, g):
-            state = _map_state(state, lambda x: x[0], lambda v: v)
-            upd, new_state = tx.update({"w": g[0, 0]}, state, {"w": jnp.zeros((n,))}, lr=0.01)
-            return (
-                _map_state(new_state, lambda x: x[None], lambda v: v),
-                upd["w"][None],
-            )
-
-        fn = jax.shard_map(
-            inner, mesh=mesh,
-            in_specs=(state_spec, P("data")),
-            out_specs=(state_spec, P("data")),
-            axis_names={"data"},
-            check_vma=False,
+    def inner(state, g):
+        state = _map_state(state, lambda x: x[0], lambda v: v)
+        upd, new_state = tx.update({"w": g[0, 0]}, state, {"w": jnp.zeros((n,))}, lr=0.01)
+        return (
+            _map_state(new_state, lambda x: x[None], lambda v: v),
+            upd["w"][None],
         )
-        return fn(state, g[:, None])
+
+    # built ONCE outside the step loop: a fresh shard_map wrapper per call
+    # is a new function identity, so every iteration recompiled the
+    # 8-device collective program (~8x this test's runtime)
+    shard_fn = jax.shard_map(
+        inner, mesh=mesh,
+        in_specs=(state_spec, P("data")),
+        out_specs=(state_spec, P("data")),
+        axis_names={"data"},
+        check_vma=False,
+    )
+
+    def one_step(state, g):
+        return shard_fn(state, g[:, None])
 
     mus = []
     for i in range(8):

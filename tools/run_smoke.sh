@@ -26,6 +26,12 @@ echo "== speculative-decode parity gate =="
 # bit-identical spec-on vs spec-off (greedy + sampled) and KV rollback
 python -m pytest tests/unit/test_spec_decode.py -q -p no:cacheprovider
 
+echo "== int8 KV parity + capacity gate =="
+# kernel/dense/reference vs dequant oracle, bounded int8 error, pool
+# capacity >=1.9x at head_dim=128, serving wiring
+python -m pytest tests/unit/test_kv_int8.py tests/unit/ops/test_paged_attention.py \
+    -q -p no:cacheprovider
+
 echo "== donation/recompile verifier (Tier B) =="
 ./bin/dstpu lint --verify
 
