@@ -188,6 +188,94 @@ def bench_overlap_ab(cfg, seq, steps=5, warmup=2):
     return out
 
 
+def bench_long_context_cp(steps=3, warmup=1):
+    """Multi-chip long-sequence leg: one train step (fwd+bwd+Adam) with the
+    sequence axis sharded over the ``context`` mesh — ring attention keeps
+    per-device activations at O(s/N) — A/B'd against the dense reference
+    attention on the SAME mesh (what long-context training falls back to
+    without a fused kernel: the [b, h, s, s] score matrix materializes).
+    Reports per-step wall clock for both arms, the ring arm's MFU against
+    the N-device aggregate peak, and the losses (close but not bitwise —
+    flash vs dense summation order). Knobs: DSTPU_BENCH_CP_SEQ,
+    DSTPU_BENCH_CP_SKIP_DENSE=1 drops the dense arm (at 32k+ the score
+    matrix is the OOM the ring exists to avoid)."""
+    import gc
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import (
+        TransformerConfig,
+        flops_per_token,
+        init_params,
+        make_loss_fn,
+    )
+    from deepspeed_tpu.parallel.topology import reset_topology
+
+    ndev = len(jax.devices())
+    if ndev < 2:
+        return {"skipped": "needs >1 device"}
+    on_tpu = jax.default_backend() == "tpu"
+    seq = int(os.environ.get("DSTPU_BENCH_CP_SEQ", 16384 if on_tpu else 1024))
+    if on_tpu:
+        base = dict(
+            vocab_size=32000, hidden_size=2048, n_layers=4, n_heads=16,
+            n_kv_heads=16, max_seq_len=seq, dtype="bfloat16",
+            remat_policy="flash",
+        )
+    else:  # CPU dev boxes: tiny widths, d=64 so the kernel path is exercised
+        base = dict(
+            vocab_size=512, hidden_size=256, n_layers=2, n_heads=4,
+            max_seq_len=seq, dtype="float32",
+        )
+    arms = [("ring", "flash_ring")]
+    if os.environ.get("DSTPU_BENCH_CP_SKIP_DENSE", "0") != "1":
+        arms.append(("dense", "reference"))
+    out = {"seq": seq, "context": ndev}
+    toks = np.random.default_rng(0).integers(
+        0, base["vocab_size"], size=(1, seq + 1)).astype(np.int32)
+    for label, impl in arms:
+        reset_topology()
+        gc.collect()
+        cfg = TransformerConfig(attention_impl=impl, **base)
+        params = init_params(cfg, jax.random.key(0))
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=make_loss_fn(cfg),
+            model_parameters=params,
+            config={
+                "train_micro_batch_size_per_gpu": 1,
+                "bf16": {"enabled": on_tpu},
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+                "zero_optimization": {"stage": 0},
+                # every device on the context axis: the whole mesh rings
+                # over one sequence (the N-chips-one-document regime)
+                "mesh": {"context": ndev},
+                "steps_per_print": 10**9,
+            },
+        )
+        batch = {"input_ids": toks}
+        for _ in range(warmup):
+            float(engine.train_batch(batch=batch))
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = engine.train_batch(batch=batch)
+        loss = float(loss)  # device sync before stopping the clock
+        dt = (time.perf_counter() - t0) / steps
+        arm = {"s_per_step": round(dt, 4), "loss": round(loss, 4)}
+        if label == "ring":
+            tok_s = seq / dt
+            mfu = tok_s * flops_per_token(cfg, seq) / (
+                peak_flops(jax.default_backend()) * ndev)
+            arm["tok_s"] = round(tok_s, 1)
+            arm["mfu_pct"] = round(mfu * 100, 2)
+        out[label] = arm
+        del engine, params
+    if "dense" in out:
+        out["ring_speedup_vs_dense"] = round(
+            out["dense"]["s_per_step"] / out["ring"]["s_per_step"], 3)
+    reset_topology()
+    gc.collect()
+    return out
+
+
 def v5e64_projection():
     """Analytic feasibility of the north-star config (Llama-2-7B ZeRO-3 on
     v5e-64) from the autotuner's memory model — per-chip model-state +
@@ -318,6 +406,11 @@ def main():
             out["overlap_ab"] = bench_overlap_ab(cfg, seq)
         except Exception as e:  # the headline metric must survive
             out["overlap_ab"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+    if os.environ.get("DSTPU_BENCH_SKIP_CP", "0") != "1":
+        try:
+            out["long_context_cp"] = bench_long_context_cp()
+        except Exception as e:  # the headline metric must survive
+            out["long_context_cp"] = {"error": f"{type(e).__name__}: {e}"[:200]}
     if on_tpu and os.environ.get("DSTPU_BENCH_SKIP_SERVING", "0") != "1":
         # free the training engine's HBM residency (params + fp32 Adam state
         # ~12.7 GB) before the serving engine allocates its KV pool

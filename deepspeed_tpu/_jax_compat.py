@@ -7,9 +7,13 @@ Older runtimes (jax < 0.5) ship the same functionality under
 module patches the gaps once, at package import, so the rest of the code can
 use the modern spellings unconditionally:
 
-  * ``jax.shard_map`` — thin wrapper over ``jax.experimental.shard_map``
-    translating ``check_vma`` -> ``check_rep`` and dropping ``axis_names``
-    (implicit in the mesh there).
+  * ``jax.shard_map`` — a keyword-normalizing wrapper installed on EVERY jax
+    generation: call sites may spell the replication-check flag either
+    ``check_rep`` (jax <= 0.4.x) or ``check_vma`` (current jax) and it is
+    translated to whichever the underlying API takes — no version sniffing
+    at call sites. On old jax the wrapper fronts
+    ``jax.experimental.shard_map`` (also dropping ``axis_names``, implicit
+    in the mesh there); on new jax it fronts the native ``jax.shard_map``.
   * ``jax.memory.Space`` / ``jax.typeof`` — sentinel fallback.  On a backend
     with a single memory space (CPU without ``pinned_host``) every array
     reports ``Space.Device`` and ``device_put`` to a Space is the identity,
@@ -28,18 +32,37 @@ __all__ = ["host_memory_kind"]
 
 
 def _ensure_shard_map():
-    if hasattr(jax, "shard_map"):
-        return
-    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+    native = getattr(jax, "shard_map", None)
+    if getattr(native, "_dstpu_compat", False):
+        return  # already normalized (module re-import)
 
-    def shard_map(f, mesh=None, in_specs=None, out_specs=None, axis_names=None,
-                  check_vma=None, **kwargs):
-        del axis_names  # implicit in `mesh` for the legacy API
-        if check_vma is not None and "check_rep" not in kwargs:
-            kwargs["check_rep"] = check_vma
-        return _legacy_shard_map(f, mesh=mesh, in_specs=in_specs,
-                                 out_specs=out_specs, **kwargs)
+    if native is not None:
+        # Current jax: the native API takes check_vma. Accept the legacy
+        # check_rep spelling too, so wrappers written against either
+        # generation run unmodified.
+        def shard_map(f, *args, check_rep=None, check_vma=None, **kwargs):
+            if check_vma is None:
+                check_vma = check_rep
+            if check_vma is not None:
+                kwargs["check_vma"] = check_vma
+            return native(f, *args, **kwargs)
+    else:
+        # jax <= 0.4.x: front jax.experimental.shard_map, which takes
+        # check_rep and no axis_names (implicit in the mesh).
+        from jax.experimental.shard_map import shard_map as _legacy_shard_map
 
+        def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                      axis_names=None, check_vma=None, check_rep=None,
+                      **kwargs):
+            del axis_names  # implicit in `mesh` for the legacy API
+            if check_rep is None:
+                check_rep = check_vma
+            if check_rep is not None:
+                kwargs["check_rep"] = check_rep
+            return _legacy_shard_map(f, mesh=mesh, in_specs=in_specs,
+                                     out_specs=out_specs, **kwargs)
+
+    shard_map._dstpu_compat = True
     jax.shard_map = shard_map
 
 

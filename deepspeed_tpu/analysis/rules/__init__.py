@@ -10,4 +10,5 @@ from deepspeed_tpu.analysis.rules import (  # noqa: F401
     donation,
     host_sync,
     jit_purity,
+    shard_specs,
 )

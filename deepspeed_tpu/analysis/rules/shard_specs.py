@@ -1,0 +1,160 @@
+"""Rule ``shard-map-axis-coverage``: every mesh axis a ``shard_map`` call
+declares manual (``axis_names``) must be sharded over by at least one
+``in_specs``/``out_specs`` entry or used by the body.
+
+The motivating bug class: a mesh config gains an axis (``context``,
+``sequence``, ...) and a ``shard_map`` site lists it in ``axis_names``
+without threading it into any PartitionSpec — every device along that
+axis then holds a full replica and computes identical work, silently
+erasing the memory/compute win the axis was configured for. The repo
+passes ``check_vma=False`` everywhere (the compat shim's contract), so
+jax's own replication checking never sees it; this rule is the static
+stand-in.
+
+Resolution is best-effort and conservative: axis names come from string
+literals and the canonical ``parallel.topology`` constants (mirrored
+below — keep in sync), specs referenced by name resolve through simple
+same-file assignments, and a call whose ``axis_names`` or body cannot be
+resolved statically is skipped rather than guessed at.
+"""
+
+import ast
+
+from deepspeed_tpu.analysis.framework import Rule, register
+from deepspeed_tpu.analysis.rules._common import dotted_name
+
+# mirror of deepspeed_tpu/parallel/topology.py — the configured mesh axes
+_AXIS_CONSTS = {
+    "PIPE_AXIS": "pipe",
+    "DATA_AXIS": "data",
+    "ZERO_AXIS": "zero",
+    "EXPERT_AXIS": "expert",
+    "CONTEXT_AXIS": "context",
+    "SEQUENCE_AXIS": "sequence",
+    "MODEL_AXIS": "model",
+}
+_AXIS_GROUPS = {
+    "MESH_AXES": tuple(_AXIS_CONSTS.values()),
+    "BATCH_AXES": ("data", "zero", "expert"),
+    "ZERO_AXES": ("data", "zero"),
+    "HEAD_AXES": ("model", "sequence"),
+}
+_KNOWN_AXES = frozenset(_AXIS_CONSTS.values())
+
+_SHARD_MAP_NAMES = (
+    "shard_map",
+    "jax.shard_map",
+    "shard_map.shard_map",
+    "jax.experimental.shard_map.shard_map",
+)
+
+
+def _is_shard_map(node: ast.AST) -> bool:
+    return dotted_name(node) in _SHARD_MAP_NAMES
+
+
+def _collect_defs(tree):
+    """(assigns, funcs): simple same-file ``name = expr`` assignments (a
+    name assigned in several scopes keeps every value — mention-finding
+    only needs ONE of them to carry the axis) and function definitions."""
+    assigns, funcs = {}, {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            assigns.setdefault(node.targets[0].id, []).append(node.value)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs.setdefault(node.name, node)
+    return assigns, funcs
+
+
+def _axes_in(node, assigns, seen=None):
+    """Every configured mesh axis mentioned anywhere under ``node``:
+    string literals, topology constants/groups by name, and names that
+    resolve through one or more same-file assignments."""
+    if node is None:
+        return set()
+    seen = set() if seen is None else seen
+    out = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and sub.value in _KNOWN_AXES:
+            out.add(sub.value)
+        elif isinstance(sub, ast.Name):
+            if sub.id in _AXIS_CONSTS:
+                out.add(_AXIS_CONSTS[sub.id])
+            elif sub.id in _AXIS_GROUPS:
+                out.update(_AXIS_GROUPS[sub.id])
+            elif sub.id in assigns and sub.id not in seen:
+                seen.add(sub.id)
+                for value in assigns[sub.id]:
+                    out.update(_axes_in(value, assigns, seen))
+    return out
+
+
+def _manual_axes(expr):
+    """The ``axis_names`` value -> set of axis strings, or None when it is
+    not a statically-resolvable literal (``set(topo.mesh.axis_names)``,
+    computed sets, ...)."""
+    if isinstance(expr, ast.Call):
+        # set(GROUP_CONST) — the full-tuple spelling
+        if dotted_name(expr.func) == "set" and len(expr.args) == 1 and \
+                isinstance(expr.args[0], ast.Name) and \
+                expr.args[0].id in _AXIS_GROUPS:
+            return set(_AXIS_GROUPS[expr.args[0].id])
+        return None
+    if not isinstance(expr, (ast.Set, ast.Tuple, ast.List)):
+        return None
+    out = set()
+    for elt in expr.elts:
+        if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+            out.add(elt.value)
+        elif isinstance(elt, ast.Name) and elt.id in _AXIS_CONSTS:
+            out.add(_AXIS_CONSTS[elt.id])
+        elif isinstance(elt, ast.Starred) and isinstance(elt.value, ast.Name) \
+                and elt.value.id in _AXIS_GROUPS:
+            out.update(_AXIS_GROUPS[elt.value.id])
+        else:
+            return None
+    return out
+
+
+@register
+class ShardMapAxisCoverageRule(Rule):
+    name = "shard-map-axis-coverage"
+    severity = "warning"
+    description = (
+        "a mesh axis declared manual via shard_map axis_names must appear "
+        "in some in_specs/out_specs entry (or be used by the body) — an "
+        "omitted axis silently replicates the whole computation"
+    )
+
+    def check(self, ctx):
+        assigns, funcs = _collect_defs(ctx.tree)
+        findings = []
+        for call in ast.walk(ctx.tree):
+            if not (isinstance(call, ast.Call) and _is_shard_map(call.func)):
+                continue
+            kwargs = {kw.arg: kw.value for kw in call.keywords if kw.arg}
+            manual = _manual_axes(kwargs.get("axis_names"))
+            if not manual:
+                continue  # absent or not statically resolvable
+            if "in_specs" not in kwargs and "out_specs" not in kwargs:
+                continue
+            body = call.args[0] if call.args else None
+            if isinstance(body, ast.Name):
+                body = funcs.get(body.id)
+            if body is None or not isinstance(
+                    body, (ast.Lambda, ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # body defined elsewhere — cannot prove anything
+            covered = (
+                _axes_in(kwargs.get("in_specs"), assigns)
+                | _axes_in(kwargs.get("out_specs"), assigns)
+                | _axes_in(body, assigns)
+            )
+            for ax in sorted(manual - covered):
+                findings.append(ctx.finding(
+                    self, call,
+                    f"axis_names declares mesh axis '{ax}' manual but no "
+                    f"in_specs/out_specs entry shards over it and the body "
+                    f"never references it — every device along '{ax}' "
+                    f"computes a full replica"))
+        return findings

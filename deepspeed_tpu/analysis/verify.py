@@ -34,6 +34,7 @@ __all__ = [
     "check_recompile",
     "run_verify",
     "verify_engine_v2",
+    "verify_ring_train",
     "verify_streamed_adam",
     "verify_train_engine",
 ]
@@ -251,20 +252,19 @@ def _engine_v2_pass(kv_dtype: str) -> List[CheckResult]:
         results.append(check_recompile(label, fn))
 
     # row step (per-row baseline path): lower directly with config shapes.
-    # int8 pools have no per-row path (it raises), so bf16 only.
+    # int8 appends the donated scale planes (argnums 7, 8).
     kv = eng.config.kv_cache
-    if kv_dtype == "bf16":
-        fn = eng._build_row_step(8)
-        row_args = (
-            eng.params,
-            jnp.zeros((1, 8), jnp.int32),
-            jnp.int32(0),
-            jnp.int32(8),
-            jnp.zeros((kv.max_blocks_per_seq,), jnp.int32),
-            eng._k_cache,
-            eng._v_cache,
-        )
-        results.append(check_donation("engine_v2.row_step", fn, row_args))
+    fn = eng._build_row_step(8)
+    row_args = (
+        eng.params,
+        jnp.zeros((1, 8), jnp.int32),
+        jnp.int32(0),
+        jnp.int32(8),
+        jnp.zeros((kv.max_blocks_per_seq,), jnp.int32),
+        eng._k_cache,
+        eng._v_cache,
+    ) + eng._scale_args()
+    results.append(check_donation(f"engine_v2.row_step{tag}", fn, row_args))
 
     # speculative verify step (serving/spec): the K+1-token draft-and-verify
     # program declares both KV pools donated — without aliasing, every spec
@@ -407,6 +407,53 @@ def verify_train_engine() -> List[CheckResult]:
     return results
 
 
+def verify_ring_train() -> List[CheckResult]:
+    """Train step through the context-parallel ring attention path
+    (ops/attention/sharded.ring_flash_attention) on a data×context virtual
+    CPU mesh. The ring body runs inside shard_map with a custom_vjp whose
+    residuals cross the shard boundary — exactly where a donated buffer can
+    silently lose its alias (the XLA annotation must survive the shard_map
+    lowering, not just the outer jit), so the donation check runs against
+    the full sharded step artifact."""
+    import jax
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import TransformerConfig, init_params, make_loss_fn
+
+    if len(jax.devices()) < 8:
+        return [CheckResult("runtime.engine.train_step[ring-cp]", "donation",
+                            True, "needs 8 devices; skipped")]
+    cfg = TransformerConfig(
+        vocab_size=64, hidden_size=32, n_layers=2, n_heads=4, max_seq_len=64,
+        dtype="float32", attention_impl="flash_ring",
+    )
+    params = init_params(cfg, jax.random.key(0))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=make_loss_fn(cfg),
+        model_parameters=params,
+        config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 0},
+            "mesh": {"data": 2, "context": 4},
+            "steps_per_print": 10**9,
+        },
+    )
+    captured: dict = {}
+    _capture_builder(engine, "_build_train_step", captured, "train_step")
+    toks = np.random.default_rng(0).integers(0, 64, size=(4, 65)).astype(np.int32)
+    engine.train_batch(batch={"input_ids": toks})
+    engine.train_batch(batch={"input_ids": toks})
+
+    name = "runtime.engine.train_step[ring-cp]"
+    if "train_step" not in captured:
+        return [CheckResult(name, "donation", False,
+                            "train step never executed in harness")]
+    fn, args = captured["train_step"]
+    return [check_donation(name, fn, args)]
+
+
 # ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
@@ -418,6 +465,7 @@ def run_verify(verbose: bool = True) -> Tuple[List[CheckResult], bool]:
         (verify_engine_v2, "engine_v2"),
         (verify_streamed_adam, "streamed_adam"),
         (verify_train_engine, "train_engine"),
+        (verify_ring_train, "ring_train"),
     ):
         try:
             results.extend(fn())

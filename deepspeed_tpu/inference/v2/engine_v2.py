@@ -325,22 +325,19 @@ class InferenceEngineV2:
 
     # ------------------------------------------------------------------
     def _build_row_step(self, t_bucket: int):
-        if self._kv_int8:
-            raise NotImplementedError(
-                "_step_per_row: int8 KV pools run only through the batched "
-                "step — the legacy per-row step gathers raw pool payloads "
-                "and would attend over quantized integers"
-            )
         c = self._mc
         kv = self.config.kv_cache
         bs = kv.block_size
         B = kv.max_blocks_per_seq
         S = B * bs  # gathered context window
+        kv_int8 = self._kv_int8
 
-        def row_step(params, tokens, start, n_valid, block_table, k_cache, v_cache):
+        def row_step(params, tokens, start, n_valid, block_table, k_cache,
+                     v_cache, *scale_caches):
             """tokens: [1, t]; start: scalar first position; n_valid: actual
-            new tokens (≤ t); block_table: [B]. Returns (logits_last [vocab],
-            k_cache, v_cache)."""
+            new tokens (≤ t); block_table: [B]. ``scale_caches`` = the int8
+            pools' (ks, vs) fp32 planes, or empty in bf16 mode. Returns
+            (logits_last [vocab], k_cache, v_cache[, ks_cache, vs_cache])."""
             t = tokens.shape[1]
             positions = start + jnp.arange(t, dtype=jnp.int32)
             x = T._scale_embed(params["embed"].astype(T.DTYPES[c.dtype])[tokens], c, T.DTYPES[c.dtype])
@@ -358,7 +355,11 @@ class InferenceEngineV2:
             row = glob % bs
 
             def layer_step(x, inputs):
-                lp, kc_l, vc_l = inputs  # kc_l: [num_blocks, bs, nkv, d]
+                if kv_int8:
+                    lp, kc_l, vc_l, ks_l, vs_l = inputs
+                else:
+                    lp, kc_l, vc_l = inputs  # kc_l: [num_blocks, bs, nkv, d]
+                    ks_l = vs_l = None
                 lp = T._dequant_tree(lp, T.DTYPES[c.dtype])
                 a = T._norm(x, lp["attn_norm"], lp.get("attn_norm_b"), c.norm, c.norm_eps)
                 b_, t_, h = a.shape
@@ -383,48 +384,88 @@ class InferenceEngineV2:
                 # a scratch block write at their own position — clip keeps
                 # them inside the table; n_valid < t only pads the tail,
                 # whose writes land at future positions and are re-written)
-                kc_l = kc_l.at[blk, row].set(k[0].transpose(1, 0, 2))
-                vc_l = vc_l.at[blk, row].set(v[0].transpose(1, 0, 2))
-                # gather the sequence's context and run masked attention
-                k_ctx = kc_l[block_table].reshape(S, nkv, d).transpose(1, 0, 2)[None]
-                v_ctx = vc_l[block_table].reshape(S, nkv, d).transpose(1, 0, 2)[None]
-                kpos = jnp.arange(S, dtype=jnp.int32)
-                mask = kpos[None, :] <= glob[:, None]  # [t, S] causal vs global pos
-                if c.sliding_window:
-                    from deepspeed_tpu.ops.attention.core import window_too_far
+                if kv_int8:
+                    # int8 pool: attend through the paged dense impl (pool
+                    # dequantizes inside its gather — raw int8 payloads never
+                    # reach the softmax), mirroring the batched step's
+                    # write-after-read protocol so per-row streams match it
+                    # bit-for-bit: the pool is gathered BEFORE this chunk's
+                    # writes (pool_limit = start masks everything newer) and
+                    # the chunk's own K/V ride alongside in compute dtype as
+                    # extra columns (epos -1 disables the padded tail).
+                    from deepspeed_tpu.ops.attention.paged_pallas import paged_attention
+                    from deepspeed_tpu.ops.quantizer.block_quant import quantize_kv
 
-                    mask = jnp.logical_and(
-                        mask,
-                        jnp.logical_not(
-                            window_too_far(glob[:, None], kpos[None, :], c.sliding_window)
+                    k_rows = k[0].transpose(1, 0, 2)  # [t, nkv, d]
+                    v_rows = v[0].transpose(1, 0, 2)
+                    epos = jnp.where(valid, glob, -1)
+                    out = paged_attention(
+                        q[0].transpose(1, 0, 2), kc_l, vc_l,
+                        jnp.broadcast_to(block_table[None], (t_, B)), glob,
+                        trash, impl="dense", window=c.sliding_window or 0,
+                        scale=c.attn_scale, k_scale=ks_l, v_scale=vs_l,
+                        extra_kv=(
+                            jnp.broadcast_to(k_rows[None], (t_, t_, nkv, d)),
+                            jnp.broadcast_to(v_rows[None], (t_, t_, nkv, d)),
+                            jnp.broadcast_to(epos[None], (t_, t_)),
                         ),
+                        pool_limit=jnp.full((t_,), start, jnp.int32),
                     )
-                bias = jnp.where(mask, 0.0, -1e30).astype(jnp.float32)[None, None]
-                from deepspeed_tpu.ops.attention import mha_reference
+                    out = out.reshape(t_, nh * d)[None]
+                    # quantize-on-write (same per-head-vector scheme as the
+                    # batched _scatter_kv); write-only after the gather above
+                    k_q, k_s = quantize_kv(k_rows)
+                    v_q, v_s = quantize_kv(v_rows)
+                    kc_l = kc_l.at[blk, row].set(k_q)
+                    vc_l = vc_l.at[blk, row].set(v_q)
+                    ks_l = ks_l.at[blk, row].set(k_s)
+                    vs_l = vs_l.at[blk, row].set(v_s)
+                else:
+                    kc_l = kc_l.at[blk, row].set(k[0].transpose(1, 0, 2))
+                    vc_l = vc_l.at[blk, row].set(v[0].transpose(1, 0, 2))
+                    # gather the sequence's context and run masked attention
+                    k_ctx = kc_l[block_table].reshape(S, nkv, d).transpose(1, 0, 2)[None]
+                    v_ctx = vc_l[block_table].reshape(S, nkv, d).transpose(1, 0, 2)[None]
+                    kpos = jnp.arange(S, dtype=jnp.int32)
+                    mask = kpos[None, :] <= glob[:, None]  # [t, S] causal vs global pos
+                    if c.sliding_window:
+                        from deepspeed_tpu.ops.attention.core import window_too_far
 
-                out = mha_reference(q, k_ctx, v_ctx, causal=False, bias=bias,
-                                    scale=c.attn_scale)
-                out = out.transpose(0, 2, 1, 3).reshape(1, t_, nh * d)
+                        mask = jnp.logical_and(
+                            mask,
+                            jnp.logical_not(
+                                window_too_far(glob[:, None], kpos[None, :], c.sliding_window)
+                            ),
+                        )
+                    bias = jnp.where(mask, 0.0, -1e30).astype(jnp.float32)[None, None]
+                    from deepspeed_tpu.ops.attention import mha_reference
+
+                    out = mha_reference(q, k_ctx, v_ctx, causal=False, bias=bias,
+                                        scale=c.attn_scale)
+                    out = out.transpose(0, 2, 1, 3).reshape(1, t_, nh * d)
                 attn_out = out @ lp["wo"]
                 if c.attn_out_bias:
                     attn_out = attn_out + lp["wo_b"]
+                caches = (kc_l, vc_l, ks_l, vs_l) if kv_int8 else (kc_l, vc_l)
                 if c.parallel_block:
                     # falcon/phi: both branches read the pre-attention state
                     m = T._norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"), c.norm, c.norm_eps)
                     mlp_out, _ = T._mlp_block(c, lp, m)
-                    return x + attn_out + mlp_out, (kc_l, vc_l)
+                    return x + attn_out + mlp_out, caches
                 x = x + attn_out
                 m = T._norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"), c.norm, c.norm_eps)
                 mlp_out, _ = T._mlp_block(c, lp, m)
-                return x + mlp_out, (kc_l, vc_l)
+                return x + mlp_out, caches
 
-            x, (k_new, v_new) = jax.lax.scan(layer_step, x, (params["layers"], k_cache, v_cache))
+            xs = (params["layers"], k_cache, v_cache) + tuple(scale_caches)
+            x, new_caches = jax.lax.scan(layer_step, x, xs)
             x = T._norm(x, params["final_norm"], params.get("final_norm_b"), c.norm, c.norm_eps)
             last = jnp.take_along_axis(x, jnp.clip(n_valid - 1, 0, t - 1)[None, None, None], axis=1)[:, 0]
             logits = T._apply_lm_head(params, last, c)
-            return logits[0].astype(jnp.float32), k_new, v_new
+            return (logits[0].astype(jnp.float32),) + tuple(new_caches)
 
-        return jax.jit(row_step, donate_argnums=(5, 6))
+        donate = (5, 6, 7, 8) if kv_int8 else (5, 6)
+        return jax.jit(row_step, donate_argnums=donate)
 
     # ------------------------------------------------------------------
     def _pool_views(self, k_cache, v_cache):
@@ -1406,7 +1447,7 @@ class InferenceEngineV2:
             padded = np.zeros((1, tb), np.int32)
             padded[0, :t] = toks
             table = jnp.asarray(self.state_manager.block_table_array(seq))
-            logits, self._k_cache, self._v_cache = self._row_jit[tb](
+            outs = self._row_jit[tb](
                 self.params,
                 jnp.asarray(padded),
                 jnp.int32(start),
@@ -1414,7 +1455,11 @@ class InferenceEngineV2:
                 table,
                 self._k_cache,
                 self._v_cache,
+                *self._scale_args(),
             )
+            logits, self._k_cache, self._v_cache = outs[0], outs[1], outs[2]
+            if self._kv_int8:
+                self._ks_cache, self._vs_cache = outs[3], outs[4]
             seq.seen_tokens += t
             if not chunked:  # prompt complete (or decode token): logits usable
                 # deliberate materialization point: one transfer per finished row

@@ -43,6 +43,7 @@ from jax.sharding import PartitionSpec as P
 from deepspeed_tpu.ops.attention import attention as attention_op
 from deepspeed_tpu.parallel.topology import (
     BATCH_AXES,
+    CONTEXT_AXIS,
     MODEL_AXIS,
     SEQUENCE_AXIS,
     constrain,
@@ -168,6 +169,11 @@ class TransformerConfig:
     # "ring" (ppermute blockwise — O(s/N) per-device memory, unbounded SP
     # degree; no segment_ids support)
     seq_impl: str = "ulysses"
+    # attention backend seam (ops.attention.core dispatch): "auto" picks the
+    # flash ring when the mesh's `context` axis is >1, else the platform
+    # best; "flash_ring" / "flash_head_sharded" / "flash" / "reference"
+    # force a specific path (hard error when shapes/mesh don't support it)
+    attention_impl: str = "auto"
     # >1: compute the LM loss per sequence tile so [b, s, vocab] logits never
     # materialize (ALST TiledFusedLogitsLoss, ulysses_sp.py:960) — frees
     # ~b*s*vocab bytes of activations at the cost of recomputing the head
@@ -204,6 +210,13 @@ class TransformerConfig:
             raise ValueError(
                 f"seq_impl={self.seq_impl!r}: expected 'ulysses' or 'ring' "
                 "(a typo would silently fall back to the wrong parallelism)"
+            )
+        if self.attention_impl not in (
+            "auto", "flash", "flash_head_sharded", "flash_ring", "reference"
+        ):
+            raise ValueError(
+                f"attention_impl={self.attention_impl!r}: expected 'auto', "
+                "'flash', 'flash_head_sharded', 'flash_ring' or 'reference'"
             )
         if self.attn_layer_pattern is not None:
             if self.sliding_window <= 0:
@@ -798,9 +811,17 @@ def _scale_embed(x, c: TransformerConfig, dtype):
 
 
 def _act_constraint(x, seq_sharded=True):
-    """Sharding constraint for [b, s, h] activations."""
+    """Sharding constraint for [b, s, h] activations. The sequence dim
+    shards over ``context`` (ring — every layer op outside attention is
+    pointwise over s, so per-device activations stay O(s/N) end to end)
+    and/or ``sequence`` (Ulysses)."""
     topo = get_topology()
-    seq = SEQUENCE_AXIS if (seq_sharded and topo.sequence_parallel_size > 1) else None
+    axes = []
+    if seq_sharded and topo.context_parallel_size > 1:
+        axes.append(CONTEXT_AXIS)
+    if seq_sharded and topo.sequence_parallel_size > 1:
+        axes.append(SEQUENCE_AXIS)
+    seq = tuple(axes) if len(axes) > 1 else (axes[0] if axes else None)
     return constrain(x, BATCH_AXES, seq, None)
 
 
@@ -897,7 +918,36 @@ def _attention_block(c: TransformerConfig, lp, x, positions, segment_ids, kv_cac
         out = attention_op(q, k, v, causal=False, bias=bias, scale=c.attn_scale)
     else:
         topo = get_topology()
-        if topo.sequence_parallel_size > 1:
+        impl = c.attention_impl
+        if impl == "auto" and topo.context_parallel_size > 1:
+            impl = "flash_ring"
+        if impl == "flash_ring":
+            # context parallelism: the ring shards the sequence dim itself
+            # (O(s/N) per-device activations); dispatch through the
+            # ops.attention seam so sharding constraints are pinned there
+            if topo.sequence_parallel_size > 1:
+                raise NotImplementedError(
+                    "ring context parallelism combined with sequence "
+                    "parallelism (Ulysses within a context shard) is not "
+                    "wired in the model attention block yet"
+                )
+            if not c.attn_causal:
+                raise NotImplementedError(
+                    "ring context parallelism is causal-only (the ring "
+                    "schedule streams the causal triangle)"
+                )
+            if c.sliding_window:
+                raise NotImplementedError(
+                    "sliding_window under ring context parallelism is not "
+                    "supported (band masks are global-position)"
+                )
+            out = attention_op(
+                q, k, v, causal=True, segment_ids=segment_ids,
+                scale=c.attn_scale, impl="flash_ring",
+                alibi_slopes=(jnp.asarray(alibi_slopes(nh))
+                              if c.position == "alibi" else None),
+            )
+        elif topo.sequence_parallel_size > 1:
             if c.position == "alibi":
                 raise NotImplementedError(
                     "alibi attention under sequence parallelism is not supported "
@@ -931,7 +981,7 @@ def _attention_block(c: TransformerConfig, lp, x, positions, segment_ids, kv_cac
             out = attention_op(
                 q, k, v, causal=True, segment_ids=segment_ids,
                 alibi_slopes=jnp.asarray(alibi_slopes(nh)),
-                alibi_positions=positions,
+                alibi_positions=positions, impl=impl,
             )
         else:
             # sliding windows ride the flash kernel (in-kernel band mask;
@@ -941,7 +991,7 @@ def _attention_block(c: TransformerConfig, lp, x, positions, segment_ids, kv_cac
             out = attention_op(
                 q, k, v, causal=c.attn_causal, segment_ids=segment_ids,
                 scale=c.attn_scale, window=c.sliding_window,
-                window_flag=local_flag,
+                window_flag=local_flag, impl=impl,
             )
     out = out.transpose(0, 2, 1, 3).reshape(b, s, nh * d)
     out = _proj(c, out, lp["wo"])

@@ -9,5 +9,14 @@ tests/unit/ops/test_attention.py.
 """
 
 from deepspeed_tpu.ops.attention.core import attention, mha_reference
+from deepspeed_tpu.ops.attention.sharded import (
+    head_sharded_flash,
+    ring_flash_attention,
+)
 
-__all__ = ["attention", "mha_reference"]
+__all__ = [
+    "attention",
+    "head_sharded_flash",
+    "mha_reference",
+    "ring_flash_attention",
+]

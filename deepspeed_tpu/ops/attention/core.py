@@ -124,94 +124,51 @@ def _flash_available() -> bool:
 
 def _flash_sharded(q, k, v, causal, segment_ids, scale, alibi_slopes=None,
                    alibi_positions=None, window=0, window_flag=None):
-    """Run the Pallas flash kernel under a multi-device mesh.
+    """Run the Pallas flash kernel under a multi-device mesh (batch/head
+    sharding — ops.attention.sharded.head_sharded_flash). Returns None when
+    the shapes don't divide; the caller falls back to the reference einsum
+    (GSPMD partitions that, but it materializes O(s²) scores — warn when
+    that happens with alibi at long sequence, the expensive case)."""
+    from deepspeed_tpu.ops.attention.sharded import head_sharded_flash
 
-    pallas_call is opaque to the GSPMD partitioner — invoked bare inside jit
-    it would force an all-gather of every operand. Batch and heads are
-    embarrassingly parallel for self-attention, so we pin the canonical
-    layout (batch over data/expert, heads over model+sequence — the TP and
-    post-Ulysses placements) and run the kernel under fully-manual shard_map;
-    each device computes its local (batch, head) slab over the full sequence.
-    """
-    from jax.sharding import PartitionSpec as P
-
-    from deepspeed_tpu.ops.attention.flash_pallas import flash_attention
-    from deepspeed_tpu.parallel.topology import (
-        BATCH_AXES,
-        MODEL_AXIS,
-        SEQUENCE_AXIS,
-        get_topology,
+    out = head_sharded_flash(
+        q, k, v, causal=causal, segment_ids=segment_ids, scale=scale,
+        alibi_slopes=alibi_slopes, alibi_positions=alibi_positions,
+        window=window, window_flag=window_flag,
     )
-
-    topo = get_topology()
-    if topo.world_size == 1:
-        return flash_attention(
-            q, k, v, causal=causal, segment_ids=segment_ids, scale=scale,
-            alibi_slopes=alibi_slopes, alibi_positions=alibi_positions,
-            window=window, window_flag=window_flag,
-        )
-    if alibi_slopes is not None:
-        # multi-device alibi would need the slope plane sharded with the
-        # head axes inside the manual region — not wired yet; the caller
-        # falls back to the reference einsum (GSPMD partitions that, but it
-        # materializes [b, h, s, s] fp32 scores — warn once, loudly)
+    if out is None and alibi_slopes is not None:
         global _warned_alibi_fallback
         if not _warned_alibi_fallback:
             _warned_alibi_fallback = True
             from deepspeed_tpu.utils.logging import logger
 
             logger.warning(
-                "alibi attention on a multi-device mesh falls back to the "
-                "dense reference path (O(seq²) HBM for scores) — the flash "
-                "kernel's in-kernel alibi is single-device only for now; "
-                "expect much higher memory at long sequence lengths"
+                "alibi attention fell back to the dense reference path "
+                "(O(seq²) HBM for scores): batch/head shapes do not divide "
+                "the mesh for the head-sharded flash kernel"
             )
-        return None
+    return out
 
+
+def _ring_eligible(q, k, bias, causal, window):
+    """Whether 'auto' dispatch may take the ring context-parallel path: the
+    topology's ``context`` axis is >1 (explicit opt-in via mesh config) and
+    the schedule/shapes fit the ring's contract."""
+    from deepspeed_tpu.parallel.topology import get_topology
+
+    topo = get_topology()
+    n = topo.context_parallel_size
+    if n <= 1 or bias is not None or not causal or window:
+        return False
     b, h, s, d = q.shape
-    h_kv = k.shape[1]
-    batch_div = topo.data_parallel_size * topo.expert_parallel_size
-    head_div = topo.model_parallel_size * topo.sequence_parallel_size
-    if b % batch_div or h % head_div or h_kv % head_div:
-        return None  # caller falls back to the reference impl
-    if (h // h_kv) > 1 and (h // head_div) % (h // h_kv) != 0:
-        return None  # GQA group would straddle a head shard
-    head_axes = (MODEL_AXIS, SEQUENCE_AXIS)
-    spec = P(BATCH_AXES, head_axes, None, None)
-    sharding = jax.sharding.NamedSharding(topo.mesh, spec)
-    q, k, v = (jax.lax.with_sharding_constraint(x, sharding) for x in (q, k, v))
+    h_kv, sk = k.shape[1], k.shape[2]
+    if s != sk or d not in (64, 128, 256) or s % n or (s // n) % 128:
+        return False
+    if not (_flash_available() or jax.default_backend() == "cpu"):
+        return False
+    from deepspeed_tpu.ops.attention.sharded import _divisible
 
-    # optional extra operands: segment ids (batch-sharded plane) and the
-    # traced per-layer window flag (replicated scalar)
-    extra_ops, extra_specs, has_seg, has_wf = [], [], segment_ids is not None, None
-    if has_seg:
-        seg_spec = P(BATCH_AXES, None)
-        segment_ids = jax.lax.with_sharding_constraint(
-            segment_ids, jax.sharding.NamedSharding(topo.mesh, seg_spec)
-        )
-        extra_ops.append(segment_ids)
-        extra_specs.append(seg_spec)
-    has_wf = window > 0 and window_flag is not None
-    if has_wf:
-        extra_ops.append(jnp.asarray(window_flag, jnp.int32))
-        extra_specs.append(P())
-
-    def body(q_, k_, v_, *rest):
-        rest = list(rest)
-        seg = rest.pop(0) if has_seg else None
-        wf = rest.pop(0) if has_wf else None
-        return flash_attention(q_, k_, v_, causal=causal, segment_ids=seg,
-                               scale=scale, window=window, window_flag=wf)
-
-    fn = jax.shard_map(
-        body,
-        mesh=topo.mesh,
-        in_specs=(spec, spec, spec, *extra_specs),
-        out_specs=spec,
-        axis_names=set(topo.mesh.axis_names),
-        check_vma=False,
-    )
-    return fn(q, k, v, *extra_ops)
+    return _divisible(topo, b, h, h_kv, s=s)
 
 
 def attention(
@@ -228,14 +185,63 @@ def attention(
     window: int = 0,
     window_flag: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """Dispatching attention entry point. ``impl`` forces 'flash' or
-    'reference'. ALiBi and sliding windows ride the flash path (in-kernel
-    masking; a static window additionally prunes out-of-band kv blocks from
-    the grid); a dense ``bias`` forces the reference path."""
+    """Dispatching attention entry point.
+
+    ``impl`` selects the backend:
+      * None / 'auto' — flash when the platform/shapes allow (ring context
+        parallelism when the topology's ``context`` axis is >1 and the
+        schedule supports it), else the jnp reference;
+      * 'flash' — flash kernel, auto-sharded over batch/head axes;
+      * 'flash_head_sharded' — splash-style head sharding, hard error if the
+        shapes don't divide the mesh;
+      * 'flash_ring' — context-parallel ring over the ``context`` mesh axis
+        (causal only; hard error on unsupported schedules);
+      * 'reference' — the jnp einsum.
+    ALiBi and sliding windows ride the flash path (in-kernel masking; a
+    static window additionally prunes out-of-band kv blocks from the grid);
+    a dense ``bias`` forces the reference path."""
     d = q.shape[-1]
     sq, sk = q.shape[2], k.shape[2]
+    if impl == "reference":
+        return mha_reference(
+            q, k, v, causal=causal, segment_ids=segment_ids, bias=bias,
+            scale=scale, alibi_slopes=alibi_slopes,
+            alibi_positions=alibi_positions, window=window,
+            window_flag=window_flag,
+        )
+    if impl in ("flash_head_sharded", "flash_ring"):
+        from deepspeed_tpu.ops.attention import sharded
+
+        if bias is not None:
+            raise ValueError(f"attention(impl={impl!r}): dense bias is not "
+                             "supported on the flash paths")
+        if impl == "flash_ring":
+            return sharded.ring_flash_attention(
+                q, k, v, causal=causal, segment_ids=segment_ids, scale=scale,
+                alibi_slopes=alibi_slopes, window=window,
+                interpret=not _flash_available(),
+            )
+        out = sharded.head_sharded_flash(
+            q, k, v, causal=causal, segment_ids=segment_ids, scale=scale,
+            alibi_slopes=alibi_slopes, alibi_positions=alibi_positions,
+            window=window, window_flag=window_flag,
+            interpret=not _flash_available(),
+        )
+        if out is None:
+            raise ValueError(
+                "attention(impl='flash_head_sharded'): batch/head shapes "
+                f"{q.shape} do not divide the mesh"
+            )
+        return out
+    if impl in (None, "auto") and _ring_eligible(q, k, bias, causal, window):
+        from deepspeed_tpu.ops.attention import sharded
+
+        return sharded.ring_flash_attention(
+            q, k, v, causal=causal, segment_ids=segment_ids, scale=scale,
+            alibi_slopes=alibi_slopes, interpret=not _flash_available(),
+        )
     use_flash = impl == "flash" or (
-        impl is None
+        impl in (None, "auto")
         and _flash_available()
         and bias is None
         and d in (64, 128, 256)

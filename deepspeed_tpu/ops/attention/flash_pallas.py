@@ -68,18 +68,31 @@ def _apply_window(logits, window, wflag_ref, q_pos, k_pos):
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
                 scale, causal, bq, bk, nk, window=0, seg_q_ref=None,
-                seg_k_ref=None, alibi_ref=None, kpos_ref=None, wflag_ref=None):
+                seg_k_ref=None, alibi_ref=None, kpos_ref=None, wflag_ref=None,
+                m_in_ref=None, l_in_ref=None, acc_in_ref=None, l_out_ref=None):
     # q_ref: [bq, d]; k_ref/v_ref: [bk, d] (one streamed block);
     # o_ref: [bq, d]; lse_ref: [bq, LANES]; scratch m/l: [bq, LANES] f32,
     # acc: [bq, d] f32 — carried across the minor (kv) grid dimension.
+    #
+    # Carry mode (ring attention, ops/attention/sharded.py): ``m_in_ref``/
+    # ``l_in_ref``/``acc_in_ref`` seed the softmax state from a previous
+    # chunk instead of (-inf, 0, 0), and ``l_out_ref`` switches the flush to
+    # RAW state output — (acc, m, l) via (o_ref, lse_ref, l_out_ref), no
+    # normalization — so chunked streaming is bit-identical to one long
+    # in-kernel stream.
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
     @pl.when(ki == 0)
     def _init():
-        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[:] = jnp.zeros_like(l_ref)
-        acc_ref[:] = jnp.zeros_like(acc_ref)
+        if m_in_ref is None:
+            m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+            l_ref[:] = jnp.zeros_like(l_ref)
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+        else:
+            m_ref[:] = m_in_ref[:]
+            l_ref[:] = l_in_ref[:]
+            acc_ref[:] = acc_in_ref[:].astype(jnp.float32)
 
     hi = (qi * bq + bq - 1) // bk  # last kv block a causal q block touches
     active = (ki <= hi) if causal else (ki >= 0)
@@ -122,23 +135,38 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
 
     @pl.when(ki == nk - 1)
     def _flush():
-        l_safe = jnp.maximum(l_ref[:, 0], 1e-30)
-        o_ref[:] = (acc_ref[:] / l_safe[:, None]).astype(o_ref.dtype)
-        lse_ref[:] = jnp.broadcast_to(
-            (m_ref[:, 0] + jnp.log(l_safe))[:, None], (bq, LANES)
-        )
+        if l_out_ref is not None:
+            # raw-state flush: the caller continues the stream (or finalizes
+            # with flash_finalize, whose math mirrors the branch below)
+            o_ref[:] = acc_ref[:]
+            lse_ref[:] = m_ref[:]
+            l_out_ref[:] = l_ref[:]
+        else:
+            l_safe = jnp.maximum(l_ref[:, 0], 1e-30)
+            o_ref[:] = (acc_ref[:] / l_safe[:, None]).astype(o_ref.dtype)
+            lse_ref[:] = jnp.broadcast_to(
+                (m_ref[:, 0] + jnp.log(l_safe))[:, None], (bq, LANES)
+            )
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
                    delta_ref, dq_acc_ref, *, scale, causal, bq, bk, nk,
                    window=0, seg_q_ref=None, seg_k_ref=None, alibi_ref=None,
-                   kpos_ref=None, wflag_ref=None):
+                   kpos_ref=None, wflag_ref=None, dq_in_ref=None,
+                   raw_out=False):
+    # Carry mode (ring bwd): ``dq_in_ref`` seeds the accumulator from the
+    # previous chunk's partial and ``raw_out`` flushes it unscaled in f32 —
+    # the ring applies `* scale` once after the last chunk, exactly like the
+    # single-kernel flush.
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
     @pl.when(ki == 0)
     def _init():
-        dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
+        if dq_in_ref is None:
+            dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
+        else:
+            dq_acc_ref[:] = dq_in_ref[:]
         delta = jnp.sum(
             do_ref[:].astype(jnp.float32) * o_ref[:].astype(jnp.float32), axis=-1
         )
@@ -183,20 +211,30 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
 
     @pl.when(ki == nk - 1)
     def _flush():
-        dq_ref[:] = (dq_acc_ref[:] * scale).astype(dq_ref.dtype)
+        if raw_out:
+            dq_ref[:] = dq_acc_ref[:]
+        else:
+            dq_ref[:] = (dq_acc_ref[:] * scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref,
                     dv_ref, dk_acc_ref, dv_acc_ref, *, scale, causal, bq, bk,
                     nq, window=0, seg_q_ref=None, seg_k_ref=None,
-                    alibi_ref=None, kpos_ref=None, wflag_ref=None):
+                    alibi_ref=None, kpos_ref=None, wflag_ref=None,
+                    dk_in_ref=None, dv_in_ref=None, raw_out=False):
+    # Carry mode mirrors _bwd_dq_kernel: seed accumulators from the previous
+    # chunk's partials, flush raw f32 when ``raw_out``.
     ki = pl.program_id(2)
     qj = pl.program_id(3)
 
     @pl.when(qj == 0)
     def _init():
-        dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
-        dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
+        if dk_in_ref is None:
+            dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
+            dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
+        else:
+            dk_acc_ref[:] = dk_in_ref[:]
+            dv_acc_ref[:] = dv_in_ref[:]
 
     lo = (ki * bk) // bq  # first q block that sees this kv block
     active = (qj >= lo) if causal else (qj >= 0)
@@ -249,9 +287,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref,
 
     @pl.when(qj == nq - 1)
     def _flush():
-        # scale moved onto the logits, so dk picks it up (dlogits/dk = scale*q)
-        dk_ref[:] = (dk_acc_ref[:] * scale).astype(dk_ref.dtype)
-        dv_ref[:] = dv_acc_ref[:].astype(dv_ref.dtype)
+        if raw_out:
+            dk_ref[:] = dk_acc_ref[:]
+            dv_ref[:] = dv_acc_ref[:]
+        else:
+            # scale moved onto the logits, so dk picks it up (dlogits/dk = scale*q)
+            dk_ref[:] = (dk_acc_ref[:] * scale).astype(dk_ref.dtype)
+            dv_ref[:] = dv_acc_ref[:].astype(dv_ref.dtype)
 
 
 def _pick_block(s, target=None):
@@ -310,16 +352,7 @@ def flash_attention(
     alibi = None
     if alibi_slopes is not None:
         b, _, s, _ = q.shape
-        slopes = jnp.asarray(alibi_slopes, jnp.float32)
-        pos = (
-            jnp.arange(s, dtype=jnp.int32)
-            if alibi_positions is None
-            else jnp.asarray(alibi_positions, jnp.int32)
-        )
-        if pos.ndim == 1:
-            pos = jnp.broadcast_to(pos[None], (b, s))
-        # lane-broadcast plane per head: the kernel reads [1, LANES] blocks
-        alibi = (jnp.broadcast_to(slopes[:, None], (slopes.shape[0], LANES)), pos)
+        alibi = build_alibi_operand(alibi_slopes, alibi_positions, b, s)
     wflag = None
     if window and window_flag is not None:
         wflag = jnp.broadcast_to(
@@ -364,11 +397,21 @@ def _seg_specs(segment_ids, q_block, q_map, k_block, k_map):
     """(extra operands, extra in_specs) for the [b, s] segment-id planes.
     ``q_map``/``k_map`` are (i, j) -> block-index functions — the same clamps
     used for the q and k/v tensor specs, so masked grid points re-fetch the
-    previous seg block (copy elided) exactly like their tensors."""
+    previous seg block (copy elided) exactly like their tensors.
+
+    ``segment_ids`` may be one [b, s] plane (self-attention: the same ids
+    mask both sides) or a ``(seg_q, seg_k)`` pair of [b, sq]/[b, sk] planes —
+    the ring path's chunks carry DIFFERENT q-side and k-side id planes (the
+    k chunk rotates, the q chunk stays home)."""
     if segment_ids is None:
         return [], []
-    seg = segment_ids.astype(jnp.int32)
-    return [seg, seg], [
+    if isinstance(segment_ids, tuple):
+        seg_q, seg_k = segment_ids
+    else:
+        seg_q = seg_k = segment_ids
+    seg_q = seg_q.astype(jnp.int32)
+    seg_k = seg_k.astype(jnp.int32)
+    return [seg_q, seg_k], [
         pl.BlockSpec((1, q_block), lambda b_, h_, i, j: (b_, q_map(i, j))),
         pl.BlockSpec((1, k_block), lambda b_, h_, i, j: (b_, k_map(i, j))),
     ]
@@ -595,3 +638,283 @@ def _flash_bwd(causal, scale, window, interpret, res, g):
 
 
 _flash_core.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Chunked (ring) entry points — ops/attention/sharded.py
+#
+# The ring context-parallel layer streams k/v (forward, dq) or q/do (dk/dv)
+# CHUNKS through the same kernels above, threading the raw softmax state /
+# gradient accumulators between pallas_calls instead of carrying them in VMEM
+# scratch across one long grid. Because chunk arrival order is arranged to
+# match the single-kernel streaming order (ascending global blocks) and the
+# block size matches, the chunked stream is BIT-IDENTICAL to one
+# flash_attention call over the gathered sequence — the acceptance bar for
+# the ring path (tests/unit/ops/test_sharded_attention.py, atol 0).
+# ---------------------------------------------------------------------------
+
+
+def build_alibi_operand(alibi_slopes, alibi_positions, b, s):
+    """Kernel-ready ALiBi operand: ([h, LANES] lane-broadcast slope plane,
+    [b, s] int32 key positions). ``alibi_positions`` defaults to arange —
+    ring chunks pass their GLOBAL key positions so slope·kpos matches the
+    unsharded kernel exactly."""
+    slopes = jnp.asarray(alibi_slopes, jnp.float32)
+    pos = (
+        jnp.arange(s, dtype=jnp.int32)
+        if alibi_positions is None
+        else jnp.asarray(alibi_positions, jnp.int32)
+    )
+    if pos.ndim == 1:
+        pos = jnp.broadcast_to(pos[None], (b, s))
+    return (jnp.broadcast_to(slopes[:, None], (slopes.shape[0], LANES)), pos)
+
+
+def flash_carry_init(b, h, s, d):
+    """Initial (m, l, acc) softmax carry — identical to the kernel's ki==0
+    seed (NEG_INF, not -inf: matches ``_fwd_kernel._init`` bitwise)."""
+    return (
+        jnp.full((b, h, s, LANES), NEG_INF, jnp.float32),
+        jnp.zeros((b, h, s, LANES), jnp.float32),
+        jnp.zeros((b, h, s, d), jnp.float32),
+    )
+
+
+def flash_finalize(carry, dtype):
+    """Normalize a streamed carry into (out, lse[..., :1]) with math identical
+    to the kernel's non-raw flush (``_fwd_kernel._flush``):
+    ``out = acc / max(l, 1e-30)``; ``lse = m + log(max(l, 1e-30))``."""
+    m, l, acc = carry
+    l_safe = jnp.maximum(l[..., :1], 1e-30)
+    out = (acc / l_safe).astype(dtype)
+    lse = m[..., :1] + jnp.log(l_safe)
+    return out, lse
+
+
+def flash_fwd_chunk(q, k, v, carry, segment_ids=None, alibi=None,
+                    causal=False, scale=None, block=None, interpret=False):
+    """Stream ONE k/v chunk into a carried flash softmax state.
+
+    q: [b, h, sq, d] (the home query shard); k, v: [b, h_kv, sk, d] (the
+    chunk currently held by this ring step). ``carry`` is ``(m, l, acc)``
+    from :func:`flash_carry_init` or a previous chunk. ``causal=True`` marks
+    the DIAGONAL chunk (sq == sk, local positions — the global offset cancels
+    on both sides of the mask). ``segment_ids`` is a ``(seg_q, seg_k)`` pair;
+    ``alibi`` a :func:`build_alibi_operand` tuple whose kpos plane holds this
+    chunk's GLOBAL key positions. ``block`` must equal the block size the
+    equivalent single-device call would pick for bitwise parity.
+
+    Returns the updated ``(m, l, acc)``.
+    """
+    b, h, sq, d = q.shape
+    h_kv, sk = k.shape[1], k.shape[2]
+    group = h // h_kv
+    scale = scale if scale is not None else d ** -0.5
+    if causal and sq != sk:
+        raise ValueError("flash_fwd_chunk: causal=True is the diagonal chunk; needs sq == sk")
+    bq = _pick_block(sq, target=block)
+    bk = _pick_block(sk, target=block)
+    nq, nk = sq // bq, sk // bk
+    jc = _kv_clamp(causal, bq, bk)
+    m, l, acc = carry
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk
+    )
+    seg_ops, seg_specs = _seg_specs(segment_ids, bq, lambda i, j: i, bk, jc)
+    alibi_ops, alibi_specs = _alibi_specs(alibi, bk, jc)
+
+    def entry(qr, kr, vr, mir, lir, air, *rest):
+        rest = list(rest)
+        kw = {
+            "m_in_ref": mir.at[0, 0],
+            "l_in_ref": lir.at[0, 0],
+            "acc_in_ref": air.at[0, 0],
+        }
+        if seg_ops:
+            kw["seg_q_ref"] = rest.pop(0).at[0]
+            kw["seg_k_ref"] = rest.pop(0).at[0]
+        if alibi_ops:
+            kw["alibi_ref"] = rest.pop(0)
+            kw["kpos_ref"] = rest.pop(0).at[0]
+        aor, mor, lor, mref, lref, aref = rest
+        kernel(qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], aor.at[0, 0],
+               mor.at[0, 0], mref, lref, aref, l_out_ref=lor.at[0, 0], **kw)
+
+    q_spec = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0))
+    lane_spec = pl.BlockSpec((1, 1, bq, LANES), lambda b_, h_, i, j: (b_, h_, i, 0))
+    acc_out, m_out, l_out = pl.pallas_call(
+        entry,
+        grid=(b, h, nq, nk),
+        in_specs=[
+            q_spec,
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, h_, i, j: (b_, h_ // group, jc(i, j), 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, h_, i, j: (b_, h_ // group, jc(i, j), 0)),
+            lane_spec,  # m carry-in
+            lane_spec,  # l carry-in
+            q_spec,     # acc carry-in
+        ] + seg_specs + alibi_specs,
+        out_specs=[q_spec, lane_spec, lane_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sq, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sq, LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, LANES), jnp.float32),
+            pltpu.VMEM((bq, LANES), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, m, l, acc, *seg_ops, *alibi_ops)
+    return m_out, l_out, acc_out
+
+
+def flash_dq_chunk(q, k, v, out, do, lse, dq_acc, segment_ids=None,
+                   alibi=None, causal=False, scale=None, block=None,
+                   interpret=False):
+    """One ring hop of the dq backward: fold this k/v chunk's contribution
+    into ``dq_acc`` ([b, h, sq, d] f32, UNSCALED). ``lse`` is the GLOBAL
+    log-sum-exp ([..., 1] or lane-broadcast) — the flash recompute
+    p = exp(qk·scale − lse) is exact per chunk, so chunk order only affects
+    the dq sum, which :func:`flash_dq_finalize` scales/casts once at the end
+    exactly like the single-kernel flush."""
+    b, h, sq, d = q.shape
+    h_kv, sk = k.shape[1], k.shape[2]
+    group = h // h_kv
+    scale = scale if scale is not None else d ** -0.5
+    if causal and sq != sk:
+        raise ValueError("flash_dq_chunk: causal=True is the diagonal chunk; needs sq == sk")
+    bq = _pick_block(sq, target=block)
+    bk = _pick_block(sk, target=block)
+    nq, nk = sq // bq, sk // bk
+    jc = _kv_clamp(causal, bq, bk)
+    lse = jnp.broadcast_to(lse, lse.shape[:-1] + (LANES,))
+
+    kernel = functools.partial(
+        _bwd_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
+        raw_out=True,
+    )
+    seg_ops, seg_specs = _seg_specs(segment_ids, bq, lambda i, j: i, bk, jc)
+    alibi_ops, alibi_specs = _alibi_specs(alibi, bk, jc)
+
+    def entry(qr, kr, vr, orf, dor, lr, dqi, *rest):
+        rest = list(rest)
+        kw = {"dq_in_ref": dqi.at[0, 0]}
+        if seg_ops:
+            kw["seg_q_ref"] = rest.pop(0).at[0]
+            kw["seg_k_ref"] = rest.pop(0).at[0]
+        if alibi_ops:
+            kw["alibi_ref"] = rest.pop(0)
+            kw["kpos_ref"] = rest.pop(0).at[0]
+        dqr, dref, aref = rest
+        kernel(qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], orf.at[0, 0],
+               dor.at[0, 0], lr.at[0, 0], dqr.at[0, 0], dref, aref, **kw)
+
+    q_spec = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0))
+    kv_spec = pl.BlockSpec((1, 1, bk, d),
+                           lambda b_, h_, i, j: (b_, h_ // group, jc(i, j), 0))
+    lane_spec = pl.BlockSpec((1, 1, bq, LANES), lambda b_, h_, i, j: (b_, h_, i, 0))
+    return pl.pallas_call(
+        entry,
+        grid=(b, h, nq, nk),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, q_spec, lane_spec, q_spec]
+        + seg_specs + alibi_specs,
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((bq, LANES), jnp.float32),  # delta
+            pltpu.VMEM((bq, d), jnp.float32),      # dq accumulator
+        ],
+        interpret=interpret,
+    )(q, k, v, out, do, lse, dq_acc, *seg_ops, *alibi_ops)
+
+
+def flash_dkv_chunk(q, k, v, out, do, lse, dk_acc, dv_acc, segment_ids=None,
+                    alibi=None, causal=False, scale=None, block=None,
+                    interpret=False):
+    """One ring hop of the dk/dv backward: the HOME k/v chunk absorbs the
+    contribution of a visiting q-side chunk (q/out/do/lse rotate; the
+    accumulators stay put). ``dk_acc``/``dv_acc`` are [b, h, sk, d] f32
+    PER-Q-HEAD partials (unscaled); :func:`flash_dkv_finalize` applies the
+    scale/cast and GQA group reduction after the last chunk."""
+    b, h, sq, d = q.shape
+    h_kv, sk = k.shape[1], k.shape[2]
+    group = h // h_kv
+    scale = scale if scale is not None else d ** -0.5
+    if causal and sq != sk:
+        raise ValueError("flash_dkv_chunk: causal=True is the diagonal chunk; needs sq == sk")
+    bq = _pick_block(sq, target=block)
+    bk = _pick_block(sk, target=block)
+    nq, nk = sq // bq, sk // bk
+    qc = _q_clamp(causal, bq, bk, nq=nq)
+    lse = jnp.broadcast_to(lse, lse.shape[:-1] + (LANES,))
+
+    kernel = functools.partial(
+        _bwd_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nq=nq,
+        raw_out=True,
+    )
+    seg_ops, seg_specs = _seg_specs(segment_ids, bq, qc, bk, lambda i, j: i)
+    alibi_ops, alibi_specs = _alibi_specs(alibi, bk, lambda i, j: i)
+
+    def entry(qr, kr, vr, orf, dor, lr, dki, dvi, *rest):
+        rest = list(rest)
+        kw = {"dk_in_ref": dki.at[0, 0], "dv_in_ref": dvi.at[0, 0]}
+        if seg_ops:
+            kw["seg_q_ref"] = rest.pop(0).at[0]
+            kw["seg_k_ref"] = rest.pop(0).at[0]
+        if alibi_ops:
+            kw["alibi_ref"] = rest.pop(0)
+            kw["kpos_ref"] = rest.pop(0).at[0]
+        dkr, dvr, dka, dva = rest
+        kernel(qr.at[0, 0], kr.at[0, 0], vr.at[0, 0], orf.at[0, 0],
+               dor.at[0, 0], lr.at[0, 0], dkr.at[0, 0], dvr.at[0, 0],
+               dka, dva, **kw)
+
+    q_spec = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, qc(i, j), 0))
+    kv_in_spec = pl.BlockSpec((1, 1, bk, d),
+                              lambda b_, h_, i, j: (b_, h_ // group, i, 0))
+    kv_spec = pl.BlockSpec((1, 1, bk, d), lambda b_, h_, i, j: (b_, h_, i, 0))
+    lane_spec = pl.BlockSpec((1, 1, bq, LANES),
+                             lambda b_, h_, i, j: (b_, h_, qc(i, j), 0))
+    return pl.pallas_call(
+        entry,
+        grid=(b, h, nk, nq),
+        in_specs=[q_spec, kv_in_spec, kv_in_spec, q_spec, q_spec, lane_spec,
+                  kv_spec, kv_spec] + seg_specs + alibi_specs,
+        out_specs=[kv_spec, kv_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sk, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sk, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, out, do, lse, dk_acc, dv_acc, *seg_ops, *alibi_ops)
+
+
+def flash_dq_finalize(dq_acc, scale, dtype):
+    """Scale + cast the streamed dq accumulator — identical to the
+    single-kernel flush (``_bwd_dq_kernel._flush``, raw_out=False)."""
+    return (dq_acc * scale).astype(dtype)
+
+
+def flash_dkv_finalize(dk_acc, dv_acc, scale, dtype, h_kv):
+    """Scale/cast the streamed per-q-head dk/dv partials and reduce the GQA
+    group — the exact cast-then-f32-sum order of ``_flash_bwd``."""
+    b, h, s, d = dk_acc.shape
+    dk = (dk_acc * scale).astype(dtype)
+    dv = dv_acc.astype(dtype)
+    if h != h_kv:
+        group = h // h_kv
+        dk = jnp.sum(
+            dk.reshape(b, h_kv, group, s, d).astype(jnp.float32), axis=2
+        ).astype(dtype)
+        dv = jnp.sum(
+            dv.reshape(b, h_kv, group, s, d).astype(jnp.float32), axis=2
+        ).astype(dtype)
+    return dk, dv

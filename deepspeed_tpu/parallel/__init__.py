@@ -1,5 +1,6 @@
 from deepspeed_tpu.parallel.topology import (
     BATCH_AXES,
+    CONTEXT_AXIS,
     DATA_AXIS,
     EXPERT_AXIS,
     MESH_AXES,

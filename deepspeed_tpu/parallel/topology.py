@@ -36,9 +36,17 @@ PIPE_AXIS = "pipe"
 DATA_AXIS = "data"
 ZERO_AXIS = "zero"
 EXPERT_AXIS = "expert"
+# ``context`` shards the SEQUENCE dimension itself for ring attention
+# (context parallelism, O(s/N) activations); distinct from ``sequence``,
+# which is Ulysses-style (all-to-all head scatter, per-device memory O(s)).
+# Both can be >1 at once: Ulysses within a context shard.
+CONTEXT_AXIS = "context"
 SEQUENCE_AXIS = "sequence"
 MODEL_AXIS = "model"
-MESH_AXES = (PIPE_AXIS, DATA_AXIS, ZERO_AXIS, EXPERT_AXIS, SEQUENCE_AXIS, MODEL_AXIS)
+MESH_AXES = (
+    PIPE_AXIS, DATA_AXIS, ZERO_AXIS, EXPERT_AXIS, CONTEXT_AXIS, SEQUENCE_AXIS,
+    MODEL_AXIS,
+)
 
 # Axis set that jointly shards the batch dimension (DP world).
 BATCH_AXES = (DATA_AXIS, ZERO_AXIS, EXPERT_AXIS)
@@ -57,28 +65,32 @@ class Topology:
         sequence: int = 1,
         expert: int = 1,
         zero: int = 1,
+        context: int = 1,
         devices: Optional[Sequence] = None,
     ):
         if devices is None:
             devices = jax.devices()
         n = len(devices)
-        fixed = model * pipe * sequence * expert * zero
+        fixed = model * pipe * sequence * expert * zero * context
         if n % fixed != 0:
             raise ValueError(
-                f"device count {n} not divisible by model*pipe*sequence*expert*zero={fixed}"
+                f"device count {n} not divisible by "
+                f"model*pipe*context*sequence*expert*zero={fixed}"
             )
         if data in (0, None):
             data = n // fixed
         if data * fixed != n:
             raise ValueError(
                 f"mesh sizes pipe={pipe} data={data} zero={zero} expert={expert} "
-                f"sequence={sequence} model={model} do not multiply to device count {n}"
+                f"context={context} sequence={sequence} model={model} do not "
+                f"multiply to device count {n}"
             )
         self.sizes = {
             PIPE_AXIS: pipe,
             DATA_AXIS: data,
             ZERO_AXIS: zero,
             EXPERT_AXIS: expert,
+            CONTEXT_AXIS: context,
             SEQUENCE_AXIS: sequence,
             MODEL_AXIS: model,
         }
@@ -122,6 +134,11 @@ class Topology:
     @property
     def sequence_parallel_size(self) -> int:
         return self.sizes[SEQUENCE_AXIS]
+
+    @property
+    def context_parallel_size(self) -> int:
+        """Ring (context-parallel) degree: shards the sequence dim itself."""
+        return self.sizes[CONTEXT_AXIS]
 
     @property
     def expert_parallel_size(self) -> int:

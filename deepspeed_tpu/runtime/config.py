@@ -186,6 +186,7 @@ class MeshConfig(DSConfigModel):
     model: int = 1  # tensor parallel
     pipe: int = 1  # pipeline parallel
     sequence: int = 1  # Ulysses / ring sequence parallel
+    context: int = 1  # ring context parallel (shards the sequence dim itself)
     expert: int = 1  # MoE expert parallel
 
 

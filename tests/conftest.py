@@ -64,6 +64,7 @@ _SMOKE_FILES = {
     "test_qmatmul.py", "test_moe_gemm.py", "test_native_ops.py",
     "test_sparse_attention.py", "test_transformer_layer.py",
     "test_fused_ce.py", "test_misc_ops.py", "test_evoformer.py",
+    "test_sharded_attention.py",
 }
 
 
@@ -76,6 +77,19 @@ def pytest_collection_modifyitems(config, items):
         if fname in _SMOKE_FILES and fname not in seen:
             item.add_marker(pytest.mark.smoke)
             seen.add(fname)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches():
+    """Drop compiled-program caches at module boundaries.
+
+    Modules rarely share compiled functions (each test builds fresh jit
+    closures), but the accumulated cache makes lookups and tracing
+    progressively slower — late-alphabet modules were running 2-3x their
+    standalone time by the end of the suite.
+    """
+    yield
+    jax.clear_caches()
 
 
 @pytest.fixture(autouse=True)

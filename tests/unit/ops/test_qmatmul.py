@@ -61,6 +61,11 @@ def test_bad_mode_rejected():
         qmatmul(x, w, "int4")
 
 
+# the dense arm is identical for every parametrized mode — train it once
+# per process, not once per mode
+_DENSE_TRAJECTORY = {}
+
+
 @pytest.mark.parametrize("mode", ["fp8", "int8"])
 def test_model_loss_parity_and_training(mode, devices8):
     """The quantized model trains and its loss trajectory stays within
@@ -72,6 +77,9 @@ def test_model_loss_parity_and_training(mode, devices8):
     for prec in ("default", mode):
         from deepspeed_tpu.parallel.topology import reset_topology
 
+        if prec == "default" and "traj" in _DENSE_TRAJECTORY:
+            losses[prec] = _DENSE_TRAJECTORY["traj"]
+            continue
         reset_topology()
         cfg = get_config("tiny", dtype="float32", matmul_precision=prec)
         params = init_params(cfg, jax.random.key(0))
@@ -87,6 +95,8 @@ def test_model_loss_parity_and_training(mode, devices8):
         )
         toks = np.random.default_rng(0).integers(0, cfg.vocab_size, size=(8, 33)).astype(np.int32)
         losses[prec] = [float(engine.train_batch(batch={"input_ids": toks})) for _ in range(6)]
+        if prec == "default":
+            _DENSE_TRAJECTORY["traj"] = losses[prec]
     dense, quant = losses["default"], losses[mode]
     assert quant[-1] < quant[0], quant  # trains
     # trajectory parity at every step: per-channel int8 is tighter than the

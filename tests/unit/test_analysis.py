@@ -346,6 +346,104 @@ class TestUnlockedSharedMutation:
 
 
 # ---------------------------------------------------------------------------
+# shard-map-axis-coverage
+# ---------------------------------------------------------------------------
+class TestShardMapAxisCoverage:
+    def test_omitted_axis_flagged(self, tmp_path):
+        found = _lint(tmp_path, """
+            import jax
+            from jax.sharding import PartitionSpec as P
+            from deepspeed_tpu.parallel.topology import CONTEXT_AXIS, DATA_AXIS
+
+            def body(x):
+                return x * 2
+
+            def run(mesh, x):
+                fn = jax.shard_map(
+                    body, mesh=mesh,
+                    in_specs=(P(DATA_AXIS, None),),
+                    out_specs=P(DATA_AXIS, None),
+                    axis_names={DATA_AXIS, CONTEXT_AXIS},
+                    check_vma=False,
+                )
+                return fn(x)
+        """, "shard-map-axis-coverage")
+        assert len(found) == 1
+        assert "'context'" in found[0].message
+        assert found[0].severity == "warning"
+
+    def test_axis_in_spec_clean(self, tmp_path):
+        found = _lint(tmp_path, """
+            import jax
+            from jax.sharding import PartitionSpec as P
+            from deepspeed_tpu.parallel.topology import (
+                BATCH_AXES, CONTEXT_AXIS,
+            )
+
+            def run(mesh, x):
+                spec = P(BATCH_AXES, CONTEXT_AXIS, None)
+                fn = jax.shard_map(
+                    lambda x_: x_ + 1, mesh=mesh,
+                    in_specs=(spec,), out_specs=spec,
+                    axis_names={*BATCH_AXES, CONTEXT_AXIS},
+                    check_vma=False,
+                )
+                return fn(x)
+        """, "shard-map-axis-coverage")
+        assert found == []
+
+    def test_axis_used_by_body_collective_clean(self, tmp_path):
+        # outputs legitimately replicated: the body psums over the axis
+        found = _lint(tmp_path, """
+            import jax
+            from jax.sharding import PartitionSpec as P
+            from deepspeed_tpu.parallel.topology import PIPE_AXIS
+
+            def body(x):
+                return jax.lax.psum(x, PIPE_AXIS)
+
+            def run(mesh, x):
+                fn = jax.shard_map(
+                    body, mesh=mesh, in_specs=(P(),), out_specs=P(),
+                    axis_names={PIPE_AXIS}, check_vma=False,
+                )
+                return fn(x)
+        """, "shard-map-axis-coverage")
+        assert found == []
+
+    def test_unresolvable_axis_names_skipped(self, tmp_path):
+        found = _lint(tmp_path, """
+            import jax
+            from jax.sharding import PartitionSpec as P
+
+            def run(topo, x):
+                fn = jax.shard_map(
+                    lambda x_: x_, mesh=topo.mesh,
+                    in_specs=(P(),), out_specs=P(),
+                    axis_names=set(topo.mesh.axis_names), check_vma=False,
+                )
+                return fn(x)
+        """, "shard-map-axis-coverage")
+        assert found == []
+
+    def test_noqa_suppresses(self, tmp_path):
+        found = _lint(tmp_path, """
+            import jax
+            from jax.sharding import PartitionSpec as P
+            from deepspeed_tpu.parallel.topology import CONTEXT_AXIS
+
+            def run(mesh, x):
+                fn = jax.shard_map(  # dstpu: noqa[shard-map-axis-coverage]
+                    lambda x_: x_, mesh=mesh,
+                    in_specs=(P(),), out_specs=P(),
+                    axis_names={CONTEXT_AXIS}, check_vma=False,
+                )
+                return fn(x)
+        """, "shard-map-axis-coverage")
+        assert found == []
+
+
+# ---------------------------------------------------------------------------
 # framework mechanics
 # ---------------------------------------------------------------------------
 class TestFramework:
@@ -382,6 +480,7 @@ class TestFramework:
             "donate-arity",
             "host-sync-in-loop",
             "impure-jit",
+            "shard-map-axis-coverage",
             "unlocked-shared-mutation",
         }
 

@@ -153,7 +153,7 @@ class TestPipelinedTransformer:
 
         reset_topology()
         set_topology(Topology())
-        ref = float(make_loss_fn(cfg)(params, batch))
+        ref = float(jax.jit(make_loss_fn(cfg))(params, batch))
 
         reset_topology()
         topo = Topology(pipe=4, data=2)
@@ -183,7 +183,7 @@ class TestPipelinedTransformer:
 
         reset_topology()
         set_topology(Topology())
-        ref = float(make_loss_fn(cfg)(params, batch))
+        ref = float(jax.jit(make_loss_fn(cfg))(params, batch))
 
         reset_topology()
         topo = Topology(pipe=4, data=2)
@@ -198,7 +198,7 @@ class TestPipelinedTransformer:
         )
         reset_topology()
         set_topology(Topology())
-        ref2 = float(make_loss_fn(cfg2)(params, batch))
+        ref2 = float(jax.jit(make_loss_fn(cfg2))(params, batch))
         reset_topology()
         set_topology(topo)
         out2 = float(jax.jit(make_pipelined_loss_fn(cfg2, micro_batches=2, topo=topo))(params, batch))

@@ -136,13 +136,13 @@ def test_zero_one_adam_skips_and_reconverges(devices8):
     # built ONCE outside the step loop: a fresh shard_map wrapper per call
     # is a new function identity, so every iteration recompiled the
     # 8-device collective program (~8x this test's runtime)
-    shard_fn = jax.shard_map(
+    shard_fn = jax.jit(jax.shard_map(
         inner, mesh=mesh,
         in_specs=(state_spec, P("data")),
         out_specs=(state_spec, P("data")),
         axis_names={"data"},
         check_vma=False,
-    )
+    ))
 
     def one_step(state, g):
         return shard_fn(state, g[:, None])

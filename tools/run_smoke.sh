@@ -32,6 +32,15 @@ echo "== int8 KV parity + capacity gate =="
 python -m pytest tests/unit/test_kv_int8.py tests/unit/ops/test_paged_attention.py \
     -q -p no:cacheprovider
 
+echo "== ring-attention parity gate (2-device mesh) =="
+# context-parallel ring fwd+bwd must be BITWISE vs the single-device flash
+# kernel on a real multi-device mesh; pinning the device count to 2 here
+# exercises the literal two-chip ring (conftest only forces 8 devices when
+# XLA_FLAGS doesn't already pin a count)
+XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+    python -m pytest tests/unit/test_sharded_attention.py::TestRingBitwise \
+    -q -p no:cacheprovider
+
 echo "== donation/recompile verifier (Tier B) =="
 ./bin/dstpu lint --verify
 
