@@ -696,6 +696,109 @@ def bench_kv_dtype_ab(cfg=None, params=None, seed=0):
     }
 
 
+def bench_comm_quant_ab(cfg=None, params=None, seed=0):
+    """Quantized-collectives A/B (riding ``--serving-load`` via the
+    DSTPU_COMM_QUANT=int8 env knob): the SAME TP-decode workload served
+    twice — full-width MODEL_AXIS psums, then int8-inside-the-collective
+    (``comm_quant: int8``) — on a ``data x model=2`` slice of the available
+    devices. Reports decode tok/s for both runs and the per-wire trace-time
+    byte accounting (quantized vs replaced full-width bytes and the derived
+    reduction ratio — the number the /metrics gauges export). Output gate:
+    the first generated token must agree for ≥75% of requests (a broken
+    (de)quant path mangles every logit and flips essentially all of them;
+    genuine int8 rounding flips only knife-edge argmax ties, which on a
+    trained model are rare and on these random-init models still spare the
+    first token). Knobs: DSTPU_COMM_QUANT (int8 enables), DSTPU_CQ_N
+    (requests), DSTPU_CQ_MAX_NEW (tokens per request)."""
+    from deepspeed_tpu.comm.quantized import reset_wire_stats, wire_stats
+    from deepspeed_tpu.inference.config import RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.models import TransformerConfig, init_params
+    from deepspeed_tpu.parallel.topology import (
+        Topology, reset_topology, set_topology,
+    )
+
+    ndev = len(jax.devices())
+    if ndev < 2 or ndev % 2:
+        return {"skipped": f"needs an even device count >= 2, have {ndev}"}
+    tp = 2
+    n_requests = int(os.environ.get("DSTPU_CQ_N", 4))
+    max_new = int(os.environ.get("DSTPU_CQ_MAX_NEW", 32))
+    if cfg is None:
+        cfg = TransformerConfig(
+            vocab_size=256, hidden_size=256, n_layers=2, n_heads=4,
+            n_kv_heads=2, max_seq_len=512, dtype="float32",
+        )
+        params = init_params(cfg, jax.random.key(0))
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, cfg.vocab_size,
+                            size=(int(rng.integers(8, 24)),)).astype(np.int32)
+               for _ in range(n_requests)]
+
+    def run(mode):
+        reset_topology()
+        set_topology(Topology(data=ndev // tp, model=tp))
+        try:
+            reset_wire_stats()
+            rc = RaggedInferenceEngineConfig.from_dict({
+                "dtype": cfg.dtype, "tp_size": tp, "comm_quant": mode,
+                "kv_cache": {"block_size": 16, "num_blocks": 128,
+                             "max_blocks_per_seq": 16},
+                "state_manager": {"max_tracked_sequences": 64,
+                                  "max_ragged_batch_size": 96,
+                                  "max_ragged_sequence_count": 16,
+                                  "max_context": 256},
+            })
+            engine = InferenceEngineV2(cfg, params, rc)
+            engine.generate(prompts[:1], max_new_tokens=8)  # compile warmup
+            t0 = time.perf_counter()
+            outs = engine.generate(prompts, max_new_tokens=max_new)
+            wall = time.perf_counter() - t0
+            toks = sum(len(o) - len(p) for o, p in zip(outs, prompts))
+            return {
+                "tok_s": toks / wall if wall > 0 else 0.0,
+                "outputs": [np.asarray(o).tolist() for o in outs],
+                "wires": wire_stats(),
+            }
+        finally:
+            reset_topology()
+
+    base = run("none")
+    quant = run("int8")
+    firsts = [
+        x[len(p)] == y[len(p)]
+        for p, x, y in zip(prompts, base["outputs"], quant["outputs"])
+        if len(x) > len(p) and len(y) > len(p)
+    ]
+    first_tok_agreement = float(np.mean(firsts)) if firsts else 0.0
+    if first_tok_agreement < 0.75:
+        raise RuntimeError(
+            f"comm-quant A/B first-token agreement {first_tok_agreement:.2f} "
+            "< 0.75: the quantized collective path is broken, not merely "
+            "rounding"
+        )
+    agree = [
+        float(np.mean([a == b for a, b in zip(x[len(p):], y[len(p):])]))
+        for p, x, y in zip(prompts, base["outputs"], quant["outputs"])
+    ]
+    return {
+        "tp": tp,
+        "none_tok_s": round(base["tok_s"], 1),
+        "int8_tok_s": round(quant["tok_s"], 1),
+        "first_token_agreement": round(first_tok_agreement, 4),
+        "token_agreement": round(float(np.mean(agree)) if agree else 0.0, 4),
+        "wires": {
+            tag: {
+                "sites": w["sites"],
+                "wire_bytes_int8": w["wire_bytes_int8"],
+                "wire_bytes_fp": w["wire_bytes_fp"],
+                "reduction": round(w["reduction"], 3),
+            }
+            for tag, w in quant["wires"].items()
+        },
+    }
+
+
 def bench_serving_load(
     n_requests=None, rate_rps=None, max_new=None, slo_e2e_s=None,
     cfg=None, params=None, seed=0,
@@ -836,6 +939,11 @@ def bench_serving_load(
     kv_report = {}
     if os.environ.get("DSTPU_KV_DTYPE", "") == "int8":
         kv_report = {"kv_int8": bench_kv_dtype_ab(seed=seed)}
+    # quantized-collectives A/B rider: DSTPU_COMM_QUANT=int8 appends a
+    # TP-decode tok/s + per-wire byte-reduction comparison vs full width
+    cq_report = {}
+    if os.environ.get("DSTPU_COMM_QUANT", "") == "int8":
+        cq_report = {"comm_quant_int8": bench_comm_quant_ab(seed=seed)}
     return {
         "mode": "serving_load",
         "n_requests": n_requests,
@@ -853,6 +961,7 @@ def bench_serving_load(
         **prefix_report,
         **spec_report,
         **kv_report,
+        **cq_report,
     }
 
 

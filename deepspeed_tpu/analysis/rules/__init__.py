@@ -10,5 +10,6 @@ from deepspeed_tpu.analysis.rules import (  # noqa: F401
     donation,
     host_sync,
     jit_purity,
+    raw_collective,
     shard_specs,
 )

@@ -1,0 +1,72 @@
+"""Rule ``raw-collective-in-hot-path``: direct ``lax`` collectives in the
+wire-bound serving/MoE/pipeline modules.
+
+The quantized-collectives layer (``comm/quantized.py``) is the designated
+entry point for the hot wires: it decomposes each collective so only int8
+payloads + fp32 block scales cross ICI when ``comm_quant="int8"``, and it
+records per-wire byte accounting either way. A raw ``lax.all_to_all``/
+``lax.ppermute``/``lax.psum`` added to one of these modules bypasses both
+the quantization seam and the accounting — the wire silently goes back to
+full width and never shows up in ``/metrics``.
+
+Scope is this rule's OWN hot set (serving/, inference/v2/, parallel/moe/,
+runtime/pipe/) — not the framework default used by the host-sync rule,
+which targets latency (runtime/zero/) rather than wire width. Sites that
+are intentionally raw (broadcast-from-last-stage psums, the
+``comm_quant="none"`` bit-identical send path) carry
+``# dstpu: noqa[raw-collective-in-hot-path]``, which doubles as
+documentation of why the wire stays full width.
+"""
+
+import ast
+import os
+
+from deepspeed_tpu.analysis.framework import Rule, register
+from deepspeed_tpu.analysis.rules._common import dotted_name
+
+#: wire-bound subtrees: every collective here should route through
+#: comm/quantized.py (or carry a noqa explaining why it stays raw)
+HOT_WIRE_PREFIXES = ("serving/", "inference/v2/", "parallel/moe/", "runtime/pipe/")
+
+_RAW_COLLECTIVES = {
+    "lax.all_to_all", "jax.lax.all_to_all",
+    "lax.ppermute", "jax.lax.ppermute",
+    "lax.psum", "jax.lax.psum",
+}
+
+
+@register
+class RawCollectiveInHotPathRule(Rule):
+    name = "raw-collective-in-hot-path"
+    severity = "warning"
+    description = (
+        "direct lax.all_to_all/ppermute/psum in a wire-bound module "
+        "(serving/MoE/pipeline) bypasses the comm_quant seam and its "
+        "wire-byte accounting; route through comm/quantized.py or annotate "
+        "the intentionally-raw site"
+    )
+
+    def check(self, ctx):
+        norm = ctx.path.replace(os.sep, "/")
+        if not any(frag in norm for frag in HOT_WIRE_PREFIXES):
+            return []
+        rule = self
+        findings = []
+
+        class V(ast.NodeVisitor):
+            def visit_Call(self, node):
+                name = dotted_name(node.func)
+                if name in _RAW_COLLECTIVES:
+                    findings.append(ctx.finding(
+                        rule, node,
+                        f"raw {name}() on a hot wire: route through "
+                        "comm.quantized (quantized_psum_tp/quantized_all_to_all/"
+                        "quantized_ppermute honor the comm_quant seam and "
+                        "record wire bytes), or mark the site "
+                        "# dstpu: noqa[raw-collective-in-hot-path] with why "
+                        "it must stay full width",
+                    ))
+                self.generic_visit(node)
+
+        V().visit(ctx.tree)
+        return findings

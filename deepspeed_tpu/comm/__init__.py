@@ -13,3 +13,9 @@ from deepspeed_tpu.comm.comm import (
     ppermute,
     reduce_scatter,
 )
+from deepspeed_tpu.comm.quantized import (  # noqa: F401
+    quantized_all_gather,
+    quantized_all_to_all,
+    quantized_ppermute,
+    quantized_psum_tp,
+)

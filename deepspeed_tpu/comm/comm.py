@@ -211,9 +211,23 @@ def _resolve_op(op):
     return op
 
 
+def _check_async_op(async_op, name):
+    """The named-axis collectives are synchronous inside the compiled
+    program (XLA schedules the overlap); ``async_op=True`` used to be
+    accepted and silently ignored — a caller expecting a handle to wait on
+    would never find out. Raise instead."""
+    if async_op:
+        raise NotImplementedError(
+            f"{name}(async_op=True): these collectives run inside jit where "
+            "XLA schedules compute/communication overlap — there is no "
+            "handle to return; call with async_op=False"
+        )
+
+
 @timed_op
 def all_reduce(tensor, axis=DATA_AXIS, op=ReduceOp.SUM, group=None, async_op=False):
     """psum/pmax/pmin over the named mesh axis (reference comm.py:641)."""
+    _check_async_op(async_op, "all_reduce")
     op = _resolve_op(op)
     if op == ReduceOp.SUM:
         return lax.psum(tensor, axis)
@@ -236,13 +250,18 @@ def inference_all_reduce(tensor, axis=MODEL_AXIS, op=ReduceOp.SUM):
 
 
 @timed_op
-def all_gather(tensor, axis=DATA_AXIS, group=None, async_op=False, tiled=False, gather_dim=0):
-    """Concatenating all-gather along gather_dim (reference all_gather :235,
-    all_gather_into_tensor)."""
-    return lax.all_gather(tensor, axis, axis=gather_dim, tiled=True)
+def all_gather(tensor, axis=DATA_AXIS, group=None, async_op=False, tiled=True, gather_dim=0):
+    """All-gather along gather_dim (reference all_gather :235,
+    all_gather_into_tensor). ``tiled=True`` (the default, matching the old
+    always-tiled behavior) concatenates the shards along ``gather_dim``;
+    ``tiled=False`` stacks them on a new leading axis of size world —
+    the parameter used to be accepted but ignored."""
+    _check_async_op(async_op, "all_gather")
+    return lax.all_gather(tensor, axis, axis=gather_dim, tiled=tiled)
 
 
 def allgather_fn(output_tensor, input_tensor, group=None, async_op=False):
+    _check_async_op(async_op, "allgather_fn")
     return all_gather(input_tensor)
 
 
@@ -250,6 +269,7 @@ def allgather_fn(output_tensor, input_tensor, group=None, async_op=False):
 def reduce_scatter(tensor, axis=DATA_AXIS, op=ReduceOp.SUM, group=None, async_op=False, scatter_dim=0):
     """Reduce-scatter along scatter_dim (reference reduce_scatter_tensor/fn).
     Only SUM/AVG lower to the native psum_scatter collective."""
+    _check_async_op(async_op, "reduce_scatter")
     op = _resolve_op(op)
     if op not in (ReduceOp.SUM, ReduceOp.AVG):
         raise ValueError(f"reduce_scatter supports SUM/AVG only, got {op!r}")
@@ -266,6 +286,7 @@ reduce_scatter_tensor = reduce_scatter
 def all_to_all(tensor, axis=DATA_AXIS, split_dim=0, concat_dim=0, group=None, async_op=False):
     """All-to-all over the named axis (reference all_to_all_single :xxx;
     the Ulysses hot op, sequence/layer.py:221 single_all_to_all)."""
+    _check_async_op(async_op, "all_to_all")
     return lax.all_to_all(tensor, axis, split_axis=split_dim, concat_axis=concat_dim, tiled=True)
 
 
@@ -279,6 +300,7 @@ def broadcast(tensor, src=0, axis=DATA_AXIS, group=None, async_op=False):
     Traced form: implemented as a masked psum, which XLA lowers to a
     broadcast-from-root collective.
     """
+    _check_async_op(async_op, "broadcast")
     idx = lax.axis_index(axis)
     # where (not multiply-by-mask) so NaN/Inf in non-src shards contribute exact 0
     return lax.psum(jnp.where(idx == src, tensor, jnp.zeros_like(tensor)), axis)
@@ -287,6 +309,7 @@ def broadcast(tensor, src=0, axis=DATA_AXIS, group=None, async_op=False):
 @timed_op
 def reduce(tensor, dst=0, axis=DATA_AXIS, op=ReduceOp.SUM, group=None, async_op=False):
     """Reduce-to-root; non-root members receive zeros (SPMD-friendly form)."""
+    _check_async_op(async_op, "reduce")
     total = all_reduce(tensor, axis=axis, op=op)
     idx = lax.axis_index(axis)
     return jnp.where(idx == dst, total, jnp.zeros_like(total))

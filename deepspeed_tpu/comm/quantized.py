@@ -1,0 +1,255 @@
+"""Quantized collectives: int8 payloads INSIDE the hot-wire collectives.
+
+EQuARX-style (PAPERS.md): rather than quantizing a tensor and then calling a
+full-width collective around it, each collective *decomposes* so only int8
+payloads and fp32 block scales ever cross ICI — an allreduce becomes an int8
+all-to-all reduce + a re-quantized int8 all-gather (the qgZ two-hop pipeline,
+``ops/quantizer/block_quant.py``), and a ``ppermute``/``all_to_all`` sends
+each shard's quantized payload with its scale plane riding the same permute.
+The (de)quant math is per-chunk, so it hides under the transfer.
+
+Three hot wires ride this layer behind the ``comm_quant: none|int8`` seam:
+
+* serving TP decode (``inference/v2/engine_v2.py``): the MODEL_AXIS psum
+  behind the attention output and MLP down projections → ``quantized_psum_tp``
+* MoE expert-parallel dispatch/combine (``parallel/moe/sharded_moe.py``):
+  the EP exchange → ``quantized_all_to_all(reduce=True)`` (the reference
+  ``all_to_all_quant_reduce`` shape) + ``quantized_all_gather``
+* pipeline activation/cotangent sends (``runtime/pipe/pipeline.py``) →
+  ``quantized_ppermute``
+
+All collective entry points must be called INSIDE ``jit``/``shard_map`` with
+the named axis bound (the same contract as the block-quant primitives they
+build on).
+
+Wire-byte accounting happens at TRACE time — shapes are static under jit, so
+each traced call site records the quantized bytes it puts on the wire and the
+bytes the full-width collective it replaces would have moved. Counters count
+compiled call *sites* (a ``fori_loop`` body traces once for all its layer
+iterations), not executions; the per-site quant/fp RATIO is exact, which is
+what the multichip A/B gates and ``/metrics`` reduction gauges consume.
+"""
+
+import threading
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deepspeed_tpu.ops.quantizer import block_quant as bq
+from deepspeed_tpu.parallel.topology import MODEL_AXIS
+
+COMM_QUANT_MODES = ("none", "int8")
+
+
+def check_comm_quant(value) -> str:
+    """Validate the ``comm_quant`` knob. A typo must not silently serve
+    full-width collectives while the operator believes the wire is int8."""
+    mode = str(value or "none")
+    if mode not in COMM_QUANT_MODES:
+        raise ValueError(
+            f"comm_quant={value!r}: expected one of {COMM_QUANT_MODES}"
+        )
+    return mode
+
+
+# ---------------------------------------------------------------------------
+# trace-time wire-bytes registry
+# ---------------------------------------------------------------------------
+_LOCK = threading.Lock()
+_WIRE: Dict[str, Dict[str, float]] = {}
+
+
+def record_wire(tag: str, quant_bytes: int, fp_bytes: int) -> None:
+    """Fold one traced collective site into the registry: ``quant_bytes`` is
+    the int8 payload + fp32 scale bytes this site moves, ``fp_bytes`` the
+    bytes the replaced full-width collective would have moved."""
+    with _LOCK:
+        e = _WIRE.setdefault(
+            tag, {"sites": 0, "wire_bytes_int8": 0, "wire_bytes_fp": 0}
+        )
+        e["sites"] += 1
+        e["wire_bytes_int8"] += int(quant_bytes)
+        e["wire_bytes_fp"] += int(fp_bytes)
+
+
+def wire_stats() -> Dict[str, Dict[str, float]]:
+    """Per-tag snapshot with the derived wire-byte ``reduction`` ratio."""
+    with _LOCK:
+        out = {tag: dict(v) for tag, v in _WIRE.items()}
+    for v in out.values():
+        q = v["wire_bytes_int8"]
+        v["reduction"] = (v["wire_bytes_fp"] / q) if q else 0.0
+    return out
+
+
+def reset_wire_stats() -> None:
+    with _LOCK:
+        _WIRE.clear()
+
+
+def _payload_bytes(payload, scales) -> int:
+    return int(payload.size) * payload.dtype.itemsize + int(scales.size) * scales.dtype.itemsize
+
+
+def _fp_bytes(x) -> int:
+    return int(x.size) * x.dtype.itemsize
+
+
+# ---------------------------------------------------------------------------
+# collectives
+# ---------------------------------------------------------------------------
+def quantized_psum_tp(
+    x: jax.Array,
+    axis_name: str = MODEL_AXIS,
+    bits: int = 8,
+    block_size: int = 256,
+    tag: str = "tp_psum",
+) -> jax.Array:
+    """Quantized SUM-allreduce for the tensor-parallel row projections:
+    int8 reduce-scatter (all-to-all quant reduce) + re-quantized int8
+    all-gather — both hops move int payloads, never full-width floats
+    (``block_quant.quantized_allreduce`` with sum semantics). Call INSIDE
+    shard_map over ``axis_name`` with this rank's partial product; returns
+    the full sum in ``x``'s shape/dtype. Identity on a 1-rank axis."""
+    W = jax.lax.axis_size(axis_name)
+    if W <= 1:
+        return x
+    n = int(x.size)
+    npad = n + ((-n) % (W * block_size))
+    per_elem = 1 if bits == 8 else 0.5  # int8 byte / packed int4 nibble
+    nb = npad // block_size
+    rs_hop = int(npad * per_elem) + nb * 4
+    chunk = npad // W  # already a block multiple (npad % W*bs == 0)
+    ag_hop = int(chunk * per_elem) + (chunk // block_size) * 4
+    # the replaced full-width psum moves x at dtype width on both hops of
+    # the same reduce-scatter + all-gather decomposition
+    record_wire(tag, rs_hop + ag_hop, 2 * n * x.dtype.itemsize)
+    return bq.quantized_allreduce(
+        x, axis_name, bits=bits, block_size=block_size, mean=False
+    )
+
+
+def quantized_all_to_all(
+    x: jax.Array,
+    axis_name: str,
+    split_dim: int = 0,
+    concat_dim: int = 0,
+    bits: int = 8,
+    block_size: int = 256,
+    reduce: bool = False,
+    tag: str = "all_to_all",
+) -> jax.Array:
+    """All-to-all with the int8 payload and its fp32 scale plane riding the
+    same exchange: each of the W shards along ``split_dim`` is blockwise
+    quantized, both planes cross via ``lax.all_to_all``, receivers
+    dequantize.
+
+    ``reduce=False``: the W received shards concatenate along ``concat_dim``
+    (standard tiled all-to-all, 1/W-width ``split_dim`` in the result).
+    ``reduce=True``: the W received shards are *summed* — the reference
+    ``all_to_all_quant_reduce`` (qgZ reduce-scatter) shape; the result is
+    this rank's ``split_dim`` slice of the sum over ranks. Identity on a
+    1-rank axis. Call INSIDE shard_map over ``axis_name``."""
+    W = jax.lax.axis_size(axis_name)
+    if W <= 1:
+        return x
+    D = x.shape[split_dim]
+    if D % W != 0:
+        raise ValueError(
+            f"split_dim {split_dim} of size {D} not divisible by axis "
+            f"{axis_name}={W}"
+        )
+    moved = jnp.moveaxis(x, split_dim, 0)
+    rest = moved.shape[1:]
+    rows = moved.reshape(W, -1).astype(jnp.float32)
+    m = rows.shape[1]
+    pad = (-m) % block_size
+    if pad:
+        rows = jnp.pad(rows, ((0, 0), (0, pad)))
+    payload, scales = bq._quantize_rows(rows, bits, block_size)
+    record_wire(tag, _payload_bytes(payload, scales), _fp_bytes(x))
+    payload_rx = lax.all_to_all(payload, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    scales_rx = lax.all_to_all(scales, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    deq = bq._dequantize_rows(payload_rx, scales_rx, bits, block_size)[:, :m]
+    if reduce:
+        out = jnp.sum(deq, axis=0).reshape((D // W,) + rest)
+        return jnp.moveaxis(out, 0, split_dim).astype(x.dtype)
+    blocks = deq.reshape((W, D // W) + rest)
+    parts = [jnp.moveaxis(blocks[i], 0, split_dim) for i in range(W)]
+    return jnp.concatenate(parts, axis=concat_dim).astype(x.dtype)
+
+
+def quantized_all_gather(
+    x: jax.Array,
+    axis_name: str,
+    dim: int = 0,
+    bits: int = 8,
+    block_size: int = 256,
+    tag: str = "all_gather",
+) -> jax.Array:
+    """Quantized concatenating all-gather along ``dim`` (qwZ shape): the
+    local slice's int8 payload + fp32 scales cross the wire, receivers
+    dequantize. Identity on a 1-rank axis."""
+    W = jax.lax.axis_size(axis_name)
+    if W <= 1:
+        return x
+    m = int(x.size)
+    mpad = m + ((-m) % block_size)
+    per_elem = 1 if bits == 8 else 0.5
+    wire = int(mpad * per_elem) + (mpad // block_size) * 4
+    record_wire(tag, wire, _fp_bytes(x))
+    return bq.quantized_all_gather_along(
+        x, axis_name, dim, bits=bits, block_size=block_size
+    )
+
+
+def quantized_ppermute(
+    tree: Any,
+    axis_name: str,
+    perm: Sequence,
+    bits: int = 8,
+    block_size: int = 256,
+    min_size: int = 1024,
+    tag: str = "ppermute",
+) -> Any:
+    """Point-to-point permute of a pytree with each leaf's int8 payload and
+    fp32 scale plane riding the SAME permutation — the pipeline activation /
+    cotangent send. Ranks outside ``perm`` receive zeros in both planes,
+    which dequantize to zeros (raw ppermute semantics preserved).
+
+    Leaves smaller than ``min_size`` elements ride the raw ppermute: a
+    scalar's block pad would cost more wire than quantization saves, and the
+    pipeline's loss/aux accumulators stay bit-exact."""
+
+    def leaf(l):
+        if l.size < min_size:
+            record_wire(tag, _fp_bytes(l), _fp_bytes(l))
+            return lax.ppermute(l, axis_name, perm=perm)
+        rows = l.reshape(1, -1).astype(jnp.float32)
+        m = rows.shape[1]
+        pad = (-m) % block_size
+        if pad:
+            rows = jnp.pad(rows, ((0, 0), (0, pad)))
+        payload, scales = bq._quantize_rows(rows, bits, block_size)
+        record_wire(tag, _payload_bytes(payload, scales), _fp_bytes(l))
+        payload_rx = lax.ppermute(payload, axis_name, perm=perm)
+        scales_rx = lax.ppermute(scales, axis_name, perm=perm)
+        deq = bq._dequantize_rows(payload_rx, scales_rx, bits, block_size)
+        return deq[0, :m].reshape(l.shape).astype(l.dtype)
+
+    return jax.tree.map(leaf, tree)
+
+
+__all__ = [
+    "COMM_QUANT_MODES",
+    "check_comm_quant",
+    "quantized_psum_tp",
+    "quantized_all_to_all",
+    "quantized_all_gather",
+    "quantized_ppermute",
+    "record_wire",
+    "wire_stats",
+    "reset_wire_stats",
+]

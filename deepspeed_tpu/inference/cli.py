@@ -42,6 +42,11 @@ def parse_args(argv=None):
                    help="v1 = bucketed KV generate; v2 = paged continuous batching")
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel ways")
+    p.add_argument("--comm-quant", default="none", choices=("none", "int8"),
+                   help="quantize collectives int8-inside-the-wire (v2 "
+                   "engine, tp>1): the MODEL_AXIS psum behind the "
+                   "attention-output and MLP down projections becomes an "
+                   "int8 reduce-scatter + all-gather with fp32 block scales")
     p.add_argument("--sample", action="store_true",
                    help="temperature sampling instead of greedy")
     p.add_argument("--temperature", type=float, default=1.0)
@@ -91,6 +96,7 @@ def generate_main(argv=None) -> int:
         blocks_per_seq = (max_len + bs - 1) // bs + 1
         rc = RaggedInferenceEngineConfig.from_dict({
             "dtype": args.dtype, "tp_size": args.tp,
+            "comm_quant": getattr(args, "comm_quant", "none"),
             "decode_steps": min(32, args.max_new_tokens),
             "greedy": not args.sample, "temperature": args.temperature,
             "top_k": args.top_k, "top_p": args.top_p, "seed": args.seed,
@@ -192,6 +198,11 @@ def serve_parse_args(argv=None):
                    "output stays bit-identical to spec-off)")
     p.add_argument("--spec-ngram", type=int, default=3,
                    help="max n-gram order for the prompt-lookup draft proposer")
+    p.add_argument("--comm-quant", default="none", choices=("none", "int8"),
+                   help="quantize collectives int8-inside-the-wire (tp>1): "
+                   "the TP decode psums run as int8 reduce-scatter + "
+                   "all-gather with fp32 block scales; per-wire byte "
+                   "counters show up in /metrics")
     p.add_argument("--no-prefix-cache", action="store_true",
                    help="disable automatic prefix caching (on by default "
                    "when serving: repeated prompt prefixes share KV blocks "
@@ -240,6 +251,7 @@ def build_serving_stack(args, cfg=None, params=None, tok=None):
         )
     rc = RaggedInferenceEngineConfig.from_dict({
         "dtype": args.dtype, "tp_size": args.tp,
+        "comm_quant": getattr(args, "comm_quant", "none"),
         "decode_steps": args.decode_steps,
         "greedy": not args.sample, "temperature": args.temperature,
         "top_k": args.top_k, "top_p": args.top_p, "seed": args.seed,

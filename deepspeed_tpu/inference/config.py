@@ -134,6 +134,13 @@ class RaggedInferenceEngineConfig(DSConfigModel):
     # gather elsewhere; "kernel"/"dense" force a path; anything else raises
     # at engine construction (no silent fallback)
     paged_attention_impl: str = "auto"
+    # quantized collectives for the TP decode step (comm/quantized.py):
+    # "int8" runs the MODEL_AXIS psum behind the attention-output and MLP
+    # down projections as an int8 reduce-scatter + re-quantized int8
+    # all-gather (EQuARX-style, inside an explicit shard_map island);
+    # "none" keeps the implicit full-width GSPMD psum. No-op at tp_size=1;
+    # anything else raises at engine construction.
+    comm_quant: str = "none"
     quant: QuantConfig = submodel(QuantConfig)
     kv_cache: Optional[KVCacheConfig] = submodel(KVCacheConfig)
     state_manager: Optional[StateManagerConfig] = submodel(StateManagerConfig)

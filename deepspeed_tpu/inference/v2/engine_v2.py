@@ -159,6 +159,17 @@ class InferenceEngineV2:
             self._kv_sharding = NamedSharding(
                 self._mesh, P(None, None, None, MODEL_AXIS, None)
             )
+        # --- quantized TP collectives: "int8" replaces the implicit GSPMD
+        # psum behind the attention-output and MLP down projections with an
+        # int8 reduce-scatter + re-quantized int8 all-gather inside an
+        # explicit shard_map island (comm/quantized.quantized_psum_tp). A
+        # typo raises here; tp_size=1 makes "int8" a validated no-op.
+        from deepspeed_tpu.comm.quantized import check_comm_quant
+
+        self._comm_quant = check_comm_quant(
+            str(getattr(self.config, "comm_quant", "none") or "none")
+        )
+        self._tp_quant = self._comm_quant == "int8" and self._tp > 1
         # --- KV payload dtype + decode-attention impl (ISSUE 6): int8 pools
         # store quantize_kv payloads + per-vector fp32 scale planes (half
         # the HBM per block → ~2x blocks per byte budget, kv_pool.py);
@@ -240,6 +251,7 @@ class InferenceEngineV2:
             f"budget {self.config.state_manager.max_ragged_batch_size} tok/step, "
             f"kv={self._kv_dtype}, attn={self._attn_impl}"
             + (f", tp={self._tp}" if self._tp > 1 else "")
+            + (", comm_quant=int8" if self._tp_quant else "")
             + (", prefix_cache=on" if self.state_manager.prefix_cache is not None else ""),
             ranks=[0],
         )
@@ -259,6 +271,24 @@ class InferenceEngineV2:
     def kv_cache_dtype(self) -> str:
         """Pool payload dtype knob value: "bf16" (compute dtype) or "int8"."""
         return self._kv_dtype
+
+    @property
+    def comm_quant(self) -> str:
+        """Quantized-collectives knob value ("none" or "int8")."""
+        return self._comm_quant
+
+    def comm_wire_info(self) -> Dict:
+        """Per-wire collective byte accounting for health()/metrics: the
+        trace-time counters from comm.quantized (per compiled call site —
+        a fori_loop layer body counts once for all its iterations), plus
+        whether the quantized TP path is actually active."""
+        from deepspeed_tpu.comm.quantized import wire_stats
+
+        return {
+            "comm_quant": self._comm_quant,
+            "tp_quant_active": bool(self._tp_quant),
+            "wires": wire_stats(),
+        }
 
     @property
     def paged_attention_impl(self) -> str:
@@ -443,18 +473,22 @@ class InferenceEngineV2:
                     out = mha_reference(q, k_ctx, v_ctx, causal=False, bias=bias,
                                         scale=c.attn_scale)
                     out = out.transpose(0, 2, 1, 3).reshape(1, t_, nh * d)
-                attn_out = out @ lp["wo"]
+                if self._tp_quant:
+                    attn_out = self._tp_row_matmul(out[0], lp["wo"], "tp_attn_out")[None]
+                else:
+                    attn_out = out @ lp["wo"]
                 if c.attn_out_bias:
                     attn_out = attn_out + lp["wo_b"]
                 caches = (kc_l, vc_l, ks_l, vs_l) if kv_int8 else (kc_l, vc_l)
+                quant_mlp = self._tp_quant and c.n_experts == 0
                 if c.parallel_block:
                     # falcon/phi: both branches read the pre-attention state
                     m = T._norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"), c.norm, c.norm_eps)
-                    mlp_out, _ = T._mlp_block(c, lp, m)
+                    mlp_out = self._mlp_quant(lp, m) if quant_mlp else T._mlp_block(c, lp, m)[0]
                     return x + attn_out + mlp_out, caches
                 x = x + attn_out
                 m = T._norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"), c.norm, c.norm_eps)
-                mlp_out, _ = T._mlp_block(c, lp, m)
+                mlp_out = self._mlp_quant(lp, m) if quant_mlp else T._mlp_block(c, lp, m)[0]
                 return x + mlp_out, caches
 
             xs = (params["layers"], k_cache, v_cache) + tuple(scale_caches)
@@ -616,22 +650,82 @@ class InferenceEngineV2:
             k = T._rope(k.transpose(1, 0, 2)[None], positions[None], c, live)[0].transpose(1, 0, 2)
         return a, q, k, v
 
+    def _tp_row_matmul(self, x2d, w, tag):
+        """``x2d @ w`` with the contraction dim sharded over MODEL_AXIS and
+        the psum quantized inside the collective: a shard_map island (GSPMD
+        cannot rewrite the reduction wire of its own implicit psum) whose
+        local matmul feeds ``quantized_psum_tp`` — int8 reduce-scatter +
+        re-quantized int8 all-gather instead of one full-width all-reduce.
+        x2d: [t, K] activations (K = heads*d or ffn dim, column-sharded by
+        GSPMD from the param shardings); w: [K, h] row-sharded. Returns
+        [t, h] replicated over the model axis."""
+        from jax.sharding import PartitionSpec as P
+
+        from deepspeed_tpu.comm.quantized import quantized_psum_tp
+        from deepspeed_tpu.parallel.topology import MODEL_AXIS
+
+        def local(xl, wl):
+            return quantized_psum_tp(xl @ wl, MODEL_AXIS, tag=tag)
+
+        return jax.shard_map(
+            local,
+            mesh=self._mesh,
+            in_specs=(P(None, MODEL_AXIS), P(MODEL_AXIS, None)),
+            out_specs=P(None, None),
+            axis_names={MODEL_AXIS},
+            check_vma=False,
+        )(x2d, w)
+
+    def _mlp_quant(self, lp, m):
+        """Dense-MLP mirror of ``T._mlp_block`` for the quantized TP path:
+        w_up/w_gate stay implicit GSPMD column-parallel (no psum on that
+        wire), the w_down row-parallel matmul runs through the quantized
+        psum island. MoE configs never reach here (caller falls back)."""
+        c = self._mc
+        up = T._proj(c, m, lp["w_up"])
+        if c.mlp_bias:
+            up = up + lp["w_up_b"]
+        if c.activation in ("swiglu", "geglu"):
+            gate = T._proj(c, m, lp["w_gate"])
+            if c.mlp_bias:
+                gate = gate + lp["w_gate_b"]
+            act = (jax.nn.gelu(gate) if c.activation == "geglu" else jax.nn.silu(gate)) * up
+        elif c.activation == "relu":
+            act = jax.nn.relu(up)
+        elif c.activation == "quick_gelu":
+            act = up * jax.nn.sigmoid(1.702 * up)
+        else:
+            act = jax.nn.gelu(up, approximate=c.activation != "gelu_exact")
+        t = act.shape[1]
+        out = self._tp_row_matmul(act.reshape(t, -1), lp["w_down"], "tp_mlp_down")[None]
+        if c.mlp_bias:
+            out = out + lp["w_down_b"]
+        return out
+
     def _layer_tail(self, lp, x, out):
         """Shared per-layer epilogue: wo projection (+ bias), then the
-        parallel-block (falcon/phi) or sequential residual + MLP."""
+        parallel-block (falcon/phi) or sequential residual + MLP. With
+        comm_quant="int8" at tp>1, the two MODEL_AXIS reductions (behind
+        wo and w_down) run int8-inside-the-collective."""
         c = self._mc
         nh, d = c.n_heads, c.head_dim
         t = x.shape[1]
-        attn_out = (out.reshape(t, nh * d) @ lp["wo"])[None]
+        if self._tp_quant:
+            attn_out = self._tp_row_matmul(
+                out.reshape(t, nh * d), lp["wo"], "tp_attn_out"
+            )[None]
+        else:
+            attn_out = (out.reshape(t, nh * d) @ lp["wo"])[None]
         if c.attn_out_bias:
             attn_out = attn_out + lp["wo_b"]
+        quant_mlp = self._tp_quant and c.n_experts == 0
         if c.parallel_block:
             m = T._norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"), c.norm, c.norm_eps)
-            mlp_out, _ = T._mlp_block(c, lp, m)
+            mlp_out = self._mlp_quant(lp, m) if quant_mlp else T._mlp_block(c, lp, m)[0]
             return x + attn_out + mlp_out
         x = x + attn_out
         m = T._norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"), c.norm, c.norm_eps)
-        mlp_out, _ = T._mlp_block(c, lp, m)
+        mlp_out = self._mlp_quant(lp, m) if quant_mlp else T._mlp_block(c, lp, m)[0]
         return x + mlp_out
 
     # ------------------------------------------------------------------

@@ -179,6 +179,12 @@ class TransformerConfig:
     # ~b*s*vocab bytes of activations at the cost of recomputing the head
     # matmul in backward (~1pp MFU at 32k vocab); enable when memory-bound
     loss_tiles: int = 0
+    # quantized collectives seam (comm/quantized.py): "int8" moves the MoE
+    # expert-parallel dispatch/combine exchange (and, via the pipe/serving
+    # configs that read it, the pipeline activation sends and the serving TP
+    # psum) as int8 payloads + fp32 block scales INSIDE the collective;
+    # "none" keeps full-width GSPMD collectives (bit-identical to before)
+    comm_quant: str = "none"
     # ZeRO-Infinity weight streaming (reference partition_parameters.py
     # remote_device + partitioned_param_coordinator prefetch): params rest in
     # pinned_host; each scan iteration stages ONE layer's weights into HBM
@@ -229,6 +235,11 @@ class TransformerConfig:
                     f"attn_layer_pattern has {len(self.attn_layer_pattern)} "
                     f"entries for {self.n_layers} layers"
                 )
+        if self.comm_quant not in ("none", "int8"):
+            raise ValueError(
+                f"comm_quant={self.comm_quant!r}: expected 'none' or 'int8' "
+                "(a typo would silently serve full-width collectives)"
+            )
         if self.matmul_precision not in ("default", "fp8", "int8", "int8_tensor"):
             raise ValueError(
                 f"matmul_precision={self.matmul_precision!r}: expected "

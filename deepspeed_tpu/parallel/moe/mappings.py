@@ -38,6 +38,20 @@ def gather_tokens(x: jax.Array, dim: int = 1) -> jax.Array:
     return constrain(x, *_axis_spec(x, dim, None))
 
 
+def quantized_ep_active(config) -> bool:
+    """True when the MoE expert-parallel dispatch/combine exchange runs
+    int8-inside-the-collective (sharded_moe._moe_exchange_quant): the model
+    config asks for ``comm_quant="int8"`` AND an expert mesh axis is live.
+    At expert degree 1 the exchange is local — no wire, nothing to quantize
+    — so "int8" stays a validated no-op, mirroring gather/drop above."""
+    from deepspeed_tpu.parallel.topology import EXPERT_AXIS
+
+    return (
+        getattr(config, "comm_quant", "none") == "int8"
+        and get_topology().axis_size(EXPERT_AXIS) > 1
+    )
+
+
 def drop_tokens(x: jax.Array, dim: int = 1) -> jax.Array:
     """Replicated tokens → sharded over the ``model`` axis along ``dim``
     (reference ``drop_tokens``): each TP rank keeps its 1/tp slice, so work

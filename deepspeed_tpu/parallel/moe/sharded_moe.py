@@ -224,6 +224,74 @@ def _expert_sharded(x, spec):
     return constrain(x, *spec)
 
 
+def _moe_exchange_quant(config, lp, tokens, dispatch, combine, dtype):
+    """Expert dispatch/combine with the EP exchange quantized INSIDE the
+    collective (comm/quantized.py, EQuARX-style int8 + fp32 block scales).
+
+    GSPMD's implicit all-to-all behind the ``ech`` resharding cannot be
+    rewritten from the outside, so the exchange runs in an explicit
+    shard_map island manual over EXPERT_AXIS only (data/zero/model stay
+    auto — the f-dim TP psum under w_down is still GSPMD's):
+
+      local partial dispatch einsum  [e, c, h]   (zeros in peer-owned slots)
+      quantized_all_to_all(reduce=True)  → this shard's experts [e/E, c, h]
+          (the reference all_to_all_quant_reduce / qgZ reduce-scatter)
+      local expert FFN
+      quantized_all_gather over e        → full [e, c, h]
+      local combine einsum               → this shard's tokens [t/E, h]
+
+    Gating stays global (capacity slots are a cumsum over the GLOBAL token
+    dim), so two shards never claim the same (e, c) slot and the
+    reduce-sum merge is exact.
+    """
+    from deepspeed_tpu.comm.quantized import quantized_all_gather, quantized_all_to_all
+
+    topo = get_topology()
+    E = topo.axis_size(EXPERT_AXIS)
+    t = tokens.shape[0]
+    e = dispatch.shape[1]
+    if e % E or t % E:
+        raise ValueError(
+            f"comm_quant='int8' MoE exchange: n_experts={e} and tokens={t} "
+            f"must both be divisible by the expert-parallel degree {E}"
+        )
+    weights = {"w_up": lp["w_up"], "w_down": lp["w_down"]}
+    if config.activation in ("swiglu", "geglu"):
+        weights["w_gate"] = lp["w_gate"]
+
+    def island(tokens_l, dispatch_l, combine_l, w):
+        partial = jnp.einsum("tec,th->ech", dispatch_l.astype(dtype), tokens_l)
+        expert_in = quantized_all_to_all(
+            partial, EXPERT_AXIS, split_dim=0, reduce=True, tag="moe_dispatch"
+        )
+        up = jnp.einsum("ech,ehf->ecf", expert_in, w["w_up"])
+        if config.activation in ("swiglu", "geglu"):
+            gate = jnp.einsum("ech,ehf->ecf", expert_in, w["w_gate"])
+            g = jax.nn.gelu(gate) if config.activation == "geglu" else jax.nn.silu(gate)
+            act = g * up
+        else:
+            act = jax.nn.gelu(up, approximate=config.activation != "gelu_exact")
+        act = constrain(act, None, None, MODEL_AXIS)
+        expert_out = jnp.einsum("ecf,efh->ech", act, w["w_down"])
+        full = quantized_all_gather(expert_out, EXPERT_AXIS, dim=0, tag="moe_combine")
+        return jnp.einsum("tec,ech->th", combine_l.astype(dtype), full)
+
+    fn = jax.shard_map(
+        island,
+        mesh=topo.mesh,
+        in_specs=(
+            P(EXPERT_AXIS, None),
+            P(EXPERT_AXIS, None, None),
+            P(EXPERT_AXIS, None, None),
+            jax.tree.map(lambda _: P(EXPERT_AXIS, None, None), weights),
+        ),
+        out_specs=P(EXPERT_AXIS, None),
+        axis_names={EXPERT_AXIS},
+        check_vma=False,
+    )
+    return fn(tokens, dispatch, combine, weights)
+
+
 def moe_mlp(config, lp, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """MoE MLP block used by models/transformer.py.
 
@@ -244,24 +312,31 @@ def moe_mlp(config, lp, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
         capacity_factor=config.moe_capacity_factor,
         normalize=getattr(config, "moe_norm_topk_prob", True),
     )
-    # dispatch: [t, e, c] bool; tokens: [t, h] → expert buffers [e, c, h]
-    expert_in = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), tokens)
-    expert_in = _expert_sharded(expert_in, P(EXPERT_AXIS, None, None))
+    from deepspeed_tpu.parallel.moe.mappings import quantized_ep_active
 
-    # per-expert FFN, e sharded over the expert axis, f over model axis
-    up = jnp.einsum("ech,ehf->ecf", expert_in, lp["w_up"])
-    if config.activation in ("swiglu", "geglu"):
-        gate = jnp.einsum("ech,ehf->ecf", expert_in, lp["w_gate"])
-        g = jax.nn.gelu(gate) if config.activation == "geglu" else jax.nn.silu(gate)
-        act = g * up
+    if quantized_ep_active(config):
+        # int8-inside-the-collective EP exchange (explicit island; the
+        # implicit GSPMD form below cannot quantize its own all-to-all)
+        out = _moe_exchange_quant(config, lp, tokens, dispatch, combine, x.dtype)
     else:
-        act = jax.nn.gelu(up, approximate=config.activation != "gelu_exact")
-    act = _expert_sharded(act, P(EXPERT_AXIS, None, MODEL_AXIS))
-    expert_out = jnp.einsum("ecf,efh->ech", act, lp["w_down"])
-    expert_out = _expert_sharded(expert_out, P(EXPERT_AXIS, None, None))
+        # dispatch: [t, e, c] bool; tokens: [t, h] → expert buffers [e, c, h]
+        expert_in = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), tokens)
+        expert_in = _expert_sharded(expert_in, P(EXPERT_AXIS, None, None))
 
-    # combine back to tokens (reverse all-to-all via resharding)
-    out = jnp.einsum("tec,ech->th", combine.astype(x.dtype), expert_out)
+        # per-expert FFN, e sharded over the expert axis, f over model axis
+        up = jnp.einsum("ech,ehf->ecf", expert_in, lp["w_up"])
+        if config.activation in ("swiglu", "geglu"):
+            gate = jnp.einsum("ech,ehf->ecf", expert_in, lp["w_gate"])
+            g = jax.nn.gelu(gate) if config.activation == "geglu" else jax.nn.silu(gate)
+            act = g * up
+        else:
+            act = jax.nn.gelu(up, approximate=config.activation != "gelu_exact")
+        act = _expert_sharded(act, P(EXPERT_AXIS, None, MODEL_AXIS))
+        expert_out = jnp.einsum("ecf,efh->ech", act, lp["w_down"])
+        expert_out = _expert_sharded(expert_out, P(EXPERT_AXIS, None, None))
+
+        # combine back to tokens (reverse all-to-all via resharding)
+        out = jnp.einsum("tec,ech->th", combine.astype(x.dtype), expert_out)
 
     def _dense_mlp(prefix):
         up = tokens @ lp[f"{prefix}_up"]

@@ -248,6 +248,13 @@ class DeepSpeedConfig(DSConfigModel):
     # — auto computes it when clipping or a monitor consumes it.
     check_grad_overflow: Optional[bool] = None
     monitor_grad_norm: Optional[bool] = None
+    # quantized collectives for the non-gradient hot wires (comm/quantized.py,
+    # EQuARX-style int8-inside-the-collective): "int8" moves the pipeline
+    # activation/cotangent ppermute sends and the MoE expert-parallel
+    # dispatch/combine as int8 payloads + fp32 block scales; "none" keeps
+    # full-width collectives. Gradient-exchange quantization has its own
+    # knobs (zero_quantized_gradients / compression).
+    comm_quant: str = "none"
     zero_allow_untested_optimizer: bool = True
     zero_force_ds_cpu_optimizer: bool = False  # [compat] no CPU-only optimizer binary on TPU
     graph_harvesting: bool = False  # [compat] jit covers CUDA-graph capture
@@ -330,6 +337,10 @@ class DeepSpeedConfig(DSConfigModel):
         self.train_micro_batch_size_per_gpu = micro_batch
         self.gradient_accumulation_steps = gas
         self._batch_assertion(dp_world_size)
+        if self.comm_quant not in ("none", "int8"):
+            raise ConfigError(
+                f"comm_quant={self.comm_quant!r}: expected 'none' or 'int8'"
+            )
 
     def _batch_assertion(self, dp_world_size):
         train_batch = self.train_batch_size

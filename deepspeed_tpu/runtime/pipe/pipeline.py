@@ -49,6 +49,7 @@ def pipeline_apply(
     x_micro: Any,
     *extra_args,
     topo: Topology = None,
+    comm_quant: str = "none",
 ) -> Any:
     """Run microbatches through pipeline stages.
 
@@ -57,8 +58,14 @@ def pipeline_apply(
     running_aux_loss)).
     stage_params: pytree, every leaf leading dim = n_stages (sharded on pipe)
     x_micro: pytree with leading [n_micro, ...] on every leaf.
+    comm_quant: "int8" sends the rotating activations between stages as int8
+    payloads + fp32 block scales riding the same ppermute
+    (comm.quantized.quantized_ppermute); "none" keeps full-width sends.
     Returns outputs of the last stage, leading dim [n_micro, ...].
     """
+    from deepspeed_tpu.comm.quantized import check_comm_quant
+
+    comm_quant = check_comm_quant(comm_quant)
     topo = topo or get_topology()
     S = topo.pipe_parallel_size
     if S <= 1:
@@ -95,7 +102,14 @@ def pipeline_apply(
             cur = _tree_index(out_buf, mb_out)
             new = _tree_where(emit, out, cur)
             out_buf = _tree_update(out_buf, new, mb_out)
-            state = jax.tree.map(lambda l: jax.lax.ppermute(l, PIPE_AXIS, perm), out)
+            if comm_quant == "int8":
+                from deepspeed_tpu.comm.quantized import quantized_ppermute
+
+                state = quantized_ppermute(out, PIPE_AXIS, perm, tag="pipe_fwd")
+            else:
+                # intentionally raw: the comm_quant="none" contract is a
+                # bit-identical full-width send
+                state = jax.tree.map(lambda l: jax.lax.ppermute(l, PIPE_AXIS, perm), out)  # dstpu: noqa[raw-collective-in-hot-path]
             return (state, out_buf), None
 
         (_, out_buf), _ = jax.lax.scan(body, (state0, out_buf0), jnp.arange(total))
@@ -103,7 +117,8 @@ def pipeline_apply(
         # pipe axis so downstream GSPMD code sees one logical value. psum of
         # the masked buffer = broadcast from last stage.
         out_buf = _tree_where(is_last, out_buf, jax.tree.map(jnp.zeros_like, out_buf))
-        return jax.tree.map(lambda l: jax.lax.psum(l, PIPE_AXIS), out_buf)
+        # broadcast-from-last-stage, not a wire-bound reduction — stays raw
+        return jax.tree.map(lambda l: jax.lax.psum(l, PIPE_AXIS), out_buf)  # dstpu: noqa[raw-collective-in-hot-path]
 
     in_specs = (
         jax.tree.map(lambda _: P(PIPE_AXIS), stage_params),
@@ -132,7 +147,9 @@ def _stack_stages(layer_tree: Any, n_stages: int) -> Any:
     return jax.tree.map(reshape, layer_tree)
 
 
-def make_pipelined_loss_fn(config, micro_batches: int, topo: Topology = None):
+def make_pipelined_loss_fn(
+    config, micro_batches: int, topo: Topology = None, comm_quant: str = None
+):
     """Causal-LM loss with the transformer layer stack pipelined over ``pipe``.
 
     Embedding and the LM head run outside the pipeline (replicated over the
@@ -142,12 +159,20 @@ def make_pipelined_loss_fn(config, micro_batches: int, topo: Topology = None):
     partition_method, runtime/pipe/module.py:393). Honors labels/loss_mask/
     positions/segment_ids batch keys and threads the MoE aux loss through the
     rotating state.
+
+    comm_quant: "int8" rides the inter-stage activation sends on
+    ``comm.quantized.quantized_ppermute``; defaults to the model config's
+    ``comm_quant`` field.
     """
+    from deepspeed_tpu.comm.quantized import check_comm_quant
     from deepspeed_tpu.models import transformer as T
 
     topo = topo or get_topology()
     S = topo.pipe_parallel_size
     c = config
+    comm_quant = check_comm_quant(
+        comm_quant if comm_quant is not None else getattr(c, "comm_quant", "none")
+    )
 
     def stage_fn(stage_layers, state, positions, segment_ids):
         x, aux = state
@@ -198,6 +223,7 @@ def make_pipelined_loss_fn(config, micro_batches: int, topo: Topology = None):
             y_micro, aux_out = pipeline_apply(
                 lambda p, st, pos: stage_fn(p, st, pos, None),
                 stage_params, (x_micro, aux_micro), positions_arg, topo=topo,
+                comm_quant=comm_quant,
             )
         else:
 
@@ -208,6 +234,7 @@ def make_pipelined_loss_fn(config, micro_batches: int, topo: Topology = None):
 
             (y_micro, aux_out), _ = pipeline_apply(
                 stage_meta, stage_params, ((x_micro, aux_micro), meta), positions_arg, topo=topo,
+                comm_quant=comm_quant,
             )
 
         y = y_micro.reshape((b,) + y_micro.shape[2:])
@@ -267,12 +294,20 @@ class Pipelined1F1BLoss:
     around autodiff, not custom grads).
     """
 
-    def __init__(self, config, micro_batches: int, topo: Topology = None):
+    def __init__(
+        self, config, micro_batches: int, topo: Topology = None, comm_quant: str = None
+    ):
+        from deepspeed_tpu.comm.quantized import check_comm_quant
         from deepspeed_tpu.parallel.topology import MODEL_AXIS
 
         self.config = config
         self.micro_batches = micro_batches
         self.topo = topo or get_topology()
+        self.comm_quant = check_comm_quant(
+            comm_quant
+            if comm_quant is not None
+            else getattr(config, "comm_quant", "none")
+        )
         if (
             config.tie_embeddings
             and config.vocab_parallel
@@ -286,7 +321,9 @@ class Pipelined1F1BLoss:
                 "trips an XLA spmd_partitioner group-assignment CHECK-crash — set "
                 "vocab_parallel=False on the model config (replicated embeddings)"
             )
-        self._fwd_loss = make_pipelined_loss_fn(config, micro_batches, self.topo)
+        self._fwd_loss = make_pipelined_loss_fn(
+            config, micro_batches, self.topo, comm_quant=self.comm_quant
+        )
 
     def __call__(self, params, batch):
         return self._fwd_loss(params, batch)
@@ -299,6 +336,7 @@ class Pipelined1F1BLoss:
 
         c = self.config
         topo = self.topo
+        comm_quant = self.comm_quant
         S = topo.pipe_parallel_size
         n_micro = self.micro_batches
         if S <= 1:
@@ -470,17 +508,26 @@ class Pipelined1F1BLoss:
                 eg = _tree_add_where(embed_on, eg, dep)
 
                 # ---- neighbor exchange: activations forward, cotangents back
-                fwd_out = jax.tree.map(lambda l: jax.lax.ppermute(l, PIPE_AXIS, perm_f), y_state)
-                bwd_out = jax.tree.map(lambda l: jax.lax.ppermute(l, PIPE_AXIS, perm_b), dstate)
+                if comm_quant == "int8":
+                    from deepspeed_tpu.comm.quantized import quantized_ppermute
+
+                    fwd_out = quantized_ppermute(y_state, PIPE_AXIS, perm_f, tag="pipe_fwd")
+                    bwd_out = quantized_ppermute(dstate, PIPE_AXIS, perm_b, tag="pipe_bwd")
+                else:
+                    # intentionally raw: comm_quant="none" promises a
+                    # bit-identical full-width exchange
+                    fwd_out = jax.tree.map(lambda l: jax.lax.ppermute(l, PIPE_AXIS, perm_f), y_state)  # dstpu: noqa[raw-collective-in-hot-path]
+                    bwd_out = jax.tree.map(lambda l: jax.lax.ppermute(l, PIPE_AXIS, perm_b), dstate)  # dstpu: noqa[raw-collective-in-hot-path]
                 return (fwd_out, bwd_out, xsave, lg, eg, hg, loss_acc), None
 
             (fwd_in, bwd_in, xsave, lg, eg, hg, loss_acc), _ = jax.lax.scan(
                 tick, carry0, jnp.arange(total)
             )
             # contributions live on single stages → psum replicates them
-            loss_out = jax.lax.psum(loss_acc, PIPE_AXIS)
-            eg = jax.tree.map(lambda l: jax.lax.psum(l, PIPE_AXIS), eg)
-            hg = jax.tree.map(lambda l: jax.lax.psum(l, PIPE_AXIS), hg)
+            # (once-per-step broadcasts, not wire-bound — stay raw)
+            loss_out = jax.lax.psum(loss_acc, PIPE_AXIS)  # dstpu: noqa[raw-collective-in-hot-path]
+            eg = jax.tree.map(lambda l: jax.lax.psum(l, PIPE_AXIS), eg)  # dstpu: noqa[raw-collective-in-hot-path]
+            hg = jax.tree.map(lambda l: jax.lax.psum(l, PIPE_AXIS), hg)  # dstpu: noqa[raw-collective-in-hot-path]
             lg = jax.tree.map(lambda l: l[None], lg)  # re-grow the pipe dim
             return loss_out, lg, eg, hg
 
@@ -516,9 +563,11 @@ class Pipelined1F1BLoss:
         return loss, grads
 
 
-def make_1f1b_loss_fn(config, micro_batches: int, topo: Topology = None) -> Pipelined1F1BLoss:
+def make_1f1b_loss_fn(
+    config, micro_batches: int, topo: Topology = None, comm_quant: str = None
+) -> Pipelined1F1BLoss:
     """The 1F1B pipelined loss (see :class:`Pipelined1F1BLoss`)."""
-    return Pipelined1F1BLoss(config, micro_batches, topo)
+    return Pipelined1F1BLoss(config, micro_batches, topo, comm_quant=comm_quant)
 
 
 def pipeline_partition_specs(config, topo: Topology = None) -> Any:

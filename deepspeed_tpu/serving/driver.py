@@ -107,6 +107,8 @@ class ServingDriver:
         if hasattr(self.engine, "kv_pool_info"):
             self._kv_info = dict(self.engine.kv_pool_info())
             self.metrics.update_kv_pool_info(self._kv_info)
+        if hasattr(self.engine, "comm_wire_info"):
+            self.metrics.update_comm_quant(self.engine.comm_wire_info())
 
     # -- engine accessors (guarded so fakes stay minimal) ----------------
     def _kv_cfg(self, name, default):
@@ -610,6 +612,10 @@ class ServingDriver:
                     cache = self._prefix_cache()
                     if cache is not None:
                         self.metrics.update_prefix_cache(cache.stats())
+                    if hasattr(self.engine, "comm_wire_info"):
+                        # wire counters accrue as step programs TRACE, so a
+                        # per-step refresh catches late-compiled shapes
+                        self.metrics.update_comm_quant(self.engine.comm_wire_info())
                     self.metrics.set_gauge("active_requests", len(self._active))
                     if not self._active and not self._queue:
                         self._idle.set()

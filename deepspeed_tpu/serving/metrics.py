@@ -126,12 +126,19 @@ class ServingMetrics:
             "kv_cache_int8": 0,
             "kv_pool_bytes": 0,
             "kv_capacity_multiplier": 1.0,
+            # quantized-collectives flag (engine.comm_wire_info); per-wire
+            # byte counters render as labeled comm_wire_* samples
+            "comm_quant_int8": 0,
             "prefix_cached_blocks": 0,
             "prefix_cached_blocks_idle": 0,
             "prefix_hit_rate": 0.0,
             "spec_acceptance_rate": 0.0,
             "spec_mean_accepted_per_round": 0.0,
         }
+        # per-wire collective byte accounting (comm.quantized.wire_stats
+        # via engine.comm_wire_info): tag -> {sites, wire_bytes_int8,
+        # wire_bytes_fp, reduction}; trace-time counts per compiled site
+        self._comm_wires: Dict[str, Dict[str, float]] = {}
 
     # -- writers ---------------------------------------------------------
     def inc(self, name: str, delta: float = 1) -> None:
@@ -172,6 +179,17 @@ class ServingMetrics:
                 "kv_capacity_multiplier", 1.0
             )
 
+    def update_comm_quant(self, info: Dict) -> None:
+        """Mirror an ``engine.comm_wire_info()`` snapshot: the comm_quant
+        mode as a 0/1 gauge plus the per-wire trace-time byte counters
+        (quantized vs replaced full-width bytes and the derived reduction
+        ratio — the number the A/B gate checks)."""
+        with self._lock:
+            self.gauges["comm_quant_int8"] = int(info.get("comm_quant") == "int8")
+            self._comm_wires = {
+                tag: dict(v) for tag, v in (info.get("wires") or {}).items()
+            }
+
     def update_prefix_cache(self, stats: Dict[str, float]) -> None:
         """Mirror a ``PrefixCache.stats()`` snapshot. The source counters
         are monotone, so assigning (not incrementing) keeps Prometheus
@@ -210,6 +228,8 @@ class ServingMetrics:
             out["ttft_mean_s"] = self.ttft.mean
             out["tpot_mean_s"] = self.tpot.mean
             out["e2e_mean_s"] = self.e2e.mean
+            for tag, w in self._comm_wires.items():
+                out[f"comm_wire_{tag}_reduction"] = w.get("reduction", 0.0)
             return out
 
     def prometheus_text(self) -> str:
@@ -220,6 +240,13 @@ class ServingMetrics:
                 samples.append((f"{p}_{name}", None, self.counters[name], "counter"))
             for name in sorted(self.gauges):
                 samples.append((f"{p}_{name}", None, self.gauges[name], "gauge"))
+            for tag in sorted(self._comm_wires):
+                w = self._comm_wires[tag]
+                lbl = {"wire": tag}
+                samples.append((f"{p}_comm_wire_sites", lbl, w.get("sites", 0), "gauge"))
+                samples.append((f"{p}_comm_wire_bytes_quant", lbl, w.get("wire_bytes_int8", 0), "gauge"))
+                samples.append((f"{p}_comm_wire_bytes_fp", lbl, w.get("wire_bytes_fp", 0), "gauge"))
+                samples.append((f"{p}_comm_wire_reduction", lbl, w.get("reduction", 0.0), "gauge"))
             for hname, hist in (
                 ("ttft_seconds", self.ttft),
                 ("tpot_seconds", self.tpot),

@@ -16,7 +16,14 @@ import os
 # API (effective until backends are initialized).
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+    _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# The suite is XLA-compile-bound (hundreds of small jits, SPMD-partitioned
+# for 8 virtual devices, serial CI core): dropping the LLVM backend opt
+# level cuts wall-clock ~15% without touching FP semantics — parity tests
+# compare programs compiled under identical flags either way.
+if "xla_backend_optimization_level" not in _flags:
+    _flags = (_flags + " --xla_backend_optimization_level=0").strip()
+os.environ["XLA_FLAGS"] = _flags
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("DS_ACCELERATOR", "cpu")
 

@@ -169,6 +169,62 @@ class TestHostSyncInLoop:
 
 
 # ---------------------------------------------------------------------------
+# raw-collective-in-hot-path (wire-bound modules only)
+# ---------------------------------------------------------------------------
+_RAW_COLL = """
+    from jax import lax
+
+    def exchange(x):
+        return lax.all_to_all(x, "expert", split_axis=0, concat_axis=0)
+"""
+
+
+class TestRawCollectiveInHotPath:
+    def test_flags_in_wire_bound_module(self, tmp_path):
+        found = _lint(tmp_path, _RAW_COLL, "raw-collective-in-hot-path",
+                      subdir="inference/v2")
+        assert len(found) == 1 and found[0].severity == "warning"
+        assert "comm.quantized" in found[0].message
+
+    def test_all_three_collectives_flagged(self, tmp_path):
+        found = _lint(tmp_path, """
+            import jax
+            from jax import lax
+
+            def hot(x, perm):
+                a = lax.psum(x, "model")
+                b = jax.lax.ppermute(a, "pipe", perm=perm)
+                return lax.all_to_all(b, "expert", split_axis=0, concat_axis=0)
+        """, "raw-collective-in-hot-path", subdir="runtime/pipe")
+        assert len(found) == 3
+
+    def test_cold_module_clean(self, tmp_path):
+        # runtime/zero is latency-hot (host-sync rule) but not wire-bound
+        found = _lint(tmp_path, _RAW_COLL, "raw-collective-in-hot-path",
+                      subdir="runtime/zero")
+        assert found == []
+
+    def test_quantized_entry_points_clean(self, tmp_path):
+        found = _lint(tmp_path, """
+            from deepspeed_tpu.comm.quantized import quantized_psum_tp
+
+            def hot(x):
+                return quantized_psum_tp(x, "model")
+        """, "raw-collective-in-hot-path", subdir="parallel/moe")
+        assert found == []
+
+    def test_noqa_suppresses(self, tmp_path):
+        found = _lint(tmp_path, """
+            from jax import lax
+
+            def broadcast_logits(x):
+                # full width on purpose: bit-identical send path
+                return lax.psum(x, "pipe")  # dstpu: noqa[raw-collective-in-hot-path]
+        """, "raw-collective-in-hot-path", subdir="runtime/pipe")
+        assert found == []
+
+
+# ---------------------------------------------------------------------------
 # impure-jit
 # ---------------------------------------------------------------------------
 class TestImpureJit:
@@ -480,6 +536,7 @@ class TestFramework:
             "donate-arity",
             "host-sync-in-loop",
             "impure-jit",
+            "raw-collective-in-hot-path",
             "shard-map-axis-coverage",
             "unlocked-shared-mutation",
         }

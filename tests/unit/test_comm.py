@@ -41,6 +41,43 @@ def test_all_gather(topo):
     np.testing.assert_allclose(out, x)
 
 
+def test_all_gather_untiled_stacks_new_axis(topo):
+    # tiled=False must actually reach lax.all_gather: a [1]-per-rank shard
+    # gathers to [8, 1] (stacked), not [8] (concatenated)
+    x = jnp.arange(8.0)
+    out = _run(
+        topo,
+        lambda v: dist.all_gather(v, axis="data", tiled=False),
+        x, P("data"), P(None, None),
+    )
+    assert out.shape == (8, 1)
+    np.testing.assert_allclose(np.asarray(out).ravel(), np.asarray(x))
+
+
+def test_all_gather_dim(topo):
+    x = jnp.arange(16.0).reshape(8, 2)
+    out = _run(
+        topo,
+        lambda v: dist.all_gather(v, axis="data", gather_dim=1),
+        x, P("data", None), P(None, None),
+    )
+    assert out.shape == (1, 16)
+
+
+def test_async_op_raises(topo):
+    # these collectives run inside jit where XLA schedules the overlap —
+    # there is no handle to return, so async_op=True must fail loudly
+    x = jnp.arange(8.0)
+    for fn in (
+        lambda v: dist.all_reduce(v, axis="data", async_op=True),
+        lambda v: dist.all_gather(v, axis="data", async_op=True),
+        lambda v: dist.reduce_scatter(v, axis="data", async_op=True),
+        lambda v: dist.broadcast(v, src=0, axis="data", async_op=True),
+    ):
+        with pytest.raises(NotImplementedError, match="async_op"):
+            _run(topo, fn, x, P("data"), P("data"))
+
+
 def test_reduce_scatter(topo):
     x = jnp.ones((8, 8))
     out = _run(topo, lambda v: dist.reduce_scatter(v, axis="data"), x, P(None, None), P("data", None))

@@ -41,6 +41,12 @@ XLA_FLAGS="--xla_force_host_platform_device_count=2" \
     python -m pytest tests/unit/test_sharded_attention.py::TestRingBitwise \
     -q -p no:cacheprovider
 
+echo "== quantized-comm parity gate (8-device mesh) =="
+# int8-inside-the-collective vs full width on every hot wire: TP decode
+# greedy agreement (argmax-within-quant-noise), MoE EP dispatch/combine
+# bounded error, GPipe/1F1B loss parity, wire-byte reduction ratios
+python -m pytest tests/unit/test_quantized_comm.py -q -p no:cacheprovider
+
 echo "== donation/recompile verifier (Tier B) =="
 ./bin/dstpu lint --verify
 
