@@ -799,6 +799,98 @@ def bench_comm_quant_ab(cfg=None, params=None, seed=0):
     }
 
 
+def bench_comm_overlap_ab(cfg=None, params=None, seed=0):
+    """Tile-granular overlap A/B (riding ``--serving-load`` via the
+    DSTPU_COMM_OVERLAP=tiled env knob): the SAME TP-decode workload served
+    twice — monolithic row-parallel psums, then per-tile collective rings
+    (``comm_overlap: tiled``, T3-style) — on a ``data x model=2`` slice.
+    Reports decode tok/s for both runs and the per-wire tile counts from
+    the trace-time registry (how many independent collective programs each
+    wire decomposed into — the structural lever the latency-hiding
+    scheduler overlaps). Output gate: tiling is pure transport, so the
+    tiled token streams must be BIT-IDENTICAL to the monolithic run — any
+    divergence is a bug, not rounding. Composes with the int8 wire: set
+    DSTPU_COMM_QUANT=int8 too and both arms run quantized, isolating the
+    overlap delta. Knobs: DSTPU_COMM_OVERLAP (tiled enables),
+    DSTPU_CO_TILES (tile count, default 4), DSTPU_CO_N (requests),
+    DSTPU_CO_MAX_NEW (tokens per request)."""
+    from deepspeed_tpu.comm.quantized import reset_wire_stats, wire_stats
+    from deepspeed_tpu.inference.config import RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.models import TransformerConfig, init_params
+    from deepspeed_tpu.parallel.topology import (
+        Topology, reset_topology, set_topology,
+    )
+
+    ndev = len(jax.devices())
+    if ndev < 2 or ndev % 2:
+        return {"skipped": f"needs an even device count >= 2, have {ndev}"}
+    tp = 2
+    tiles = int(os.environ.get("DSTPU_CO_TILES", 4))
+    comm_quant = os.environ.get("DSTPU_COMM_QUANT", "") or "none"
+    n_requests = int(os.environ.get("DSTPU_CO_N", 4))
+    max_new = int(os.environ.get("DSTPU_CO_MAX_NEW", 32))
+    if cfg is None:
+        cfg = TransformerConfig(
+            vocab_size=256, hidden_size=256, n_layers=2, n_heads=4,
+            n_kv_heads=2, max_seq_len=512, dtype="float32",
+        )
+        params = init_params(cfg, jax.random.key(0))
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, cfg.vocab_size,
+                            size=(int(rng.integers(8, 24)),)).astype(np.int32)
+               for _ in range(n_requests)]
+
+    def run(mode):
+        reset_topology()
+        set_topology(Topology(data=ndev // tp, model=tp))
+        try:
+            reset_wire_stats()
+            rc = RaggedInferenceEngineConfig.from_dict({
+                "dtype": cfg.dtype, "tp_size": tp, "comm_quant": comm_quant,
+                "comm_overlap": mode, "tp_overlap_tiles": tiles,
+                "kv_cache": {"block_size": 16, "num_blocks": 128,
+                             "max_blocks_per_seq": 16},
+                "state_manager": {"max_tracked_sequences": 64,
+                                  "max_ragged_batch_size": 96,
+                                  "max_ragged_sequence_count": 16,
+                                  "max_context": 256},
+            })
+            engine = InferenceEngineV2(cfg, params, rc)
+            engine.generate(prompts[:1], max_new_tokens=8)  # compile warmup
+            t0 = time.perf_counter()
+            outs = engine.generate(prompts, max_new_tokens=max_new)
+            wall = time.perf_counter() - t0
+            toks = sum(len(o) - len(p) for o, p in zip(outs, prompts))
+            return {
+                "tok_s": toks / wall if wall > 0 else 0.0,
+                "outputs": [np.asarray(o).tolist() for o in outs],
+                "wires": wire_stats(),
+            }
+        finally:
+            reset_topology()
+
+    base = run("none")
+    tiled = run("tiled")
+    if base["outputs"] != tiled["outputs"]:
+        raise RuntimeError(
+            "comm-overlap A/B output mismatch: tiled decode must be "
+            "bit-identical to the monolithic wire (pure transport); "
+            "divergence is a ring bug, not rounding"
+        )
+    return {
+        "tp": tp,
+        "comm_quant": comm_quant,
+        "tp_overlap_tiles": tiles,
+        "none_tok_s": round(base["tok_s"], 1),
+        "tiled_tok_s": round(tiled["tok_s"], 1),
+        "outputs_identical": True,
+        "wire_tiles": {
+            tag: w.get("tiles", 1) for tag, w in tiled["wires"].items()
+        },
+    }
+
+
 def bench_serving_load(
     n_requests=None, rate_rps=None, max_new=None, slo_e2e_s=None,
     cfg=None, params=None, seed=0,
@@ -944,6 +1036,12 @@ def bench_serving_load(
     cq_report = {}
     if os.environ.get("DSTPU_COMM_QUANT", "") == "int8":
         cq_report = {"comm_quant_int8": bench_comm_quant_ab(seed=seed)}
+    # tile-granular overlap A/B rider: DSTPU_COMM_OVERLAP=tiled appends a
+    # TP-decode tok/s comparison (bit-identical outputs enforced) plus the
+    # per-wire tile counts; composes with DSTPU_COMM_QUANT=int8
+    co_report = {}
+    if os.environ.get("DSTPU_COMM_OVERLAP", "") == "tiled":
+        co_report = {"comm_overlap_tiled": bench_comm_overlap_ab(seed=seed)}
     return {
         "mode": "serving_load",
         "n_requests": n_requests,
@@ -962,6 +1060,7 @@ def bench_serving_load(
         **spec_report,
         **kv_report,
         **cq_report,
+        **co_report,
     }
 
 

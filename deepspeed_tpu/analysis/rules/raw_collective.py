@@ -10,12 +10,18 @@ the quantization seam and the accounting — the wire silently goes back to
 full width and never shows up in ``/metrics``.
 
 Scope is this rule's OWN hot set (serving/, inference/v2/, parallel/moe/,
-runtime/pipe/) — not the framework default used by the host-sync rule,
-which targets latency (runtime/zero/) rather than wire width. Sites that
-are intentionally raw (broadcast-from-last-stage psums, the
-``comm_quant="none"`` bit-identical send path) carry
-``# dstpu: noqa[raw-collective-in-hot-path]``, which doubles as
-documentation of why the wire stays full width.
+runtime/pipe/, and comm/ itself) — not the framework default used by the
+host-sync rule, which targets latency (runtime/zero/) rather than wire
+width. ``comm/quantized.py`` and ``comm/overlap_tiled.py`` are the
+DESIGNATED seam modules: their per-tile ``ppermute`` rings and all-to-all
+hops ARE the decomposition every other hot-path collective must route
+through, so they are exempt (as are the 1-bit compression seam and the
+dist-compat facade, see ``SEAM_MODULES``) — a raw collective in any
+*other* comm/ module is a new wire dodging the seams. Sites that are
+intentionally raw
+(broadcast-from-last-stage psums, the ``comm_quant="none"`` bit-identical
+send path) carry ``# dstpu: noqa[raw-collective-in-hot-path]``, which
+doubles as documentation of why the wire stays full width.
 """
 
 import ast
@@ -25,8 +31,23 @@ from deepspeed_tpu.analysis.framework import Rule, register
 from deepspeed_tpu.analysis.rules._common import dotted_name
 
 #: wire-bound subtrees: every collective here should route through
-#: comm/quantized.py (or carry a noqa explaining why it stays raw)
-HOT_WIRE_PREFIXES = ("serving/", "inference/v2/", "parallel/moe/", "runtime/pipe/")
+#: comm/quantized.py / comm/overlap_tiled.py (or carry a noqa explaining
+#: why it stays raw)
+HOT_WIRE_PREFIXES = (
+    "serving/", "inference/v2/", "parallel/moe/", "runtime/pipe/", "comm/",
+)
+
+#: exempt modules: the seam modules' raw ppermute/all_to_all calls ARE the
+#: decomposed transport every hot wire routes through; comm/comm.py is the
+#: torch.distributed-compat facade whose wrapper bodies are, by definition,
+#: the raw primitives (the rule targets call SITES that bypass the seams,
+#: not the layer beneath them)
+SEAM_MODULES = (
+    "comm/quantized.py",          # int8 wire seam
+    "comm/overlap_tiled.py",      # tile-granular overlap seam
+    "runtime/comm/compressed.py",  # 1-bit error-feedback compression seam
+    "comm/comm.py",               # dist-compat facade (below the seams)
+)
 
 _RAW_COLLECTIVES = {
     "lax.all_to_all", "jax.lax.all_to_all",
@@ -49,6 +70,8 @@ class RawCollectiveInHotPathRule(Rule):
     def check(self, ctx):
         norm = ctx.path.replace(os.sep, "/")
         if not any(frag in norm for frag in HOT_WIRE_PREFIXES):
+            return []
+        if any(norm.endswith(seam) for seam in SEAM_MODULES):
             return []
         rule = self
         findings = []

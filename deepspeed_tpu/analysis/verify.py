@@ -21,6 +21,9 @@ Entry points covered (the compiled hot paths every perf PR leans on):
   * ``runtime.streamed_adam`` per-leaf donated update
   * quantized-collective variants: TP decode through the int8 psum islands,
     pipelined train step through int8 ppermute activation sends
+  * tiled-overlap variants (``comm_overlap="tiled"``): tp2 decode through
+    the per-tile ppermute rings, ZeRO-3 train step through tiled
+    prefetch-bucket all-gathers
 
 Run via ``dstpu lint --verify`` (wired into tools/run_smoke.sh).
 """
@@ -39,6 +42,7 @@ __all__ = [
     "verify_quantized_comm",
     "verify_ring_train",
     "verify_streamed_adam",
+    "verify_tiled_overlap",
     "verify_train_engine",
 ]
 
@@ -649,6 +653,128 @@ def verify_quantized_comm() -> List[CheckResult]:
     return results
 
 
+def verify_tiled_overlap() -> List[CheckResult]:
+    """Donation coverage for the ``comm_overlap="tiled"`` step artifacts:
+    the tp2 serving decode whose row wires decompose into per-tile ppermute
+    rings (comm/overlap_tiled.py), and the ZeRO-3 train step whose prefetch
+    bucket all-gathers split into per-tile collectives. Each tile's ring
+    builds fresh per-chunk intermediates inside shard_map right next to the
+    donated KV pools / grad buffers — more lowering surface between the
+    donation annotation and the compiled alias than the monolithic wire, so
+    both tiled steps get the full donation check."""
+    import jax
+    import numpy as np
+
+    from deepspeed_tpu.parallel.topology import (
+        Topology,
+        reset_topology,
+        set_topology,
+    )
+
+    if len(jax.devices()) < 8:
+        return [CheckResult("tiled_overlap", "donation", True,
+                            "needs 8 devices; skipped")]
+
+    results: List[CheckResult] = []
+
+    # --- TP decode: per-tile rings behind attention-out / MLP-down ---------
+    from deepspeed_tpu.inference.config import RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.models import get_config, init_params
+
+    reset_topology()
+    try:
+        set_topology(Topology(data=4, model=2))
+        cfg = get_config("tiny", n_layers=2, dtype="float32", max_seq_len=512)
+        params = init_params(cfg, jax.random.key(0))
+        rc = RaggedInferenceEngineConfig.from_dict({
+            "dtype": "float32",
+            "tp_size": 2,
+            "comm_overlap": "tiled",
+            "tp_overlap_tiles": 2,
+            "decode_steps": 2,
+            "kv_cache": {"block_size": 4, "num_blocks": 128,
+                         "max_blocks_per_seq": 32},
+            "state_manager": {"max_tracked_sequences": 16,
+                              "max_ragged_batch_size": 256,
+                              "max_ragged_sequence_count": 4,
+                              "max_context": 256},
+        })
+        eng = InferenceEngineV2(cfg, params, rc)
+        captured: dict = {}
+        _capture_builder(eng, "_build_split_step", captured, "split_step")
+        _capture_builder(eng, "_build_multistep_decode", captured,
+                         "multistep_decode")
+
+        def prompts(seed):
+            rng = np.random.default_rng(seed)
+            return [rng.integers(1, cfg.vocab_size, size=(12,)).astype(np.int32)
+                    for _ in range(2)]
+
+        eng.generate(prompts(0), max_new_tokens=6)
+        eng.generate(prompts(1), max_new_tokens=6)
+        for key, label in (
+            ("split_step", "engine_v2.split_step[tp2+tiled]"),
+            ("multistep_decode", "engine_v2.multistep_decode[tp2+tiled]"),
+        ):
+            if key not in captured:
+                results.append(CheckResult(
+                    label, "donation", False,
+                    "entry point never executed in harness"))
+                continue
+            fn, args = captured[key]
+            results.append(check_donation(label, fn, args))
+    finally:
+        reset_topology()
+
+    # --- ZeRO-3 train step: tiled prefetch-bucket all-gathers --------------
+    import deepspeed_tpu
+    import jax.numpy as jnp
+
+    W = 8
+    key = jax.random.key(0)
+    keys = jax.random.split(key, 2)
+    params = {
+        f"layer_{i}": {
+            "w": (jax.random.normal(keys[i], (16, 16)) * 0.1).astype(jnp.float32),
+            "b": jnp.zeros((16,), jnp.float32),
+        }
+        for i in range(2)
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=_mlp_loss,
+        model_parameters=params,
+        config={
+            "train_micro_batch_size_per_gpu": 8,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 3, "param_persistence_threshold": 0},
+            "comm_overlap": "tiled",
+            "tp_overlap_tiles": 2,
+            "mesh": {"data": W},
+            "steps_per_print": 10**9,
+        },
+    )
+    captured2: dict = {}
+    _capture_builder(engine, "_build_train_step", captured2, "train_step")
+    rng = np.random.default_rng(0)
+
+    def batch():
+        x = rng.normal(size=(8 * W, 16)).astype(np.float32)
+        return {"x": x, "y": (x * 0.5).astype(np.float32)}
+
+    engine.train_batch(batch=batch())
+    engine.train_batch(batch=batch())
+
+    name = "runtime.engine.train_step[zero3+tiled]"
+    if "train_step" not in captured2:
+        results.append(CheckResult(name, "donation", False,
+                                   "train step never executed in harness"))
+    else:
+        fn, args = captured2["train_step"]
+        results.append(check_donation(name, fn, args))
+    return results
+
+
 # ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
@@ -662,6 +788,7 @@ def run_verify(verbose: bool = True) -> Tuple[List[CheckResult], bool]:
         (verify_train_engine, "train_engine"),
         (verify_ring_train, "ring_train"),
         (verify_quantized_comm, "quantized_comm"),
+        (verify_tiled_overlap, "tiled_overlap"),
     ):
         try:
             results.extend(fn())

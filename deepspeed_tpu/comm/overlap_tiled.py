@@ -1,0 +1,302 @@
+"""Tile-granular compute/collective overlap for the TP row wires (T3-style).
+
+PR 1 hides ZeRO-3 gathers at *bucket* granularity and the quantized-comm
+layer (``comm/quantized.py``) shrank the hot wires to int8 — but each wire
+still fires as ONE monolithic collective after its full producer GEMM, the
+exposed-comm tail the T3 paper eliminates by decomposing collectives into
+tiles that launch as producer slices complete. This module is that
+decomposition for the row-parallel matmul+reduce wires:
+
+* ``tiled_tp_matmul`` runs the local ``[t,K] @ [K,h]`` product inside one
+  shard_map island and fires each of N output-row tiles' reduce-scatter →
+  all-gather ring as ``lax.ppermute`` steps. The tiles are issued from a
+  Python loop — never a scan — so they are independent *peers* in the HLO
+  (the Domino lesson, ``runtime/domino/transformer.py``): XLA's
+  latency-hiding scheduler can interleave tile k's ring with tile k+1's
+  quant/dequant math and the surrounding layer compute. With
+  ``comm_quant="int8"`` the int8 payload + fp32 scale planes of
+  ``quantized_psum_tp`` ride the same per-tile permutes.
+* ``peer_chunks`` is the bare chunk-and-issue-as-peers helper the Domino
+  wrappers now build on — one overlap idiom, two consumers.
+
+Numerics contract (the parity tests in ``tests/unit/test_tiled_overlap.py``
+pin all of this):
+
+* The ring is transport-only: direct-offset permutes move each chunk
+  losslessly, receivers reorder by source rank and accumulate in ASCENDING
+  rank order — measured bitwise-equal to ``lax.psum``'s reduction on this
+  backend at every axis width tested (2/4/8), and to ``lax.psum`` applied
+  per tile at every dtype.
+* ``comm_quant="int8"``: per-tile quantization blocks are the SAME global
+  flat blocks as the untiled ``quantized_psum_tp`` layout — tiling along
+  the row axis keeps every (tile, rank-chunk) range contiguous and
+  block-aligned in flat coordinates when ``W * block_size`` divides the
+  per-tile element count — so the tiled wire is BITWISE identical to the
+  untiled int8 wire at every tile count, fp32 and bf16.
+* ``comm_quant="none"``: chunks move in fp32 and the result rounds to the
+  operand dtype once, after the summed chunks reassemble. fp32 operands are
+  bitwise vs the monolithic ``lax.psum``. bf16 operands are bitwise vs a
+  per-tile ``lax.psum`` of the same operand, but NOT vs the monolithic psum
+  of a *fused* bf16 GEMM: XLA sinks the dot's f32→bf16 convert past its own
+  all-reduce, so the untiled baseline sums unrounded f32 dot outputs — a
+  value no decomposed collective can observe (measured, 1-ulp differences).
+  The engine-level bit-parity gate therefore runs fp32 (and int8-any-dtype,
+  where both paths materialize f32 identically).
+* The producer GEMM is computed ONCE and its output rows are sliced per
+  tile. Slicing the GEMM itself (``split_gemm=True``, the full T3 form —
+  each tile's ring depends only on its own ``[t/N,K] @ [K,h]`` slice) is
+  bitwise-safe only where the dot's accumulation order is independent of
+  the row count; measured NOT true of this CPU backend (row-sliced products
+  differ in the last ulp at some shapes), so the engine seam keeps the
+  bitwise-safe default and ``split_gemm`` stays an explicit opt-in for MXU
+  backends.
+
+Non-divisible shapes (``tiles`` ∤ ``t``, or a per-tile flat size the axis
+width / quant blocks don't divide) fall back to the untiled wire — same
+numerics, ``tiles=1`` in the wire registry.
+"""
+
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deepspeed_tpu.ops.quantizer import block_quant as bq
+from deepspeed_tpu.parallel.topology import MODEL_AXIS
+
+COMM_OVERLAP_MODES = ("none", "tiled")
+
+__all__ = [
+    "COMM_OVERLAP_MODES",
+    "check_comm_overlap",
+    "check_overlap_tiles",
+    "effective_tiles",
+    "peer_chunks",
+    "tiled_tp_matmul",
+]
+
+
+def check_comm_overlap(value) -> str:
+    """Validate the ``comm_overlap`` knob. A typo must not silently serve
+    the monolithic wire while the operator believes the tiles overlap."""
+    mode = str(value or "none")
+    if mode not in COMM_OVERLAP_MODES:
+        raise ValueError(
+            f"comm_overlap={value!r}: expected one of {COMM_OVERLAP_MODES}"
+        )
+    return mode
+
+
+def check_overlap_tiles(value) -> int:
+    """Validate ``tp_overlap_tiles`` (the per-wire tile count)."""
+    tiles = int(value if value is not None else 4)
+    if tiles < 1:
+        raise ValueError(f"tp_overlap_tiles={value!r}: expected an int >= 1")
+    return tiles
+
+
+def effective_tiles(
+    t: int,
+    h: int,
+    tiles: int,
+    world: int,
+    comm_quant: str = "none",
+    bits: int = 8,
+    block_size: int = 256,
+) -> int:
+    """The tile count a ``[t, h]`` product actually runs at: the requested
+    ``tiles`` when every tile's flat size splits into ``world`` rank chunks
+    (and, under int8, into whole quant blocks so the tiled blocks stay the
+    untiled wire's global flat blocks — the bitwise-parity condition), else
+    1 (untiled fallback)."""
+    if tiles <= 1 or world <= 1:
+        return 1
+    if t % tiles:
+        return 1
+    per_tile = (t // tiles) * h
+    quantum = world * block_size if comm_quant == "int8" else world
+    if per_tile % quantum:
+        return 1
+    return tiles
+
+
+def peer_chunks(
+    fn: Callable,
+    n_chunks: int,
+    *arrays: Optional[jax.Array],
+    axis: int = 0,
+) -> List:
+    """Split each array in ``arrays`` into ``n_chunks`` along ``axis`` and
+    call ``fn`` once per chunk tuple, from a Python loop — NEVER a scan: the
+    chunk programs must be peers in the HLO schedule for the latency-hiding
+    scheduler to interleave one chunk's collectives with another's compute;
+    a scan would serialize them behind its loop carry. ``None`` arrays pass
+    through as ``None`` to every call. Returns the per-chunk results in
+    order; the caller reassembles (concatenate, average, ...)."""
+    split = [
+        [None] * n_chunks if a is None else jnp.split(a, n_chunks, axis=axis)
+        for a in arrays
+    ]
+    return [fn(*(s[i] for s in split)) for i in range(n_chunks)]
+
+
+# ---------------------------------------------------------------------------
+# transport-only ppermute ring (inside shard_map)
+# ---------------------------------------------------------------------------
+def _stack_by_source(plane: jax.Array, world: int, axis_name: str,
+                     per_dest: bool) -> jax.Array:
+    """Collect one plane from every rank of ``axis_name``, stacked in
+    ascending SOURCE-rank order — the transport half of a decomposed
+    collective, as W-1 direct-offset ``ppermute`` steps plus the local
+    contribution (no relay chain: every step is an independent HLO peer).
+
+    ``per_dest=True``: ``plane`` is ``[W, ...]`` with row w destined for
+    rank w (the reduce-scatter exchange — rank r sends row ``(r+s)%W`` at
+    offset s and receives source ``(r-s)%W``'s row r). ``per_dest=False``:
+    ``plane`` is one local ``[...]`` broadcast to all ranks (the all-gather
+    hop). Receivers reorder the offset-stacked planes by source
+    (``stacked[(r - src) % W] == source src's plane``) so the downstream
+    accumulation order is ascending — the order ``lax.psum`` reduces in."""
+    r = lax.axis_index(axis_name)
+    recv = []
+    for s in range(world):
+        if per_dest:
+            send = lax.dynamic_index_in_dim(
+                plane, jnp.mod(r + s, world), 0, keepdims=True
+            )
+        else:
+            send = plane[None]
+        if s == 0:
+            recv.append(send)
+            continue
+        perm = [(i, (i + s) % world) for i in range(world)]
+        recv.append(lax.ppermute(send, axis_name, perm=perm))
+    stacked = jnp.concatenate(recv, axis=0)  # index j holds source (r-j)%W
+    return stacked[jnp.mod(r - jnp.arange(world), world)]
+
+
+def _ring_allreduce(y: jax.Array, world: int, axis_name: str) -> jax.Array:
+    """Full-width tile ring: chunks move in fp32, each rank sums its chunk
+    over sources in ascending order, the reduced chunks broadcast back and
+    reassemble; ONE round to ``y.dtype`` at the end (matching the single
+    rounding of an fp32-accumulated psum)."""
+    flat = y.reshape(-1).astype(jnp.float32)
+    rows = flat.reshape(world, flat.shape[0] // world)
+    total = jnp.sum(_stack_by_source(rows, world, axis_name, True), axis=0)
+    full = _stack_by_source(total, world, axis_name, False)
+    return full.reshape(y.shape).astype(y.dtype)
+
+
+def _ring_allreduce_int8(y: jax.Array, world: int, axis_name: str,
+                         bits: int, block_size: int) -> jax.Array:
+    """Int8 tile ring: the two hops of ``block_quant.quantized_allreduce``
+    (quantized reduce-scatter, then a re-quantized all-gather) with the
+    int8 payload and fp32 scale planes riding the same per-tile permutes.
+    Caller guarantees ``world * block_size`` divides ``y.size`` (the
+    no-padding condition under which every (tile, rank-chunk) quant block
+    is a global flat block of the untiled wire — the bitwise-parity
+    invariant)."""
+    flat = y.reshape(-1).astype(jnp.float32)
+    rows = flat.reshape(world, flat.shape[0] // world)
+    payload, scales = bq._quantize_rows(rows, bits, block_size)
+    deq = bq._dequantize_rows(
+        _stack_by_source(payload, world, axis_name, True),
+        _stack_by_source(scales, world, axis_name, True),
+        bits, block_size,
+    )
+    # ascending-source sum, then the untiled wire's per-chunk round to the
+    # operand dtype BEFORE the second hop re-quantizes
+    total = jnp.sum(deq, axis=0).astype(y.dtype)
+    payload2, scales2 = bq._quantize_rows(
+        total.reshape(1, -1).astype(jnp.float32), bits, block_size
+    )
+    deq2 = bq._dequantize_rows(
+        _stack_by_source(payload2[0], world, axis_name, False),
+        _stack_by_source(scales2[0], world, axis_name, False),
+        bits, block_size,
+    )
+    return deq2.reshape(y.shape).astype(y.dtype)
+
+
+# ---------------------------------------------------------------------------
+# the tiled row-parallel matmul+reduce primitive
+# ---------------------------------------------------------------------------
+def tiled_tp_matmul(
+    x2d: jax.Array,
+    w: jax.Array,
+    mesh,
+    tiles: int,
+    comm_quant: str = "none",
+    axis_name: str = MODEL_AXIS,
+    bits: int = 8,
+    block_size: int = 256,
+    tag: str = "tp_tiled",
+    split_gemm: bool = False,
+) -> jax.Array:
+    """``x2d @ w`` with the contraction dim sharded over ``axis_name`` and
+    the reduction wire decomposed into N independent per-tile rings.
+
+    x2d: ``[t, K]`` activations (K column-sharded by GSPMD from the param
+    shardings); w: ``[K, h]`` row-sharded. Returns ``[t, h]`` replicated
+    over the axis. One shard_map island computes the local product and
+    fires each output-row tile's reduce-scatter → all-gather ring as
+    ppermute peers; ``comm_quant="int8"`` sends int8 payloads + fp32
+    scales on the same permutes, bitwise-identical to the untiled
+    ``quantized_psum_tp`` wire. ``split_gemm=True`` additionally slices
+    the producer GEMM per tile (the full T3 pairing — only for backends
+    whose dot accumulation is row-count-invariant; see module docstring).
+
+    Shapes the tile constraint rejects run untiled (same numerics); the
+    wire registry records the per-wire tile count either way."""
+    from deepspeed_tpu.comm.quantized import quantized_psum_tp, record_wire
+
+    t, h = int(x2d.shape[0]), int(w.shape[1])
+    world = int(mesh.shape[axis_name])
+    if world <= 1:
+        return x2d @ w
+    n_tiles = effective_tiles(t, h, tiles, world, comm_quant, bits, block_size)
+
+    def local(xl, wl):
+        if comm_quant == "int8" and n_tiles == 1:
+            # untiled int8 wire (quantized_psum_tp records it)
+            return quantized_psum_tp(
+                xl @ wl, axis_name, bits=bits, block_size=block_size, tag=tag
+            )
+        n = t * h
+        if comm_quant == "int8":
+            npad = n + ((-n) % (world * block_size))  # == n (tile condition)
+            nb = npad // block_size
+            chunk = npad // world
+            wire = (npad + nb * 4) + (chunk + (chunk // block_size) * 4)
+        else:
+            # the full-width ring moves fp32 chunks (the accumulation
+            # dtype that keeps the tiled sum bitwise vs psum) — honest
+            # accounting shows the inflation for sub-fp32 operands; the
+            # narrow-wire pairing is comm_quant="int8" on the same tiles
+            wire = 2 * n * 4
+        record_wire(tag, wire, 2 * n * x2d.dtype.itemsize, tiles=n_tiles)
+        if n_tiles == 1:
+            return _ring_allreduce(xl @ wl, world, axis_name)
+
+        def tile_ring(yi):
+            if comm_quant == "int8":
+                return _ring_allreduce_int8(yi, world, axis_name, bits, block_size)
+            return _ring_allreduce(yi, world, axis_name)
+
+        if split_gemm:
+            outs = peer_chunks(lambda xi: tile_ring(xi @ wl), n_tiles, xl)
+        else:
+            outs = peer_chunks(tile_ring, n_tiles, xl @ wl)
+        return jnp.concatenate(outs, axis=0)
+
+    from jax.sharding import PartitionSpec as P
+
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(None, axis_name), P(axis_name, None)),
+        out_specs=P(None, None),
+        axis_names={axis_name},
+        check_vma=False,
+    )(x2d, w)

@@ -61,17 +61,23 @@ _LOCK = threading.Lock()
 _WIRE: Dict[str, Dict[str, float]] = {}
 
 
-def record_wire(tag: str, quant_bytes: int, fp_bytes: int) -> None:
+def record_wire(tag: str, quant_bytes: int, fp_bytes: int, tiles: int = 1) -> None:
     """Fold one traced collective site into the registry: ``quant_bytes`` is
     the int8 payload + fp32 scale bytes this site moves, ``fp_bytes`` the
-    bytes the replaced full-width collective would have moved."""
+    bytes the replaced full-width collective would have moved, and ``tiles``
+    the tile-granular overlap factor (``comm/overlap_tiled.py``): how many
+    independent per-tile collective programs the site decomposed into, 1
+    for a monolithic wire. Per tag the registry keeps the max tile count
+    seen — one tag's sites all trace the same seam, so a smaller value only
+    means some shape fell back to untiled."""
     with _LOCK:
         e = _WIRE.setdefault(
-            tag, {"sites": 0, "wire_bytes_int8": 0, "wire_bytes_fp": 0}
+            tag, {"sites": 0, "wire_bytes_int8": 0, "wire_bytes_fp": 0, "tiles": 1}
         )
         e["sites"] += 1
         e["wire_bytes_int8"] += int(quant_bytes)
         e["wire_bytes_fp"] += int(fp_bytes)
+        e["tiles"] = max(int(e.get("tiles", 1)), int(tiles))
 
 
 def wire_stats() -> Dict[str, Dict[str, float]]:
@@ -85,6 +91,10 @@ def wire_stats() -> Dict[str, Dict[str, float]]:
 
 
 def reset_wire_stats() -> None:
+    """Clear the registry. Engine builds call this (engine_v2 init) so A/B
+    runs and tests that construct several engines in one process don't
+    accumulate stale per-tag byte/tile counts across configurations —
+    ``wire_stats()`` then describes the CURRENT engine's traced wires."""
     with _LOCK:
         _WIRE.clear()
 

@@ -47,6 +47,13 @@ def parse_args(argv=None):
                    "engine, tp>1): the MODEL_AXIS psum behind the "
                    "attention-output and MLP down projections becomes an "
                    "int8 reduce-scatter + all-gather with fp32 block scales")
+    p.add_argument("--comm-overlap", default="none", choices=("none", "tiled"),
+                   help="tile-granular compute/collective overlap (v2 "
+                   "engine, tp>1): split each TP row wire into independent "
+                   "per-tile reduce-scatter + all-gather rings the "
+                   "scheduler overlaps with compute")
+    p.add_argument("--tp-overlap-tiles", type=int, default=4,
+                   help="tiles per wire for --comm-overlap tiled")
     p.add_argument("--sample", action="store_true",
                    help="temperature sampling instead of greedy")
     p.add_argument("--temperature", type=float, default=1.0)
@@ -97,6 +104,8 @@ def generate_main(argv=None) -> int:
         rc = RaggedInferenceEngineConfig.from_dict({
             "dtype": args.dtype, "tp_size": args.tp,
             "comm_quant": getattr(args, "comm_quant", "none"),
+        "comm_overlap": getattr(args, "comm_overlap", "none"),
+        "tp_overlap_tiles": getattr(args, "tp_overlap_tiles", 4),
             "decode_steps": min(32, args.max_new_tokens),
             "greedy": not args.sample, "temperature": args.temperature,
             "top_k": args.top_k, "top_p": args.top_p, "seed": args.seed,
@@ -203,6 +212,13 @@ def serve_parse_args(argv=None):
                    "the TP decode psums run as int8 reduce-scatter + "
                    "all-gather with fp32 block scales; per-wire byte "
                    "counters show up in /metrics")
+    p.add_argument("--comm-overlap", default="none", choices=("none", "tiled"),
+                   help="tile-granular compute/collective overlap (tp>1): "
+                   "split each TP decode wire into independent per-tile "
+                   "reduce-scatter + all-gather rings; per-wire tile "
+                   "counts show up in /metrics")
+    p.add_argument("--tp-overlap-tiles", type=int, default=4,
+                   help="tiles per wire for --comm-overlap tiled")
     p.add_argument("--no-prefix-cache", action="store_true",
                    help="disable automatic prefix caching (on by default "
                    "when serving: repeated prompt prefixes share KV blocks "
@@ -252,6 +268,8 @@ def build_serving_stack(args, cfg=None, params=None, tok=None):
     rc = RaggedInferenceEngineConfig.from_dict({
         "dtype": args.dtype, "tp_size": args.tp,
         "comm_quant": getattr(args, "comm_quant", "none"),
+        "comm_overlap": getattr(args, "comm_overlap", "none"),
+        "tp_overlap_tiles": getattr(args, "tp_overlap_tiles", 4),
         "decode_steps": args.decode_steps,
         "greedy": not args.sample, "temperature": args.temperature,
         "top_k": args.top_k, "top_p": args.top_p, "seed": args.seed,

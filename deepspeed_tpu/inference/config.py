@@ -141,6 +141,17 @@ class RaggedInferenceEngineConfig(DSConfigModel):
     # "none" keeps the implicit full-width GSPMD psum. No-op at tp_size=1;
     # anything else raises at engine construction.
     comm_quant: str = "none"
+    # tile-granular compute/collective overlap (comm/overlap_tiled.py):
+    # "tiled" decomposes each TP row wire (attention-output / MLP down
+    # psum) into tp_overlap_tiles independent per-tile reduce-scatter→
+    # all-gather ppermute rings — peers the latency-hiding scheduler can
+    # interleave with compute; comm_quant's int8 payload+scale planes ride
+    # the same tiles. "none" keeps the monolithic wire. No-op at tp_size=1;
+    # shapes the tile constraint rejects fall back to untiled (same
+    # numerics); anything else raises at engine construction.
+    comm_overlap: str = "none"
+    # per-wire tile count for comm_overlap="tiled" (>= 1)
+    tp_overlap_tiles: int = 4
     quant: QuantConfig = submodel(QuantConfig)
     kv_cache: Optional[KVCacheConfig] = submodel(KVCacheConfig)
     state_manager: Optional[StateManagerConfig] = submodel(StateManagerConfig)

@@ -170,6 +170,30 @@ class InferenceEngineV2:
             str(getattr(self.config, "comm_quant", "none") or "none")
         )
         self._tp_quant = self._comm_quant == "int8" and self._tp > 1
+        # --- tile-granular overlap (comm/overlap_tiled.py): "tiled" splits
+        # each TP row wire into tp_overlap_tiles independent per-tile
+        # reduce-scatter→all-gather rings (ppermute peers the latency-hiding
+        # scheduler can interleave with compute); the int8 planes ride the
+        # same tiles. tp_size=1 makes it a validated no-op. The wire
+        # registry resets here so wire_stats() describes THIS engine's
+        # traced wires, not a previous configuration's.
+        from deepspeed_tpu.comm.overlap_tiled import (
+            check_comm_overlap,
+            check_overlap_tiles,
+        )
+        from deepspeed_tpu.comm.quantized import reset_wire_stats
+
+        reset_wire_stats()
+        self._comm_overlap = check_comm_overlap(
+            str(getattr(self.config, "comm_overlap", "none") or "none")
+        )
+        self._overlap_tiles = check_overlap_tiles(
+            getattr(self.config, "tp_overlap_tiles", 4)
+        )
+        self._tp_tiled = self._comm_overlap == "tiled" and self._tp > 1
+        # any explicit-wire mode routes the row projections through the
+        # shard_map island in _tp_row_matmul instead of the implicit psum
+        self._tp_wire = self._tp_quant or self._tp_tiled
         # --- KV payload dtype + decode-attention impl (ISSUE 6): int8 pools
         # store quantize_kv payloads + per-vector fp32 scale planes (half
         # the HBM per block → ~2x blocks per byte budget, kv_pool.py);
@@ -252,6 +276,7 @@ class InferenceEngineV2:
             f"kv={self._kv_dtype}, attn={self._attn_impl}"
             + (f", tp={self._tp}" if self._tp > 1 else "")
             + (", comm_quant=int8" if self._tp_quant else "")
+            + (f", comm_overlap=tiled({self._overlap_tiles})" if self._tp_tiled else "")
             + (", prefix_cache=on" if self.state_manager.prefix_cache is not None else ""),
             ranks=[0],
         )
@@ -277,16 +302,25 @@ class InferenceEngineV2:
         """Quantized-collectives knob value ("none" or "int8")."""
         return self._comm_quant
 
+    @property
+    def comm_overlap(self) -> str:
+        """Tile-granular overlap knob value ("none" or "tiled")."""
+        return self._comm_overlap
+
     def comm_wire_info(self) -> Dict:
         """Per-wire collective byte accounting for health()/metrics: the
         trace-time counters from comm.quantized (per compiled call site —
-        a fori_loop layer body counts once for all its iterations), plus
-        whether the quantized TP path is actually active."""
+        a fori_loop layer body counts once for all its iterations; each
+        entry carries its tile-granular overlap factor), plus whether the
+        quantized / tiled TP paths are actually active."""
         from deepspeed_tpu.comm.quantized import wire_stats
 
         return {
             "comm_quant": self._comm_quant,
             "tp_quant_active": bool(self._tp_quant),
+            "comm_overlap": self._comm_overlap,
+            "tp_overlap_tiles": int(self._overlap_tiles),
+            "tp_tiled_active": bool(self._tp_tiled),
             "wires": wire_stats(),
         }
 
@@ -473,14 +507,14 @@ class InferenceEngineV2:
                     out = mha_reference(q, k_ctx, v_ctx, causal=False, bias=bias,
                                         scale=c.attn_scale)
                     out = out.transpose(0, 2, 1, 3).reshape(1, t_, nh * d)
-                if self._tp_quant:
+                if self._tp_wire:
                     attn_out = self._tp_row_matmul(out[0], lp["wo"], "tp_attn_out")[None]
                 else:
                     attn_out = out @ lp["wo"]
                 if c.attn_out_bias:
                     attn_out = attn_out + lp["wo_b"]
                 caches = (kc_l, vc_l, ks_l, vs_l) if kv_int8 else (kc_l, vc_l)
-                quant_mlp = self._tp_quant and c.n_experts == 0
+                quant_mlp = self._tp_wire and c.n_experts == 0
                 if c.parallel_block:
                     # falcon/phi: both branches read the pre-attention state
                     m = T._norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"), c.norm, c.norm_eps)
@@ -652,10 +686,12 @@ class InferenceEngineV2:
 
     def _tp_row_matmul(self, x2d, w, tag):
         """``x2d @ w`` with the contraction dim sharded over MODEL_AXIS and
-        the psum quantized inside the collective: a shard_map island (GSPMD
-        cannot rewrite the reduction wire of its own implicit psum) whose
-        local matmul feeds ``quantized_psum_tp`` — int8 reduce-scatter +
-        re-quantized int8 all-gather instead of one full-width all-reduce.
+        the reduction wire rewritten inside a shard_map island (GSPMD cannot
+        rewrite its own implicit psum). comm_overlap="tiled" decomposes the
+        wire into tp_overlap_tiles independent per-tile reduce-scatter→
+        all-gather ppermute rings (comm/overlap_tiled.tiled_tp_matmul; the
+        comm_quant="int8" payload+scale planes ride the same tiles);
+        otherwise the monolithic ``quantized_psum_tp`` int8 two-hop.
         x2d: [t, K] activations (K = heads*d or ffn dim, column-sharded by
         GSPMD from the param shardings); w: [K, h] row-sharded. Returns
         [t, h] replicated over the model axis."""
@@ -663,6 +699,14 @@ class InferenceEngineV2:
 
         from deepspeed_tpu.comm.quantized import quantized_psum_tp
         from deepspeed_tpu.parallel.topology import MODEL_AXIS
+
+        if self._tp_tiled:
+            from deepspeed_tpu.comm.overlap_tiled import tiled_tp_matmul
+
+            return tiled_tp_matmul(
+                x2d, w, self._mesh, self._overlap_tiles,
+                comm_quant=self._comm_quant, tag=tag,
+            )
 
         def local(xl, wl):
             return quantized_psum_tp(xl @ wl, MODEL_AXIS, tag=tag)
@@ -710,7 +754,7 @@ class InferenceEngineV2:
         c = self._mc
         nh, d = c.n_heads, c.head_dim
         t = x.shape[1]
-        if self._tp_quant:
+        if self._tp_wire:
             attn_out = self._tp_row_matmul(
                 out.reshape(t, nh * d), lp["wo"], "tp_attn_out"
             )[None]
@@ -718,7 +762,7 @@ class InferenceEngineV2:
             attn_out = (out.reshape(t, nh * d) @ lp["wo"])[None]
         if c.attn_out_bias:
             attn_out = attn_out + lp["wo_b"]
-        quant_mlp = self._tp_quant and c.n_experts == 0
+        quant_mlp = self._tp_wire and c.n_experts == 0
         if c.parallel_block:
             m = T._norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"), c.norm, c.norm_eps)
             mlp_out = self._mlp_quant(lp, m) if quant_mlp else T._mlp_block(c, lp, m)[0]

@@ -255,6 +255,14 @@ class DeepSpeedConfig(DSConfigModel):
     # full-width collectives. Gradient-exchange quantization has its own
     # knobs (zero_quantized_gradients / compression).
     comm_quant: str = "none"
+    # tile-granular compute/collective overlap (comm/overlap_tiled.py):
+    # "tiled" splits the ZeRO-3 bucketed parameter all-gathers into
+    # tp_overlap_tiles independent per-tile collectives so parameter tiles
+    # stream in behind the transformer scan's GEMM slices instead of
+    # arriving bucket-at-a-time (bitwise-identical either way — the
+    # gathers are transport-only); "none" keeps one collective per bucket.
+    comm_overlap: str = "none"
+    tp_overlap_tiles: int = 4
     zero_allow_untested_optimizer: bool = True
     zero_force_ds_cpu_optimizer: bool = False  # [compat] no CPU-only optimizer binary on TPU
     graph_harvesting: bool = False  # [compat] jit covers CUDA-graph capture
@@ -340,6 +348,14 @@ class DeepSpeedConfig(DSConfigModel):
         if self.comm_quant not in ("none", "int8"):
             raise ConfigError(
                 f"comm_quant={self.comm_quant!r}: expected 'none' or 'int8'"
+            )
+        if self.comm_overlap not in ("none", "tiled"):
+            raise ConfigError(
+                f"comm_overlap={self.comm_overlap!r}: expected 'none' or 'tiled'"
+            )
+        if int(self.tp_overlap_tiles) < 1:
+            raise ConfigError(
+                f"tp_overlap_tiles={self.tp_overlap_tiles!r}: expected an int >= 1"
             )
 
     def _batch_assertion(self, dp_world_size):

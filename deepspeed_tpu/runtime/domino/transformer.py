@@ -12,35 +12,43 @@ latency-hiding scheduler interleave chunk k's psum with chunk k+1's matmuls
 — no handle bookkeeping. The wrapper composes with ANY layer fn (the
 reference hardcodes its own attention/MLP pair).
 
-Measured (PERF.md "Domino chunking"): on every configuration reachable in
-this environment the chunking does NOT pay — single real TPU chip: +0.1%
-(n=2) / +2.0% (n=4) overhead, exact numerics; tp2 x dp4 on the 8-device CPU
-mesh: 0.90x (n=2) / 0.46x (n=4) of the unchunked throughput. The HLO does
-show the structural precondition the technique needs (2x independent
-half-size all-reduces per layer, no serializing dependency between chunk
-programs), but the CPU backend has no latency-hiding scheduler to exploit
-it, and one chip has no collectives to hide. Treat n_chunks>1 as
-UNVALIDATED until profiled on a real multi-chip TPU slice; default off."""
+The chunk decomposition itself lives in ``comm/overlap_tiled.py``
+(``peer_chunks``) — the same Python-loop peer split that powers the
+``comm_overlap: tiled`` seam, which applies the identical lesson one level
+down (per-tile collective rings inside a single projection's wire instead
+of batch chunks across a whole layer). These wrappers are the thin
+layer-granular face of that primitive; both paths are exact because chunks
+see the same weights and only the schedule changes.
+
+Measured (PERF.md "Domino chunking"): on the configurations reachable in
+this environment the layer-granular chunking does not pay — single real TPU
+chip: +0.1% (n=2) / +2.0% (n=4) overhead, exact numerics; tp2 x dp4 on the
+8-device CPU mesh: 0.90x (n=2) / 0.46x (n=4) of the unchunked throughput.
+The HLO shows the structural precondition (independent half-size
+all-reduces per layer, no serializing dependency between chunk programs),
+but the CPU backend has no latency-hiding scheduler to exploit it and one
+chip has no collectives to hide. On multi-chip slices prefer the
+finer-grained ``comm_overlap: tiled`` seam, which decomposes the wire
+itself (and composes with ``comm_quant: int8``); keep n_chunks>1 off unless
+a profile on the target slice says otherwise. Default off."""
 
 from typing import Callable
 
 import jax
 import jax.numpy as jnp
 
+from deepspeed_tpu.comm.overlap_tiled import peer_chunks
+
 
 def domino_layer(layer_fn: Callable, x: jax.Array, n_chunks: int = 2, batch_axis: int = 0):
-    """Run ``layer_fn`` per batch chunk; XLA overlaps one chunk's TP
-    collectives with the next chunk's compute. Exact: chunks see the same
-    weights, outputs concatenate back. Falls through when the batch does not
-    divide."""
+    """Run ``layer_fn`` per batch chunk via ``peer_chunks``; XLA overlaps
+    one chunk's TP collectives with the next chunk's compute. Exact: chunks
+    see the same weights, outputs concatenate back. Falls through when the
+    batch does not divide."""
     b = x.shape[batch_axis]
     if n_chunks <= 1 or b % n_chunks:
         return layer_fn(x)
-    chunks = jnp.split(x, n_chunks, axis=batch_axis)
-    # a Python loop (not scan): the chunk programs must be peers in the HLO
-    # schedule for the latency-hiding scheduler to interleave them — a scan
-    # would serialize them behind a loop carry
-    outs = [layer_fn(c) for c in chunks]
+    outs = peer_chunks(layer_fn, n_chunks, x, axis=batch_axis)
     return jnp.concatenate(outs, axis=batch_axis)
 
 
@@ -55,12 +63,10 @@ def domino_transformer_layer(config, lp, x, positions, segment_ids, n_chunks: in
     b = x.shape[0]
     if n_chunks <= 1 or b % n_chunks:
         return T._layer(config, lp, x, positions, segment_ids, local_flag)
-    outs, auxes = [], []
-    for i, xc in enumerate(jnp.split(x, n_chunks, axis=0)):
-        seg_c = None
-        if segment_ids is not None:
-            seg_c = jnp.split(segment_ids, n_chunks, axis=0)[i]
-        y, aux = T._layer(config, lp, xc, positions, seg_c, local_flag)
-        outs.append(y)
-        auxes.append(aux)
+    results = peer_chunks(
+        lambda xc, sc: T._layer(config, lp, xc, positions, sc, local_flag),
+        n_chunks, x, segment_ids, axis=0,
+    )
+    outs = [y for y, _ in results]
+    auxes = [aux for _, aux in results]
     return jnp.concatenate(outs, axis=0), sum(auxes) / n_chunks

@@ -398,6 +398,15 @@ class DeepSpeedEngine:
         self._overlap = zcfg_o.overlap_enabled
         self._reduce_bucket_bytes = int(zcfg_o.reduce_bucket_size)
         self._prefetch_bucket_bytes = int(zcfg_o.effective_prefetch_bucket_size)
+        # tile-granular overlap seam (comm/overlap_tiled.py): "tiled" splits
+        # each prefetch bucket's fused all-gather into tp_overlap_tiles
+        # independent per-tile collectives — bitwise-identical transport the
+        # latency-hiding scheduler can stream behind the scan's GEMMs
+        self._comm_overlap = config.comm_overlap
+        self._overlap_tiles = int(config.tp_overlap_tiles)
+        self._gather_tiles = (
+            self._overlap_tiles if self._comm_overlap == "tiled" else 1
+        )
         self._overlap_scan_chunk = 1
         if (
             self._overlap
@@ -1176,7 +1185,8 @@ class DeepSpeedEngine:
                 for b in buckets:
                     sel = [idxs[j] for j in b]
                     res = fuse(
-                        [flat_p[i] for i in sel], [ks[i] for i in sel], DATA_AXIS
+                        [flat_p[i] for i in sel], [ks[i] for i in sel], DATA_AXIS,
+                        tiles=getattr(self, "_gather_tiles", 1),
                     )
                     for i, r in zip(sel, res):
                         out[i] = r

@@ -26,6 +26,15 @@ does:
   all-gather (or pinned_host→HBM stage) in the SAME scan body as layer
   ``b``'s compute, where the scheduler can overlap them — impossible across
   sequential scan iterations.
+* ``tiles > 1`` (the ``comm_overlap: tiled`` seam, ``comm/overlap_tiled.py``)
+  further splits each bucket's fused payload into up to ``tiles`` contiguous
+  column chunks and fires one all-gather per chunk from a Python loop — the
+  chunks are independent HLO peers (no loop carry), so parameter tiles
+  stream in behind the transformer scan's GEMM slices instead of arriving
+  bucket-at-a-time. All-gather is pure transport (no reduction order), so
+  the tiled result is BITWISE identical to the monolithic gather; the
+  quantized form splits on block boundaries so each chunk dequantizes
+  exactly as its slice of the fused exchange.
 
 All ``bucketed_*`` functions must be called INSIDE ``shard_map`` over
 ``axis_name`` (same contract as their per-leaf counterparts).
@@ -94,6 +103,35 @@ def overlap_chunk(n_layers: int, layer_bytes: int, target_bytes: int,
 # ---------------------------------------------------------------------------
 # bucketed wire collectives (shard_map manual region)
 # ---------------------------------------------------------------------------
+def _tile_bounds(n_cols: int, tiles: int, quantum: int = 1) -> List[int]:
+    """Column boundaries splitting ``[0, n_cols)`` into at most ``tiles``
+    contiguous chunks, each a ``quantum``-column multiple (``quantum`` =
+    ``block_size`` for quantized payloads so every chunk dequantizes on
+    block boundaries). ``n_cols`` must itself be a quantum multiple.
+    Uneven remainders spread one quantum at a time over the leading chunks;
+    fewer units than tiles just yields fewer chunks — there is no fallback
+    to untiled because any contiguous split is transport-identical."""
+    units = n_cols // quantum
+    t = max(1, min(int(tiles), units))
+    base, extra = divmod(units, t)
+    bounds = [0]
+    for i in range(t):
+        bounds.append(bounds[-1] + (base + (1 if i < extra else 0)) * quantum)
+    return bounds
+
+
+def _record_gather_wire(tag: str, quant_bytes: int, leaves, tiles: int) -> None:
+    """Fold one traced bucket gather into the shared wire registry
+    (``comm.quantized.record_wire``) so ``wire_stats()`` shows the ZeRO-3
+    prefetch wire next to the serving wires — including its tile-granular
+    overlap factor. ``fp_bytes`` is what the unquantized fused gather would
+    put on the wire (the local concat payload at leaf dtype width)."""
+    from deepspeed_tpu.comm.quantized import record_wire
+
+    fp_bytes = sum(int(x.size) * x.dtype.itemsize for x in leaves)
+    record_wire(tag, int(quant_bytes), int(fp_bytes), tiles=tiles)
+
+
 def _rows_for_scatter(x: jax.Array, dim: int, W: int, block_size: int):
     """Per-leaf reduce-scatter layout — identical to
     ``quantized_reduce_scatter_along``: moveaxis ``dim``→0, reshape to
@@ -218,10 +256,14 @@ def bucketed_quantized_all_gather(
     axis_name: str,
     bits: int = 8,
     block_size: int = 256,
+    tiles: int = 1,
 ) -> List[jax.Array]:
     """One bucket's qwZ gather: per-leaf quantization identical to
     ``quantized_all_gather_along`` ([1, m] local rows), payloads fused into
-    one all-gather pair along the block axis."""
+    one all-gather pair along the block axis. ``tiles > 1`` splits the fused
+    payload on block boundaries into independent per-tile all-gather pairs
+    (see module docstring); the reassembled planes are bitwise identical to
+    the monolithic exchange, so dequantization is unchanged."""
     payloads, scales, metas = [], [], []
     for x, k in zip(leaves, dims):
         moved = jnp.moveaxis(x, k, 0)
@@ -234,12 +276,41 @@ def bucketed_quantized_all_gather(
         payloads.append(p)
         scales.append(s)
         metas.append((m, moved.shape, p.shape[1]))
-    payload_all = jax.lax.all_gather(
-        jnp.concatenate(payloads, axis=1), axis_name, axis=0, tiled=True
+    payload_cat = jnp.concatenate(payloads, axis=1)
+    scales_cat = jnp.concatenate(scales, axis=1)
+    # axis 1 of both planes is the BLOCK axis (one unit per block_size
+    # chunk), so block-aligned tiling is just a contiguous index split —
+    # payload and scales share the same boundaries
+    pb = _tile_bounds(payload_cat.shape[1], tiles)
+    _record_gather_wire(
+        "zero3_gather",
+        int(payload_cat.size) * payload_cat.dtype.itemsize
+        + int(scales_cat.size) * scales_cat.dtype.itemsize,
+        leaves,
+        tiles=len(pb) - 1,
     )
-    scales_all = jax.lax.all_gather(
-        jnp.concatenate(scales, axis=1), axis_name, axis=0, tiled=True
-    )
+    if len(pb) > 2:
+        payload_all = jnp.concatenate(
+            [
+                jax.lax.all_gather(
+                    payload_cat[:, pb[i]:pb[i + 1]], axis_name, axis=0, tiled=True
+                )
+                for i in range(len(pb) - 1)
+            ],
+            axis=1,
+        )
+        scales_all = jnp.concatenate(
+            [
+                jax.lax.all_gather(
+                    scales_cat[:, pb[i]:pb[i + 1]], axis_name, axis=0, tiled=True
+                )
+                for i in range(len(pb) - 1)
+            ],
+            axis=1,
+        )
+    else:
+        payload_all = jax.lax.all_gather(payload_cat, axis_name, axis=0, tiled=True)
+        scales_all = jax.lax.all_gather(scales_cat, axis_name, axis=0, tiled=True)
     W = payload_all.shape[0]
     out, off = [], 0
     for x, k, (m, moved_shape, nb) in zip(leaves, dims, metas):
@@ -256,11 +327,15 @@ def bucketed_all_gather(
     leaves: Sequence[jax.Array],
     dims: Sequence[int],
     axis_name: str,
+    tiles: int = 1,
 ) -> List[jax.Array]:
     """Unquantized bucket gather: each local shard flattened to [1, m]
     (leading axis = gather dim, so rank r's row chunk IS its dim-k slice),
     concatenated and gathered in ONE collective, then split and restored —
-    value-identical to per-leaf ``jax.lax.all_gather(..., tiled=True)``."""
+    value-identical to per-leaf ``jax.lax.all_gather(..., tiled=True)``.
+    ``tiles > 1`` fires one all-gather per contiguous column chunk instead
+    (independent HLO peers, see module docstring) — pure transport, so
+    reassembly is bitwise identical to the monolithic gather."""
     flats, metas = [], []
     for x, k in zip(leaves, dims):
         moved = jnp.moveaxis(x, k, 0)
@@ -269,9 +344,27 @@ def bucketed_all_gather(
     widths = {f.dtype for f in flats}
     if len(widths) != 1:
         raise ValueError("bucket leaves must share a dtype")
-    gathered = jax.lax.all_gather(
-        jnp.concatenate(flats, axis=1), axis_name, axis=0, tiled=True
-    )  # [W, sum_m]
+    concat = jnp.concatenate(flats, axis=1)
+    tb = _tile_bounds(concat.shape[1], tiles)
+    _record_gather_wire(
+        "zero3_gather",
+        int(concat.size) * concat.dtype.itemsize,
+        leaves,
+        tiles=len(tb) - 1,
+    )
+    if len(tb) > 2:
+        gathered = jnp.concatenate(
+            [
+                jax.lax.all_gather(
+                    concat[:, tb[i]:tb[i + 1]], axis_name, axis=0, tiled=True
+                )
+                for i in range(len(tb) - 1)
+            ],
+            axis=1,
+        )
+    else:
+        gathered = jax.lax.all_gather(concat, axis_name, axis=0, tiled=True)
+    # [W, sum_m]
     W = gathered.shape[0]
     out, off = [], 0
     for x, k, (moved_shape, m) in zip(leaves, dims, metas):

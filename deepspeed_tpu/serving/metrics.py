@@ -180,12 +180,16 @@ class ServingMetrics:
             )
 
     def update_comm_quant(self, info: Dict) -> None:
-        """Mirror an ``engine.comm_wire_info()`` snapshot: the comm_quant
-        mode as a 0/1 gauge plus the per-wire trace-time byte counters
-        (quantized vs replaced full-width bytes and the derived reduction
-        ratio — the number the A/B gate checks)."""
+        """Mirror an ``engine.comm_wire_info()`` snapshot: the comm_quant /
+        comm_overlap modes as 0/1 gauges plus the per-wire trace-time
+        counters (quantized vs replaced full-width bytes, the derived
+        reduction ratio the A/B gate checks, and the tile-granular overlap
+        factor each wire decomposed into)."""
         with self._lock:
             self.gauges["comm_quant_int8"] = int(info.get("comm_quant") == "int8")
+            self.gauges["comm_overlap_tiled"] = int(
+                info.get("comm_overlap") == "tiled"
+            )
             self._comm_wires = {
                 tag: dict(v) for tag, v in (info.get("wires") or {}).items()
             }
@@ -230,6 +234,7 @@ class ServingMetrics:
             out["e2e_mean_s"] = self.e2e.mean
             for tag, w in self._comm_wires.items():
                 out[f"comm_wire_{tag}_reduction"] = w.get("reduction", 0.0)
+                out[f"comm_wire_{tag}_tiles"] = w.get("tiles", 1)
             return out
 
     def prometheus_text(self) -> str:
@@ -247,6 +252,7 @@ class ServingMetrics:
                 samples.append((f"{p}_comm_wire_bytes_quant", lbl, w.get("wire_bytes_int8", 0), "gauge"))
                 samples.append((f"{p}_comm_wire_bytes_fp", lbl, w.get("wire_bytes_fp", 0), "gauge"))
                 samples.append((f"{p}_comm_wire_reduction", lbl, w.get("reduction", 0.0), "gauge"))
+                samples.append((f"{p}_comm_wire_tiles", lbl, w.get("tiles", 1), "gauge"))
             for hname, hist in (
                 ("ttft_seconds", self.ttft),
                 ("tpot_seconds", self.tpot),

@@ -47,6 +47,13 @@ echo "== quantized-comm parity gate (8-device mesh) =="
 # bounded error, GPipe/1F1B loss parity, wire-byte reduction ratios
 python -m pytest tests/unit/test_quantized_comm.py -q -p no:cacheprovider
 
+echo "== tiled-overlap parity gate (8-device mesh) =="
+# tile-granular T3-style overlap vs the monolithic wires: per-tile ring
+# BITWISE parity (fp32/bf16 x none/int8), engine decode token streams
+# bit-identical tiled-vs-none (greedy + seeded), zero3 tiled-gather train
+# parity, HLO max-antichain >= tile count (the overlap claim, structurally)
+python -m pytest tests/unit/test_tiled_overlap.py -q -p no:cacheprovider
+
 echo "== donation/recompile verifier (Tier B) =="
 ./bin/dstpu lint --verify
 
