@@ -891,6 +891,100 @@ def bench_comm_overlap_ab(cfg=None, params=None, seed=0):
     }
 
 
+def bench_disagg_replicas(n_replicas=2, cfg=None, params=None, seed=0):
+    """Multi-replica serving A/B (``DSTPU_SERVE_REPLICAS=N`` rider on
+    --serving-load): the same saturating workload — every request submitted
+    up front, so the engines, not the arrival process, are the bottleneck —
+    against (a) the single-engine ServingDriver and (b) a Router with N
+    colocated decode replicas at EQUAL per-replica settings (same pool,
+    same batch budget each). Reports aggregate decode goodput ratio and the
+    per-replica utilization balance (min/max decode tokens — placement
+    should keep it near 1)."""
+    from deepspeed_tpu.inference.config import RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.models import TransformerConfig, init_params
+    from deepspeed_tpu.serving.cluster import Router
+    from deepspeed_tpu.serving.driver import ServingDriver
+    from deepspeed_tpu.serving.request import SamplingParams
+
+    n_replicas = int(n_replicas)
+    n_requests = int(os.environ.get("DSTPU_SERVE_N", 24)) * 2
+    max_new = int(os.environ.get("DSTPU_SERVE_MAX_NEW", 12)) * 2
+    if cfg is None:
+        cfg = TransformerConfig(
+            vocab_size=512, hidden_size=128, n_layers=2, n_heads=4,
+            max_seq_len=512, dtype="float32",
+        )
+        params = init_params(cfg, jax.random.key(0))
+    rc_dict = {
+        "dtype": cfg.dtype,
+        "kv_cache": {"block_size": 16, "num_blocks": 384,
+                     "max_blocks_per_seq": 16},
+        "state_manager": {"max_tracked_sequences": 64,
+                          "max_ragged_batch_size": 96,
+                          "max_ragged_sequence_count": 16,
+                          "max_context": 256},
+    }
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, cfg.vocab_size, size=(int(l),)).astype(np.int32)
+               for l in rng.integers(8, 32, size=n_requests)]
+
+    def run(front):
+        # warm pass = the full workload, unmeasured: every replica compiles
+        # its step shapes (a single warm request would leave the OTHER
+        # replicas compiling inside the measured window)
+        warm = [front.submit(p, params=SamplingParams(max_new_tokens=max_new,
+                                                      ignore_eos=True))
+                for p in prompts]
+        for r in warm:
+            r.wait(300)
+        t0 = time.perf_counter()
+        reqs = [front.submit(p, params=SamplingParams(max_new_tokens=max_new,
+                                                      ignore_eos=True))
+                for p in prompts]
+        for r in reqs:
+            r.wait(300)
+        wall = time.perf_counter() - t0
+        done = [r for r in reqs if r.state == "finished"]
+        return sum(len(r.generated) for r in done) / wall, len(done)
+
+    single = ServingDriver(
+        InferenceEngineV2(cfg, params,
+                          RaggedInferenceEngineConfig.from_dict(rc_dict)),
+        max_queue=n_requests + 1, kv_headroom=0.05,
+    ).start()
+    single_tok_s, single_done = run(single)
+    single.shutdown(drain=True, timeout=60)
+
+    engines = [
+        InferenceEngineV2(cfg, params,
+                          RaggedInferenceEngineConfig.from_dict(rc_dict))
+        for _ in range(n_replicas)
+    ]
+    router = Router(engines=engines, num_prefill_workers=0,
+                    max_queue=n_requests + 1, kv_headroom=0.05).start()
+    multi_tok_s, multi_done = run(router)
+    health = router.health()
+    per_replica = {name: int(st["decode_tokens_total"])
+                   for name, st in health["replicas"].items()}
+    router.shutdown(drain=True, timeout=60)
+    decode_counts = [v for v in per_replica.values()] or [0]
+    balance = (min(decode_counts) / max(decode_counts)
+               if max(decode_counts) else 0.0)
+    return {
+        "n_decode_replicas": n_replicas,
+        "n_requests": n_requests,
+        "max_new": max_new,
+        "single_goodput_tok_s": round(single_tok_s, 1),
+        "multi_goodput_tok_s": round(multi_tok_s, 1),
+        "disagg_goodput_ratio": round(multi_tok_s / single_tok_s, 2)
+        if single_tok_s else None,
+        "completed": [single_done, multi_done],
+        "replica_decode_tokens": per_replica,
+        "utilization_balance": round(balance, 3),
+    }
+
+
 def bench_serving_load(
     n_requests=None, rate_rps=None, max_new=None, slo_e2e_s=None,
     cfg=None, params=None, seed=0,
@@ -1042,6 +1136,14 @@ def bench_serving_load(
     co_report = {}
     if os.environ.get("DSTPU_COMM_OVERLAP", "") == "tiled":
         co_report = {"comm_overlap_tiled": bench_comm_overlap_ab(seed=seed)}
+    # multi-replica rider: DSTPU_SERVE_REPLICAS=N (>=2) appends a Router
+    # scale-out A/B — aggregate decode goodput vs the single driver at
+    # equal per-replica settings, plus per-replica utilization balance
+    disagg_report = {}
+    n_repl = int(os.environ.get("DSTPU_SERVE_REPLICAS", "0") or 0)
+    if n_repl >= 2:
+        disagg_report = {"disagg": bench_disagg_replicas(
+            n_replicas=n_repl, cfg=cfg, params=params, seed=seed)}
     return {
         "mode": "serving_load",
         "n_requests": n_requests,
@@ -1061,6 +1163,7 @@ def bench_serving_load(
         **kv_report,
         **cq_report,
         **co_report,
+        **disagg_report,
     }
 
 

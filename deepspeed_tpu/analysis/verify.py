@@ -38,6 +38,7 @@ __all__ = [
     "check_donation",
     "check_recompile",
     "run_verify",
+    "verify_disagg",
     "verify_engine_v2",
     "verify_quantized_comm",
     "verify_ring_train",
@@ -775,6 +776,52 @@ def verify_tiled_overlap() -> List[CheckResult]:
     return results
 
 
+def verify_disagg() -> List[CheckResult]:
+    """Disaggregated serving: the Router's extracted scheduling loop must
+    leave each engine's donated step programs intact. The prefill worker's
+    split step and the decode replicas' fused decode rounds both consume
+    and reassign the donated KV pools, and the KV-handoff import path
+    reassigns them too (``import_kv_blocks`` scatter) — a broken donation
+    here would copy a full paged pool every step on every replica."""
+    import numpy as np
+
+    from deepspeed_tpu.serving.cluster import Router
+    from deepspeed_tpu.serving.request import SamplingParams
+
+    results: List[CheckResult] = []
+    engines = [_tiny_v2_engine(decode_steps=2)[1] for _ in range(3)]
+    captured: dict = {}
+    _capture_builder(engines[0], "_build_split_step", captured, "split")
+    for eng in engines[1:]:
+        # both replicas store under one key; setdefault keeps the first
+        _capture_builder(eng, "_build_multistep_decode", captured, "multistep")
+    router = Router(engines=engines, num_prefill_workers=1,
+                    decode_steps=2).start()
+    try:
+        reqs = [
+            router.submit(
+                np.arange(1 + i, 13 + i, dtype=np.int32),
+                params=SamplingParams(max_new_tokens=6, ignore_eos=True),
+            )
+            for i in range(4)
+        ]
+        for r in reqs:
+            if not r.wait(300):
+                raise RuntimeError("disagg verify request did not finish")
+    finally:
+        router.shutdown()
+    for key, label in (("split", "disagg.prefill_split_step"),
+                       ("multistep", "disagg.decode_multistep")):
+        if key not in captured:
+            results.append(CheckResult(label, "donation", False,
+                                       "entry point never executed under the router"))
+            continue
+        fn, args = captured[key]
+        results.append(check_donation(label, fn, args))
+        results.append(check_recompile(label, fn))
+    return results
+
+
 # ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
@@ -789,6 +836,7 @@ def run_verify(verbose: bool = True) -> Tuple[List[CheckResult], bool]:
         (verify_ring_train, "ring_train"),
         (verify_quantized_comm, "quantized_comm"),
         (verify_tiled_overlap, "tiled_overlap"),
+        (verify_disagg, "disagg"),
     ):
         try:
             results.extend(fn())

@@ -219,6 +219,18 @@ def serve_parse_args(argv=None):
                    "counts show up in /metrics")
     p.add_argument("--tp-overlap-tiles", type=int, default=4,
                    help="tiles per wire for --comm-overlap tiled")
+    p.add_argument("--num-prefill-workers", type=int, default=0,
+                   help="disaggregated serving: dedicate this many engines "
+                   "to chunked prefill; finished prefills hand their KV "
+                   "blocks off to a decode replica (0 = colocated)")
+    p.add_argument("--num-decode-replicas", type=int, default=1,
+                   help="decode replicas behind the router (each owns its "
+                   "own KV pool; >1 or --num-prefill-workers >= 1 builds "
+                   "the multi-engine Router instead of the single driver)")
+    p.add_argument("--placement", default="slo",
+                   choices=("slo", "round_robin", "least_loaded"),
+                   help="decode-replica placement policy: slo ranks by "
+                   "free-block headroom / queue depth / deadline slack")
     p.add_argument("--no-prefix-cache", action="store_true",
                    help="disable automatic prefix caching (on by default "
                    "when serving: repeated prompt prefixes share KV blocks "
@@ -234,11 +246,15 @@ def serve_parse_args(argv=None):
 
 
 def build_serving_stack(args, cfg=None, params=None, tok=None):
-    """Engine + driver from parsed serve args (split out so tests can build
-    the stack without a socket). Pass cfg/params/tok to skip checkpoint
-    loading."""
+    """Engine(s) + driver from parsed serve args (split out so tests can
+    build the stack without a socket). Pass cfg/params/tok to skip
+    checkpoint loading. One engine serves behind ``ServingDriver``; with
+    ``--num-decode-replicas`` > 1 or ``--num-prefill-workers`` >= 1 the
+    engines (sharing the read-only params, each with its own KV pool) go
+    behind the multi-engine ``Router``."""
     from deepspeed_tpu.inference.config import RaggedInferenceEngineConfig
     from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.serving.cluster import Router
     from deepspeed_tpu.serving.driver import ServingDriver
 
     if cfg is None or params is None:
@@ -291,17 +307,42 @@ def build_serving_stack(args, cfg=None, params=None, tok=None):
             "max_context": args.max_context,
         },
     })
-    engine = InferenceEngineV2(cfg, params, rc)
-    driver = ServingDriver(
-        engine,
+    n_prefill = int(getattr(args, "num_prefill_workers", 0) or 0)
+    n_decode = int(getattr(args, "num_decode_replicas", 1) or 1)
+    if n_prefill < 0 or n_decode < 1:
+        raise ValueError(
+            f"need num_prefill_workers >= 0 and num_decode_replicas >= 1 "
+            f"(got {n_prefill}/{n_decode})"
+        )
+    if n_prefill == 0 and n_decode == 1:
+        engine = InferenceEngineV2(cfg, params, rc)
+        driver = ServingDriver(
+            engine,
+            eos_token_id=getattr(tok, "eos_token_id", None),
+            max_queue=args.max_queue,
+            kv_headroom=args.kv_headroom,
+            default_timeout_s=args.timeout,
+            decode_steps=args.decode_steps,
+            spec_ngram=getattr(args, "spec_ngram", 3),
+        )
+        return driver, tok
+    # params are read-only at inference time: every engine shares them,
+    # only the per-engine KV pools and scheduler state are separate
+    engines = [
+        InferenceEngineV2(cfg, params, rc) for _ in range(n_prefill + n_decode)
+    ]
+    router = Router(
+        engines=engines,
+        num_prefill_workers=n_prefill,
         eos_token_id=getattr(tok, "eos_token_id", None),
         max_queue=args.max_queue,
         kv_headroom=args.kv_headroom,
         default_timeout_s=args.timeout,
         decode_steps=args.decode_steps,
         spec_ngram=getattr(args, "spec_ngram", 3),
+        placement=getattr(args, "placement", "slo"),
     )
-    return driver, tok
+    return router, tok
 
 
 def serve_main(argv=None) -> int:

@@ -64,6 +64,17 @@ class BlockedAllocator:
         """Ids of all blocks with at least one holder (sorted)."""
         return np.flatnonzero(self._refcount > 0).astype(np.int64)
 
+    def stats(self) -> dict:
+        """Pool occupancy counters for health/metrics surfaces: ``held`` is
+        blocks with at least one holder, ``shared`` the subset with more
+        than one (prefix-cache + live-sequence overlap)."""
+        return {
+            "total": self._num_blocks,
+            "free": int(self._top),
+            "held": int(np.count_nonzero(self._refcount > 0)),
+            "shared": int(np.count_nonzero(self._refcount > 1)),
+        }
+
     def _validate(self, blocks: np.ndarray, op: str) -> None:
         """Validate the WHOLE set before mutating: a partial free on error
         would leave the list in an in-between state."""

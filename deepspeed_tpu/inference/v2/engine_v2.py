@@ -260,6 +260,7 @@ class InferenceEngineV2:
         self._multistep_jit = None
         self._multistep_n = 0
         self._verify_jit = {}  # k -> compiled speculative verify step
+        self._kv_scatter_jit = None  # handoff import: donated pool scatter
         self._spec_rr = 0  # rotation cursor for budget-capped spec rounds
         self.last_spec = {"drafted": 0, "accepted": 0, "per_uid": {}}
         self.last_scheduled_tokens = 0
@@ -342,6 +343,54 @@ class InferenceEngineV2:
         )
         info["paged_attention_impl"] = self._attn_impl
         return info
+
+    # -- cross-engine KV-block handoff (disaggregated prefill/decode) ------
+    def export_kv_blocks(self, block_ids) -> Dict[str, np.ndarray]:
+        """Gather the pool planes for ``block_ids`` to host numpy, keyed by
+        plane name. The payload is the unit of prefill→decode handoff: it
+        carries the quantized int8 codes + fp32 scale planes verbatim when
+        the pool is int8, so a re-import is bitwise (no requantization)."""
+        idx = jnp.asarray(np.asarray(list(block_ids), np.int32))
+        out = {
+            "k": np.asarray(self._k_cache[:, idx]),
+            "v": np.asarray(self._v_cache[:, idx]),
+        }
+        if self._kv_int8:
+            out["k_scale"] = np.asarray(self._ks_cache[:, idx])
+            out["v_scale"] = np.asarray(self._vs_cache[:, idx])
+        return out
+
+    def import_kv_blocks(self, block_ids, payload: Dict[str, np.ndarray]) -> None:
+        """Scatter an exported payload into THIS pool at ``block_ids`` (the
+        importer's freshly allocated table slots — ids need not match the
+        exporter's). Donated functional update: the pool array is consumed
+        and reassigned, same discipline as the step programs' KV carry, so
+        callers must serialize this against stepping (router step_lock)."""
+        n = len(block_ids)
+        if n == 0:
+            return
+        for name, plane in payload.items():
+            if plane.shape[1] != n:
+                raise ValueError(
+                    f"import_kv_blocks: payload[{name!r}] carries "
+                    f"{plane.shape[1]} blocks for {n} target slots"
+                )
+        if self._kv_scatter_jit is None:
+            self._kv_scatter_jit = jax.jit(
+                lambda pool, idx, vals: pool.at[:, idx].set(vals),
+                donate_argnums=(0,),
+            )
+        idx = jnp.asarray(np.asarray(list(block_ids), np.int32))
+        scatter = self._kv_scatter_jit
+        self._k_cache = scatter(
+            self._k_cache, idx, jnp.asarray(payload["k"], self._k_cache.dtype))
+        self._v_cache = scatter(
+            self._v_cache, idx, jnp.asarray(payload["v"], self._v_cache.dtype))
+        if self._kv_int8:
+            self._ks_cache = scatter(
+                self._ks_cache, idx, jnp.asarray(payload["k_scale"], jnp.float32))
+            self._vs_cache = scatter(
+                self._vs_cache, idx, jnp.asarray(payload["v_scale"], jnp.float32))
 
     def set_sampling(self, greedy=None, temperature=None, top_k=None,
                      top_p=None, seed=None):

@@ -192,6 +192,26 @@ class DSStateManager:
             "cached_only": len(cached - live),
         }
 
+    def alloc_stats(self) -> Dict[str, int]:
+        """Allocator occupancy counters (total/free/held/shared) for
+        per-replica health surfaces."""
+        return self._alloc.stats()
+
+    def export_sequence(self, uid: int) -> Dict:
+        """Host-side snapshot of a live sequence for cross-engine KV
+        handoff: token history, KV cursor, and the block-table ids whose
+        pool rows the exporter gathers. Pure read — ownership of the
+        blocks stays with this manager until ``flush_sequence``."""
+        seq = self._seqs.get(uid)
+        if seq is None or seq.finished:
+            raise KeyError(f"export_sequence({uid}): no live sequence")
+        return {
+            "uid": uid,
+            "tokens": list(seq.tokens),
+            "seen_tokens": seq.seen_tokens,
+            "block_table": list(seq.block_table),
+        }
+
     def flush_sequence(self, uid: int) -> None:
         """Release a finished sequence's blocks (reference flush)."""
         seq = self._seqs.pop(uid, None)

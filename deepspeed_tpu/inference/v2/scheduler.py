@@ -115,6 +115,24 @@ class RaggedScheduler:
         if uid not in self._running:
             self._running.append(uid)
 
+    def adopt(self, uid: int, pending_token: int) -> None:
+        """Resume an imported (cross-engine KV-handoff) sequence as
+        RUNNING: the importer already materialized its state here — block
+        table populated, pool KV written, ``seen_tokens`` at the handoff
+        cursor — and the prefill engine's sampled first token rides the
+        normal feedback path so the next step decodes it like any locally
+        prefilled row. Loud failure (unlike ``feedback``'s silent drop):
+        an adopt without materialized state is an importer bug."""
+        seq = self._mgr.get_sequence(uid)
+        if seq is None or seq.finished:
+            raise ValueError(f"adopt({uid}): no live sequence to resume")
+        if seq.seen_tokens != len(seq.tokens):
+            raise ValueError(
+                f"adopt({uid}): history/KV cursor mismatch "
+                f"({len(seq.tokens)} tokens vs seen_tokens={seq.seen_tokens})"
+            )
+        self.feedback(uid, pending_token)
+
     def finish(self, uid: int) -> None:
         seq = self._mgr.get_sequence(uid)
         if seq is not None:

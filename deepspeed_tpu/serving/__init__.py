@@ -14,8 +14,17 @@ ragged batches. This package is that layer for ``InferenceEngineV2``:
   * ``server``     — stdlib-only HTTP front end (/generate, /health, /metrics)
   * ``spec``       — speculative decoding: draft proposers + adaptive draft
                      length over the engine's K+1-token verify rounds
+  * ``cluster``    — disaggregated prefill/decode serving: multi-engine
+                     Router with KV-block handoff and SLO-aware placement
 """
 
+from deepspeed_tpu.serving.cluster import (
+    EngineCore,
+    HandoffError,
+    KVHandoff,
+    Router,
+    get_placement,
+)
 from deepspeed_tpu.serving.driver import RequestRejected, ServingDriver
 from deepspeed_tpu.serving.metrics import ServingMetrics
 from deepspeed_tpu.serving.request import Request, RequestState, SamplingParams
@@ -30,6 +39,11 @@ from deepspeed_tpu.serving.streaming import IncrementalDetokenizer, TokenStream
 __all__ = [
     "AdaptiveSpecController",
     "DraftProposer",
+    "EngineCore",
+    "HandoffError",
+    "KVHandoff",
+    "Router",
+    "get_placement",
     "IncrementalDetokenizer",
     "NgramProposer",
     "Request",

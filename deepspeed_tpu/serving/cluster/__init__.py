@@ -1,0 +1,43 @@
+"""Disaggregated prefill/decode serving.
+
+A front-end :class:`Router` owns N engines split into prefill workers and
+decode replicas. Each engine is wrapped in an :class:`EngineCore` — the
+scheduling/admission loop extracted from the single-engine
+``ServingDriver`` (which is now one degenerate 1-prefill=1-decode
+colocated instance of the same core). Prefill workers run chunked prefill
+and hand finished KV blocks (paged block tables + int8 scale planes) to
+the decode replica chosen by an SLO-aware placement policy; hot prefixes
+replicate through each replica's token-block trie.
+"""
+
+from deepspeed_tpu.serving.cluster.core import EngineCore
+from deepspeed_tpu.serving.cluster.handoff import (
+    HandoffError,
+    KVHandoff,
+    export_sequence,
+    import_sequence,
+)
+from deepspeed_tpu.serving.cluster.placement import (
+    PLACEMENTS,
+    LeastLoadedPlacement,
+    PlacementPolicy,
+    RoundRobinPlacement,
+    SLOPlacement,
+    get_placement,
+)
+from deepspeed_tpu.serving.cluster.router import Router
+
+__all__ = [
+    "EngineCore",
+    "HandoffError",
+    "KVHandoff",
+    "export_sequence",
+    "import_sequence",
+    "PLACEMENTS",
+    "PlacementPolicy",
+    "SLOPlacement",
+    "RoundRobinPlacement",
+    "LeastLoadedPlacement",
+    "get_placement",
+    "Router",
+]

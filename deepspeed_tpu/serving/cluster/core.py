@@ -1,0 +1,336 @@
+"""Engine-agnostic scheduling/admission core.
+
+This is the single-engine ``ServingDriver`` loop body factored out of its
+one-engine assumption: everything that talks to the ENGINE — KV-aware
+admissibility, scheduler submission, fused/speculative/plain stepping,
+capped-sequence reaping — lives here, keyed by a core instance, while
+everything that talks to the REQUEST (token delivery, terminal
+transitions, metrics) is delegated to an owner-provided *sink*. One
+``ServingDriver`` owns one core; a ``Router`` owns many (prefill workers +
+decode replicas) and multiplexes requests across them.
+
+Sink protocol (the owner implements it; ``core`` is passed back so one
+owner can serve many cores):
+
+  * ``deliver(core, req, token, feedback=True) -> bool`` — one generated
+    token landed; False when the request terminated (stop/error).
+  * ``engine_failed(core, error)`` — an engine-level step failure: the
+    sink fails the core's in-flight request set (per-request state is
+    unknowable after a failed step).
+  * ``finish_capped(core, req)`` — the scheduler force-finished the
+    sequence at its block/context cap (blocks already freed).
+
+Thread safety: each core carries a ``step_lock`` serializing engine
+stepping against cross-engine KV block import/export — both paths
+reassign the donated pool arrays, so an unserialized import racing a step
+would be silently dropped when the step's donated carry lands.
+"""
+
+import threading
+from typing import Dict, Optional
+
+from deepspeed_tpu.serving.request import Request
+from deepspeed_tpu.utils.logging import logger
+
+
+class EngineCore:
+    """One engine's slice of the serving loop: admission accounting,
+    stepping, and the request set resident on that engine."""
+
+    def __init__(
+        self,
+        engine,
+        name: str = "replica0",
+        role: str = "both",  # "prefill" | "decode" | "both" (colocated)
+        decode_steps: int = 1,
+        kv_headroom: float = 0.0,
+        spec_k: Optional[int] = None,
+        spec_ngram: int = 3,
+        proposer=None,
+        metrics=None,
+    ):
+        self.engine = engine
+        self.name = str(name)
+        self.role = role
+        self.decode_steps = int(decode_steps)
+        self.kv_headroom = float(kv_headroom)
+        self.metrics = metrics
+        self.requests: Dict[int, Request] = {}  # uid -> Request resident here
+        # serializes engine stepping against KV import/export (both
+        # reassign the donated pool arrays) and scheduler mutation from
+        # other threads (admission, cancel cleanup)
+        self.step_lock = threading.RLock()
+        self.kv_total = int(self._kv_cfg("num_blocks", 0))
+        self.kv_info: Dict = {}
+        if hasattr(engine, "kv_pool_info"):
+            self.kv_info = dict(engine.kv_pool_info())
+        # per-replica tallies for the labeled /metrics gauges
+        self.decode_tokens = 0
+        self.handoffs_in = 0
+        self.handoffs_out = 0
+        # speculative decoding: spec_k=None inherits the engine config's
+        # spec_k; 0 disables. Only meaningful on cores that decode.
+        if spec_k is None:
+            spec_k = int(getattr(getattr(engine, "config", None), "spec_k", 0) or 0)
+        self.spec_k = int(spec_k)
+        self.spec_ctl = None
+        self.proposer = proposer
+        if self.spec_k > 0 and role != "prefill" and hasattr(engine, "spec_round"):
+            from deepspeed_tpu.serving.spec import AdaptiveSpecController, NgramProposer
+
+            if self.proposer is None:
+                self.proposer = NgramProposer(max_ngram=max(1, int(spec_ngram)))
+            self.spec_ctl = AdaptiveSpecController(self.spec_k)
+
+    # -- engine accessors (guarded so fakes stay minimal) ----------------
+    def _kv_cfg(self, name, default):
+        kv = getattr(getattr(self.engine, "config", None), "kv_cache", None)
+        return getattr(kv, name, default) if kv is not None else default
+
+    def _sm_cfg(self, name, default):
+        sm = getattr(getattr(self.engine, "config", None), "state_manager", None)
+        return getattr(sm, name, default) if sm is not None else default
+
+    def free_blocks(self) -> int:
+        return int(getattr(self.engine.state_manager, "free_blocks", 0))
+
+    def prefix_cache(self):
+        return getattr(getattr(self.engine, "state_manager", None), "prefix_cache", None)
+
+    def _inc(self, name: str, delta: float = 1) -> None:
+        if self.metrics is not None:
+            self.metrics.inc(name, delta)
+
+    # -- admission accounting --------------------------------------------
+    def blocks_needed(self, req: Request, prefill_only: bool = False) -> int:
+        """Blocks this request would CHARGE against ``free_blocks``: its
+        full token budget (prompt only for a pure prefill worker — the
+        handoff frees the worker's blocks right after the first token),
+        minus blocks a prefix-cache hit would seed for free."""
+        bs = int(self._kv_cfg("block_size", 1))
+        cap = int(self._kv_cfg("max_blocks_per_seq", 1 << 30))
+        total = len(req.prompt_tokens)
+        if not prefill_only:
+            total += req.params.max_new_tokens
+        need = min((total + bs - 1) // bs, cap)
+        cache = self.prefix_cache()
+        if cache is not None:
+            need = max(0, need - cache.peek(req.prompt_tokens))
+        return need
+
+    def admissible(
+        self,
+        req: Request,
+        reserved_blocks: int = 0,
+        reserved_seqs: int = 0,
+        prefill_only: bool = False,
+    ) -> bool:
+        """KV-aware admission gate for THIS engine. ``reserved_*`` are
+        blocks/sequence-slots a router has promised to in-flight handoffs
+        that have not yet materialized here."""
+        max_tracked = self._sm_cfg("max_tracked_sequences", None)
+        occupied = len(self.requests) + int(reserved_seqs)
+        if max_tracked is not None and occupied >= int(max_tracked):
+            return False
+        free = self.free_blocks() - int(reserved_blocks)
+        cache = self.prefix_cache()
+        if cache is not None:
+            # cached blocks no sequence shares are reclaimable on demand
+            # (extend() evicts LRU when the pool runs dry) — a pool full of
+            # idle cache must not read as "no room". Blocks this request
+            # would HIT are excluded: they'll be shared, not evicted (and
+            # blocks_needed already discounts them).
+            idle = int(cache.stats()["cached_blocks_idle"])
+            free += max(0, idle - cache.peek(req.prompt_tokens))
+        need = self.blocks_needed(req, prefill_only=prefill_only)
+        if not occupied:
+            # empty engine: headroom gating would starve a request larger
+            # than the reserve forever — admit whatever fits outright
+            return need <= free
+        headroom = int(self.kv_headroom * self.kv_total)
+        return need + headroom <= free
+
+    def admit(self, req: Request) -> None:
+        """Hand the request to this engine's scheduler (raises on late
+        inadmissibility) and make it resident here. Caller holds
+        ``step_lock``."""
+        self.engine.scheduler.submit(req.uid, req.prompt_tokens)
+        self.requests[req.uid] = req
+
+    def release(self, uid: int, scheduler_done: bool = False) -> None:
+        """Detach a request from this engine: drop scheduler state (frees
+        KV blocks + pending chunks) and spec history. Caller holds
+        ``step_lock``."""
+        if not scheduler_done:
+            try:
+                self.engine.scheduler.finish(uid)
+            except Exception as e:  # never let cleanup kill the loop
+                logger.warning(f"serving[{self.name}]: finish({uid}) raised: {e}")
+        self.requests.pop(uid, None)
+        if self.spec_ctl is not None:
+            self.spec_ctl.forget(uid)
+
+    def has_work(self) -> bool:
+        return self.engine.scheduler.has_work()
+
+    # -- stepping --------------------------------------------------------
+    def _reap_capped(self, sink) -> None:
+        """Sequences the scheduler force-finished at the block/context cap:
+        their blocks are already freed — report a length_cap finish."""
+        capped = set()
+        sched_drain = getattr(self.engine.scheduler, "drain_capped", None)
+        if sched_drain is not None:
+            capped |= sched_drain()
+        last = getattr(self.engine, "last_capped", None)
+        if last:
+            capped |= set(last)
+            self.engine.last_capped = set()
+        for uid in capped:
+            req = self.requests.get(uid)
+            if req is not None:
+                sink.finish_capped(self, req)
+
+    def _build_drafts(self) -> Dict[int, list]:
+        """Per-uid draft tokens for the next verify round. Resolves the
+        per-request SpecParams against the core's spec_k, asks the
+        adaptive controller for this round's draft length (0 during
+        fallback cooldown), and caps drafts by the request's remaining
+        token budget — a draft past max_new_tokens could only be cut."""
+        drafts: Dict[int, list] = {}
+        for uid in self.engine.scheduler.running_uids():
+            req = self.requests.get(uid)
+            k_cap = self.spec_k
+            if req is not None and req.params.spec is not None:
+                if not req.params.spec.enabled:
+                    drafts[uid] = []
+                    continue
+                k_cap = min(k_cap, req.params.spec.k)
+            k = self.spec_ctl.current_k(uid, k_cap)
+            if req is not None:
+                k = min(k, max(0, req.remaining_tokens - 1))
+            if k < 1:
+                drafts[uid] = []
+                continue
+            seq = self.engine.state_manager.get_sequence(uid)
+            hist = seq.tokens if seq is not None else []
+            drafts[uid] = list(self.proposer.propose(hist, k))
+        return drafts
+
+    def _spec_step(self, sink, sched) -> bool:
+        """One speculative verify round: propose drafts, verify K+1 tokens
+        per row in one program, deliver the accepted burst. Returns True
+        when the round ran (progress or not); the caller falls through to
+        plain stepping when no row drafted anything."""
+        drafts = self._build_drafts()
+        if not any(drafts.values()):
+            return False  # nothing to verify: fused decode round is cheaper
+        round_res = self.engine.spec_round(self.spec_k, drafts=drafts)
+        if not round_res:
+            # every row was skipped (context/block caps, pool exhaustion):
+            # the per-step path knows how to cap/stall them
+            return False
+        self._inc("engine_steps_total")
+        per_uid = dict(self.engine.last_spec.get("per_uid", {}))
+        if self.metrics is not None:
+            self.metrics.observe_spec_round(per_uid)
+        for uid, (drafted, accepted) in per_uid.items():
+            self.spec_ctl.update(uid, drafted, accepted)
+        for uid, toks in round_res.items():
+            req = self.requests.get(uid)
+            if req is None:
+                sched.finish(uid)
+                continue
+            for tok in toks:
+                # apply_spec_round already advanced the scheduler: deliver
+                # without feedback, exactly like fused decode rounds
+                if not sink.deliver(self, req, int(tok), feedback=False):
+                    break
+        self._reap_capped(sink)
+        return True
+
+    def step_once(self, sink) -> bool:
+        """One engine step (or fused decode / speculative verify round).
+        Returns True if any token landed / request advanced (progress).
+        Caller holds ``step_lock``."""
+        sched = self.engine.scheduler
+        use_spec = (
+            self.spec_ctl is not None
+            and not sched.has_pending()
+            and bool(sched.running_uids())
+        )
+        use_round = (
+            self.decode_steps > 1
+            and hasattr(self.engine, "decode_round")
+            and not sched.has_pending()
+            and bool(sched.running_uids())
+        )
+        progress = False
+        try:
+            if use_spec and self._spec_step(sink, sched):
+                return True
+            if use_round:
+                round_res = self.engine.decode_round(self.decode_steps)
+                if round_res:
+                    self._inc("engine_steps_total")
+                    for uid, toks in round_res.items():
+                        req = self.requests.get(uid)
+                        if req is None:
+                            sched.finish(uid)
+                            continue
+                        for tok in toks:
+                            progress = True
+                            if not sink.deliver(self, req, int(tok), feedback=False):
+                                break
+                    self._reap_capped(sink)
+                    return progress
+            results = self.engine.step_tokens()
+            self._inc("engine_steps_total")
+        except Exception as e:
+            # engine-level failure: per-request state is unknowable, so the
+            # in-flight set fails — but the owner survives for new requests
+            logger.warning(
+                f"serving[{self.name}]: engine step failed: {type(e).__name__}: {e}"
+            )
+            sink.engine_failed(self, f"{type(e).__name__}: {e}")
+            cache = self.prefix_cache()
+            if cache is not None:
+                # the failed step may have left cached blocks' device KV
+                # unwritten/garbage — a later hit would serve corrupt
+                # context. Drop the whole trie (all actives just finished,
+                # so every cached block frees outright).
+                try:
+                    cache.clear()
+                except Exception as ce:
+                    logger.warning(
+                        f"serving[{self.name}]: prefix-cache clear failed: {ce}"
+                    )
+            return True
+        for uid, tok in results.items():
+            req = self.requests.get(uid)
+            if req is None:
+                # finished between steps (cancel/timeout): drop the token,
+                # make sure scheduler state is gone
+                sched.finish(uid)
+                continue
+            progress = True
+            sink.deliver(self, req, int(tok))
+        self._reap_capped(sink)
+        return progress
+
+    # -- observability ---------------------------------------------------
+    def replica_stats(self) -> Dict[str, float]:
+        """Per-replica gauge snapshot for the labeled /metrics samples."""
+        free = self.free_blocks()
+        stats = {
+            "kv_free_blocks": free,
+            "kv_total_blocks": self.kv_total,
+            "kv_blocks_in_use": max(0, self.kv_total - free),
+            "active_requests": len(self.requests),
+            "decode_tokens_total": self.decode_tokens,
+            "handoffs_in_total": self.handoffs_in,
+            "handoffs_out_total": self.handoffs_out,
+        }
+        alloc_stats = getattr(self.engine.state_manager, "alloc_stats", None)
+        if alloc_stats is not None:
+            stats["kv_blocks_shared"] = alloc_stats()["shared"]
+        return stats

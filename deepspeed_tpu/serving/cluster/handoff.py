@@ -1,0 +1,107 @@
+"""Cross-engine KV-block handoff.
+
+The transfer unit is the paged ``BlockedAllocator`` block: a prefill
+worker that just produced a request's first token exports the sequence's
+token history plus the block-gathered slice of each KV pool
+(``[n_layers, n_blocks, block_size, kv_heads, head_dim]`` per pool, and
+the fp32 scale planes ``[n_layers, n_blocks, block_size, kv_heads]`` when
+``kv_cache_dtype=int8`` — quantized blocks transfer bit-exactly), and the
+decode replica scatters the payload into freshly allocated blocks of its
+own pool. Engines without device pools (compute-free fakes) hand off with
+``payload=None`` — the table/history bookkeeping is identical.
+
+Prefix replication rides the same path: the importer first seeds from the
+TARGET replica's token-block trie (a hit skips the payload copy for the
+covered blocks entirely), then registers the imported prefix into that
+trie — so a hot system prompt lands in every replica's cache after its
+first handoff there and subsequent requests hit locally.
+
+Bit-identity: the payload copy is bitwise, and sampling is
+content-addressed by (seed, uid, position) — so a sequence prefilled on
+worker A and decoded on replica B streams exactly the tokens the
+single-engine driver would have produced.
+"""
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class HandoffError(RuntimeError):
+    """KV-block import/export failed (pool exhausted, dead sequence, ...)."""
+
+
+@dataclass
+class KVHandoff:
+    """A sequence snapshot in flight between engines."""
+
+    uid: int
+    tokens: List[int]  # full token history whose KV the payload holds
+    seen_tokens: int  # KV cursor (== len(tokens) at handoff time)
+    pending_token: int  # first generated token; target feeds it back
+    n_blocks: int
+    payload: Optional[Dict[str, np.ndarray]]  # k/v (+ *_scale); None for fakes
+
+
+def export_sequence(engine, uid: int, pending_token: int) -> KVHandoff:
+    """Snapshot a finished-prefill sequence OFF ``engine``: token history,
+    KV cursor, and the pool payload for its block table. The payload is a
+    host copy, so the caller releases the source sequence (freeing its
+    blocks) immediately after. Caller holds the source core's step lock."""
+    seq = engine.state_manager.get_sequence(uid)
+    if seq is None or seq.finished:
+        raise HandoffError(f"export({uid}): no live sequence")
+    blocks = [int(b) for b in seq.block_table]
+    export = getattr(engine, "export_kv_blocks", None)
+    payload = export(blocks) if export is not None else None
+    return KVHandoff(
+        uid=uid,
+        tokens=list(seq.tokens),
+        seen_tokens=int(seq.seen_tokens),
+        pending_token=int(pending_token),
+        n_blocks=len(blocks),
+        payload=payload,
+    )
+
+
+def import_sequence(engine, handoff: KVHandoff) -> int:
+    """Materialize a handed-off sequence ON ``engine`` and resume it as a
+    RUNNING decode row: seed shared blocks from this replica's prefix
+    cache (replicated hot prefixes skip the copy), allocate private blocks
+    for the remainder, scatter the payload, register the prefix into this
+    replica's trie, and feed the pending first token back through the
+    scheduler. Returns the number of payload blocks actually copied.
+    Caller holds the target core's step lock."""
+    mgr = engine.state_manager
+    sched = engine.scheduler
+    if mgr.get_sequence(handoff.uid) is not None:
+        raise HandoffError(f"import({handoff.uid}): uid already live on target")
+    seq = mgr.get_or_create_sequence(handoff.uid)  # raises at max_tracked
+    try:
+        n_cached_tokens = mgr.seed_from_cache(seq, handoff.tokens)
+        n_cached = len(seq.block_table)
+        if not mgr.extend(seq, handoff.seen_tokens - n_cached_tokens):
+            raise HandoffError(
+                f"import({handoff.uid}): target pool exhausted "
+                f"({mgr.free_blocks} free, {handoff.n_blocks - n_cached} needed)"
+            )
+        seq.tokens = list(handoff.tokens)
+        seq.seen_tokens = int(handoff.seen_tokens)
+        fresh = [int(b) for b in seq.block_table[n_cached:]]
+        importer = getattr(engine, "import_kv_blocks", None)
+        if importer is not None and handoff.payload is not None and fresh:
+            # payload columns are the SOURCE table in order; the first
+            # n_cached columns are covered by this replica's cache hit
+            importer(fresh, {k: v[:, n_cached:] for k, v in handoff.payload.items()})
+        # replicate the hot prefix into THIS replica's trie: the next
+        # request sharing the prompt hits locally (full blocks only, so
+        # decode writes never land in shared blocks — same discipline as
+        # single-engine prefill)
+        mgr.cache_prefill_blocks(seq, seq.seen_tokens)
+        sched.adopt(handoff.uid, handoff.pending_token)
+        return len(fresh)
+    except Exception:
+        # unwind whatever was seeded/allocated; refcounts stay conserved
+        sched.finish(handoff.uid)
+        raise

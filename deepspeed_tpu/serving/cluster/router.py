@@ -1,0 +1,661 @@
+"""Front-end router for disaggregated prefill/decode serving.
+
+One ``Router`` owns N engines wrapped in :class:`EngineCore`: the first
+``P`` are **prefill workers** (chunked prefill only — their split-step
+produces the request's first token, then the sequence's KV blocks hand
+off), the rest are **decode replicas** (fused decode rounds, spec decode).
+With ``P == 0`` the decode replicas are colocated engines — each request
+runs prefill AND decode on the replica the placement policy picked, with
+no handoff — which is the pure scale-out mode (and what the single-engine
+``ServingDriver`` is one instance of).
+
+Threads:
+  * one **coordinator** — queue timeouts, SLO-aware admission (placement
+    picks the decode target by per-replica free-block headroom / queue
+    depth / deadline slack; the decode budget is reserved at admission so
+    concurrent prefills can't oversubscribe a replica), idle tracking.
+  * one **worker per engine** — steps its core under the core's
+    ``step_lock``, delivers tokens through the shared sink callbacks, and
+    (prefill workers) exports finished prefills and imports them into
+    their reserved decode replicas.
+
+Lock order is ``core.step_lock -> router._cond``, never the reverse: any
+thread touching an engine's scheduler/pools holds that core's step lock,
+and request bookkeeping happens under the router condition inside it.
+
+Output parity: uids are assigned in submit order starting at 0 and every
+engine is built from the same config seed, so content-addressed sampling
+keys make the streams bit-identical to the single-engine driver no matter
+which replica decodes a request.
+"""
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.serving.cluster.core import EngineCore
+from deepspeed_tpu.serving.cluster.handoff import export_sequence, import_sequence
+from deepspeed_tpu.serving.cluster.placement import get_placement
+from deepspeed_tpu.serving.driver import RequestRejected
+from deepspeed_tpu.serving.metrics import ServingMetrics
+from deepspeed_tpu.serving.request import Request, RequestState, SamplingParams
+from deepspeed_tpu.serving.streaming import TokenStream
+from deepspeed_tpu.utils.logging import logger
+
+
+class Router:
+    def __init__(
+        self,
+        engines: Optional[List] = None,
+        *,
+        prefill_engines: Optional[List] = None,
+        decode_engines: Optional[List] = None,
+        num_prefill_workers: int = 0,
+        eos_token_id: Optional[int] = None,
+        max_queue: int = 128,
+        kv_headroom: float = 0.0,
+        default_timeout_s: Optional[float] = None,
+        decode_steps: int = 1,
+        poll_interval_s: float = 0.02,
+        monitor=None,
+        spec_k: Optional[int] = None,
+        spec_ngram: int = 3,
+        proposer=None,
+        placement: str = "slo",
+    ):
+        """Engines either pre-split (``prefill_engines``/``decode_engines``)
+        or one flat ``engines`` list whose first ``num_prefill_workers``
+        become prefill workers."""
+        if engines is not None:
+            p = int(num_prefill_workers)
+            prefill_engines = list(engines[:p])
+            decode_engines = list(engines[p:])
+        prefill_engines = prefill_engines or []
+        decode_engines = decode_engines or []
+        if not decode_engines:
+            raise ValueError("Router needs at least one decode engine")
+        self.eos_token_id = eos_token_id
+        self.max_queue = int(max_queue)
+        self.default_timeout_s = default_timeout_s
+        self.poll_interval_s = float(poll_interval_s)
+        self.monitor = monitor
+        self.metrics = ServingMetrics()
+        self._placement = get_placement(placement)
+
+        colocated = not prefill_engines
+        self.prefill = [
+            EngineCore(e, name=f"p{i}", role="prefill", decode_steps=1,
+                       kv_headroom=kv_headroom, spec_k=0, metrics=self.metrics)
+            for i, e in enumerate(prefill_engines)
+        ]
+        self.decode = [
+            EngineCore(e, name=f"d{i}", role="both" if colocated else "decode",
+                       decode_steps=decode_steps, kv_headroom=kv_headroom,
+                       spec_k=spec_k, spec_ngram=spec_ngram, proposer=proposer,
+                       metrics=self.metrics)
+            for i, e in enumerate(decode_engines)
+        ]
+        self.cores = self.prefill + self.decode
+        self.spec_k = self.decode[0].spec_k
+
+        self._cond = threading.Condition()
+        self._queue: deque = deque()  # Requests awaiting admission
+        self._by_uid: Dict[int, Request] = {}  # every live request
+        self._owner: Dict[int, EngineCore] = {}  # admitted -> resident core
+        self._target: Dict[int, EngineCore] = {}  # planned decode replica
+        self._resv: Dict[int, tuple] = {}  # uid -> (core, reserved blocks)
+        self._reserved: Dict[str, list] = {c.name: [0, 0] for c in self.cores}
+        self._handoff_out: Dict[str, list] = {}  # core name -> [(req, tok)]
+        self._tally: Dict[str, Dict[str, float]] = {
+            c.name: {"finished": 0, "ttft_sum": 0.0, "ttft_n": 0,
+                     "tpot_sum": 0.0, "tpot_n": 0}
+            for c in self.cores
+        }
+        self._cancel_uids: set = set()
+        self._next_uid = 0
+        self._draining = False
+        self._stopping = False
+        self._idle = threading.Event()
+        self._idle.set()
+        self._threads: List[threading.Thread] = []
+
+        self.metrics.counters.setdefault("kv_handoffs_total", 0)
+        if self.decode[0].kv_info:
+            self.metrics.update_kv_pool_info(self.decode[0].kv_info)
+        if hasattr(self.decode[0].engine, "comm_wire_info"):
+            self.metrics.update_comm_quant(self.decode[0].engine.comm_wire_info())
+        with self._cond:
+            self.metrics.update_kv(
+                sum(c.free_blocks() for c in self.cores),
+                sum(c.kv_total for c in self.cores),
+            )
+            for core in self.cores:
+                self.metrics.update_replica(
+                    core.name, core.replica_stats(), role=core.role
+                )
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "Router":
+        if self._threads:
+            raise RuntimeError("router already started")
+        self._threads.append(threading.Thread(
+            target=self._coordinate, name="serving-router", daemon=True))
+        for core in self.cores:
+            self._threads.append(threading.Thread(
+                target=self._worker, args=(core,),
+                name=f"serving-{core.name}", daemon=True))
+        for t in self._threads:
+            t.start()
+        return self
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb):
+        self.shutdown(drain=exc_type is None)
+
+    # -- public API (mirrors ServingDriver) ------------------------------
+    def submit(
+        self,
+        prompt_tokens,
+        params: Optional[SamplingParams] = None,
+        timeout_s: Optional[float] = None,
+        stop_fn=None,
+    ) -> Request:
+        prompt = np.asarray(prompt_tokens, np.int32).reshape(-1)
+        params = params or SamplingParams()
+        if len(prompt) == 0:
+            self._reject("empty_prompt")
+        max_ctx = self.decode[0]._sm_cfg("max_context", None)
+        if max_ctx is not None and len(prompt) >= max_ctx:
+            self._reject(
+                "max_context",
+                f"prompt of {len(prompt)} tokens >= max_context={max_ctx}",
+            )
+        # never-fits guard, PER replica group: the prompt must be
+        # schedulable on at least one prefill-capable engine and one decode
+        # replica (admission itself re-checks live per-replica free blocks
+        # through the placement policy)
+        groups = ([self.prefill] if self.prefill else []) + [self.decode]
+        for cores in groups:
+            err = None
+            for core in cores:
+                check = getattr(core.engine.state_manager, "check_admissible", None)
+                if check is None:
+                    err = None
+                    break
+                try:
+                    check(len(prompt))
+                    err = None
+                    break
+                except ValueError as e:
+                    err = str(e)
+            if err is not None:
+                self._reject("inadmissible", err)
+        timeout = timeout_s if timeout_s is not None else self.default_timeout_s
+        with self._cond:
+            if self._draining or self._stopping:
+                self._reject("draining")
+            if len(self._queue) >= self.max_queue:
+                self._reject("queue_full", f"admission queue full ({self.max_queue})")
+            req = Request(
+                uid=self._next_uid,
+                prompt_tokens=prompt,
+                params=params,
+                deadline=(time.monotonic() + timeout) if timeout else None,
+                stop_fn=stop_fn,
+            )
+            self._next_uid += 1
+            req.stream = TokenStream(req.uid)
+            self._queue.append(req)
+            self._by_uid[req.uid] = req
+            self._idle.clear()
+            self.metrics.inc("requests_submitted_total")
+            self.metrics.set_gauge("queue_depth", len(self._queue))
+            self._cond.notify_all()
+        return req
+
+    def cancel(self, uid: int) -> bool:
+        with self._cond:
+            for req in list(self._queue):
+                if req.uid == uid:
+                    self._queue.remove(req)
+                    self._by_uid.pop(uid, None)
+                    self._release_resv_locked(uid)
+                    self._terminate_locked(req, RequestState.CANCELLED, "cancelled")
+                    self.metrics.set_gauge("queue_depth", len(self._queue))
+                    return True
+            if uid in self._by_uid:
+                self._cancel_uids.add(uid)
+                self._cond.notify_all()
+                return True
+        return False
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+        return self._idle.wait(timeout)
+
+    def shutdown(self, drain: bool = True, timeout: Optional[float] = None) -> None:
+        if drain:
+            self.drain(timeout)
+        with self._cond:
+            self._stopping = True
+            if not drain:
+                for req in list(self._queue):
+                    self._by_uid.pop(req.uid, None)
+                    self._release_resv_locked(req.uid)
+                    self._terminate_locked(req, RequestState.CANCELLED, "shutdown")
+                self._queue.clear()
+                self._cancel_uids.update(self._by_uid.keys())
+            self._cond.notify_all()
+        for t in self._threads:
+            t.join(timeout=30)
+        self._threads = []
+        self._flush_monitor()
+
+    @property
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    @property
+    def num_active(self) -> int:
+        with self._cond:
+            return len(self._owner)
+
+    def reserved_for(self, core: EngineCore):
+        """(blocks, sequences) the router has promised to in-flight
+        handoffs targeting ``core``. Called under ``_cond`` (placement runs
+        inside the coordinator's admission pass)."""
+        r = self._reserved[core.name]
+        return int(r[0]), int(r[1])
+
+    def health(self) -> Dict:
+        with self._cond:
+            snap = self.metrics.snapshot()
+            replicas = {}
+            for core in self.cores:
+                st = core.replica_stats()
+                st["role"] = core.role
+                st["reserved_blocks"] = self._reserved[core.name][0]
+                t = self._tally[core.name]
+                st["requests_finished_total"] = t["finished"]
+                if t["ttft_n"]:
+                    st["ttft_mean_s"] = round(t["ttft_sum"] / t["ttft_n"], 6)
+                if t["tpot_n"]:
+                    st["tpot_mean_s"] = round(t["tpot_sum"] / t["tpot_n"], 6)
+                replicas[core.name] = st
+            kv_info = self.decode[0].kv_info
+            spec = next((c.spec_ctl for c in self.decode), None)
+            return {
+                "status": "draining" if self._draining else "ok",
+                "queue_depth": len(self._queue),
+                "active_requests": len(self._owner),
+                "kv_free_blocks": sum(c.free_blocks() for c in self.cores),
+                "kv_total_blocks": sum(c.kv_total for c in self.cores),
+                "kv_cache_dtype": kv_info.get("kv_cache_dtype", "bf16"),
+                "kv_pool_bytes": kv_info.get("kv_pool_bytes", 0),
+                "kv_capacity_multiplier": kv_info.get("kv_capacity_multiplier", 1.0),
+                "num_prefill_workers": len(self.prefill),
+                "num_decode_replicas": len(self.decode),
+                "placement": self._placement.name,
+                "kv_handoffs": int(snap.get("kv_handoffs_total", 0)),
+                "replicas": replicas,
+                "spec": {
+                    "enabled": spec is not None,
+                    "k": self.spec_k,
+                    "rounds": int(snap["spec_rounds_total"]),
+                    "draft_tokens": int(snap["spec_draft_tokens_total"]),
+                    "accepted_tokens": int(snap["spec_accepted_tokens_total"]),
+                    "acceptance_rate": snap["spec_acceptance_rate"],
+                },
+            }
+
+    # -- internals -------------------------------------------------------
+    def _reject(self, reason: str, message: str = ""):
+        self.metrics.inc("requests_rejected_total")
+        raise RequestRejected(reason, message)
+
+    def _terminate_locked(self, req: Request, state: str, reason: str,
+                          error: Optional[str] = None):
+        req.state = state
+        req.finish_reason = reason
+        req.error = error
+        req.t_finish = time.monotonic()
+        if req.stream is not None:
+            req.stream.close(reason, error=error)
+        req._done.set()
+        self.metrics.observe_request(req)
+        key = {
+            RequestState.FINISHED: "requests_finished_total",
+            RequestState.CANCELLED: "requests_cancelled_total",
+            RequestState.TIMED_OUT: "requests_timed_out_total",
+            RequestState.FAILED: "requests_failed_total",
+        }.get(state)
+        if key:
+            self.metrics.inc(key)
+
+    def _release_resv_locked(self, uid: int):
+        ent = self._resv.pop(uid, None)
+        if ent is not None:
+            core, blocks = ent
+            r = self._reserved[core.name]
+            r[0] -= blocks
+            r[1] -= 1
+        self._target.pop(uid, None)
+
+    def _finish_on_locked(self, core: EngineCore, req: Request, state: str,
+                          reason: str, error: Optional[str] = None,
+                          scheduler_done: bool = False):
+        """Terminal transition for a request RESIDENT on ``core``. Caller
+        holds ``core.step_lock`` and ``self._cond``."""
+        core.release(req.uid, scheduler_done=scheduler_done)
+        self._release_resv_locked(req.uid)
+        self._owner.pop(req.uid, None)
+        self._by_uid.pop(req.uid, None)
+        self._cancel_uids.discard(req.uid)
+        self._terminate_locked(req, state, reason, error)
+        t = self._tally[core.name]
+        if state == RequestState.FINISHED:
+            t["finished"] += 1
+        if req.ttft_s is not None:
+            t["ttft_sum"] += req.ttft_s
+            t["ttft_n"] += 1
+        if req.tpot_s is not None:
+            t["tpot_sum"] += req.tpot_s
+            t["tpot_n"] += 1
+
+    # -- EngineCore sink protocol ----------------------------------------
+    def deliver(self, core: EngineCore, req: Request, token: int,
+                feedback: bool = True) -> bool:
+        with self._cond:
+            try:
+                now = time.monotonic()
+                if req.t_first_token is None:
+                    req.t_first_token = now
+                    req.state = RequestState.DECODE
+                req.generated.append(int(token))
+                self.metrics.inc("decode_tokens_total")
+                core.decode_tokens += 1
+                req.stream.put(int(token))
+                reason = req.should_stop(int(token), self.eos_token_id)
+                if reason is not None:
+                    self._finish_on_locked(core, req, RequestState.FINISHED, reason)
+                elif core.role == "prefill":
+                    # first token out of the split-step: queue the KV
+                    # handoff; the worker exports right after this step
+                    self._handoff_out.setdefault(core.name, []).append(
+                        (req, int(token)))
+                elif feedback:
+                    core.engine.scheduler.feedback(req.uid, int(token))
+            except Exception as e:
+                logger.warning(
+                    f"serving: request {req.uid} failed: {type(e).__name__}: {e}")
+                self._finish_on_locked(core, req, RequestState.FAILED, "error",
+                                       error=f"{type(e).__name__}: {e}")
+                return False
+        return not req.is_terminal
+
+    def engine_failed(self, core: EngineCore, error: str):
+        with self._cond:
+            self._handoff_out.pop(core.name, None)
+            for req in list(core.requests.values()):
+                self._finish_on_locked(core, req, RequestState.FAILED,
+                                       "engine_error", error=error)
+
+    def finish_capped(self, core: EngineCore, req: Request):
+        with self._cond:
+            self._finish_on_locked(core, req, RequestState.FINISHED,
+                                   "length_cap", scheduler_done=True)
+
+    # -- admission (coordinator) -----------------------------------------
+    def _expire_queue_locked(self):
+        now = time.monotonic()
+        for req in [r for r in self._queue
+                    if r.deadline is not None and now >= r.deadline]:
+            self._queue.remove(req)
+            self._by_uid.pop(req.uid, None)
+            self._release_resv_locked(req.uid)
+            self._terminate_locked(req, RequestState.TIMED_OUT, "timeout")
+        self.metrics.set_gauge("queue_depth", len(self._queue))
+
+    def _plan_admission_locked(self):
+        """FIFO head admission: the placement policy picks the decode
+        replica (per-replica free blocks, reservations included); in
+        disaggregated mode the least-loaded admissible prefill worker runs
+        the prefill and the decode budget is reserved on the target until
+        the handoff lands."""
+        if not self._queue:
+            return None
+        req = self._queue[0]
+        dcore = self._placement.choose(self.decode, req, self)
+        if dcore is None:
+            self.metrics.inc("admission_blocked_total")
+            return None
+        if self.prefill:
+            candidates = [c for c in self.prefill
+                          if c.admissible(req, prefill_only=True)]
+            if not candidates:
+                self.metrics.inc("admission_blocked_total")
+                return None
+            pcore = min(candidates, key=lambda c: len(c.requests))
+            blocks = dcore.blocks_needed(req)
+            self._resv[req.uid] = (dcore, blocks)
+            r = self._reserved[dcore.name]
+            r[0] += blocks
+            r[1] += 1
+        else:
+            pcore = dcore
+        self._target[req.uid] = dcore
+        self._queue.popleft()
+        return (req, pcore)
+
+    def _coordinate(self):
+        while True:
+            plan = None
+            with self._cond:
+                while True:
+                    if self._stopping and not self._queue and not self._by_uid:
+                        self._idle.set()
+                        self._cond.notify_all()
+                        return
+                    self._expire_queue_locked()
+                    plan = self._plan_admission_locked()
+                    if plan is not None:
+                        break
+                    if not self._queue and not self._by_uid:
+                        self._idle.set()
+                        self._flush_monitor()
+                    now = time.monotonic()
+                    deadlines = [r.deadline for r in self._queue
+                                 if r.deadline is not None]
+                    timeout = None
+                    if deadlines:
+                        timeout = max(0.0, min(deadlines) - now)
+                    if self._queue:
+                        # head may become admissible as other engines free
+                        # blocks — workers notify after every step, the
+                        # poll is only a backstop against missed wakeups
+                        poll = self.poll_interval_s * 5
+                        timeout = min(poll, timeout) if timeout is not None else poll
+                    self._cond.wait(timeout)
+            req, pcore = plan
+            err = None
+            with pcore.step_lock:
+                try:
+                    pcore.admit(req)
+                except Exception as e:
+                    # late inadmissibility (e.g. raced config change): isolate
+                    err = str(e)
+            with self._cond:
+                if err is None:
+                    req.state = RequestState.PREFILL
+                    req.t_admitted = time.monotonic()
+                    self._owner[req.uid] = pcore
+                    self.metrics.inc("prefill_tokens_total", len(req.prompt_tokens))
+                else:
+                    self._release_resv_locked(req.uid)
+                    self._by_uid.pop(req.uid, None)
+                    self._terminate_locked(req, RequestState.REJECTED,
+                                           "inadmissible", err)
+                    self.metrics.inc("requests_rejected_total")
+                self.metrics.set_gauge("queue_depth", len(self._queue))
+                self.metrics.set_gauge("active_requests", len(self._owner))
+                self._cond.notify_all()
+
+    # -- handoff ---------------------------------------------------------
+    def _complete_handoff(self, req: Request, ho):
+        with self._cond:
+            target = self._target.get(req.uid)
+        if target is None:  # terminated mid-flight
+            return
+        with target.step_lock:
+            if req.is_terminal:
+                return
+            try:
+                copied = import_sequence(target.engine, ho)
+            except Exception as e:
+                logger.warning(
+                    f"serving: handoff import of uid={req.uid} onto "
+                    f"{target.name} failed: {type(e).__name__}: {e}")
+                with self._cond:
+                    self._release_resv_locked(req.uid)
+                    self._by_uid.pop(req.uid, None)
+                    self._cancel_uids.discard(req.uid)
+                    self._terminate_locked(
+                        req, RequestState.FAILED, "error",
+                        error=f"handoff import: {type(e).__name__}: {e}")
+                return
+            with self._cond:
+                target.requests[req.uid] = req
+                self._owner[req.uid] = target
+                self._release_resv_locked(req.uid)
+                target.handoffs_in += 1
+                self.metrics.inc("kv_handoffs_total")
+                self.metrics.inc("kv_handoff_blocks_total", ho.n_blocks)
+                self.metrics.inc("kv_handoff_blocks_copied_total", copied)
+                self._cond.notify_all()
+
+    # -- workers ---------------------------------------------------------
+    def _core_flags_locked(self, core: EngineCore) -> bool:
+        return any(uid in self._cancel_uids for uid in core.requests)
+
+    def _core_deadline_locked(self, core: EngineCore) -> Optional[float]:
+        deadlines = [r.deadline for r in core.requests.values()
+                     if r.deadline is not None]
+        return min(deadlines) if deadlines else None
+
+    def _expire_core_locked(self, core: EngineCore):
+        now = time.monotonic()
+        for req in list(core.requests.values()):
+            if req.uid in self._cancel_uids:
+                self._finish_on_locked(core, req, RequestState.CANCELLED, "cancelled")
+            elif req.deadline is not None and now >= req.deadline:
+                self._finish_on_locked(core, req, RequestState.TIMED_OUT, "timeout")
+
+    def _refresh_metrics_locked(self, core: EngineCore):
+        self.metrics.update_kv(
+            sum(c.free_blocks() for c in self.cores),
+            sum(c.kv_total for c in self.cores),
+        )
+        # prefix-cache rollup: counters are per-replica monotone, so the
+        # sums are too; the rate is recomputed from the summed counters
+        agg = None
+        for c in self.cores:
+            cache = c.prefix_cache()
+            if cache is None:
+                continue
+            st = cache.stats()
+            if agg is None:
+                agg = dict(st)
+            else:
+                for k, v in st.items():
+                    agg[k] = agg.get(k, 0) + v
+        if agg is not None:
+            agg["hit_rate"] = (
+                agg["hits"] / agg["queries"] if agg.get("queries") else 0.0
+            )
+            self.metrics.update_prefix_cache(agg)
+        st = core.replica_stats()
+        st["reserved_blocks"] = self._reserved[core.name][0]
+        st["requests_finished_total"] = self._tally[core.name]["finished"]
+        self.metrics.update_replica(core.name, st, role=core.role)
+        self.metrics.set_gauge("active_requests", len(self._owner))
+
+    def _maybe_idle_locked(self):
+        if not self._queue and not self._by_uid:
+            self._idle.set()
+            self._flush_monitor()
+
+    def _flush_monitor(self):
+        if self.monitor is not None:
+            try:
+                self.monitor.write_events(self.metrics.to_events())
+            except Exception as e:
+                logger.warning(f"serving: monitor write failed: {e}")
+
+    def _worker(self, core: EngineCore):
+        stall_wait = False
+        while True:
+            with self._cond:
+                while True:
+                    if self._stopping and not self._queue and not self._by_uid:
+                        self._cond.notify_all()
+                        return
+                    work = self._core_flags_locked(core) or core.has_work()
+                    now = time.monotonic()
+                    deadline = self._core_deadline_locked(core)
+                    if deadline is not None and now >= deadline:
+                        break
+                    if work and not stall_wait:
+                        break
+                    timeout = None
+                    if deadline is not None:
+                        timeout = max(0.0, deadline - now)
+                    if stall_wait:
+                        timeout = (min(self.poll_interval_s, timeout)
+                                   if timeout is not None else self.poll_interval_s)
+                    self._cond.wait(timeout)
+                    stall_wait = False
+            stepped = False
+            handoffs = []
+            with core.step_lock:
+                with self._cond:
+                    self._expire_core_locked(core)
+                if core.has_work():
+                    stepped = core.step_once(self)
+                # export finished prefills while still under the SOURCE
+                # lock (the payload gather must not race the next step's
+                # donated pool reassignment), then release the source seq
+                with self._cond:
+                    pending = self._handoff_out.pop(core.name, [])
+                for req, tok in pending:
+                    if req.is_terminal:
+                        continue
+                    try:
+                        ho = export_sequence(core.engine, req.uid, tok)
+                    except Exception as e:
+                        with self._cond:
+                            self._finish_on_locked(
+                                core, req, RequestState.FAILED, "error",
+                                error=f"handoff export: {type(e).__name__}: {e}")
+                        continue
+                    core.release(req.uid)
+                    with self._cond:
+                        self._owner.pop(req.uid, None)
+                        core.handoffs_out += 1
+                    handoffs.append((req, ho))
+            # imports take each TARGET's own lock; source lock released so
+            # the prefill worker never blocks a decode replica's step
+            for req, ho in handoffs:
+                self._complete_handoff(req, ho)
+            with self._cond:
+                self._refresh_metrics_locked(core)
+                self._maybe_idle_locked()
+                self._cond.notify_all()
+            stall_wait = not stepped

@@ -26,6 +26,12 @@ The driver needs only a small engine protocol — ``scheduler`` (the
 ``RaggedScheduler`` API), ``state_manager`` (``free_blocks``), and
 ``step_tokens()`` returning ``{uid: next-token int}`` — so tests drive it
 with a compute-free fake over the REAL scheduler/allocator stack.
+
+Since the disaggregated-serving refactor the engine-facing half of the
+loop (admission accounting, stepping, spec rounds, capped reaping) lives
+in ``serving.cluster.core.EngineCore``; this driver is the degenerate
+one-engine (1-prefill=1-decode colocated) owner of a single core, and
+``serving.cluster.router.Router`` is the many-engine owner.
 """
 
 import threading
@@ -73,25 +79,30 @@ class ServingDriver:
         self.poll_interval_s = float(poll_interval_s)
         self.monitor = monitor
         self.metrics = ServingMetrics()
-        # speculative decoding: spec_k=None inherits the engine config's
-        # spec_k; 0 disables. The proposer is injectable (a small-model
-        # drafter satisfies the same protocol); default is the model-free
-        # n-gram prompt-lookup drafter.
-        if spec_k is None:
-            spec_k = int(getattr(getattr(engine, "config", None), "spec_k", 0) or 0)
-        self.spec_k = int(spec_k)
-        self._spec_ctl = None
-        self.proposer = proposer
-        if self.spec_k > 0 and hasattr(engine, "spec_round"):
-            from deepspeed_tpu.serving.spec import AdaptiveSpecController, NgramProposer
+        # the engine-facing half of the loop (admission accounting,
+        # stepping, spec rounds, capped reaping) — spec_k=None inherits
+        # the engine config's spec_k; 0 disables; the proposer is
+        # injectable (a small-model drafter satisfies the same protocol)
+        from deepspeed_tpu.serving.cluster.core import EngineCore
 
-            if self.proposer is None:
-                self.proposer = NgramProposer(max_ngram=max(1, int(spec_ngram)))
-            self._spec_ctl = AdaptiveSpecController(self.spec_k)
+        self.core = EngineCore(
+            engine,
+            name="replica0",
+            role="both",
+            decode_steps=self.decode_steps,
+            kv_headroom=self.kv_headroom,
+            spec_k=spec_k,
+            spec_ngram=spec_ngram,
+            proposer=proposer,
+            metrics=self.metrics,
+        )
+        self.spec_k = self.core.spec_k
+        self._spec_ctl = self.core.spec_ctl
+        self.proposer = self.core.proposer
 
         self._cond = threading.Condition()
         self._queue: deque = deque()  # Requests awaiting admission
-        self._active: Dict[int, Request] = {}  # uid -> Request in the scheduler
+        self._active = self.core.requests  # uid -> Request in the scheduler
         self._cancel_uids: set = set()
         self._next_uid = 0
         self._draining = False
@@ -99,31 +110,31 @@ class ServingDriver:
         self._idle = threading.Event()
         self._idle.set()
         self._thread: Optional[threading.Thread] = None
-        self._kv_total = int(self._kv_cfg("num_blocks", 0))
+        self._kv_total = self.core.kv_total
         self.metrics.update_kv(self._free_blocks(), self._kv_total)
         # static pool byte accounting (int8 capacity multiplier etc.) —
         # getattr-guarded so minimal fake engines in tests stay minimal
-        self._kv_info = {}
-        if hasattr(self.engine, "kv_pool_info"):
-            self._kv_info = dict(self.engine.kv_pool_info())
+        self._kv_info = self.core.kv_info
+        if self._kv_info:
             self.metrics.update_kv_pool_info(self._kv_info)
         if hasattr(self.engine, "comm_wire_info"):
             self.metrics.update_comm_quant(self.engine.comm_wire_info())
+        self.metrics.update_replica(
+            self.core.name, self.core.replica_stats(), role=self.core.role
+        )
 
     # -- engine accessors (guarded so fakes stay minimal) ----------------
     def _kv_cfg(self, name, default):
-        kv = getattr(getattr(self.engine, "config", None), "kv_cache", None)
-        return getattr(kv, name, default) if kv is not None else default
+        return self.core._kv_cfg(name, default)
 
     def _sm_cfg(self, name, default):
-        sm = getattr(getattr(self.engine, "config", None), "state_manager", None)
-        return getattr(sm, name, default) if sm is not None else default
+        return self.core._sm_cfg(name, default)
 
     def _free_blocks(self) -> int:
-        return int(getattr(self.engine.state_manager, "free_blocks", 0))
+        return self.core.free_blocks()
 
     def _prefix_cache(self):
-        return getattr(getattr(self.engine, "state_manager", None), "prefix_cache", None)
+        return self.core.prefix_cache()
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "ServingDriver":
@@ -244,12 +255,15 @@ class ServingDriver:
     def health(self) -> Dict:
         with self._cond:
             snap = self.metrics.snapshot()
+            replica = self.core.replica_stats()
+            replica["role"] = self.core.role
             return {
                 "status": "draining" if self._draining else "ok",
                 "queue_depth": len(self._queue),
                 "active_requests": len(self._active),
                 "kv_free_blocks": self._free_blocks(),
                 "kv_total_blocks": self._kv_total,
+                "replicas": {self.core.name: replica},
                 "kv_cache_dtype": self._kv_info.get("kv_cache_dtype", "bf16"),
                 "kv_pool_bytes": self._kv_info.get("kv_pool_bytes", 0),
                 "kv_capacity_multiplier": self._kv_info.get(
@@ -294,54 +308,16 @@ class ServingDriver:
                        error: Optional[str] = None, scheduler_done: bool = False):
         """Terminal transition for an ACTIVE request: release its scheduler
         state (frees KV blocks + pending prompt chunks) and close out."""
-        if not scheduler_done:
-            try:
-                self.engine.scheduler.finish(req.uid)
-            except Exception as e:  # never let cleanup kill the loop
-                logger.warning(f"serving: finish({req.uid}) raised: {e}")
-        self._active.pop(req.uid, None)
+        self.core.release(req.uid, scheduler_done=scheduler_done)
         self._cancel_uids.discard(req.uid)
-        if self._spec_ctl is not None:
-            self._spec_ctl.forget(req.uid)
         self._terminate(req, state, reason, error)
 
     # admission ---------------------------------------------------------
     def _blocks_needed(self, req: Request) -> int:
-        """Blocks this request would CHARGE against ``free_blocks``: its
-        full token budget, minus blocks a prefix-cache hit would seed for
-        free (shared blocks cost no new allocation). Charging uncached
-        blocks only is what lets a hot shared prompt multiply effective KV
-        capacity — thousands of hit requests each charge only their
-        private suffix."""
-        bs = int(self._kv_cfg("block_size", 1))
-        cap = int(self._kv_cfg("max_blocks_per_seq", 1 << 30))
-        total = len(req.prompt_tokens) + req.params.max_new_tokens
-        need = min((total + bs - 1) // bs, cap)
-        cache = self._prefix_cache()
-        if cache is not None:
-            need = max(0, need - cache.peek(req.prompt_tokens))
-        return need
+        return self.core.blocks_needed(req)
 
     def _admissible(self, req: Request) -> bool:
-        max_tracked = self._sm_cfg("max_tracked_sequences", None)
-        if max_tracked is not None and len(self._active) >= int(max_tracked):
-            return False
-        free = self._free_blocks()
-        cache = self._prefix_cache()
-        if cache is not None:
-            # cached blocks no sequence shares are reclaimable on demand
-            # (extend() evicts LRU when the pool runs dry) — a pool full of
-            # idle cache must not read as "no room". Blocks this request
-            # would HIT are excluded: they'll be shared, not evicted (and
-            # _blocks_needed already discounts them).
-            idle = int(cache.stats()["cached_blocks_idle"])
-            free += max(0, idle - cache.peek(req.prompt_tokens))
-        if not self._active:
-            # empty engine: headroom gating would starve a request larger
-            # than the reserve forever — admit whatever fits outright
-            return self._blocks_needed(req) <= free
-        headroom = int(self.kv_headroom * self._kv_total)
-        return self._blocks_needed(req) + headroom <= free
+        return self.core.admissible(req)
 
     def _admit_locked(self) -> bool:
         admitted = False
@@ -352,7 +328,7 @@ class ServingDriver:
                 break
             self._queue.popleft()
             try:
-                self.engine.scheduler.submit(req.uid, req.prompt_tokens)
+                self.core.admit(req)
             except Exception as e:
                 # late inadmissibility (e.g. raced config change): isolate
                 self._terminate(req, RequestState.REJECTED, "inadmissible", str(e))
@@ -360,7 +336,6 @@ class ServingDriver:
                 continue
             req.state = RequestState.PREFILL
             req.t_admitted = time.monotonic()
-            self._active[req.uid] = req
             self.metrics.inc("prefill_tokens_total", len(req.prompt_tokens))
             admitted = True
         self.metrics.set_gauge("queue_depth", len(self._queue))
@@ -399,6 +374,7 @@ class ServingDriver:
             req.state = RequestState.DECODE
         req.generated.append(int(token))
         self.metrics.inc("decode_tokens_total")
+        self.core.decode_tokens += 1
         req.stream.put(int(token))
         reason = req.should_stop(int(token), self.eos_token_id)
         if reason is not None:
@@ -419,146 +395,27 @@ class ServingDriver:
         return not req.is_terminal
 
     # engine stepping ---------------------------------------------------
-    def _reap_capped(self):
-        """Sequences the scheduler force-finished at the block/context cap:
-        their blocks are already freed — report a length_cap finish."""
-        capped = set()
-        sched_drain = getattr(self.engine.scheduler, "drain_capped", None)
-        if sched_drain is not None:
-            capped |= sched_drain()
-        last = getattr(self.engine, "last_capped", None)
-        if last:
-            capped |= set(last)
-            self.engine.last_capped = set()
-        for uid in capped:
-            req = self._active.get(uid)
-            if req is not None:
-                self._finish_active(req, RequestState.FINISHED, "length_cap",
-                                    scheduler_done=True)
+    # The step body lives in EngineCore.step_once; the driver implements
+    # the core's sink protocol (token delivery / engine failure / length
+    # cap) over its single-engine request bookkeeping.
+    def deliver(self, core, req: Request, token: int, feedback: bool = True) -> bool:
+        return self._deliver_or_fail(req, token, feedback=feedback)
 
-    # speculative decoding -----------------------------------------------
-    def _build_drafts(self) -> Dict[int, list]:
-        """Per-uid draft tokens for the next verify round. Resolves the
-        per-request SpecParams against the driver's spec_k, asks the
-        adaptive controller for this round's draft length (0 during
-        fallback cooldown), and caps drafts by the request's remaining
-        token budget — a draft past max_new_tokens could only be cut."""
-        drafts: Dict[int, list] = {}
-        for uid in self.engine.scheduler.running_uids():
-            req = self._active.get(uid)
-            k_cap = self.spec_k
-            if req is not None and req.params.spec is not None:
-                if not req.params.spec.enabled:
-                    drafts[uid] = []
-                    continue
-                k_cap = min(k_cap, req.params.spec.k)
-            k = self._spec_ctl.current_k(uid, k_cap)
-            if req is not None:
-                k = min(k, max(0, req.remaining_tokens - 1))
-            if k < 1:
-                drafts[uid] = []
-                continue
-            seq = self.engine.state_manager.get_sequence(uid)
-            hist = seq.tokens if seq is not None else []
-            drafts[uid] = list(self.proposer.propose(hist, k))
-        return drafts
+    def engine_failed(self, core, error: str):
+        # engine-level failure: per-request state is unknowable, so the
+        # in-flight set fails — but the driver survives for new requests
+        for req in list(self._active.values()):
+            self._finish_active(req, RequestState.FAILED, "engine_error", error=error)
 
-    def _spec_step(self, sched) -> bool:
-        """One speculative verify round: propose drafts, verify K+1 tokens
-        per row in one program, deliver the accepted burst. Returns True
-        when the round ran (progress or not); the caller falls through to
-        plain stepping when no row drafted anything."""
-        drafts = self._build_drafts()
-        if not any(drafts.values()):
-            return False  # nothing to verify: fused decode round is cheaper
-        round_res = self.engine.spec_round(self.spec_k, drafts=drafts)
-        if not round_res:
-            # every row was skipped (context/block caps, pool exhaustion):
-            # the per-step path knows how to cap/stall them
-            return False
-        self.metrics.inc("engine_steps_total")
-        per_uid = dict(self.engine.last_spec.get("per_uid", {}))
-        self.metrics.observe_spec_round(per_uid)
-        for uid, (drafted, accepted) in per_uid.items():
-            self._spec_ctl.update(uid, drafted, accepted)
-        for uid, toks in round_res.items():
-            req = self._active.get(uid)
-            if req is None:
-                sched.finish(uid)
-                continue
-            for tok in toks:
-                # apply_spec_round already advanced the scheduler: deliver
-                # without feedback, exactly like fused decode rounds
-                if not self._deliver_or_fail(req, int(tok), feedback=False):
-                    break
-        self._reap_capped()
-        return True
+    def finish_capped(self, core, req: Request):
+        self._finish_active(req, RequestState.FINISHED, "length_cap",
+                            scheduler_done=True)
 
     def _step_once(self) -> bool:
         """One engine step (or fused decode / speculative verify round).
         Returns True if any token landed / request advanced (progress)."""
-        sched = self.engine.scheduler
-        use_spec = (
-            self._spec_ctl is not None
-            and not sched.has_pending()
-            and bool(sched.running_uids())
-        )
-        use_round = (
-            self.decode_steps > 1
-            and hasattr(self.engine, "decode_round")
-            and not sched.has_pending()
-            and bool(sched.running_uids())
-        )
-        progress = False
-        try:
-            if use_spec and self._spec_step(sched):
-                return True
-            if use_round:
-                round_res = self.engine.decode_round(self.decode_steps)
-                if round_res:
-                    self.metrics.inc("engine_steps_total")
-                    for uid, toks in round_res.items():
-                        req = self._active.get(uid)
-                        if req is None:
-                            sched.finish(uid)
-                            continue
-                        for tok in toks:
-                            progress = True
-                            if not self._deliver_or_fail(req, int(tok), feedback=False):
-                                break
-                    self._reap_capped()
-                    return progress
-            results = self.engine.step_tokens()
-            self.metrics.inc("engine_steps_total")
-        except Exception as e:
-            # engine-level failure: per-request state is unknowable, so the
-            # in-flight set fails — but the driver survives for new requests
-            logger.warning(f"serving: engine step failed: {type(e).__name__}: {e}")
-            for req in list(self._active.values()):
-                self._finish_active(req, RequestState.FAILED, "engine_error",
-                                    error=f"{type(e).__name__}: {e}")
-            cache = self._prefix_cache()
-            if cache is not None:
-                # the failed step may have left cached blocks' device KV
-                # unwritten/garbage — a later hit would serve corrupt
-                # context. Drop the whole trie (all actives just finished,
-                # so every cached block frees outright).
-                try:
-                    cache.clear()
-                except Exception as ce:
-                    logger.warning(f"serving: prefix-cache clear failed: {ce}")
-            return True
-        for uid, tok in results.items():
-            req = self._active.get(uid)
-            if req is None:
-                # finished between steps (cancel/timeout): drop the token,
-                # make sure scheduler state is gone
-                sched.finish(uid)
-                continue
-            progress = True
-            self._deliver_or_fail(req, int(tok))
-        self._reap_capped()
-        return progress
+        with self.core.step_lock:
+            return self.core.step_once(self)
 
     def _flush_monitor(self):
         if self.monitor is not None:
@@ -616,6 +473,10 @@ class ServingDriver:
                         # wire counters accrue as step programs TRACE, so a
                         # per-step refresh catches late-compiled shapes
                         self.metrics.update_comm_quant(self.engine.comm_wire_info())
+                    self.metrics.update_replica(
+                        self.core.name, self.core.replica_stats(),
+                        role=self.core.role,
+                    )
                     self.metrics.set_gauge("active_requests", len(self._active))
                     if not self._active and not self._queue:
                         self._idle.set()

@@ -139,6 +139,11 @@ class ServingMetrics:
         # via engine.comm_wire_info): tag -> {sites, wire_bytes_int8,
         # wire_bytes_fp, reduction}; trace-time counts per compiled site
         self._comm_wires: Dict[str, Dict[str, float]] = {}
+        # per-replica gauge snapshots (disaggregated serving): name ->
+        # (role, {stat: value}); rendered as replica=/role=-labeled
+        # dstpu_serving_replica_* samples. The unlabeled kv_*/queue/latency
+        # gauges stay the router-level rollup.
+        self._replicas: Dict[str, Tuple[str, Dict[str, float]]] = {}
 
     # -- writers ---------------------------------------------------------
     def inc(self, name: str, delta: float = 1) -> None:
@@ -194,6 +199,25 @@ class ServingMetrics:
                 tag: dict(v) for tag, v in (info.get("wires") or {}).items()
             }
 
+    def update_replica(
+        self, name: str, stats: Dict[str, float], role: str = "both"
+    ) -> None:
+        """Per-replica gauge snapshot (disaggregated serving): KV blocks,
+        resident requests, handoff/decode tallies for ONE engine, labeled
+        ``replica=name`` / ``role=...`` in the exposition. Non-numeric
+        entries are dropped (labels carry the strings)."""
+        clean = {}
+        for k, v in stats.items():
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            clean[k] = v * 1.0
+        with self._lock:
+            self._replicas[name] = (str(role), clean)
+
+    def replica_snapshot(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {name: dict(st) for name, (_role, st) in self._replicas.items()}
+
     def update_prefix_cache(self, stats: Dict[str, float]) -> None:
         """Mirror a ``PrefixCache.stats()`` snapshot. The source counters
         are monotone, so assigning (not incrementing) keeps Prometheus
@@ -235,6 +259,9 @@ class ServingMetrics:
             for tag, w in self._comm_wires.items():
                 out[f"comm_wire_{tag}_reduction"] = w.get("reduction", 0.0)
                 out[f"comm_wire_{tag}_tiles"] = w.get("tiles", 1)
+            for name, (_role, st) in self._replicas.items():
+                for key, value in st.items():
+                    out[f"replica_{name}_{key}"] = value
             return out
 
     def prometheus_text(self) -> str:
@@ -253,6 +280,11 @@ class ServingMetrics:
                 samples.append((f"{p}_comm_wire_bytes_fp", lbl, w.get("wire_bytes_fp", 0), "gauge"))
                 samples.append((f"{p}_comm_wire_reduction", lbl, w.get("reduction", 0.0), "gauge"))
                 samples.append((f"{p}_comm_wire_tiles", lbl, w.get("tiles", 1), "gauge"))
+            for name in sorted(self._replicas):
+                role, st = self._replicas[name]
+                lbl = {"replica": name, "role": role}
+                for key in sorted(st):
+                    samples.append((f"{p}_replica_{key}", lbl, st[key], "gauge"))
             for hname, hist in (
                 ("ttft_seconds", self.ttft),
                 ("tpot_seconds", self.tpot),

@@ -1,0 +1,471 @@
+"""Disaggregated prefill/decode serving tests.
+
+The Router tests mirror test_serving.py's approach: the compute-free
+``FakeEngine`` (real scheduler + allocator + state manager, fake compute)
+exercises admission, placement, KV-block handoff bookkeeping, prefix
+replication, and refcount conservation in milliseconds; the real-engine
+tests prove the acceptance bar — a request prefilled on worker A and
+decoded on replica B streams BIT-IDENTICAL tokens to the single-engine
+``ServingDriver``, greedy and seeded, bf16 and int8 KV.
+"""
+
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.config import KVCacheConfig, StateManagerConfig
+from deepspeed_tpu.inference.v2.ragged_manager import DSStateManager
+from deepspeed_tpu.inference.v2.scheduler import RaggedScheduler
+from deepspeed_tpu.serving import (
+    RequestRejected,
+    Router,
+    SamplingParams,
+    ServingDriver,
+)
+from deepspeed_tpu.serving.request import RequestState
+from tests.unit.test_serving import FakeEngine, _expected_tokens
+
+
+def _cached_fake(prefix_blocks=64, **kw):
+    """FakeEngine with the prefix cache ON (the trie rides the real
+    DSStateManager, so handoff prefix replication is exercised for real)."""
+    eng = FakeEngine(**kw)
+    kv = KVCacheConfig(
+        block_size=eng.config.kv_cache.block_size,
+        num_blocks=eng.config.kv_cache.num_blocks,
+        max_blocks_per_seq=eng.config.kv_cache.max_blocks_per_seq,
+        prefix_cache=True,
+        prefix_cache_blocks=prefix_blocks,
+    )
+    sm = eng.config.state_manager
+    eng.config = SimpleNamespace(kv_cache=kv, state_manager=sm)
+    eng.state_manager = DSStateManager(sm, kv)
+    eng.scheduler = RaggedScheduler(sm, eng.state_manager)
+    return eng
+
+
+def _wait_idle(router, timeout=10):
+    """Wait for in-flight work to clear WITHOUT drain() (drain is terminal:
+    the router rejects submits afterwards, same as the driver)."""
+    deadline = time.monotonic() + timeout
+    while router.num_active or router.queue_depth:
+        assert time.monotonic() < deadline, "router did not go idle"
+        time.sleep(0.002)
+
+
+def _run_all(router, prompts, n_new, timeout=30, **submit_kw):
+    reqs = [
+        router.submit(p, params=SamplingParams(max_new_tokens=n_new,
+                                               ignore_eos=True), **submit_kw)
+        for p in prompts
+    ]
+    for r in reqs:
+        assert r.wait(timeout), f"request {r.uid} did not finish"
+    return reqs
+
+
+class TestPlacement:
+    def test_one_full_one_empty_admits_to_empty(self):
+        """The satellite regression: one replica's pool exhausted, the
+        other empty — admission must consult PER-REPLICA free blocks
+        through the placement policy and land on the empty replica
+        immediately, not stall on (or reject against) the full one."""
+        engines = [
+            FakeEngine(block_size=4, num_blocks=8, max_blocks_per_seq=8,
+                       max_context=64, step_delay=0.004)
+            for _ in range(2)
+        ]
+        router = Router(engines=engines, num_prefill_workers=0).start()
+        try:
+            # A charges the whole first pool: (8 prompt + 24 new) / 4 = 8
+            a = router.submit(np.arange(1, 9, dtype=np.int32),
+                              params=SamplingParams(max_new_tokens=24,
+                                                    ignore_eos=True))
+            a.stream.get(timeout=10)  # A is decoding on its replica
+            full = next(e for e in engines
+                        if e.state_manager.n_tracked_sequences)
+            empty = engines[1 - engines.index(full)]
+            # B needs the full pool too: only the empty replica fits it
+            b = router.submit(np.arange(1, 9, dtype=np.int32),
+                              params=SamplingParams(max_new_tokens=24,
+                                                    ignore_eos=True))
+            b.stream.get(timeout=10)
+            assert not a.is_terminal, "B should admit while A still runs"
+            assert empty.state_manager.n_tracked_sequences == 1
+            assert a.wait(30) and b.wait(30)
+            assert a.generated == _expected_tokens(np.arange(1, 9), 24)
+            assert b.generated == a.generated
+            health = router.health()
+            per_replica_finished = sorted(
+                r["requests_finished_total"] for r in health["replicas"].values()
+            )
+            assert per_replica_finished == [1, 1]
+        finally:
+            router.shutdown()
+        for e in engines:
+            assert e.state_manager.free_blocks == 8
+
+    def test_unknown_placement_rejected(self):
+        with pytest.raises(ValueError, match="unknown placement"):
+            Router(engines=[FakeEngine()], placement="nope")
+
+    def test_round_robin_spreads_load(self):
+        engines = [FakeEngine() for _ in range(3)]
+        router = Router(engines=engines, placement="round_robin").start()
+        try:
+            prompts = [np.asarray([10 * (i + 1)], np.int32) for i in range(6)]
+            _run_all(router, prompts, 4)
+            assert router.drain(10)
+        finally:
+            router.shutdown()
+        assert [e.steps > 0 for e in engines] == [True] * 3
+
+
+class TestDisaggFake:
+    def test_handoff_parity_and_drain(self):
+        """1 prefill worker + 2 decode replicas stream exactly what the
+        single-engine driver streams (FakeEngine is deterministic), every
+        request's KV hands off, and drain leaves all pools full-free."""
+        prompts = [np.arange(1 + 10 * i, 7 + 10 * i, dtype=np.int32)
+                   for i in range(6)]
+        single = ServingDriver(FakeEngine()).start()
+        want = [list(r.generated) for r in _run_all(single, prompts, 12)]
+        single.shutdown()
+
+        engines = [FakeEngine(step_delay=0.001) for _ in range(3)]
+        router = Router(engines=engines, num_prefill_workers=1).start()
+        try:
+            streamed = {}
+
+            def consume(req):
+                streamed[req.uid] = list(req.stream)
+
+            reqs = []
+            threads = []
+            for p in prompts:
+                r = router.submit(p, params=SamplingParams(max_new_tokens=12,
+                                                           ignore_eos=True))
+                t = threading.Thread(target=consume, args=(r,))
+                t.start()
+                reqs.append(r)
+                threads.append(t)
+            for r in reqs:
+                assert r.wait(30)
+            for t in threads:
+                t.join(10)
+            got = [list(r.generated) for r in reqs]
+            assert got == want
+            assert [streamed[r.uid] for r in reqs] == want  # stream == record
+            for r, p in zip(reqs, prompts):
+                assert r.generated == _expected_tokens(p, 12)
+
+            health = router.health()
+            assert health["num_prefill_workers"] == 1
+            assert health["num_decode_replicas"] == 2
+            assert health["kv_handoffs"] == len(prompts)
+            assert health["replicas"]["p0"]["handoffs_out_total"] == len(prompts)
+            din = sum(health["replicas"][d]["handoffs_in_total"]
+                      for d in ("d0", "d1"))
+            assert din == len(prompts)
+            # prefill worker never decodes past the first token
+            assert health["replicas"]["p0"]["requests_finished_total"] == 0
+            assert router.drain(10)
+        finally:
+            router.shutdown()
+        for e in engines:
+            assert e.state_manager.free_blocks == e.config.kv_cache.num_blocks
+            assert not e.scheduler.has_work()
+
+    def test_first_token_stop_never_hands_off(self):
+        """A request whose FIRST token trips eos finishes on the prefill
+        worker — no handoff, no decode-replica residency."""
+        engines = [FakeEngine() for _ in range(2)]
+        router = Router(engines=engines, num_prefill_workers=1,
+                        eos_token_id=11).start()
+        try:
+            r = router.submit(np.asarray([10], np.int32),
+                              params=SamplingParams(max_new_tokens=50))
+            assert r.wait(30)
+            assert r.finish_reason == "eos" and r.generated == [11]
+            assert router.health()["kv_handoffs"] == 0
+        finally:
+            router.shutdown()
+        assert engines[1].steps == 0
+
+    def test_cancel_and_timeout_on_router(self):
+        engines = [FakeEngine(step_delay=0.004) for _ in range(3)]
+        router = Router(engines=engines, num_prefill_workers=1).start()
+        try:
+            r = router.submit(np.asarray([1, 2, 3], np.int32),
+                              params=SamplingParams(max_new_tokens=10000,
+                                                    ignore_eos=True))
+            assert r.stream.get(timeout=10) == 4
+            assert router.cancel(r.uid)
+            assert r.wait(10) and r.state == RequestState.CANCELLED
+            assert not router.cancel(424242)
+
+            t = router.submit(np.asarray([5], np.int32),
+                              params=SamplingParams(max_new_tokens=10000,
+                                                    ignore_eos=True),
+                              timeout_s=0.1)
+            assert t.wait(10) and t.state == RequestState.TIMED_OUT
+        finally:
+            router.shutdown()
+        for e in engines:
+            assert e.state_manager.free_blocks == e.config.kv_cache.num_blocks
+
+    def test_decode_engine_failure_isolated(self):
+        """A decode replica's step failure fails only ITS residents; the
+        other replica and later requests keep streaming."""
+        engines = [FakeEngine() for _ in range(2)]
+        router = Router(engines=engines, num_prefill_workers=0).start()
+        try:
+            engines[0].fail_next = 1
+            engines[1].fail_next = 1
+            r1 = router.submit(np.asarray([1, 2], np.int32),
+                               params=SamplingParams(max_new_tokens=4,
+                                                     ignore_eos=True))
+            assert r1.wait(30) and r1.state == RequestState.FAILED
+            r2 = router.submit(np.asarray([1, 2], np.int32),
+                               params=SamplingParams(max_new_tokens=4,
+                                                     ignore_eos=True))
+            assert r2.wait(30)
+            assert r2.state == RequestState.FINISHED
+            assert r2.generated == [3, 4, 5, 6]
+        finally:
+            router.shutdown()
+        for e in engines:
+            assert e.state_manager.free_blocks == e.config.kv_cache.num_blocks
+
+    def test_submit_rejections(self):
+        engines = [FakeEngine(block_size=4, num_blocks=8, max_blocks_per_seq=2,
+                              max_context=16) for _ in range(2)]
+        router = Router(engines=engines, num_prefill_workers=1, max_queue=1)
+        with pytest.raises(RequestRejected) as ei:
+            router.submit(np.asarray([], np.int32))
+        assert ei.value.reason == "empty_prompt"
+        with pytest.raises(RequestRejected) as ei:
+            router.submit(np.arange(20, dtype=np.int32))
+        assert ei.value.reason == "max_context"
+        with pytest.raises(RequestRejected) as ei:
+            router.submit(np.arange(15, dtype=np.int32))  # > 2 blocks anywhere
+        assert ei.value.reason == "inadmissible"
+        router.submit(np.asarray([1], np.int32))
+        with pytest.raises(RequestRejected) as ei:
+            router.submit(np.asarray([1], np.int32))
+        assert ei.value.reason == "queue_full"
+
+
+class TestHandoffInvariants:
+    def test_prefix_replication_and_refcounts(self):
+        """Handoff of a cached-prefix request: the imported blocks register
+        in the TARGET replica's trie (prefix replication), a second request
+        with the same prompt skips the covered payload copy, shared-block
+        refcounts stay conserved on both engines throughout, and drain
+        leaves exactly the cached blocks held."""
+        prompt = np.arange(1, 18, dtype=np.int32)  # 17 toks, bs=4 -> 4 full
+        engines = [_cached_fake(block_size=4, num_blocks=64,
+                                max_blocks_per_seq=16, step_delay=0.001)
+                   for _ in range(2)]
+        psrc, dtgt = engines
+        router = Router(engines=engines, num_prefill_workers=1).start()
+        try:
+            r1 = _run_all(router, [prompt], 8)[0]
+            assert r1.generated == _expected_tokens(prompt, 8)
+            _wait_idle(router)
+            snap1 = router.metrics.snapshot()
+            assert snap1["kv_handoffs_total"] == 1
+            # full handoff: every source block crossed; the target trie now
+            # holds the full-block prefix of the prompt
+            assert snap1["kv_handoff_blocks_total"] >= 4
+            tgt_cache = dtgt.state_manager.prefix_cache
+            assert tgt_cache.stats()["cached_blocks"] >= 4
+            # conservation on both engines: free + live + cached_only = total
+            for e in engines:
+                acct = e.state_manager.kv_block_accounting()
+                assert acct["free"] + acct["live"] + acct["cached_only"] == acct["total"]
+                assert acct["live"] == 0  # drained
+
+            r2 = _run_all(router, [prompt], 8)[0]
+            assert r2.generated == r1.generated
+            _wait_idle(router)
+            snap2 = router.metrics.snapshot()
+            assert snap2["kv_handoffs_total"] == 2
+            # second import seeds from the target trie: at least the
+            # matchable (n-1)//bs = 4 blocks skip the copy
+            copied_2nd = (snap2["kv_handoff_blocks_copied_total"]
+                          - snap1["kv_handoff_blocks_copied_total"])
+            blocks_2nd = (snap2["kv_handoff_blocks_total"]
+                          - snap1["kv_handoff_blocks_total"])
+            assert copied_2nd <= blocks_2nd - 4
+            # a handed-off block shared with the trie survives the request:
+            # finishing r2 dropped one holder, the cache still holds its ref
+            for e in engines:
+                acct = e.state_manager.kv_block_accounting()
+                assert acct["free"] + acct["live"] + acct["cached_only"] == acct["total"]
+            assert dtgt.state_manager.prefix_cache.stats()["cached_blocks"] >= 4
+        finally:
+            router.shutdown()
+        # clearing the tries returns every block: nothing leaked
+        for e in engines:
+            e.state_manager.prefix_cache.clear()
+            assert e.state_manager.free_blocks == e.config.kv_cache.num_blocks
+
+    def test_import_failure_unwinds_target(self):
+        """Target pool exhausted mid-import: the request fails but the
+        target's allocator stays conserved (the partial seed unwinds)."""
+        from deepspeed_tpu.serving.cluster.handoff import (
+            HandoffError,
+            KVHandoff,
+            import_sequence,
+        )
+
+        tgt = FakeEngine(block_size=4, num_blocks=4, max_blocks_per_seq=16)
+        ho = KVHandoff(uid=0, tokens=list(range(40)), seen_tokens=40,
+                       pending_token=99, n_blocks=10, payload=None)
+        with pytest.raises(HandoffError, match="pool exhausted"):
+            import_sequence(tgt, ho)
+        assert tgt.state_manager.free_blocks == 4
+        assert tgt.state_manager.get_sequence(0) is None
+
+    def test_adopt_requires_materialized_state(self):
+        eng = FakeEngine()
+        with pytest.raises(ValueError, match="no live sequence"):
+            eng.scheduler.adopt(7, 1)
+        seq = eng.state_manager.get_or_create_sequence(7)
+        seq.tokens = [1, 2, 3]
+        seq.seen_tokens = 1  # cursor behind history: not materialized
+        with pytest.raises(ValueError, match="mismatch"):
+            eng.scheduler.adopt(7, 1)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    import jax
+
+    from deepspeed_tpu.models import get_config, init_params
+
+    cfg = get_config("tiny", n_layers=2, dtype="float32", max_seq_len=512)
+    return cfg, init_params(cfg, jax.random.key(0))
+
+
+def _real_engine(tiny_model, kv_dtype):
+    from deepspeed_tpu.inference.config import RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+
+    cfg, params = tiny_model
+    rc = RaggedInferenceEngineConfig.from_dict({
+        "dtype": "float32",
+        "seed": 7,
+        "kv_cache": {"block_size": 16, "num_blocks": 64,
+                     "max_blocks_per_seq": 8, "kv_cache_dtype": kv_dtype},
+        "state_manager": {"max_tracked_sequences": 8,
+                          "max_ragged_batch_size": 128,
+                          "max_ragged_sequence_count": 4,
+                          "max_context": 256},
+    })
+    return InferenceEngineV2(cfg, params, rc)
+
+
+def _stream_parity_roundtrip(tiny_model, kv_dtype):
+    """The acceptance bar: prompts prefilled on worker p0 and decoded on
+    replicas d0/d1 stream bit-identically to the single-engine driver —
+    greedy first, then seeded sampling via set_sampling on the SAME
+    engines (content-addressed keys make uid reuse safe after drain)."""
+    prompts = [np.arange(1 + 3 * i, 25 + 3 * i, dtype=np.int32)
+               for i in range(3)]
+    single = _real_engine(tiny_model, kv_dtype)
+    cluster = [_real_engine(tiny_model, kv_dtype) for _ in range(3)]
+
+    for sampling in ({"greedy": True},
+                     {"greedy": False, "temperature": 0.8, "seed": 123}):
+        for e in [single] + cluster:
+            e.set_sampling(**sampling)
+        drv = ServingDriver(single).start()
+        want = [list(r.generated)
+                for r in _run_all(drv, prompts, 6, timeout=300)]
+        drv.shutdown()
+
+        router = Router(engines=cluster, num_prefill_workers=1).start()
+        try:
+            got = [list(r.generated)
+                   for r in _run_all(router, prompts, 6, timeout=300)]
+            assert got == want, f"disagg streams diverged ({kv_dtype}, {sampling})"
+            assert router.health()["kv_handoffs"] == len(prompts)
+        finally:
+            router.shutdown()
+    for e in [single] + cluster:
+        assert e.state_manager.free_blocks == 64
+
+
+class TestDisaggRealEngine:
+    def test_stream_parity_bf16(self, tiny_model):
+        _stream_parity_roundtrip(tiny_model, "bf16")
+
+    @pytest.mark.slow
+    def test_stream_parity_int8(self, tiny_model):
+        """int8 KV: quantized codes + scale planes cross the handoff
+        bit-exactly (no requantization), so parity still holds."""
+        _stream_parity_roundtrip(tiny_model, "int8")
+
+
+class TestServeCLI:
+    def test_build_serving_stack_router_mode(self, tiny_model):
+        """--num-prefill-workers/--num-decode-replicas build the Router
+        (separate KV pools, shared read-only params); the flag defaults
+        keep the single-engine ServingDriver path."""
+        from deepspeed_tpu.inference.cli import build_serving_stack, serve_parse_args
+
+        cfg, params = tiny_model
+        tok = SimpleNamespace(eos_token_id=None)
+        flags = ["--model", "unused", "--dtype", "float32",
+                 "--block-size", "16", "--num-blocks", "64",
+                 "--max-blocks-per-seq", "8", "--max-context", "256",
+                 "--max-concurrent", "8"]
+        args = serve_parse_args(flags + ["--num-prefill-workers", "1",
+                                         "--num-decode-replicas", "2",
+                                         "--placement", "least_loaded"])
+        front, _ = build_serving_stack(args, cfg=cfg, params=params, tok=tok)
+        assert isinstance(front, Router)
+        h = front.health()
+        assert set(h["replicas"]) == {"p0", "d0", "d1"}
+        assert h["placement"] == "least_loaded"
+
+        args = serve_parse_args(flags)
+        front, _ = build_serving_stack(args, cfg=cfg, params=params, tok=tok)
+        assert isinstance(front, ServingDriver)
+
+
+class TestRouterMetrics:
+    def test_replica_labels_in_prometheus_text(self):
+        engines = [FakeEngine() for _ in range(3)]
+        router = Router(engines=engines, num_prefill_workers=1).start()
+        try:
+            _run_all(router, [np.asarray([5, 6], np.int32)], 4)
+            assert router.drain(10)
+            text = router.metrics.prometheus_text()
+        finally:
+            router.shutdown()
+        for name in ("p0", "d0", "d1"):
+            assert f'replica="{name}"' in text
+        assert 'role="prefill"' in text and 'role="decode"' in text
+        assert "dstpu_serving_replica_kv_free_blocks" in text
+        assert "dstpu_serving_replica_handoffs_out_total" in text
+        snap = router.metrics.snapshot()
+        # router-level rollup sums the per-replica pools
+        assert snap["kv_total_blocks"] == sum(
+            e.config.kv_cache.num_blocks for e in engines)
+
+    def test_driver_health_has_replica_block(self):
+        """The single-engine driver is one degenerate replica: health()
+        carries the same per-replica schema under its own name."""
+        eng = FakeEngine()
+        with ServingDriver(eng) as driver:
+            h = driver.health()
+        assert set(h["replicas"]) == {"replica0"}
+        rep = h["replicas"]["replica0"]
+        assert rep["role"] == "both"
+        assert rep["kv_total_blocks"] == eng.config.kv_cache.num_blocks

@@ -54,7 +54,16 @@ echo "== tiled-overlap parity gate (8-device mesh) =="
 # parity, HLO max-antichain >= tile count (the overlap claim, structurally)
 python -m pytest tests/unit/test_tiled_overlap.py -q -p no:cacheprovider
 
+echo "== disaggregated-serving parity gate (router, 2 replicas) =="
+# 1 prefill worker + 2 decode replicas on CPU must stream BIT-IDENTICAL
+# tokens to the single-engine driver (greedy + seeded, bf16 + int8 KV),
+# KV-block handoff refcounts/prefix replication conserved, drain clean;
+# runs the file unfiltered so the slow-marked int8 combo is included
+python -m pytest tests/unit/test_disagg.py -q -p no:cacheprovider
+
 echo "== donation/recompile verifier (Tier B) =="
+# includes the disagg pass: decode replicas' donated step programs must
+# survive the extracted scheduler + KV-handoff import path
 ./bin/dstpu lint --verify
 
 echo "run_smoke: all gates passed"
