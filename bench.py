@@ -696,6 +696,120 @@ def bench_kv_dtype_ab(cfg=None, params=None, seed=0):
     }
 
 
+def bench_host_tier_ab(cfg=None, params=None, seed=0):
+    """Tiered-KV A/B (riding ``--serving-load`` via the
+    DSTPU_KV_HOST_TIER_BYTES env knob): the SAME hot-prefix workload served
+    twice under a KV pool deliberately sized to evict — once with the host
+    tier off (an evicted prefix re-prefills) and once with it on (the
+    evicted prefix spills to the host store and re-imports through the
+    double-buffered chunked scatter). The sequence is: seed a shared
+    30-block system prompt, then per revisit round flood with long unique
+    prompts until the trie fully evicts it and revisit it; the report
+    compares revisit TTFT across the two runs. The per-step token budget
+    (96) makes the win legible on CPU: a cold revisit needs 6 prefill
+    steps, a readmitted one covers the hot blocks from host memory (two
+    16-block scatter windows) and prefills only the truly-cold tail in one.
+    Token streams must be BIT-identical tier on vs off (the tier moves
+    bytes, never changes them) — any divergence raises. Knobs:
+    DSTPU_KV_HOST_TIER_BYTES (>0 enables), DSTPU_HOST_TIER_FLOODS,
+    DSTPU_HOST_TIER_REVISITS."""
+    from deepspeed_tpu.inference.config import RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.models import TransformerConfig, init_params
+    from deepspeed_tpu.serving.driver import ServingDriver
+    from deepspeed_tpu.serving.request import SamplingParams
+
+    tier_bytes = int(os.environ.get("DSTPU_KV_HOST_TIER_BYTES", 1 << 26))
+    # floods PER revisit round: 3 x 35 blocks overflows the 96-block pool
+    n_floods = int(os.environ.get("DSTPU_HOST_TIER_FLOODS", 3))
+    n_revisits = int(os.environ.get("DSTPU_HOST_TIER_REVISITS", 4))
+    if cfg is None:
+        cfg = TransformerConfig(
+            vocab_size=512, hidden_size=128, n_layers=2, n_heads=4,
+            max_seq_len=1024, dtype="float32",
+        )
+        params = init_params(cfg, jax.random.key(0))
+    rng = np.random.default_rng(seed)
+    block_size = 16
+    hot = rng.integers(0, cfg.vocab_size, size=(480,)).astype(np.int32)  # 30 blocks
+    tails = [rng.integers(0, cfg.vocab_size, size=(24,)).astype(np.int32)
+             for _ in range(n_revisits + 1)]
+    floods = [rng.integers(0, cfg.vocab_size, size=(560,)).astype(np.int32)
+              for _ in range(n_floods * n_revisits)]
+
+    def run(htb):
+        rc = RaggedInferenceEngineConfig.from_dict({
+            "dtype": cfg.dtype,
+            # 96-block pool vs a flood round of n_floods x 35 blocks: every
+            # round overflows the pool, so the trie MUST fully evict the
+            # 30-block hot prefix before each revisit
+            "kv_cache": {"block_size": block_size, "num_blocks": 96,
+                         "max_blocks_per_seq": 40, "prefix_cache": True,
+                         "host_tier_bytes": htb, "host_tier_chunk_blocks": 16},
+            "state_manager": {"max_tracked_sequences": 32,
+                              "max_ragged_batch_size": 96,
+                              "max_ragged_sequence_count": 8,
+                              "max_context": 768},
+        })
+        engine = InferenceEngineV2(cfg, params, rc)
+        driver = ServingDriver(engine, max_queue=64).start()
+        outputs = []
+
+        def go(prompt, max_new=8):
+            r = driver.submit(prompt, params=SamplingParams(
+                max_new_tokens=max_new, ignore_eos=True))
+            r.wait(300)
+            outputs.append(list(r.generated))
+            return r
+
+        go(np.concatenate([hot, tails[0]]))  # seed the hot prefix (+ warmup)
+        revisit_ttfts = []
+        fi = iter(floods)
+        for t in tails[1:]:
+            for _ in range(n_floods):  # evict it (tier on: spill it) ...
+                go(next(fi))
+            r = go(np.concatenate([hot, t]))  # ... then revisit it
+            if r.ttft_s is not None:
+                revisit_ttfts.append(r.ttft_s)
+        tier = engine.host_tier
+        stats = dict(tier.stats()) if tier is not None else None
+        driver.shutdown(drain=True, timeout=60)
+        return {
+            "ttft_revisit_mean_s": (float(np.mean(revisit_ttfts))
+                                    if revisit_ttfts else None),
+            "outputs": outputs,
+            "tier": stats,
+        }
+
+    base = run(0)
+    tiered = run(tier_bytes)
+    if base["outputs"] != tiered["outputs"]:
+        raise RuntimeError(
+            "host-tier A/B streams diverged: the tier must be bit-invisible "
+            "(spill/readmit moves bytes, never changes them)"
+        )
+    st = tiered["tier"] or {}
+    if not st.get("spills") or not st.get("readmits"):
+        raise RuntimeError(
+            f"host-tier A/B measured nothing: spills={st.get('spills')} "
+            f"readmits={st.get('readmits')} — the pool never evicted the hot "
+            "prefix, resize the workload"
+        )
+    off_t, on_t = base["ttft_revisit_mean_s"], tiered["ttft_revisit_mean_s"]
+    return {
+        "tier_bytes": tier_bytes,
+        "ttft_revisit_off_s": round(off_t, 4) if off_t is not None else None,
+        "ttft_revisit_on_s": round(on_t, 4) if on_t is not None else None,
+        "ttft_speedup": (round(off_t / on_t, 3)
+                         if off_t and on_t else None),
+        "spills": int(st.get("spills", 0)),
+        "readmits": int(st.get("readmits", 0)),
+        "host_tier_hits": int(st.get("hits", 0)),
+        "host_bytes_peak": int(st.get("bytes", 0)),
+        "outputs_bit_identical": True,
+    }
+
+
 def bench_comm_quant_ab(cfg=None, params=None, seed=0):
     """Quantized-collectives A/B (riding ``--serving-load`` via the
     DSTPU_COMM_QUANT=int8 env knob): the SAME TP-decode workload served
@@ -1125,6 +1239,12 @@ def bench_serving_load(
     kv_report = {}
     if os.environ.get("DSTPU_KV_DTYPE", "") == "int8":
         kv_report = {"kv_int8": bench_kv_dtype_ab(seed=seed)}
+    # tiered-KV host-store rider: DSTPU_KV_HOST_TIER_BYTES>0 appends an
+    # evict→spill→readmit revisit-TTFT comparison vs plain re-prefill
+    # under an eviction-forcing pool (streams must stay bit-identical)
+    ht_report = {}
+    if int(os.environ.get("DSTPU_KV_HOST_TIER_BYTES", "0") or 0) > 0:
+        ht_report = {"kv_host_tier": bench_host_tier_ab(seed=seed)}
     # quantized-collectives A/B rider: DSTPU_COMM_QUANT=int8 appends a
     # TP-decode tok/s + per-wire byte-reduction comparison vs full width
     cq_report = {}
@@ -1161,6 +1281,7 @@ def bench_serving_load(
         **prefix_report,
         **spec_report,
         **kv_report,
+        **ht_report,
         **cq_report,
         **co_report,
         **disagg_report,

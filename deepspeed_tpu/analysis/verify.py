@@ -24,6 +24,8 @@ Entry points covered (the compiled hot paths every perf PR leans on):
   * tiled-overlap variants (``comm_overlap="tiled"``): tp2 decode through
     the per-tile ppermute rings, ZeRO-3 train step through tiled
     prefetch-bucket all-gathers
+  * tiered-KV readmit (``import_kv_blocks_chunked``): the double-buffered
+    host→HBM window scatter, bf16 and int8 pools
 
 Run via ``dstpu lint --verify`` (wired into tools/run_smoke.sh).
 """
@@ -40,6 +42,7 @@ __all__ = [
     "run_verify",
     "verify_disagg",
     "verify_engine_v2",
+    "verify_host_tier",
     "verify_quantized_comm",
     "verify_ring_train",
     "verify_streamed_adam",
@@ -261,7 +264,8 @@ def _capture_builder(obj, attr: str, store: dict, key: str):
     setattr(obj, attr, build)
 
 
-def _tiny_v2_engine(decode_steps: int = 2, kv_dtype: str = "bf16"):
+def _tiny_v2_engine(decode_steps: int = 2, kv_dtype: str = "bf16",
+                    kv_extra: Optional[dict] = None):
     import jax
 
     from deepspeed_tpu.inference.config import RaggedInferenceEngineConfig
@@ -270,11 +274,13 @@ def _tiny_v2_engine(decode_steps: int = 2, kv_dtype: str = "bf16"):
 
     cfg = get_config("tiny", n_layers=2, dtype="float32", max_seq_len=512)
     params = init_params(cfg, jax.random.key(0))
+    kv = {"block_size": 4, "num_blocks": 128, "max_blocks_per_seq": 32,
+          "kv_cache_dtype": kv_dtype}
+    kv.update(kv_extra or {})
     rc = RaggedInferenceEngineConfig.from_dict({
         "dtype": "float32",
         "decode_steps": decode_steps,
-        "kv_cache": {"block_size": 4, "num_blocks": 128, "max_blocks_per_seq": 32,
-                     "kv_cache_dtype": kv_dtype},
+        "kv_cache": kv,
         "state_manager": {"max_tracked_sequences": 16,
                           "max_ragged_batch_size": 256,
                           "max_ragged_sequence_count": 4, "max_context": 256},
@@ -822,6 +828,47 @@ def verify_disagg() -> List[CheckResult]:
     return results
 
 
+def verify_host_tier() -> List[CheckResult]:
+    """Tiered-KV re-import (``engine_v2.import_kv_blocks_chunked``): the
+    double-buffered window scatter must keep the pool donated (a lost alias
+    copies the full paged pool once per window, per readmitted prefix) and
+    must compile exactly once per plane family — the tail window pads its
+    index vector with the trash row and zero-fills values precisely so the
+    shapes never vary. bf16 pools scatter one (payload) shape; int8 pools
+    add the fp32 scale-plane shape, so their steady state is two cache
+    entries, not one."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    results: List[CheckResult] = []
+    for kv_dtype, max_traces in (("bf16", 1), ("int8", 2)):
+        tag = "" if kv_dtype == "bf16" else f"[{kv_dtype}]"
+        label = f"engine_v2.kv_readmit{tag}"
+        _, eng = _tiny_v2_engine(kv_dtype=kv_dtype, kv_extra={
+            "prefix_cache": True,
+            "host_tier_bytes": 1 << 20,
+            "host_tier_chunk_blocks": 2,
+        })
+        blocks = [1, 2, 3, 4, 5]  # 5 blocks @ chunk 2 -> 3 windows, padded tail
+        payload = eng.export_kv_blocks(blocks)
+        # two identical chunked imports: pass 1 traces, pass 2 must hit the
+        # cache — any growth is a per-window recompile on the readmit path
+        eng.import_kv_blocks_chunked(blocks, payload, chunk_blocks=2)
+        eng.import_kv_blocks_chunked(blocks, payload, chunk_blocks=2)
+        fn = eng._kv_readmit_jit
+        if fn is None:
+            results.append(CheckResult(
+                label, "donation", False,
+                "chunked import never built the readmit scatter"))
+            continue
+        pool = eng._k_cache
+        vals = jnp.zeros((pool.shape[0], 2) + tuple(pool.shape[2:]), pool.dtype)
+        results.append(check_donation(
+            label, fn, (pool, jnp.zeros((2,), jnp.int32), vals)))
+        results.append(check_recompile(label, fn, max_traces=max_traces))
+    return results
+
+
 # ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
@@ -837,6 +884,7 @@ def run_verify(verbose: bool = True) -> Tuple[List[CheckResult], bool]:
         (verify_quantized_comm, "quantized_comm"),
         (verify_tiled_overlap, "tiled_overlap"),
         (verify_disagg, "disagg"),
+        (verify_host_tier, "host_tier"),
     ):
         try:
             results.extend(fn())

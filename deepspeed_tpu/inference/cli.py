@@ -237,6 +237,13 @@ def serve_parse_args(argv=None):
                    "and skip their prefill)")
     p.add_argument("--prefix-cache-blocks", type=int, default=0,
                    help="cap on trie-held KV blocks (0 = bounded by pool)")
+    p.add_argument("--kv-host-tier-bytes", type=int, default=0,
+                   help="host-memory KV tier budget in bytes (0 = off): "
+                   "trie-evicted idle blocks spill to a host LRU store and "
+                   "re-import through a double-buffered scatter instead of "
+                   "re-prefilling; int8 pools pack ~2x the blocks per byte")
+    p.add_argument("--kv-host-tier-chunk-blocks", type=int, default=8,
+                   help="blocks per double-buffered re-import window")
     p.add_argument("--sample", action="store_true")
     p.add_argument("--temperature", type=float, default=1.0)
     p.add_argument("--top-k", type=int, default=0)
@@ -299,6 +306,10 @@ def build_serving_stack(args, cfg=None, params=None, tok=None):
             "prefix_cache": not getattr(args, "no_prefix_cache", False),
             "prefix_cache_blocks": getattr(args, "prefix_cache_blocks", 0),
             "kv_cache_dtype": kv_dtype,
+            "host_tier_bytes": getattr(args, "kv_host_tier_bytes", 0),
+            "host_tier_chunk_blocks": getattr(
+                args, "kv_host_tier_chunk_blocks", 8
+            ),
         },
         "state_manager": {
             "max_tracked_sequences": args.max_concurrent,

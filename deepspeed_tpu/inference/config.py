@@ -82,6 +82,18 @@ class KVCacheConfig(DSConfigModel):
     # blocks (admission / prefix-cache capacity) at a fixed byte budget.
     # Dequantization happens inside the attention read (in-kernel on TPU).
     kv_cache_dtype: str = "bf16"
+    # host-memory block tier behind the prefix trie (host_tier.py): > 0
+    # bounds a pinned-host LRU of evicted prefix blocks at this many
+    # bytes; trie misses that hit the tier re-import through the donated
+    # KV scatter instead of re-prefilling. 0 disables. Requires
+    # prefix_cache; payloads are stored as exported, so an int8 pool's
+    # tier holds ~2x the blocks per byte. Outputs are bit-identical
+    # tier on vs off.
+    host_tier_bytes: int = 0
+    # blocks per window of the double-buffered chunked re-import
+    # (engine_v2.import_kv_blocks_chunked); one fixed window shape keeps
+    # the donated scatter at zero steady-state recompiles
+    host_tier_chunk_blocks: int = 8
 
 
 @dataclass

@@ -64,15 +64,25 @@ class BlockedAllocator:
         """Ids of all blocks with at least one holder (sorted)."""
         return np.flatnonzero(self._refcount > 0).astype(np.int64)
 
+    def idle_mask(self, blocks) -> np.ndarray:
+        """Boolean mask of blocks with EXACTLY one holder — the prefix
+        cache's spill/evict candidate test, vectorized (the host tier
+        makes eviction a hot path; a per-block ``refcount()`` loop over
+        the cached set is O(cached) Python calls per eviction)."""
+        return self._refcount[np.atleast_1d(np.asarray(blocks, np.int64))] == 1
+
     def stats(self) -> dict:
         """Pool occupancy counters for health/metrics surfaces: ``held`` is
         blocks with at least one holder, ``shared`` the subset with more
-        than one (prefix-cache + live-sequence overlap)."""
+        than one (prefix-cache + live-sequence overlap), ``idle`` the
+        single-holder subset (with a prefix cache live these are the
+        evict-and-spill candidates: cache-only KV no sequence shares)."""
         return {
             "total": self._num_blocks,
             "free": int(self._top),
             "held": int(np.count_nonzero(self._refcount > 0)),
             "shared": int(np.count_nonzero(self._refcount > 1)),
+            "idle": int(np.count_nonzero(self._refcount == 1)),
         }
 
     def _validate(self, blocks: np.ndarray, op: str) -> None:

@@ -261,6 +261,27 @@ class InferenceEngineV2:
         self._multistep_n = 0
         self._verify_jit = {}  # k -> compiled speculative verify step
         self._kv_scatter_jit = None  # handoff import: donated pool scatter
+        # chunked re-import: ONE fixed window shape (tail padded into the
+        # trash row) so the donated scatter never recompiles in steady state
+        self._kv_readmit_jit = None
+        # --- host block tier (host_tier.py, ROADMAP item 3): LRU-evicted
+        # prefix-trie blocks demote their KV to a byte-budgeted host store
+        # instead of vanishing; a trie miss the store covers re-imports
+        # through the donated scatter instead of re-prefilling. Outputs are
+        # bit-identical tier on vs off.
+        self._host_tier = None
+        htb = int(getattr(kv, "host_tier_bytes", 0) or 0)
+        if htb > 0:
+            if self.state_manager.prefix_cache is None:
+                raise ValueError(
+                    "kv_cache.host_tier_bytes requires kv_cache.prefix_cache: "
+                    "the host tier spills and readmits through the prefix trie"
+                )
+            from deepspeed_tpu.inference.v2.host_tier import HostBlockStore
+
+            self._host_tier = HostBlockStore(htb)
+            self.state_manager.prefix_cache.spill_fn = self._spill_block
+            self.state_manager.host_readmit = self._host_readmit
         self._spec_rr = 0  # rotation cursor for budget-capped spec rounds
         self.last_spec = {"drafted": 0, "accepted": 0, "per_uid": {}}
         self.last_scheduled_tokens = 0
@@ -278,7 +299,8 @@ class InferenceEngineV2:
             + (f", tp={self._tp}" if self._tp > 1 else "")
             + (", comm_quant=int8" if self._tp_quant else "")
             + (f", comm_overlap=tiled({self._overlap_tiles})" if self._tp_tiled else "")
-            + (", prefix_cache=on" if self.state_manager.prefix_cache is not None else ""),
+            + (", prefix_cache=on" if self.state_manager.prefix_cache is not None else "")
+            + (f", host_tier={htb}B" if self._host_tier is not None else ""),
             ranks=[0],
         )
 
@@ -360,6 +382,45 @@ class InferenceEngineV2:
             out["v_scale"] = np.asarray(self._vs_cache[:, idx])
         return out
 
+    def _kv_pool_planes(self) -> Dict[str, "jnp.ndarray"]:
+        planes = {"k": self._k_cache, "v": self._v_cache}
+        if self._kv_int8:
+            planes["k_scale"] = self._ks_cache
+            planes["v_scale"] = self._vs_cache
+        return planes
+
+    def _check_kv_payload(self, n: int, payload: Dict[str, np.ndarray]) -> None:
+        """Raise loudly on any payload/pool mismatch BEFORE scattering: a
+        malformed payload (wrong dtype, wrong trailing dims, missing or
+        stray scale planes) must never silently cast-and-scatter garbage
+        into live KV."""
+        pools = self._kv_pool_planes()
+        missing = sorted(set(pools) - set(payload))
+        extra = sorted(set(payload) - set(pools))
+        if missing or extra:
+            raise ValueError(
+                f"import_kv_blocks: payload planes {sorted(payload)} do not "
+                f"match the {self._kv_dtype} pool's {sorted(pools)}"
+                + (f"; missing {missing}" if missing else "")
+                + (f"; unexpected {extra}" if extra else "")
+            )
+        for name, pool in pools.items():
+            plane = payload[name]
+            expect = (pool.shape[0], n) + tuple(pool.shape[2:])
+            if tuple(plane.shape) != expect:
+                raise ValueError(
+                    f"import_kv_blocks: payload[{name!r}] shape "
+                    f"{tuple(plane.shape)} != {expect} expected for {n} "
+                    f"target blocks"
+                )
+            if np.dtype(plane.dtype) != np.dtype(pool.dtype):
+                raise ValueError(
+                    f"import_kv_blocks: payload[{name!r}] dtype "
+                    f"{np.dtype(plane.dtype)} != pool dtype "
+                    f"{np.dtype(pool.dtype)} (a silent cast would corrupt "
+                    "quantized codes/scales)"
+                )
+
     def import_kv_blocks(self, block_ids, payload: Dict[str, np.ndarray]) -> None:
         """Scatter an exported payload into THIS pool at ``block_ids`` (the
         importer's freshly allocated table slots — ids need not match the
@@ -369,12 +430,7 @@ class InferenceEngineV2:
         n = len(block_ids)
         if n == 0:
             return
-        for name, plane in payload.items():
-            if plane.shape[1] != n:
-                raise ValueError(
-                    f"import_kv_blocks: payload[{name!r}] carries "
-                    f"{plane.shape[1]} blocks for {n} target slots"
-                )
+        self._check_kv_payload(n, payload)
         if self._kv_scatter_jit is None:
             self._kv_scatter_jit = jax.jit(
                 lambda pool, idx, vals: pool.at[:, idx].set(vals),
@@ -391,6 +447,143 @@ class InferenceEngineV2:
                 self._ks_cache, idx, jnp.asarray(payload["k_scale"], jnp.float32))
             self._vs_cache = scatter(
                 self._vs_cache, idx, jnp.asarray(payload["v_scale"], jnp.float32))
+
+    def import_kv_blocks_chunked(self, block_ids, payload: Dict[str, np.ndarray],
+                                 chunk_blocks: int = 0) -> None:
+        """``import_kv_blocks`` in fixed-size double-buffered windows — the
+        streamed-AdamW pattern (runtime/zero/streamed_adam.py) applied to
+        the host→HBM re-import: window w+1's host→device transfer is
+        issued (async ``device_put``) before window w's donated scatter is
+        consumed, so the PCIe copy overlaps the scatter already in flight
+        and the step loop never stalls on one bulk transfer.
+
+        Every window has the SAME shape: the tail window's index vector is
+        padded with the pool's trash row (``num_blocks``, the +1 row
+        padded prefill tokens already scatter into) and its values
+        zero-padded, so the donated scatter compiles exactly once per
+        plane family — zero steady-state recompiles (Tier-B
+        ``verify_host_tier`` pins this). Same locking contract as
+        ``import_kv_blocks``."""
+        n = len(block_ids)
+        if n == 0:
+            return
+        self._check_kv_payload(n, payload)
+        kv = self.config.kv_cache
+        chunk = int(chunk_blocks) or int(
+            getattr(kv, "host_tier_chunk_blocks", 8) or 8)
+        if n <= chunk and chunk_blocks == 0:
+            # small imports reuse the handoff scatter: no window win below
+            # one chunk, and the shapes stay off the readmit jit's cache
+            return self.import_kv_blocks(block_ids, payload)
+        trash = kv.num_blocks  # the +1 trash row: pad writes land there
+        n_win = -(-n // chunk)
+        idx_host = np.full(n_win * chunk, trash, np.int32)
+        idx_host[:n] = np.asarray(list(block_ids), np.int32)
+        if self._kv_readmit_jit is None:
+            self._kv_readmit_jit = jax.jit(
+                lambda pool, idx, vals: pool.at[:, idx].set(vals),
+                donate_argnums=(0,),
+            )
+        scatter = self._kv_readmit_jit
+        names = sorted(payload)
+        attrs = {"k": "_k_cache", "v": "_v_cache",
+                 "k_scale": "_ks_cache", "v_scale": "_vs_cache"}
+
+        def _stage(w: int):
+            """Issue window w's host→device copies (async)."""
+            lo, hi = w * chunk, (w + 1) * chunk
+            idx = jnp.asarray(idx_host[lo:hi])
+            vals = {}
+            for name in names:
+                v = payload[name][:, lo:min(hi, n)]
+                if v.shape[1] < chunk:  # tail: zero-fill the trash columns
+                    pad = [(0, 0)] * v.ndim
+                    pad[1] = (0, chunk - v.shape[1])
+                    v = np.pad(v, pad)
+                vals[name] = jax.device_put(v)
+            return idx, vals
+
+        staged = _stage(0)
+        for w in range(n_win):
+            # double buffer: stage w+1's transfer BEFORE consuming w, so
+            # the copy rides behind the in-flight donated scatter
+            nxt = _stage(w + 1) if w + 1 < n_win else None
+            idx, vals = staged
+            for name in names:
+                attr = attrs[name]
+                setattr(self, attr, scatter(getattr(self, attr), idx, vals[name]))
+            staged = nxt
+
+    # -- host block tier (HBM → host → peer, host_tier.py) -----------------
+    @property
+    def host_tier(self):
+        """The host-memory block tier (None when kv_cache.host_tier_bytes
+        is 0). Spill/readmit hooks are wired at construction; peers (the
+        router's PrefixDirectory pull) inject entries directly."""
+        return self._host_tier
+
+    def _spill_block(self, hkey: bytes, block: int) -> None:
+        """Prefix-trie eviction hook: demote one idle cached block's KV to
+        the host tier before its pool row returns to the free list. Runs
+        under the engine's step serialization (eviction happens inside
+        extend/insert); failures degrade to a re-prefill, never a stall."""
+        store = self._host_tier
+        if store is None:
+            return
+        payload = self.export_kv_blocks([block])
+        store.put(hkey, {name: plane[:, 0] for name, plane in payload.items()})
+
+    def _host_readmit(self, seq, prompt_tokens, n_cached: int) -> int:
+        """``seed_from_cache`` continuation: after the trie covered
+        ``n_cached`` prompt tokens, cover the next contiguous run of FULL
+        blocks from the host tier — allocate fresh pool blocks, re-import
+        the stored payloads through the chunked donated scatter, and
+        register the readmitted prefix back into the trie. Returns the new
+        cached-token count; prefill then charges only the truly-cold tail
+        (the scheduler's chunk budget never sees readmitted tokens)."""
+        store = self._host_tier
+        cache = self.state_manager.prefix_cache
+        if store is None or cache is None or len(store) == 0:
+            return n_cached
+        from deepspeed_tpu.inference.v2.host_tier import chain_hashes
+
+        toks = np.asarray(prompt_tokens).reshape(-1)
+        bs = cache.block_size
+        matchable = cache._matchable_blocks(len(toks))
+        start = n_cached // bs
+        if start >= matchable:
+            return n_cached
+        keys = chain_hashes(toks, bs, matchable)
+        run = store.match(keys, start)
+        if run == 0:
+            return n_cached
+        # fetch payloads BEFORE allocating: the extend() below may evict →
+        # spill → LRU-drop matched store entries; holding the dicts keeps
+        # the arrays alive regardless
+        payloads = []
+        for key in keys[start : start + run]:
+            entry = store.get(key)
+            if entry is None:  # pragma: no cover — single-threaded store
+                break
+            payloads.append(entry)
+        run = len(payloads)
+        if run == 0:
+            return n_cached
+        mgr = self.state_manager
+        if not mgr.extend(seq, run * bs):
+            return n_cached  # pool too tight even after eviction: re-prefill
+        fresh = seq.block_table[start:]
+        stacked = {
+            name: np.stack([p[name] for p in payloads], axis=1)
+            for name in payloads[0]
+        }
+        self.import_kv_blocks_chunked(fresh, stacked)
+        seq.seen_tokens = n_cached + run * bs
+        store.note_readmits(run)
+        # re-register the readmitted prefix: the trie takes its own
+        # reference per block, so the KV outlives this sequence again
+        cache.insert(toks[: seq.seen_tokens], seq.block_table)
+        return seq.seen_tokens
 
     def set_sampling(self, greedy=None, temperature=None, top_k=None,
                      top_p=None, seed=None):

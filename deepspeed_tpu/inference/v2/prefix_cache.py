@@ -31,18 +31,26 @@ a shared block is never appended to in place — a prompt's partial tail
 block is always recomputed into the sequence's own fresh block. And a full
 prompt hit is capped at ``len(prompt) - 1`` tokens: the engine must still
 prefill at least one token to produce next-token logits.
+
+Tiering: every node carries the chain hash of its block-aligned prefix
+(``host_tier.block_hash``), and an optional ``spill_fn`` hook fires on
+eviction while the victim's pool rows are still valid — the engine wires
+it to the host tier so evicted KV demotes to host memory instead of
+vanishing (see ``host_tier.py``).
 """
 
+import heapq
 import itertools
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from deepspeed_tpu.inference.v2.blocked_allocator import BlockedAllocator
+from deepspeed_tpu.inference.v2.host_tier import block_hash
 
 
 class _Node:
-    __slots__ = ("key", "parent", "children", "block", "last_used")
+    __slots__ = ("key", "parent", "children", "block", "last_used", "hkey")
 
     def __init__(self, key: Tuple[int, ...], parent: Optional["_Node"], block: int):
         self.key = key  # token tuple of THIS block
@@ -50,6 +58,9 @@ class _Node:
         self.children: Dict[Tuple[int, ...], "_Node"] = {}
         self.block = block
         self.last_used = 0
+        # chain hash of the block-aligned prefix ending here — the block's
+        # cluster-wide identity (host tier key, PrefixDirectory advert)
+        self.hkey = block_hash(parent.hkey, key) if parent is not None else b""
 
 
 class PrefixCache:
@@ -67,6 +78,12 @@ class PrefixCache:
         self._root = _Node((), None, -1)
         self._by_block: Dict[int, _Node] = {}
         self._clock = itertools.count(1)
+        # optional spill hook: called as spill_fn(chain_hash, block_id)
+        # inside _drop BEFORE the block returns to the free list (its pool
+        # rows are still valid KV). The engine wires this to the host tier
+        # (engine_v2._spill_block); it must swallow its own failures — a
+        # missed spill degrades to a re-prefill, never a stalled evict.
+        self.spill_fn: Optional[Callable[[bytes, int], None]] = None
         # counters surfaced through stats() -> serving metrics
         self.queries = 0
         self.hits = 0
@@ -170,29 +187,50 @@ class PrefixCache:
 
     # -- eviction ---------------------------------------------------------
     def _evictable_leaves(self) -> List[_Node]:
-        return [
-            n for n in self._by_block.values()
-            if not n.children and self._alloc.refcount(n.block) == 1
-        ]
+        nodes = list(self._by_block.values())
+        if not nodes:
+            return []
+        idle = self._alloc.idle_mask([n.block for n in nodes])
+        return [n for n, i in zip(nodes, idle) if i and not n.children]
 
     def evict(self, n_blocks: int) -> int:
         """Free up to ``n_blocks`` cached blocks, LRU leaves first (a
         parent freed before its child would orphan reachable KV; once a
         leaf goes, its parent becomes the next candidate). Only blocks
         whose sole holder is the cache are touched — anything a live
-        sequence shares stays. Returns the number actually freed."""
+        sequence shares stays. Returns the number actually freed.
+
+        The candidate set is computed ONCE per call (vectorized idle
+        mask) and maintained as a heap — parents promoted as their last
+        child drops — so a mass eviction is O(E log C) instead of the
+        O(E·C) rescan-per-block the host tier's spill path can't afford.
+        Refcounts cannot change underneath the heap: eviction runs under
+        the engine's step serialization, and dropping a victim never
+        alters another candidate's holder count."""
+        if not self._by_block:
+            return 0
+        heap = [(n.last_used, n.block) for n in self._evictable_leaves()]
+        heapq.heapify(heap)
         freed = 0
-        while freed < n_blocks:
-            leaves = self._evictable_leaves()
-            if not leaves:
-                break
-            victim = min(leaves, key=lambda n: n.last_used)
-            self._drop(victim)
+        while freed < n_blocks and heap:
+            _, block = heapq.heappop(heap)
+            node = self._by_block.get(block)
+            if node is None or node.children:
+                continue  # stale heap entry
+            parent = node.parent
+            self._drop(node)
             freed += 1
+            if (parent is not self._root and not parent.children
+                    and self._alloc.refcount(parent.block) == 1):
+                heapq.heappush(heap, (parent.last_used, parent.block))
         self.evictions += freed
         return freed
 
     def _drop(self, node: _Node) -> None:
+        if self.spill_fn is not None:
+            # spill BEFORE free: once the block is back on the free list a
+            # later allocation may overwrite its pool rows
+            self.spill_fn(node.hkey, node.block)
         del node.parent.children[node.key]
         del self._by_block[node.block]
         self._alloc.free([node.block])
@@ -210,11 +248,23 @@ class PrefixCache:
         self._root = _Node((), None, -1)
         return dropped
 
+    def prefix_hashes(self) -> set:
+        """Chain hashes of every cached block (device-tier half of a
+        replica's PrefixDirectory advertisement)."""
+        return {n.hkey for n in self._by_block.values()}
+
+    def blocks_by_hash(self) -> Dict[bytes, int]:
+        """chain hash → physical block id for every cached block — the
+        peer-pull exporter's lookup (a peer asks for prefixes by hash,
+        the exporter gathers pool rows by block id)."""
+        return {n.hkey: n.block for n in self._by_block.values()}
+
     # -- reporting --------------------------------------------------------
     def stats(self) -> Dict[str, float]:
         cached = len(self._by_block)
-        idle = sum(1 for n in self._by_block.values()
-                   if self._alloc.refcount(n.block) == 1)
+        idle = (int(np.count_nonzero(
+            self._alloc.idle_mask(list(self._by_block))))
+            if self._by_block else 0)
         return {
             "cached_blocks": cached,
             "cached_blocks_idle": idle,

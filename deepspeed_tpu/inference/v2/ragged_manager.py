@@ -47,6 +47,11 @@ class DSStateManager:
             if getattr(kv_config, "prefix_cache", False)
             else None
         )
+        # host-tier readmit hook (engine_v2._host_readmit): called by
+        # seed_from_cache as fn(seq, prompt_tokens, n_cached) -> n_cached'
+        # to extend trie coverage with re-imported host-tier blocks. None
+        # when the host tier is off; the manager stays engine-agnostic.
+        self.host_readmit = None
 
     # -- reference API --------------------------------------------------------
     @property
@@ -122,13 +127,22 @@ class DSStateManager:
         the trie (taking one reference per block for this sequence).
         Returns the number of prompt tokens whose KV is already in the
         pool — prefill starts there. No-op (0) without a cache or for a
-        non-fresh sequence."""
+        non-fresh sequence.
+
+        With a host tier live, the trie match is then extended through
+        ``host_readmit``: the next contiguous run of full blocks resident
+        in the host store is re-imported into freshly allocated pool
+        blocks (double-buffered chunked scatter) and counted as cached —
+        so downstream prefill charging (the scheduler's chunk budget)
+        sees only the truly-cold tail."""
         if self.prefix_cache is None or seq.seen_tokens or seq.block_table:
             return 0
         blocks, n_tokens = self.prefix_cache.acquire(prompt_tokens)
         if n_tokens:
             seq.block_table.extend(int(b) for b in blocks)
             seq.seen_tokens = n_tokens
+        if self.host_readmit is not None:
+            n_tokens = self.host_readmit(seq, prompt_tokens, n_tokens)
         return n_tokens
 
     def cache_prefill_blocks(self, seq: DSSequenceDescriptor, upto_tokens: int) -> int:

@@ -100,6 +100,9 @@ class RaggedScheduler:
             # Prefix-cache consult (no-op when the cache is off): a hit
             # seeds the block table with shared, already-populated blocks
             # and prefill starts at the first uncached block boundary.
+            # With a host tier, the seed also covers host-resident blocks
+            # (re-imported, not recomputed), so the chunk budget below is
+            # charged only for the truly-cold tail of the prompt.
             n_cached = seed(seq, toks)
             if n_cached:
                 toks = toks[n_cached:]

@@ -97,6 +97,52 @@ class EngineCore:
     def prefix_cache(self):
         return getattr(getattr(self.engine, "state_manager", None), "prefix_cache", None)
 
+    def host_tier(self):
+        """The engine's host-memory block tier (None when disabled or the
+        engine is a fake without one)."""
+        return getattr(self.engine, "host_tier", None)
+
+    # -- tiered prefix store (PrefixDirectory bridge) ---------------------
+    def prefix_hashes(self) -> set:
+        """Chain hashes this replica can seed a prefix from — device trie
+        ∪ host tier — i.e. its PrefixDirectory advertisement. Caller holds
+        ``step_lock`` (the trie mutates under stepping)."""
+        out = set()
+        cache = self.prefix_cache()
+        if cache is not None and hasattr(cache, "prefix_hashes"):
+            out |= cache.prefix_hashes()
+        tier = self.host_tier()
+        if tier is not None:
+            out |= set(tier.keys())
+        return out
+
+    def prefix_chain(self, tokens) -> list:
+        """Chain hashes of the full prompt blocks a seed could cover
+        (capped one token short: prefill must still produce next-token
+        logits). Empty without a prefix cache."""
+        cache = self.prefix_cache()
+        if cache is None or not hasattr(cache, "_matchable_blocks"):
+            return []
+        from deepspeed_tpu.inference.v2.host_tier import chain_hashes
+
+        toks = list(tokens)
+        return chain_hashes(toks, cache.block_size,
+                            cache._matchable_blocks(len(toks)))
+
+    def prefix_coverage(self, keys) -> int:
+        """Contiguous run from the start of ``keys`` this replica holds
+        (device or host tier). Pure probe — no refs, no LRU touches —
+        used by placement affinity and the router's peer-pull planner."""
+        if not keys:
+            return 0
+        held = self.prefix_hashes()
+        n = 0
+        for key in keys:
+            if key not in held:
+                break
+            n += 1
+        return n
+
     def _inc(self, name: str, delta: float = 1) -> None:
         if self.metrics is not None:
             self.metrics.inc(name, delta)
@@ -333,4 +379,9 @@ class EngineCore:
         alloc_stats = getattr(self.engine.state_manager, "alloc_stats", None)
         if alloc_stats is not None:
             stats["kv_blocks_shared"] = alloc_stats()["shared"]
+        tier = self.host_tier()
+        if tier is not None:
+            t = tier.stats()
+            stats["kv_host_tier_bytes"] = t["bytes"]
+            stats["kv_host_tier_blocks"] = t["blocks"]
         return stats

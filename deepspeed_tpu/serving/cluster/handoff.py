@@ -14,7 +14,12 @@ Prefix replication rides the same path: the importer first seeds from the
 TARGET replica's token-block trie (a hit skips the payload copy for the
 covered blocks entirely), then registers the imported prefix into that
 trie — so a hot system prompt lands in every replica's cache after its
-first handoff there and subsequent requests hit locally.
+first handoff there and subsequent requests hit locally. With a host
+tier live, the seed ALSO covers blocks resident in the target's host
+store (including blocks the router's PrefixDirectory pulled from a
+peer): those re-import through the double-buffered chunked scatter
+instead of riding the handoff payload — the uncovered tail is all the
+wire ever carries.
 
 Bit-identity: the payload copy is bitwise, and sampling is
 content-addressed by (seed, uid, position) — so a sequence prefilled on
@@ -89,10 +94,16 @@ def import_sequence(engine, handoff: KVHandoff) -> int:
         seq.tokens = list(handoff.tokens)
         seq.seen_tokens = int(handoff.seen_tokens)
         fresh = [int(b) for b in seq.block_table[n_cached:]]
-        importer = getattr(engine, "import_kv_blocks", None)
+        # prefer the double-buffered chunked scatter (large handoffs overlap
+        # device_put with the scatter; small ones fall through to the plain
+        # import inside it)
+        importer = getattr(engine, "import_kv_blocks_chunked", None)
+        if importer is None:
+            importer = getattr(engine, "import_kv_blocks", None)
         if importer is not None and handoff.payload is not None and fresh:
             # payload columns are the SOURCE table in order; the first
             # n_cached columns are covered by this replica's cache hit
+            # (device trie AND host-tier readmits — seed_from_cache counts both)
             importer(fresh, {k: v[:, n_cached:] for k, v in handoff.payload.items()})
         # replicate the hot prefix into THIS replica's trie: the next
         # request sharing the prompt hits locally (full blocks only, so

@@ -32,13 +32,25 @@ class SLOPlacement(PlacementPolicy):
     load (resident + reserved sequences vs the tracked-sequence cap); a
     deadline-tight request weights load more — deep queues cost it TTFT it
     cannot afford, so it prefers the emptier replica even at slightly
-    worse headroom."""
+    worse headroom. With a prefix directory live, a replica that already
+    holds a longer run of the request's prefix chain (device trie or host
+    tier) earns an affinity bonus: seeding from resident blocks beats
+    recomputing them, and beats pulling them from a peer."""
 
     name = "slo"
+    # affinity weight: full prefix coverage is worth a quarter of the
+    # whole pool's headroom — enough to break near-ties toward the
+    # replica that skips the prefill, never enough to pile every hot
+    # request onto one overloaded replica
+    prefix_affinity = 0.25
 
     def choose(self, cores, req, router):
         best, best_score = None, None
         now = time.monotonic()
+        directory = getattr(router, "directory", None)
+        keys = []
+        if directory is not None and cores:
+            keys = cores[0].prefix_chain(req.prompt_tokens)
         for core in cores:
             if not self.admissible(core, req, router):
                 continue
@@ -54,6 +66,9 @@ class SLOPlacement(PlacementPolicy):
                 slack = max(0.0, req.deadline - now)
                 urgency = 1.0 / (1.0 + slack)
             score = headroom - load * (1.0 + urgency)
+            if keys:
+                covered = directory.coverage(core.name, keys)
+                score += self.prefix_affinity * (covered / len(keys))
             # strict > keeps ties deterministic: first (lowest-index) wins
             if best_score is None or score > best_score:
                 best, best_score = core, score

@@ -39,6 +39,7 @@ import numpy as np
 from deepspeed_tpu.serving.cluster.core import EngineCore
 from deepspeed_tpu.serving.cluster.handoff import export_sequence, import_sequence
 from deepspeed_tpu.serving.cluster.placement import get_placement
+from deepspeed_tpu.serving.cluster.prefix_directory import PrefixDirectory
 from deepspeed_tpu.serving.driver import RequestRejected
 from deepspeed_tpu.serving.metrics import ServingMetrics
 from deepspeed_tpu.serving.request import Request, RequestState, SamplingParams
@@ -100,6 +101,11 @@ class Router:
         ]
         self.cores = self.prefill + self.decode
         self.spec_k = self.decode[0].spec_k
+        # cluster-wide prefix store: replicas advertise the chain hashes
+        # they hold (device trie ∪ host tier) after each step; admission
+        # pulls a hot prefix's uncovered tail from the best peer into the
+        # target's host tier instead of re-prefilling it
+        self.directory = PrefixDirectory()
 
         self._cond = threading.Condition()
         self._queue: deque = deque()  # Requests awaiting admission
@@ -305,6 +311,9 @@ class Router:
                 "num_decode_replicas": len(self.decode),
                 "placement": self._placement.name,
                 "kv_handoffs": int(snap.get("kv_handoffs_total", 0)),
+                "kv_host_tier": self._host_tier_health_locked(),
+                "prefix_peer_pulls": int(snap.get("prefix_peer_pulls_total", 0)),
+                "prefix_directory": self.directory.stats(),
                 "replicas": replicas,
                 "spec": {
                     "enabled": spec is not None,
@@ -315,6 +324,17 @@ class Router:
                     "acceptance_rate": snap["spec_acceptance_rate"],
                 },
             }
+
+    def _host_tier_health_locked(self) -> Dict:
+        """Aggregated host-tier snapshot across cores for health()."""
+        tiers = [t for t in (c.host_tier() for c in self.cores) if t is not None]
+        if not tiers:
+            return {"enabled": False}
+        agg: Dict[str, float] = {"enabled": True}
+        for t in tiers:
+            for k, v in t.stats().items():
+                agg[k] = agg.get(k, 0) + v
+        return agg
 
     # -- internals -------------------------------------------------------
     def _reject(self, reason: str, message: str = ""):
@@ -453,7 +473,72 @@ class Router:
             pcore = dcore
         self._target[req.uid] = dcore
         self._queue.popleft()
-        return (req, pcore)
+        return (req, pcore, self._plan_prefix_pull_locked(req, pcore))
+
+    def _plan_prefix_pull_locked(self, req: Request, seed_core: EngineCore):
+        """Directory consult for the core that will SEED this request (the
+        colocated/prefill core running its prefill): if a peer's last
+        advertisement covers a strictly longer contiguous run of the
+        request's prefix chain than the seed core's own, plan a pull of
+        the uncovered tail. Pure planning — advertisement snapshots only,
+        no engine locks (the live trie must not be read under _cond)."""
+        if seed_core.host_tier() is None:
+            return None
+        keys = seed_core.prefix_chain(req.prompt_tokens)
+        if not keys:
+            return None
+        covered = self.directory.coverage(seed_core.name, keys)
+        peer = self.directory.best_peer(keys, exclude=seed_core.name,
+                                        min_extra=covered + 1)
+        if peer is None:
+            return None
+        src = next((c for c in self.cores if c.name == peer[0]), None)
+        if src is None:
+            return None
+        return (src, seed_core, keys[covered:peer[1]])
+
+    def _execute_prefix_pull(self, src: EngineCore, dst: EngineCore, keys) -> int:
+        """Copy the planned prefix blocks from ``src`` into ``dst``'s host
+        tier. Host-tier entries move host-to-host (no device work); blocks
+        only the source's device trie holds are gathered in ONE batched
+        export. Source and target locks are taken sequentially, never
+        nested — no ordering constraint against stepping. A stale
+        advertisement just shortens (or empties) the pulled run; the
+        request then re-prefills the remainder — correctness never depends
+        on the pull."""
+        pulled = []
+        with src.step_lock:
+            tier = src.host_tier()
+            cache = src.prefix_cache()
+            by_hash = (cache.blocks_by_hash()
+                       if cache is not None and hasattr(cache, "blocks_by_hash")
+                       else {})
+            dev_keys = [k for k in keys
+                        if (tier is None or k not in tier) and k in by_hash]
+            dev_payload = None
+            if dev_keys and hasattr(src.engine, "export_kv_blocks"):
+                dev_payload = src.engine.export_kv_blocks(
+                    [by_hash[k] for k in dev_keys])
+            dev_pos = {k: i for i, k in enumerate(dev_keys)}
+            for key in keys:
+                entry = tier.peek(key) if tier is not None else None
+                if entry is None and dev_payload is not None and key in dev_pos:
+                    i = dev_pos[key]
+                    entry = {name: np.asarray(plane[:, i])  # dstpu: noqa[host-sync-in-loop] — per-block split of ONE batched device gather above; planes are already host numpy, no device sync here
+                             for name, plane in dev_payload.items()}
+                if entry is None:
+                    break  # advert went stale: keep the contiguous head only
+                pulled.append((key, entry))
+        if not pulled:
+            return 0
+        n = 0
+        with dst.step_lock:
+            dtier = dst.host_tier()
+            if dtier is not None:
+                for key, entry in pulled:
+                    if dtier.put(key, entry, peer_pull=True):
+                        n += 1
+        return n
 
     def _coordinate(self):
         while True:
@@ -484,7 +569,23 @@ class Router:
                         poll = self.poll_interval_s * 5
                         timeout = min(poll, timeout) if timeout is not None else poll
                     self._cond.wait(timeout)
-            req, pcore = plan
+            req, pcore, pull = plan
+            if pull is not None:
+                # seed the target's host tier from the peer BEFORE admission:
+                # submit()'s seed_from_cache then re-imports the pulled
+                # blocks instead of re-prefilling them
+                src, dst, keys = pull
+                try:
+                    n_pulled = self._execute_prefix_pull(src, dst, keys)
+                except Exception as e:
+                    n_pulled = 0
+                    logger.warning(
+                        f"serving: prefix pull {src.name}->{dst.name} failed: "
+                        f"{type(e).__name__}: {e}")
+                if n_pulled:
+                    with self._cond:
+                        self.metrics.inc("prefix_peer_pulls_total")
+                        self.metrics.inc("prefix_peer_pull_blocks_total", n_pulled)
             err = None
             with pcore.step_lock:
                 try:
@@ -581,6 +682,15 @@ class Router:
                 agg["hits"] / agg["queries"] if agg.get("queries") else 0.0
             )
             self.metrics.update_prefix_cache(agg)
+        # host-tier rollup (bytes/blocks are gauges, the rest monotone
+        # per-replica counters, so summing preserves both semantics)
+        tiers = [t for t in (c.host_tier() for c in self.cores) if t is not None]
+        if tiers:
+            agg_t: Dict[str, float] = {}
+            for t in tiers:
+                for k, v in t.stats().items():
+                    agg_t[k] = agg_t.get(k, 0) + v
+            self.metrics.update_host_tier(agg_t)
         st = core.replica_stats()
         st["reserved_blocks"] = self._reserved[core.name][0]
         st["requests_finished_total"] = self._tally[core.name]["finished"]
@@ -624,11 +734,17 @@ class Router:
                     stall_wait = False
             stepped = False
             handoffs = []
+            advert = None
             with core.step_lock:
                 with self._cond:
                     self._expire_core_locked(core)
                 if core.has_work():
                     stepped = core.step_once(self)
+                # directory advertisement: snapshot the held prefix hashes
+                # (device trie ∪ host tier) under the step lock — the trie
+                # only mutates under stepping, so this is race-free
+                if core.prefix_cache() is not None or core.host_tier() is not None:
+                    advert = core.prefix_hashes()
                 # export finished prefills while still under the SOURCE
                 # lock (the payload gather must not race the next step's
                 # donated pool reassignment), then release the source seq
@@ -655,6 +771,8 @@ class Router:
             for req, ho in handoffs:
                 self._complete_handoff(req, ho)
             with self._cond:
+                if advert is not None:
+                    self.directory.advertise(core.name, advert)
                 self._refresh_metrics_locked(core)
                 self._maybe_idle_locked()
                 self._cond.notify_all()

@@ -269,6 +269,7 @@ class ServingDriver:
                 "kv_capacity_multiplier": self._kv_info.get(
                     "kv_capacity_multiplier", 1.0
                 ),
+                "kv_host_tier": self._host_tier_health(),
                 "spec": {
                     "enabled": self._spec_ctl is not None,
                     "k": self.spec_k,
@@ -278,6 +279,12 @@ class ServingDriver:
                     "acceptance_rate": snap["spec_acceptance_rate"],
                 },
             }
+
+    def _host_tier_health(self) -> Dict:
+        tier = self.core.host_tier()
+        if tier is None:
+            return {"enabled": False}
+        return {"enabled": True, **tier.stats()}
 
     # -- internals -------------------------------------------------------
     def _reject(self, reason: str, message: str = ""):
@@ -469,6 +476,9 @@ class ServingDriver:
                     cache = self._prefix_cache()
                     if cache is not None:
                         self.metrics.update_prefix_cache(cache.stats())
+                    tier = self.core.host_tier()
+                    if tier is not None:
+                        self.metrics.update_host_tier(tier.stats())
                     if hasattr(self.engine, "comm_wire_info"):
                         # wire counters accrue as step programs TRACE, so a
                         # per-step refresh catches late-compiled shapes

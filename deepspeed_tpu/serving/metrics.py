@@ -31,6 +31,16 @@ DEFAULT_BUCKETS = (
 SPEC_ACCEPT_BUCKETS = (0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0)
 
 
+def _safe_rate(value: float) -> float:
+    """Clamp a ratio gauge to a finite number: 0.0 in place of NaN/inf.
+    A hit rate before any query (0/0) must render as 0.0 in ``/metrics``
+    and ``health()``, not poison the JSON/exposition with NaN."""
+    v = float(value)
+    if v != v or v == float("inf") or v == float("-inf"):
+        return 0.0
+    return v
+
+
 class Histogram:
     """Prometheus-style cumulative histogram (counts per le-bucket + sum)."""
 
@@ -108,6 +118,15 @@ class ServingMetrics:
             "prefix_hit_tokens_total": 0,
             "prefix_inserted_blocks_total": 0,
             "prefix_evictions_total": 0,
+            # tiered KV host store (HostBlockStore.stats() rollup) + the
+            # router's cross-replica prefix pulls
+            "kv_host_tier_hits_total": 0,
+            "kv_host_tier_misses_total": 0,
+            "kv_host_tier_spills_total": 0,
+            "kv_host_tier_readmits_total": 0,
+            "kv_host_tier_evictions_total": 0,
+            "prefix_peer_pulls_total": 0,
+            "prefix_peer_pull_blocks_total": 0,
             # speculative decoding
             "spec_rounds_total": 0,
             "spec_draft_tokens_total": 0,
@@ -132,6 +151,11 @@ class ServingMetrics:
             "prefix_cached_blocks": 0,
             "prefix_cached_blocks_idle": 0,
             "prefix_hit_rate": 0.0,
+            # host tier occupancy (bytes/blocks resident right now)
+            "kv_host_tier_bytes": 0,
+            "kv_host_tier_blocks": 0,
+            "kv_host_tier_budget_bytes": 0,
+            "kv_host_tier_hit_rate": 0.0,
             "spec_acceptance_rate": 0.0,
             "spec_mean_accepted_per_round": 0.0,
         }
@@ -230,7 +254,29 @@ class ServingMetrics:
             self.counters["prefix_evictions_total"] = stats["evictions"]
             self.gauges["prefix_cached_blocks"] = stats["cached_blocks"]
             self.gauges["prefix_cached_blocks_idle"] = stats["cached_blocks_idle"]
-            self.gauges["prefix_hit_rate"] = stats["hit_rate"]
+            # the source computes hits/queries; guard the 0/0 (and any
+            # NaN that leaks through a zero-query snapshot) to 0.0
+            self.gauges["prefix_hit_rate"] = _safe_rate(stats["hit_rate"])
+
+    def update_host_tier(self, stats: Dict[str, float]) -> None:
+        """Mirror a ``HostBlockStore.stats()`` snapshot (or a cross-replica
+        sum of them, from the router rollup). Counters are monotone at the
+        source, so assignment keeps Prometheus counter semantics."""
+        with self._lock:
+            self.gauges["kv_host_tier_bytes"] = stats.get("bytes", 0)
+            self.gauges["kv_host_tier_blocks"] = stats.get("blocks", 0)
+            self.gauges["kv_host_tier_budget_bytes"] = stats.get("budget_bytes", 0)
+            hits = stats.get("hits", 0)
+            misses = stats.get("misses", 0)
+            self.counters["kv_host_tier_hits_total"] = hits
+            self.counters["kv_host_tier_misses_total"] = misses
+            self.counters["kv_host_tier_spills_total"] = stats.get("spills", 0)
+            self.counters["kv_host_tier_readmits_total"] = stats.get("readmits", 0)
+            self.counters["kv_host_tier_evictions_total"] = stats.get("evictions", 0)
+            probes = hits + misses
+            self.gauges["kv_host_tier_hit_rate"] = (
+                _safe_rate(hits / probes) if probes else 0.0
+            )
 
     def observe_spec_round(self, per_uid: Dict[int, Tuple[int, int]]) -> None:
         """Fold one verify round's (drafted, accepted) per sequence into the
@@ -322,4 +368,5 @@ __all__ = [
     "Histogram",
     "ServingMetrics",
     "prometheus_metric_name",
+    "_safe_rate",
 ]

@@ -1,0 +1,503 @@
+"""Tiered KV/prefix store tests: HBM → host tier → peer pull.
+
+Layers covered, bottom up: ``HostBlockStore`` LRU/budget invariants and
+chain-hash determinism (host_tier.py); ``import_kv_blocks`` validation
+negatives (a malformed payload must raise loudly, never scatter garbage);
+the acceptance bar — token streams BIT-identical tier on vs off through a
+forced evict → spill → readmit cycle (greedy + seeded, bf16 + int8 pools);
+the scheduler charging only the truly-cold tail after a host readmit; the
+serving metrics host-tier gauges and the divide-by-zero hit-rate guard;
+and the router-level ``PrefixDirectory`` peer pull, whose streams must
+match the single-engine driver bit-for-bit.
+"""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2.blocked_allocator import BlockedAllocator
+from deepspeed_tpu.inference.v2.host_tier import (
+    HostBlockStore,
+    block_hash,
+    chain_hashes,
+    payload_nbytes,
+)
+from deepspeed_tpu.inference.v2.prefix_cache import PrefixCache
+
+pytestmark = []
+
+
+def _payload(fill=0.0, shape=(2, 4, 2), dtype=np.float32):
+    return {"k": np.full(shape, fill, dtype), "v": np.full(shape, -fill, dtype)}
+
+
+# ---------------------------------------------------------------------------
+# HostBlockStore
+# ---------------------------------------------------------------------------
+class TestHostBlockStore:
+    def test_put_get_roundtrip_and_counters(self):
+        s = HostBlockStore(1 << 20)
+        p = _payload(1.0)
+        assert s.put(b"a", p)
+        assert b"a" in s and len(s) == 1
+        assert s.bytes_used == payload_nbytes(p)
+        got = s.get(b"a")
+        np.testing.assert_array_equal(got["k"], p["k"])
+        assert s.get(b"nope") is None
+        st = s.stats()
+        assert st["hits"] == 1 and st["misses"] == 1 and st["spills"] == 1
+
+    def test_budget_lru_eviction_order(self):
+        one = payload_nbytes(_payload())
+        s = HostBlockStore(3 * one)
+        for key in (b"a", b"b", b"c"):
+            assert s.put(key, _payload())
+        s.get(b"a")  # a becomes MRU: LRU order is now b, c, a
+        assert s.put(b"d", _payload())  # evicts b
+        assert b"b" not in s and b"a" in s and b"c" in s and b"d" in s
+        assert s.put(b"e", _payload())  # evicts c
+        assert b"c" not in s
+        assert s.stats()["evictions"] == 2
+        assert s.bytes_used == 3 * one  # budget held exactly
+
+    def test_oversized_payload_rejected_and_stores_nothing(self):
+        s = HostBlockStore(8)
+        assert not s.put(b"big", _payload())
+        assert len(s) == 0 and s.bytes_used == 0
+        assert s.stats()["spills"] == 0
+
+    def test_refresh_reaccounts_bytes(self):
+        s = HostBlockStore(1 << 20)
+        s.put(b"a", _payload(shape=(2, 4, 2)))
+        s.put(b"a", _payload(shape=(2, 8, 2)))  # refresh with a bigger entry
+        assert len(s) == 1
+        assert s.bytes_used == payload_nbytes(_payload(shape=(2, 8, 2)))
+
+    def test_peek_and_match_have_no_side_effects(self):
+        one = payload_nbytes(_payload())
+        s = HostBlockStore(2 * one)
+        s.put(b"a", _payload())
+        s.put(b"b", _payload())
+        before = s.stats()
+        assert s.peek(b"a") is not None and s.peek(b"x") is None
+        assert s.match([b"a", b"b", b"x"]) == 2
+        assert s.match([b"a", b"b"], start=1) == 1
+        assert s.match([b"x", b"a"]) == 0
+        assert s.stats() == before  # no counters, no byte movement
+        # and no LRU touch: a was NOT refreshed by peek/match, so it is
+        # still the LRU entry and goes first under pressure
+        s.put(b"c", _payload())
+        assert b"a" not in s and b"b" in s
+
+    def test_discard(self):
+        s = HostBlockStore(1 << 20)
+        s.put(b"a", _payload())
+        s.discard(b"a")
+        s.discard(b"a")  # idempotent
+        assert len(s) == 0 and s.bytes_used == 0
+
+    def test_budget_must_be_positive(self):
+        with pytest.raises(ValueError, match="budget_bytes"):
+            HostBlockStore(0)
+
+    def test_peer_pull_counter_attribution(self):
+        s = HostBlockStore(1 << 20)
+        s.put(b"a", _payload(), peer_pull=True)
+        st = s.stats()
+        assert st["peer_pulled"] == 1 and st["spills"] == 0
+
+
+# ---------------------------------------------------------------------------
+# chain hashes: the cluster-wide content address
+# ---------------------------------------------------------------------------
+class TestChainHashes:
+    def test_deterministic_and_parent_sensitive(self):
+        toks = list(range(12))
+        a = chain_hashes(toks, 4)
+        b = chain_hashes(toks, 4)
+        assert a == b and len(a) == 3
+        # same block content under a different parent names a DIFFERENT
+        # prefix: [4..8) as block 2 of one chain vs block 1 of another
+        other = chain_hashes(toks[4:], 4)
+        assert a[1] != other[0]
+        assert block_hash(b"", toks[:4]) == a[0]
+
+    def test_n_blocks_cap_and_partial_tail_ignored(self):
+        toks = list(range(11))  # 2 full blocks + partial
+        assert len(chain_hashes(toks, 4)) == 2
+        assert chain_hashes(toks, 4, n_blocks=1) == chain_hashes(toks, 4)[:1]
+
+    def test_matches_trie_hkeys(self):
+        """The trie's per-node hkey and chain_hashes name the same prefix
+        identically — the invariant the host tier and directory stand on."""
+        alloc = BlockedAllocator(16)
+        cache = PrefixCache(4, alloc)
+        toks = list(range(12))
+        table = alloc.allocate(3)
+        cache.insert(toks, table)
+        assert cache.prefix_hashes() == set(chain_hashes(toks, 4))
+        by_hash = cache.blocks_by_hash()
+        for key, block in zip(chain_hashes(toks, 4), table):
+            assert by_hash[key] == int(block)
+
+
+# ---------------------------------------------------------------------------
+# real-engine fixtures
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def tiny_model():
+    import jax
+
+    from deepspeed_tpu.models import get_config, init_params
+
+    cfg = get_config("tiny", n_layers=2, dtype="float32", max_seq_len=512)
+    return cfg, init_params(cfg, jax.random.key(0))
+
+
+def _tiered_engine(tiny_model, host_tier_bytes, greedy=True, kv_dtype="bf16",
+                   num_blocks=24, seed=7):
+    from deepspeed_tpu.inference.config import RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+
+    cfg, params = tiny_model
+    rc = RaggedInferenceEngineConfig.from_dict({
+        "dtype": "float32",
+        "greedy": greedy, "temperature": 0.9, "seed": seed,
+        "kv_cache": {"block_size": 4, "num_blocks": num_blocks,
+                     "max_blocks_per_seq": 16, "prefix_cache": True,
+                     "kv_cache_dtype": kv_dtype,
+                     "host_tier_bytes": host_tier_bytes,
+                     "host_tier_chunk_blocks": 2},
+        "state_manager": {"max_tracked_sequences": 16,
+                          "max_ragged_batch_size": 256,
+                          "max_ragged_sequence_count": 8,
+                          "max_context": 256},
+    })
+    return InferenceEngineV2(cfg, params, rc)
+
+
+def _cycle_prompts():
+    rng = np.random.default_rng(0)
+    hot = rng.integers(0, 128, size=13).tolist()  # 3 full blocks + tail
+    floods = [rng.integers(0, 128, size=17).tolist() for _ in range(6)]
+    return hot, floods
+
+
+def _evict_cycle(engine, max_new=8):
+    """Seed a hot prefix, flood the 24-block pool until the trie evicts it
+    (tier on: spills it), then revisit it (tier on: readmits it)."""
+    hot, floods = _cycle_prompts()
+    outs = [np.asarray(o)
+            for o in engine.generate([list(hot) + [5, 6]],
+                                     max_new_tokens=max_new)]
+    for f in floods:
+        outs += [np.asarray(o)
+                 for o in engine.generate([f], max_new_tokens=max_new)]
+    outs += [np.asarray(o)
+             for o in engine.generate([list(hot) + [9, 9, 2]],
+                                      max_new_tokens=max_new)]
+    return outs
+
+
+# ---------------------------------------------------------------------------
+# satellite: import_kv_blocks validation negatives
+# ---------------------------------------------------------------------------
+class TestImportValidation:
+    def test_missing_plane_raises(self, tiny_model):
+        eng = _tiered_engine(tiny_model, 0)
+        payload = eng.export_kv_blocks([1, 2])
+        del payload["v"]
+        with pytest.raises(ValueError, match="missing"):
+            eng.import_kv_blocks([1, 2], payload)
+
+    def test_unexpected_scale_plane_on_bf16_pool_raises(self, tiny_model):
+        eng = _tiered_engine(tiny_model, 0)
+        payload = eng.export_kv_blocks([1, 2])
+        payload["k_scale"] = np.zeros((2, 2, 4, 2), np.float32)
+        with pytest.raises(ValueError, match="unexpected"):
+            eng.import_kv_blocks([1, 2], payload)
+
+    def test_wrong_block_count_raises(self, tiny_model):
+        eng = _tiered_engine(tiny_model, 0)
+        payload = eng.export_kv_blocks([1, 2])
+        with pytest.raises(ValueError, match="shape"):
+            eng.import_kv_blocks([1, 2, 3], payload)
+
+    def test_wrong_trailing_shape_raises(self, tiny_model):
+        eng = _tiered_engine(tiny_model, 0)
+        payload = eng.export_kv_blocks([1, 2])
+        payload["k"] = payload["k"][..., :-1]
+        with pytest.raises(ValueError, match="shape"):
+            eng.import_kv_blocks([1, 2], payload)
+
+    def test_wrong_dtype_raises_instead_of_silent_cast(self, tiny_model):
+        eng = _tiered_engine(tiny_model, 0)
+        payload = eng.export_kv_blocks([1, 2])
+        payload["k"] = np.asarray(payload["k"], np.float16)
+        with pytest.raises(ValueError, match="dtype"):
+            eng.import_kv_blocks([1, 2], payload)
+
+    def test_int8_missing_scales_raise(self, tiny_model):
+        eng = _tiered_engine(tiny_model, 0, kv_dtype="int8")
+        payload = eng.export_kv_blocks([1, 2])
+        assert set(payload) == {"k", "v", "k_scale", "v_scale"}
+        bad = {k: v for k, v in payload.items() if not k.endswith("_scale")}
+        with pytest.raises(ValueError, match="missing"):
+            eng.import_kv_blocks([1, 2], bad)
+
+    def test_int8_wrong_scale_dtype_raises(self, tiny_model):
+        eng = _tiered_engine(tiny_model, 0, kv_dtype="int8")
+        payload = eng.export_kv_blocks([1, 2])
+        payload["k_scale"] = payload["k_scale"].astype(np.float64)
+        with pytest.raises(ValueError, match="dtype"):
+            eng.import_kv_blocks([1, 2], payload)
+
+    def test_chunked_import_validates_too(self, tiny_model):
+        eng = _tiered_engine(tiny_model, 0)
+        payload = eng.export_kv_blocks([1, 2, 3])
+        del payload["k"]
+        with pytest.raises(ValueError, match="missing"):
+            eng.import_kv_blocks_chunked([1, 2, 3], payload, chunk_blocks=2)
+
+    def test_valid_roundtrip_still_works(self, tiny_model):
+        eng = _tiered_engine(tiny_model, 0)
+        payload = eng.export_kv_blocks([1, 2, 3])
+        eng.import_kv_blocks_chunked([4, 5, 6], payload, chunk_blocks=2)
+        back = eng.export_kv_blocks([4, 5, 6])
+        for name in payload:
+            np.testing.assert_array_equal(payload[name], back[name])
+
+
+# ---------------------------------------------------------------------------
+# acceptance bar: streams bit-identical tier on vs off through a forced
+# evict -> spill -> readmit cycle
+# ---------------------------------------------------------------------------
+class TestTierParity:
+    @pytest.mark.parametrize("greedy", [True, False],
+                             ids=["greedy", "sampled"])
+    def test_bit_identical_bf16(self, tiny_model, greedy):
+        off = _evict_cycle(_tiered_engine(tiny_model, 0, greedy=greedy))
+        eng = _tiered_engine(tiny_model, 1 << 20, greedy=greedy)
+        on = _evict_cycle(eng)
+        st = eng.host_tier.stats()
+        assert st["spills"] > 0, "pool never evicted: the cycle tested nothing"
+        assert st["readmits"] > 0, "revisit never readmitted from the host tier"
+        for a, b in zip(off, on):
+            np.testing.assert_array_equal(a, b)
+
+    @pytest.mark.slow  # run_smoke runs this file unfiltered
+    @pytest.mark.parametrize("greedy", [True, False],
+                             ids=["greedy", "sampled"])
+    def test_bit_identical_int8(self, tiny_model, greedy):
+        """int8 pools spill quantized codes + fp32 scale planes verbatim
+        and re-import them bit-exactly — no requantization anywhere."""
+        off = _evict_cycle(_tiered_engine(tiny_model, 0, greedy=greedy,
+                                          kv_dtype="int8"))
+        eng = _tiered_engine(tiny_model, 1 << 20, greedy=greedy,
+                             kv_dtype="int8")
+        on = _evict_cycle(eng)
+        st = eng.host_tier.stats()
+        assert st["spills"] > 0 and st["readmits"] > 0
+        for a, b in zip(off, on):
+            np.testing.assert_array_equal(a, b)
+
+    def test_int8_payloads_denser_than_bf16(self, tiny_model):
+        """Same workload, same budget: the int8 tier holds the same blocks
+        in roughly half the bytes (codes + scale planes vs bf16 payload)."""
+        bf = _tiered_engine(tiny_model, 1 << 20)
+        q = _tiered_engine(tiny_model, 1 << 20, kv_dtype="int8")
+        _evict_cycle(bf)
+        _evict_cycle(q)
+        sb, sq = bf.host_tier.stats(), q.host_tier.stats()
+        assert sq["blocks"] == sb["blocks"]
+        assert sq["bytes"] < 0.6 * sb["bytes"]
+
+    def test_engine_requires_prefix_cache(self, tiny_model):
+        from deepspeed_tpu.inference.config import RaggedInferenceEngineConfig
+        from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+
+        cfg, params = tiny_model
+        rc = RaggedInferenceEngineConfig.from_dict({
+            "dtype": "float32",
+            "kv_cache": {"block_size": 4, "num_blocks": 24,
+                         "max_blocks_per_seq": 8, "prefix_cache": False,
+                         "host_tier_bytes": 1 << 20},
+            "state_manager": {"max_tracked_sequences": 16,
+                              "max_ragged_batch_size": 256,
+                              "max_ragged_sequence_count": 4,
+                              "max_context": 128},
+        })
+        with pytest.raises(ValueError, match="prefix_cache"):
+            InferenceEngineV2(cfg, params, rc)
+
+
+# ---------------------------------------------------------------------------
+# the scheduler charges only the truly-cold tail after a readmit
+# ---------------------------------------------------------------------------
+class TestColdCharging:
+    def test_readmit_shrinks_scheduler_charge(self, tiny_model):
+        eng = _tiered_engine(tiny_model, 1 << 20)
+        hot, floods = _cycle_prompts()
+        eng.generate([hot], max_new_tokens=4)
+        for f in floods:
+            eng.generate([f], max_new_tokens=4)
+        store = eng.host_tier
+        assert store.spills > 0
+        trie_blocks = eng.prefix_cache.peek(hot)  # still device-resident
+        readmits_before = store.readmits
+        eng.scheduler.submit(999, hot)
+        seq = eng.state_manager.get_sequence(999)
+        uid, remaining = eng.scheduler._pending[-1]
+        assert uid == 999
+        # the pending prompt chunk is EXACTLY the uncovered tail: the
+        # ragged budget never sees trie-covered or readmitted tokens
+        assert len(remaining) == len(hot) - seq.seen_tokens
+        assert store.readmits > readmits_before
+        assert seq.seen_tokens > trie_blocks * 4  # host tier beat trie-only
+        eng.scheduler.finish(999)
+
+
+# ---------------------------------------------------------------------------
+# serving metrics: host-tier gauges + divide-by-zero guards
+# ---------------------------------------------------------------------------
+class TestHostTierMetrics:
+    def test_safe_rate_clamps_nan_and_inf(self):
+        from deepspeed_tpu.serving.metrics import _safe_rate
+
+        assert _safe_rate(float("nan")) == 0.0
+        assert _safe_rate(float("inf")) == 0.0
+        assert _safe_rate(0.5) == 0.5
+
+    def test_prefix_hit_rate_never_nan(self):
+        from deepspeed_tpu.serving.metrics import ServingMetrics
+
+        m = ServingMetrics()
+        m.update_prefix_cache({
+            "queries": 0, "hits": 0, "hit_tokens": 0, "inserted_blocks": 0,
+            "evictions": 0, "cached_blocks": 0, "cached_blocks_idle": 0,
+            "hit_rate": float("nan"),
+        })
+        assert m.snapshot()["prefix_hit_rate"] == 0.0
+        assert "NaN" not in m.prometheus_text()
+
+    def test_update_host_tier_gauges_and_counters(self):
+        from deepspeed_tpu.serving.metrics import ServingMetrics
+
+        m = ServingMetrics()
+        m.update_host_tier({"bytes": 1024, "blocks": 3, "budget_bytes": 4096,
+                            "hits": 6, "misses": 2, "spills": 9,
+                            "readmits": 4, "evictions": 1, "peer_pulled": 0})
+        snap = m.snapshot()
+        assert snap["kv_host_tier_bytes"] == 1024
+        assert snap["kv_host_tier_blocks"] == 3
+        assert snap["kv_host_tier_hits_total"] == 6
+        assert snap["kv_host_tier_spills_total"] == 9
+        assert snap["kv_host_tier_readmits_total"] == 4
+        assert snap["kv_host_tier_hit_rate"] == pytest.approx(0.75)
+        text = m.prometheus_text()
+        for name in ("kv_host_tier_bytes", "kv_host_tier_blocks",
+                     "kv_host_tier_hits_total", "kv_host_tier_spills_total",
+                     "kv_host_tier_readmits_total", "prefix_peer_pulls_total"):
+            assert f"dstpu_serving_{name}" in text
+
+    def test_zero_probe_hit_rate_is_zero(self):
+        from deepspeed_tpu.serving.metrics import ServingMetrics
+
+        m = ServingMetrics()
+        m.update_host_tier({"bytes": 0, "blocks": 0, "hits": 0, "misses": 0})
+        assert m.snapshot()["kv_host_tier_hit_rate"] == 0.0
+
+    def test_driver_health_reports_tier(self, tiny_model):
+        from deepspeed_tpu.serving.driver import ServingDriver
+        from deepspeed_tpu.serving.request import SamplingParams
+
+        eng = _tiered_engine(tiny_model, 1 << 20)
+        driver = ServingDriver(eng, max_queue=8).start()
+        try:
+            r = driver.submit(np.arange(1, 10, dtype=np.int32),
+                              params=SamplingParams(max_new_tokens=3,
+                                                    ignore_eos=True))
+            assert r.wait(120)
+            h = driver.health()
+            assert h["kv_host_tier"]["enabled"] is True
+            assert h["kv_host_tier"]["budget_bytes"] == 1 << 20
+        finally:
+            driver.shutdown(drain=True, timeout=30)
+
+    def test_driver_health_tier_disabled(self, tiny_model):
+        from deepspeed_tpu.serving.driver import ServingDriver
+
+        eng = _tiered_engine(tiny_model, 0)
+        with ServingDriver(eng) as driver:
+            assert driver.health()["kv_host_tier"] == {"enabled": False}
+
+
+# ---------------------------------------------------------------------------
+# router peer pull: one replica's hot prefix seeds another through the
+# directory, streams bit-identical to the single-engine driver
+# ---------------------------------------------------------------------------
+class TestPrefixDirectory:
+    def test_coverage_and_best_peer(self):
+        from deepspeed_tpu.serving.cluster.prefix_directory import (
+            PrefixDirectory,
+        )
+
+        d = PrefixDirectory()
+        keys = [b"a", b"b", b"c"]
+        d.advertise("r0", {b"a", b"b"})
+        d.advertise("r1", {b"a", b"b", b"c"})
+        d.advertise("r2", {b"b", b"c"})  # no contiguous head
+        assert d.coverage("r0", keys) == 2
+        assert d.coverage("r2", keys) == 0
+        assert d.best_peer(keys, exclude="r1") == ("r0", 2)
+        assert d.best_peer(keys, exclude="r0") == ("r1", 3)
+        assert d.best_peer(keys, exclude="r0", min_extra=4) is None
+        d.forget("r1")
+        assert d.holders(b"c") == ["r2"]
+        assert d.holders(b"a") == ["r0"]
+        assert d.stats()["replicas"] == 2
+
+    def test_peer_pull_stream_parity(self, tiny_model):
+        from deepspeed_tpu.serving import Router, SamplingParams, ServingDriver
+
+        def submit_all(front, prompts):
+            outs = []
+            for p in prompts:
+                r = front.submit(p, params=SamplingParams(max_new_tokens=5,
+                                                          ignore_eos=True))
+                assert r.wait(300)
+                outs.append(list(r.generated))
+            return outs
+
+        rng = np.random.default_rng(11)
+        hot = rng.integers(0, 128, size=32).astype(np.int32)  # 8 full blocks
+        prompts = [np.concatenate([hot, np.asarray([200 + i, 201 + i, 202 + i],
+                                                   np.int32)])
+                   for i in range(4)]
+
+        single = _tiered_engine(tiny_model, 1 << 20, num_blocks=64)
+        drv = ServingDriver(single).start()
+        try:
+            want = submit_all(drv, prompts)
+        finally:
+            drv.shutdown(drain=True, timeout=60)
+
+        replicas = [_tiered_engine(tiny_model, 1 << 20, num_blocks=64)
+                    for _ in range(2)]
+        router = Router(engines=replicas, num_prefill_workers=0,
+                        placement="round_robin").start()
+        try:
+            got = submit_all(router, prompts)
+            snap = router.metrics.snapshot()
+            pulls = snap["prefix_peer_pulls_total"]
+            health = router.health()
+        finally:
+            router.shutdown()
+        assert got == want, "peer-pull streams diverged from single engine"
+        # round-robin alternates replicas, so the second request's seed
+        # replica had nothing local and the directory MUST have pulled
+        assert pulls >= 1
+        assert health["kv_host_tier"]["enabled"] is True
+        assert health["kv_host_tier"]["peer_pulled"] >= 1
+        assert health["prefix_directory"]["replicas"] == 2
+        # pulled blocks landed in a host tier and were readmitted
+        assert sum(e.host_tier.stats()["readmits"] for e in replicas) > 0

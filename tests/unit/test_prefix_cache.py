@@ -269,6 +269,100 @@ class TestPrefixTrie:
         assert alloc.free_blocks == alloc.total_blocks
 
 
+class TestEvictionRegression:
+    """Pins the eviction contract the host tier's spill path leans on:
+    blocks a live sequence still references are NEVER evicted no matter
+    the pressure, idle blocks go in strict LRU order, and the spill hook
+    fires exactly on eviction (never on ``clear``), before the block
+    returns to the free list."""
+
+    def test_live_refs_survive_arbitrary_pressure(self):
+        alloc, cache = _cache(num_blocks=32, bs=4)
+        live = _prefill(alloc, cache, list(range(100, 112)))  # 3-block chain
+        idle = _prefill(alloc, cache, list(range(200, 212)))
+        alloc.free(idle)  # this chain is cache-only: fair game
+        for _ in range(5):  # repeated mass evictions, way past pool size
+            cache.evict(10**6)
+        cached = set(cache.cached_block_ids())
+        assert {int(b) for b in live} <= cached  # live chain untouched
+        assert not ({int(b) for b in idle} & cached)  # idle chain gone
+        # the live sequence's refs are intact: seq + cache on each block
+        assert list(alloc.refcounts(live)) == [2, 2, 2]
+        # once the sequence finishes, the same blocks become evictable
+        alloc.free(live)
+        assert cache.evict(10**6) == 3
+        assert alloc.free_blocks == alloc.total_blocks
+
+    def test_partial_chain_pins_prefix(self):
+        """A live sequence sharing only the chain HEAD pins that head:
+        eviction may take the idle tail leaves but never the shared
+        prefix blocks above them."""
+        alloc, cache = _cache(bs=4)
+        common = list(range(4))
+        t1 = _prefill(alloc, cache, common + [10, 11, 12, 13])
+        # second sequence acquires (shares) only the common head block
+        head, n = cache.acquire(common + [99])
+        assert n == 4 and list(head) == [int(t1[0])]
+        alloc.free(t1)  # first sequence finishes; head still shared
+        assert cache.evict(10**6) == 1  # only the idle leaf went
+        assert cache.cached_block_ids() == [int(t1[0])]
+        alloc.free(head)
+        assert cache.evict(10**6) == 1
+
+    def test_strict_lru_idle_order(self):
+        """Idle blocks leave in exactly last-touched order, one evict(1)
+        at a time — the order the host tier's spill stream sees."""
+        alloc, cache = _cache(num_blocks=32, bs=4)
+        chains = {}
+        for i in range(4):
+            toks = [400 + 10 * i + j for j in range(4)]  # disjoint chains
+            t = _prefill(alloc, cache, toks)
+            alloc.free(t)
+            chains[i] = (toks, int(t[0]))
+        touch_order = [2, 0, 3, 1]  # recency, oldest first after touching
+        for i in touch_order:
+            toks, block = chains[i]
+            got, n = cache.acquire(toks + [7])  # distinct last_used each
+            assert n == 4 and list(got) == [block]
+            alloc.free(got)
+        evicted = []
+        while True:
+            before = set(cache.cached_block_ids())
+            if not cache.evict(1):
+                break
+            evicted += list(before - set(cache.cached_block_ids()))
+        assert evicted == [chains[i][1] for i in touch_order]
+
+    def test_spill_hook_on_evict_only_and_before_free(self):
+        from deepspeed_tpu.inference.v2.host_tier import chain_hashes
+
+        alloc, cache = _cache(bs=4)
+        toks = list(range(8))
+        t = _prefill(alloc, cache, toks)
+        alloc.free(t)
+        spilled = []
+
+        def spill(hkey, block):
+            # spill runs BEFORE the block returns to the free list: the
+            # pool rows are still safe to export at this point
+            assert alloc.refcount(block) == 1
+            spilled.append((hkey, block))
+
+        cache.spill_fn = spill
+        assert cache.evict(10**6) == 2
+        # hooks fired for both blocks with the content-addressed chain
+        # hashes (leaf first), matching chain_hashes exactly
+        keys = chain_hashes(toks, 4)
+        assert spilled == [(keys[1], int(t[1])), (keys[0], int(t[0]))]
+        # clear() is failure recovery — device KV may be garbage, so it
+        # must NOT feed the host tier
+        t2 = _prefill(alloc, cache, toks)
+        alloc.free(t2)
+        spilled.clear()
+        assert cache.clear() == 2
+        assert spilled == []
+
+
 # ---------------------------------------------------------------------------
 # state-manager bridge
 # ---------------------------------------------------------------------------

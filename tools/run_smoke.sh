@@ -54,6 +54,21 @@ echo "== tiled-overlap parity gate (8-device mesh) =="
 # parity, HLO max-antichain >= tile count (the overlap claim, structurally)
 python -m pytest tests/unit/test_tiled_overlap.py -q -p no:cacheprovider
 
+echo "== tiered-KV parity gate (evict -> spill -> re-import) =="
+# host-tier store/hash units, import validation negatives, trie eviction
+# regression, and BIT-identical streams tier on/off through a forced
+# evict->spill->readmit cycle (greedy + seeded, bf16 + int8), plus the
+# router's directory peer-pull parity
+python -m pytest tests/unit/test_host_tier.py -q -p no:cacheprovider
+
+echo "== host-sync annotation gate (Tier A, hot serving modules) =="
+# every host-sync copy site lexically inside a loop in the serving/engine
+# hot paths must carry a reasoned 'dstpu: noqa[host-sync-in-loop]' — the
+# host tier added host<->device copy loops on purpose; this keeps each one
+# deliberate and documented
+./bin/dstpu lint deepspeed_tpu/inference/v2 deepspeed_tpu/serving \
+    --select host-sync-in-loop --fail-on warning
+
 echo "== disaggregated-serving parity gate (router, 2 replicas) =="
 # 1 prefill worker + 2 decode replicas on CPU must stream BIT-IDENTICAL
 # tokens to the single-engine driver (greedy + seeded, bf16 + int8 KV),
