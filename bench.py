@@ -1099,6 +1099,143 @@ def bench_disagg_replicas(n_replicas=2, cfg=None, params=None, seed=0):
     }
 
 
+def parse_load_trace(spec):
+    """``DSTPU_SERVE_LOAD_TRACE`` — a piecewise-Poisson arrival trace as
+    ``"rate:dur,rate:dur,..."`` (requests/s : seconds). Bursty open-loop
+    load is where the elastic control plane earns its keep; a single flat
+    rate never exercises scale-up or the shed ladder."""
+    segments = []
+    for part in str(spec).split(","):
+        rate, _, dur = part.strip().partition(":")
+        rate, dur = float(rate), float(dur)
+        if rate <= 0 or dur <= 0:
+            raise ValueError(
+                f"load trace segment {part!r}: rate and duration must be "
+                "positive (format 'rate:dur,rate:dur')")
+        segments.append((rate, dur))
+    if not segments:
+        raise ValueError("empty load trace")
+    return segments
+
+
+def bench_elastic_burst(trace, cfg=None, params=None, seed=0):
+    """Elastic-serving burst benchmark (``DSTPU_SERVE_LOAD_TRACE`` rider
+    on --serving-load): drive an elastic Router — 1 decode replica + 1
+    warm spare, QoS tiers assigned round-robin, the shed ladder armed —
+    with the piecewise-Poisson trace, and report what the control plane
+    did: per-tier completion/shed/goodput/TTFT, preempt/resume counts,
+    and scale-up/down decisions. The interesting number under burst is
+    the interactive tier's p99 TTFT staying near its steady-state while
+    the batch tier sheds first."""
+    from deepspeed_tpu.inference.config import RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.models import TransformerConfig, init_params
+    from deepspeed_tpu.serving import ElasticServingConfig, WarmSparePool
+    from deepspeed_tpu.serving.cluster import Router
+    from deepspeed_tpu.serving.driver import RequestRejected
+    from deepspeed_tpu.serving.request import QOS_TIERS, SamplingParams
+
+    segments = parse_load_trace(trace)
+    max_new = int(os.environ.get("DSTPU_SERVE_MAX_NEW", 12))
+    max_queue = int(os.environ.get("DSTPU_SERVE_QUEUE", 16))
+    if cfg is None:
+        cfg = TransformerConfig(
+            vocab_size=512, hidden_size=128, n_layers=2, n_heads=4,
+            max_seq_len=512, dtype="float32",
+        )
+        params = init_params(cfg, jax.random.key(0))
+    rc_dict = {
+        "dtype": cfg.dtype,
+        "kv_cache": {"block_size": 16, "num_blocks": 128,
+                     "max_blocks_per_seq": 16},
+        "state_manager": {"max_tracked_sequences": 32,
+                          "max_ragged_batch_size": 96,
+                          "max_ragged_sequence_count": 8,
+                          "max_context": 256},
+    }
+
+    def mk():
+        return InferenceEngineV2(cfg, params,
+                                 RaggedInferenceEngineConfig.from_dict(rc_dict))
+
+    ecfg = ElasticServingConfig(
+        min_decode_replicas=1, max_decode_replicas=2,
+        control_interval_s=0.05, scale_up_after=2, scale_down_after=40,
+    )
+    # the spare pre-traces the step programs at spawn: scale-up inside the
+    # burst is wiring, not compiling (assert_warm_replicas pins it below)
+    pool = WarmSparePool(factory=mk, count=1, warm_kw={"decode_steps": 1})
+    router = Router(engines=[mk()], num_prefill_workers=0, elastic=ecfg,
+                    spare_pool=pool, max_queue=max_queue,
+                    kv_headroom=0.05).start()
+
+    rng = np.random.default_rng(seed)
+    tiers = sorted(QOS_TIERS, key=QOS_TIERS.get)  # interactive first
+    reqs, shed = [], {t: 0 for t in tiers}
+    warm = router.submit(
+        rng.integers(0, cfg.vocab_size, size=(8,)).astype(np.int32),
+        params=SamplingParams(max_new_tokens=2, ignore_eos=True))
+    warm.wait(300)
+    t0 = time.perf_counter()
+    i = 0
+    for rate, dur in segments:
+        seg_end = time.perf_counter() + dur
+        while time.perf_counter() < seg_end:
+            time.sleep(float(rng.exponential(1.0 / rate)))
+            tier = tiers[i % len(tiers)]
+            i += 1
+            prompt = rng.integers(
+                0, cfg.vocab_size, size=(int(rng.integers(8, 32)),)
+            ).astype(np.int32)
+            try:
+                reqs.append((tier, router.submit(
+                    prompt,
+                    params=SamplingParams(max_new_tokens=max_new,
+                                          ignore_eos=True, qos=tier))))
+            except RequestRejected:
+                shed[tier] += 1
+    for _, r in reqs:
+        r.wait(300)
+    wall = time.perf_counter() - t0
+    new_traces = router.assert_warm_replicas()  # raises on a burst compile
+    snap = router.metrics.snapshot()
+    health = router.health()
+    router.shutdown(drain=True, timeout=60)
+
+    def pct(vals, q):
+        return (round(float(np.percentile(np.asarray(vals), q)), 4)
+                if vals else None)
+
+    per_tier = {}
+    for tier in tiers:
+        mine = [r for t, r in reqs if t == tier]
+        done = [r for r in mine if r.state == "finished"]
+        ttfts = [r.ttft_s for r in done if r.ttft_s is not None]
+        per_tier[tier] = {
+            "submitted": len(mine),
+            "completed": len(done),
+            "shed": shed[tier],
+            "preempted": sum(r.preemptions for r in mine),
+            "goodput_tok_s": round(
+                sum(len(r.generated) for r in done) / wall, 1),
+            "ttft_p50_s": pct(ttfts, 50),
+            "ttft_p99_s": pct(ttfts, 99),
+        }
+    return {
+        "trace": [list(s) for s in segments],
+        "max_new": max_new,
+        "max_queue": max_queue,
+        "tiers": per_tier,
+        "preempted_total": int(snap.get("requests_preempted_total", 0)),
+        "resumed_total": int(snap.get("requests_resumed_total", 0)),
+        "shed_total": int(snap.get("requests_shed_total", 0)),
+        "scale_up_total": int(snap.get("scale_up_total", 0)),
+        "scale_down_total": int(snap.get("scale_down_total", 0)),
+        "decode_replicas_final": health["elastic"]["decode_replicas"],
+        "warm_replicas_asserted": int(new_traces),
+    }
+
+
 def bench_serving_load(
     n_requests=None, rate_rps=None, max_new=None, slo_e2e_s=None,
     cfg=None, params=None, seed=0,
@@ -1264,6 +1401,14 @@ def bench_serving_load(
     if n_repl >= 2:
         disagg_report = {"disagg": bench_disagg_replicas(
             n_replicas=n_repl, cfg=cfg, params=params, seed=seed)}
+    # elastic burst rider: DSTPU_SERVE_LOAD_TRACE="rate:dur,rate:dur"
+    # appends a piecewise-Poisson burst against the elastic Router —
+    # per-tier goodput/TTFT, shed and preempt counts, scaling decisions
+    elastic_report = {}
+    load_trace = os.environ.get("DSTPU_SERVE_LOAD_TRACE", "")
+    if load_trace:
+        elastic_report = {"elastic_burst": bench_elastic_burst(
+            load_trace, cfg=cfg, params=params, seed=seed)}
     return {
         "mode": "serving_load",
         "n_requests": n_requests,
@@ -1285,6 +1430,7 @@ def bench_serving_load(
         **cq_report,
         **co_report,
         **disagg_report,
+        **elastic_report,
     }
 
 

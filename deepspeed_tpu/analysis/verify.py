@@ -41,6 +41,7 @@ __all__ = [
     "check_recompile",
     "run_verify",
     "verify_disagg",
+    "verify_elastic",
     "verify_engine_v2",
     "verify_host_tier",
     "verify_quantized_comm",
@@ -869,6 +870,90 @@ def verify_host_tier() -> List[CheckResult]:
     return results
 
 
+def verify_elastic() -> List[CheckResult]:
+    """Elastic serving: a warm spare's ``warm_trace`` must cover EVERY step
+    program the serving loop drives, so post-warm serving traffic — prefill,
+    fused decode rounds, and the preempt-checkpoint resume import — runs
+    entirely inside the jit caches (zero admission-time compiles), and a
+    preempted-then-resumed greedy stream must replay bit-identically to the
+    uninterrupted one (content-addressed sampling + exact KV cursor
+    restore)."""
+    import numpy as np
+
+    from deepspeed_tpu.serving.elastic import (
+        WarmSparePool,
+        assert_no_new_traces,
+        preempt_sequence,
+        resume_sequence,
+    )
+
+    results: List[CheckResult] = []
+
+    # -- warm spare: serving-shaped traffic after warm_trace is compile-free
+    pool = WarmSparePool(
+        factory=lambda: _tiny_v2_engine(decode_steps=2)[1],
+        count=1,
+        warm_kw={"decode_steps": 2, "spec_k": 0},
+    )
+    eng, baseline = pool.acquire()
+    sched = eng.scheduler
+    uid = 7
+    sched.submit(uid, np.arange(1, 13, dtype=np.int32))
+    tok = None
+    for _ in range(8):
+        out = eng.step_tokens()
+        if uid in out:
+            tok = out[uid]
+            break
+    sched.feedback(uid, tok)
+    for _ in range(3):
+        eng.decode_round(2)
+    label = "elastic.warm_spare"
+    try:
+        assert_no_new_traces(eng, baseline, label=label)
+        results.append(CheckResult(
+            label, "recompile", True,
+            f"{len(baseline)} warmed program(s), zero new traces under "
+            "serving traffic"))
+    except RuntimeError as e:
+        results.append(CheckResult(label, "recompile", False, str(e)))
+
+    # -- preempt → resume on the warm engine: KV-cursor restore is exact
+    # and the resumed stream continues the same greedy tokens; the resume
+    # import must also stay inside the warmed caches
+    seq = eng.state_manager.get_sequence(uid)
+    pre_tokens = list(seq.tokens)
+    ho = preempt_sequence(eng, uid)
+    sched.finish(uid)
+    resume_sequence(eng, ho)
+    seq2 = eng.state_manager.get_sequence(uid)
+    label = "elastic.preempt_resume"
+    ok = (list(seq2.tokens) == pre_tokens
+          and int(seq2.seen_tokens) == len(pre_tokens) - 1)
+    results.append(CheckResult(
+        label, "parity", ok,
+        "checkpoint restored token history + KV cursor exactly" if ok
+        else f"history/cursor drifted: {len(seq2.tokens)} tokens, "
+             f"cursor {seq2.seen_tokens} (want {len(pre_tokens)} / "
+             f"{len(pre_tokens) - 1})"))
+    for _ in range(2):
+        eng.decode_round(2)
+    label = "elastic.resume_no_retrace"
+    try:
+        assert_no_new_traces(eng, baseline, label=label)
+        results.append(CheckResult(
+            label, "recompile", True,
+            "resume import + post-resume decode hit the warmed caches"))
+    except RuntimeError as e:
+        results.append(CheckResult(label, "recompile", False, str(e)))
+    sched.finish(uid)
+
+    # the warmed split program itself must be single-trace per bucket
+    for key, fn in getattr(eng, "_split_jit", {}).items():
+        results.append(check_recompile(f"elastic.split_step[tq={key}]", fn))
+    return results
+
+
 # ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
@@ -885,6 +970,7 @@ def run_verify(verbose: bool = True) -> Tuple[List[CheckResult], bool]:
         (verify_tiled_overlap, "tiled_overlap"),
         (verify_disagg, "disagg"),
         (verify_host_tier, "host_tier"),
+        (verify_elastic, "elastic"),
     ):
         try:
             results.extend(fn())

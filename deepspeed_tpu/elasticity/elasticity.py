@@ -21,8 +21,10 @@ class ElasticityError(Exception):
     pass
 
 
-class ElasticityConfigError(ElasticityError):
-    pass
+class ElasticityConfigError(ElasticityError, ValueError):
+    """Invalid elasticity config section. Also a ValueError so generic
+    config-validation callers (and the serving bridge) can catch it
+    without importing this package."""
 
 
 @dataclass
@@ -39,6 +41,31 @@ class ElasticityConfig:
     prefer_larger_batch: bool = True
     model_parallel_size: int = 1
     num_gpus_per_node: int = 1
+
+    def __post_init__(self):
+        if self.min_gpus < 1:
+            raise ElasticityConfigError(
+                f"min_gpus must be >= 1, got {self.min_gpus}"
+            )
+        if self.max_gpus < self.min_gpus:
+            raise ElasticityConfigError(
+                f"max_gpus ({self.max_gpus}) must be >= min_gpus ({self.min_gpus})"
+            )
+        if not self.micro_batch_sizes:
+            raise ElasticityConfigError("micro_batch_sizes must be non-empty")
+        if any(int(mb) < 1 for mb in self.micro_batch_sizes):
+            raise ElasticityConfigError(
+                f"micro_batch_sizes must all be >= 1, got {self.micro_batch_sizes}"
+            )
+        if self.max_train_batch_size < min(self.micro_batch_sizes):
+            raise ElasticityConfigError(
+                f"max_train_batch_size ({self.max_train_batch_size}) is below "
+                f"the smallest micro batch ({min(self.micro_batch_sizes)})"
+            )
+        if self.model_parallel_size < 1 or self.num_gpus_per_node < 1:
+            raise ElasticityConfigError(
+                "model_parallel_size and num_gpus_per_node must be >= 1"
+            )
 
     @classmethod
     def from_dict(cls, d: dict) -> "ElasticityConfig":

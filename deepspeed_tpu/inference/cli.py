@@ -231,6 +231,23 @@ def serve_parse_args(argv=None):
                    choices=("slo", "round_robin", "least_loaded"),
                    help="decode-replica placement policy: slo ranks by "
                    "free-block headroom / queue depth / deadline slack")
+    p.add_argument("--min-decode-replicas", type=int, default=0,
+                   help="elastic serving floor: autoscaling never retires "
+                   "below this (0 = elastic control plane off)")
+    p.add_argument("--max-decode-replicas", type=int, default=0,
+                   help="elastic serving ceiling: engines beyond "
+                   "--num-decode-replicas spawn as WARM SPARES (step "
+                   "programs pre-traced) so scale-up admits requests with "
+                   "zero new compilations")
+    p.add_argument("--shed-degrade-at", type=float, default=0.5,
+                   help="queue occupancy at which non-interactive tiers get "
+                   "their max_new_tokens capped")
+    p.add_argument("--shed-spec-off-at", type=float, default=0.75,
+                   help="queue occupancy at which speculative decoding is "
+                   "disabled for non-interactive tiers")
+    p.add_argument("--shed-reject-at", type=float, default=0.9,
+                   help="queue occupancy at which the lowest QoS tier is "
+                   "rejected with 503 + Retry-After")
     p.add_argument("--no-prefix-cache", action="store_true",
                    help="disable automatic prefix caching (on by default "
                    "when serving: repeated prompt prefixes share KV blocks "
@@ -325,7 +342,23 @@ def build_serving_stack(args, cfg=None, params=None, tok=None):
             f"need num_prefill_workers >= 0 and num_decode_replicas >= 1 "
             f"(got {n_prefill}/{n_decode})"
         )
-    if n_prefill == 0 and n_decode == 1:
+    # elastic control plane: --min/--max-decode-replicas bound the
+    # autoscaler; engines past --num-decode-replicas spawn as warm spares
+    elastic_min = int(getattr(args, "min_decode_replicas", 0) or 0)
+    elastic_max = int(getattr(args, "max_decode_replicas", 0) or 0)
+    elastic_cfg = None
+    if elastic_min or elastic_max:
+        from deepspeed_tpu.serving.elastic import ElasticServingConfig
+
+        elastic_cfg = ElasticServingConfig(
+            min_decode_replicas=max(1, elastic_min),
+            max_decode_replicas=max(1, elastic_min, elastic_max, n_decode),
+            shed_degrade_at=getattr(args, "shed_degrade_at", 0.5),
+            shed_spec_off_at=getattr(args, "shed_spec_off_at", 0.75),
+            shed_reject_at=getattr(args, "shed_reject_at", 0.9),
+        )
+        n_decode = max(n_decode, elastic_cfg.min_decode_replicas)
+    if n_prefill == 0 and n_decode == 1 and elastic_cfg is None:
         engine = InferenceEngineV2(cfg, params, rc)
         driver = ServingDriver(
             engine,
@@ -342,6 +375,18 @@ def build_serving_stack(args, cfg=None, params=None, tok=None):
     engines = [
         InferenceEngineV2(cfg, params, rc) for _ in range(n_prefill + n_decode)
     ]
+    spare_pool = None
+    if elastic_cfg is not None:
+        from deepspeed_tpu.serving.elastic import WarmSparePool
+
+        # spares spawn (and pre-trace their step programs) NOW, at build
+        # time — scale-up later is pure wiring, zero compiles at admission
+        spare_pool = WarmSparePool(
+            factory=lambda: InferenceEngineV2(cfg, params, rc),
+            count=max(0, elastic_cfg.max_decode_replicas - n_decode),
+            warm_kw={"decode_steps": args.decode_steps,
+                     "spec_k": int(getattr(args, "spec_k", 0) or 0)},
+        )
     router = Router(
         engines=engines,
         num_prefill_workers=n_prefill,
@@ -352,6 +397,8 @@ def build_serving_stack(args, cfg=None, params=None, tok=None):
         decode_steps=args.decode_steps,
         spec_ngram=getattr(args, "spec_ngram", 3),
         placement=getattr(args, "placement", "slo"),
+        elastic=elastic_cfg,
+        spare_pool=spare_pool,
     )
     return router, tok
 

@@ -585,6 +585,96 @@ class InferenceEngineV2:
         cache.insert(toks[: seq.seen_tokens], seq.block_table)
         return seq.seen_tokens
 
+    # -- warm spares (elastic serving) -------------------------------------
+    def trace_signature(self) -> Dict[str, int]:
+        """Snapshot of every step-program jit cache: key -> compiled-variant
+        count. The warm-spare admission contract compares two snapshots —
+        any growth is a compile the serving path paid at admission time."""
+        def _n(fn) -> int:
+            try:
+                return int(fn._cache_size())
+            except AttributeError:  # pragma: no cover — older jax fallback
+                return 1
+
+        sig: Dict[str, int] = {}
+        for name in ("_row_jit", "_split_jit", "_verify_jit"):
+            for key, fn in getattr(self, name, {}).items():
+                sig[f"{name}[{key}]"] = _n(fn)
+        for name in ("_multistep_jit", "_kv_scatter_jit", "_kv_readmit_jit"):
+            fn = getattr(self, name, None)
+            if fn is not None:
+                sig[name] = _n(fn)
+        return sig
+
+    def warm_trace(self, decode_steps: int = 1, spec_k: int = 0,
+                   uid: int = (1 << 30) + 7) -> Dict[str, int]:
+        """Pre-trace every step program the serving loop will drive, so a
+        warm-spare engine admits requests with ZERO admission-time
+        compiles: the split-phase step at both chunk buckets (128 and
+        ``prompt_chunk``), the fused decode round at ``decode_steps``, the
+        speculative verify step at ``spec_k``, and the fixed-window
+        chunked re-import scatter (preemption resume / host-tier readmit).
+        The throwaway sequences are finished and scrubbed from the prefix
+        trie afterwards, and sampling keys are content-addressed — warm
+        tracing never perturbs later streams. Returns the post-warm
+        ``trace_signature`` (the baseline scale-up asserts against).
+        Call BEFORE serving and AFTER the final ``set_sampling`` (sampling
+        knobs shape the programs and invalidate these caches)."""
+        sched = self.scheduler
+        vocab = int(getattr(self._mc, "vocab_size", 0) or 2)
+        cache = self.state_manager.prefix_cache
+        spill = getattr(cache, "spill_fn", None) if cache is not None else None
+        if cache is not None:
+            cache.spill_fn = None  # warm KV must not demote into the tier
+        lens = [8]
+        pc = int(sched.prompt_chunk)
+        if pc > 128 and int(self.config.state_manager.max_context) > pc + 8:
+            lens.append(pc)  # the long-prompt chunk bucket (tq=prompt_chunk)
+        try:
+            for i, length in enumerate(lens):
+                wuid = uid + i
+                toks = (np.arange(length, dtype=np.int32) % max(1, vocab - 1)) + 1
+                sched.submit(wuid, toks)
+                try:
+                    tok = None
+                    for _ in range(8 + length // max(1, pc)):
+                        out = self.step_tokens()
+                        if wuid in out:
+                            tok = out[wuid]
+                            break
+                    if tok is None:
+                        raise RuntimeError(
+                            f"warm_trace: prefill of {length} tokens never "
+                            "produced a first token"
+                        )
+                    sched.feedback(wuid, tok)
+                    if i == 0:
+                        if decode_steps > 1 and hasattr(self, "decode_round"):
+                            self.decode_round(int(decode_steps))
+                        if spec_k > 0 and hasattr(self, "spec_round"):
+                            self.spec_round(
+                                int(spec_k), drafts={wuid: [1] * int(spec_k)}
+                            )
+                finally:
+                    sched.finish(wuid)
+            # the fixed-window re-import scatter (resume/readmit path): one
+            # chunk+1-block round trip traces the padded-tail window shape
+            kv = self.config.kv_cache
+            chunk = int(getattr(kv, "host_tier_chunk_blocks", 8) or 8)
+            n = min(chunk + 1, int(kv.num_blocks))
+            if n > chunk:
+                blocks = list(range(n))
+                self.import_kv_blocks_chunked(
+                    blocks, self.export_kv_blocks(blocks), chunk_blocks=chunk
+                )
+        finally:
+            if cache is not None:
+                try:
+                    cache.clear()  # warm prefixes must never serve a hit
+                finally:
+                    cache.spill_fn = spill
+        return self.trace_signature()
+
     def set_sampling(self, greedy=None, temperature=None, top_k=None,
                      top_p=None, seed=None):
         """Update sampling knobs. greedy/top_k/top_p are compile-time

@@ -16,6 +16,9 @@ ragged batches. This package is that layer for ``InferenceEngineV2``:
                      length over the engine's K+1-token verify rounds
   * ``cluster``    — disaggregated prefill/decode serving: multi-engine
                      Router with KV-block handoff and SLO-aware placement
+  * ``elastic``    — elastic control plane: autoscaling decode replicas
+                     from warm spares, QoS tiers with preempt-and-resume,
+                     graceful load shedding with Retry-After
 """
 
 from deepspeed_tpu.serving.cluster import (
@@ -26,6 +29,12 @@ from deepspeed_tpu.serving.cluster import (
     get_placement,
 )
 from deepspeed_tpu.serving.driver import RequestRejected, ServingDriver
+from deepspeed_tpu.serving.elastic import (
+    DegradationLadder,
+    ElasticController,
+    ElasticServingConfig,
+    WarmSparePool,
+)
 from deepspeed_tpu.serving.metrics import ServingMetrics
 from deepspeed_tpu.serving.request import Request, RequestState, SamplingParams
 from deepspeed_tpu.serving.spec import (
@@ -38,8 +47,12 @@ from deepspeed_tpu.serving.streaming import IncrementalDetokenizer, TokenStream
 
 __all__ = [
     "AdaptiveSpecController",
+    "DegradationLadder",
     "DraftProposer",
+    "ElasticController",
+    "ElasticServingConfig",
     "EngineCore",
+    "WarmSparePool",
     "HandoffError",
     "KVHandoff",
     "Router",

@@ -56,6 +56,9 @@ class EngineCore:
         self.kv_headroom = float(kv_headroom)
         self.metrics = metrics
         self.requests: Dict[int, Request] = {}  # uid -> Request resident here
+        # elastic scale-down: a retired core takes no new admissions and its
+        # worker thread exits once the resident set drains
+        self.retired = False
         # serializes engine stepping against KV import/export (both
         # reassign the donated pool arrays) and scheduler mutation from
         # other threads (admission, cancel cleanup)
@@ -164,6 +167,21 @@ class EngineCore:
             need = max(0, need - cache.peek(req.prompt_tokens))
         return need
 
+    def committed_blocks(self) -> int:
+        """Blocks the resident requests will eventually hold if every one
+        runs to its full token budget. Admission must charge THIS, not the
+        current holdings: a resident that has only prefilled so far still
+        owns its future growth, and seating a second request into that
+        headroom can exhaust the pool mid-decode with neither sequence
+        terminal — nothing ever frees a block and both streams stall."""
+        bs = int(self._kv_cfg("block_size", 1))
+        cap = int(self._kv_cfg("max_blocks_per_seq", 1 << 30))
+        total = 0
+        for r in self.requests.values():
+            need = (len(r.prompt_tokens) + r.params.max_new_tokens + bs - 1) // bs
+            total += min(need, cap)
+        return total
+
     def admissible(
         self,
         req: Request,
@@ -188,6 +206,11 @@ class EngineCore:
             # blocks_needed already discounts them).
             idle = int(cache.stats()["cached_blocks_idle"])
             free += max(0, idle - cache.peek(req.prompt_tokens))
+        if not prefill_only:
+            # residents' unrealized growth still claims pool space (a pure
+            # prefill worker is exempt: its blocks free at the handoff)
+            free = min(free, self.kv_total - self.committed_blocks()
+                       - int(reserved_blocks))
         need = self.blocks_needed(req, prefill_only=prefill_only)
         if not occupied:
             # empty engine: headroom gating would starve a request larger

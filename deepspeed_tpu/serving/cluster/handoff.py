@@ -94,17 +94,25 @@ def import_sequence(engine, handoff: KVHandoff) -> int:
         seq.tokens = list(handoff.tokens)
         seq.seen_tokens = int(handoff.seen_tokens)
         fresh = [int(b) for b in seq.block_table[n_cached:]]
-        # prefer the double-buffered chunked scatter (large handoffs overlap
-        # device_put with the scatter; small ones fall through to the plain
-        # import inside it)
-        importer = getattr(engine, "import_kv_blocks_chunked", None)
-        if importer is None:
-            importer = getattr(engine, "import_kv_blocks", None)
-        if importer is not None and handoff.payload is not None and fresh:
+        # prefer the double-buffered chunked scatter, and force its
+        # FIXED-size windows even below one chunk: every handoff/resume
+        # then rides the single-shape readmit program, so an import never
+        # compiles at admission time (the warm-spare zero-trace contract —
+        # the plain per-size scatter would retrace for every distinct
+        # block count)
+        chunked = getattr(engine, "import_kv_blocks_chunked", None)
+        plain = getattr(engine, "import_kv_blocks", None)
+        if handoff.payload is not None and fresh:
             # payload columns are the SOURCE table in order; the first
             # n_cached columns are covered by this replica's cache hit
             # (device trie AND host-tier readmits — seed_from_cache counts both)
-            importer(fresh, {k: v[:, n_cached:] for k, v in handoff.payload.items()})
+            sliced = {k: v[:, n_cached:] for k, v in handoff.payload.items()}
+            if chunked is not None:
+                kv = getattr(getattr(engine, "config", None), "kv_cache", None)
+                chunk = int(getattr(kv, "host_tier_chunk_blocks", 8) or 8)
+                chunked(fresh, sliced, chunk_blocks=chunk)
+            elif plain is not None:
+                plain(fresh, sliced)
         # replicate the hot prefix into THIS replica's trie: the next
         # request sharing the prompt hits locally (full blocks only, so
         # decode writes never land in shared blocks — same discipline as

@@ -66,10 +66,19 @@ class Router:
         spec_ngram: int = 3,
         proposer=None,
         placement: str = "slo",
+        elastic=None,
+        spare_pool=None,
     ):
         """Engines either pre-split (``prefill_engines``/``decode_engines``)
         or one flat ``engines`` list whose first ``num_prefill_workers``
-        become prefill workers."""
+        become prefill workers.
+
+        ``elastic`` (an :class:`ElasticServingConfig`) turns the router
+        into a fleet manager: the autoscaling control loop scales the
+        decode side between the configured bounds (drawing warm engines
+        from ``spare_pool``), the QoS ladder degrades/sheds admissions by
+        queue occupancy, and higher tiers preempt lower-tier decodes when
+        placement can't seat them."""
         if engines is not None:
             p = int(num_prefill_workers)
             prefill_engines = list(engines[:p])
@@ -128,6 +137,25 @@ class Router:
         self._idle.set()
         self._threads: List[threading.Thread] = []
 
+        # elastic control plane: config, degradation ladder, warm-spare
+        # pool, and the autoscaling controller (started with the router)
+        self._elastic = elastic
+        self._spares = spare_pool
+        self._shed = None
+        self._controller = None
+        if elastic is not None:
+            from deepspeed_tpu.serving.elastic import (
+                DegradationLadder, ElasticController,
+            )
+            elastic.validate_fleet(
+                len(self.decode),
+                spare_pool.available if spare_pool is not None else 0,
+            )
+            self._shed = DegradationLadder(elastic)
+            self._controller = ElasticController(self, elastic)
+        self._decode_seq = len(self.decode)  # next dN replica name
+        self._finish_times: deque = deque(maxlen=64)  # Retry-After drain rate
+
         self.metrics.counters.setdefault("kv_handoffs_total", 0)
         if self.decode[0].kv_info:
             self.metrics.update_kv_pool_info(self.decode[0].kv_info)
@@ -142,6 +170,9 @@ class Router:
                 self.metrics.update_replica(
                     core.name, core.replica_stats(), role=core.role
                 )
+            self.metrics.set_gauge("decode_replicas", len(self.decode))
+            if self._spares is not None:
+                self.metrics.set_gauge("warm_spares", self._spares.available)
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "Router":
@@ -155,6 +186,8 @@ class Router:
                 name=f"serving-{core.name}", daemon=True))
         for t in self._threads:
             t.start()
+        if self._controller is not None:
+            self._controller.start()
         return self
 
     def __enter__(self):
@@ -205,8 +238,27 @@ class Router:
         with self._cond:
             if self._draining or self._stopping:
                 self._reject("draining")
+            if self._shed is not None:
+                decision = self._shed.apply(params, len(self._queue),
+                                            self.max_queue)
+                self.metrics.set_gauge("shed_level", decision.level)
+                if decision.reject:
+                    self.metrics.inc("requests_shed_total")
+                    self.metrics.observe_tier(params.tenant, params.qos,
+                                              "shed_total")
+                    self._reject(
+                        "shed",
+                        f"overloaded: {params.qos!r} tier is shedding "
+                        f"(queue {len(self._queue)}/{self.max_queue})",
+                        retry_after_s=self._retry_after_locked(),
+                    )
+                params = decision.params
             if len(self._queue) >= self.max_queue:
-                self._reject("queue_full", f"admission queue full ({self.max_queue})")
+                self._reject(
+                    "queue_full",
+                    f"admission queue full ({self.max_queue})",
+                    retry_after_s=self._retry_after_locked(),
+                )
             req = Request(
                 uid=self._next_uid,
                 prompt_tokens=prompt,
@@ -221,6 +273,7 @@ class Router:
             self._idle.clear()
             self.metrics.inc("requests_submitted_total")
             self.metrics.set_gauge("queue_depth", len(self._queue))
+            self._update_tier_queue_locked()
             self._cond.notify_all()
         return req
 
@@ -247,6 +300,8 @@ class Router:
         return self._idle.wait(timeout)
 
     def shutdown(self, drain: bool = True, timeout: Optional[float] = None) -> None:
+        if self._controller is not None:
+            self._controller.stop()
         if drain:
             self.drain(timeout)
         with self._cond:
@@ -315,6 +370,29 @@ class Router:
                 "prefix_peer_pulls": int(snap.get("prefix_peer_pulls_total", 0)),
                 "prefix_directory": self.directory.stats(),
                 "replicas": replicas,
+                "elastic": {
+                    "enabled": self._elastic is not None,
+                    "decode_replicas": len(self.decode),
+                    "min_decode_replicas": (
+                        self._elastic.min_decode_replicas
+                        if self._elastic is not None else len(self.decode)),
+                    "max_decode_replicas": (
+                        self._elastic.max_decode_replicas
+                        if self._elastic is not None else len(self.decode)),
+                    "warm_spares": (self._spares.available
+                                    if self._spares is not None else 0),
+                    "shed_level": int(snap.get("shed_level", 0)),
+                    "preempted": int(snap.get("requests_preempted_total", 0)),
+                    "resumed": int(snap.get("requests_resumed_total", 0)),
+                    "shed": int(snap.get("requests_shed_total", 0)),
+                    "scale_up": int(snap.get("scale_up_total", 0)),
+                    "scale_down": int(snap.get("scale_down_total", 0)),
+                },
+                "qos": {
+                    f"{tenant}/{tier}": cell
+                    for (tenant, tier), cell
+                    in self.metrics.tier_snapshot().items()
+                },
                 "spec": {
                     "enabled": spec is not None,
                     "k": self.spec_k,
@@ -337,9 +415,31 @@ class Router:
         return agg
 
     # -- internals -------------------------------------------------------
-    def _reject(self, reason: str, message: str = ""):
+    def _reject(self, reason: str, message: str = "",
+                retry_after_s: Optional[float] = None):
         self.metrics.inc("requests_rejected_total")
-        raise RequestRejected(reason, message)
+        raise RequestRejected(reason, message, retry_after_s=retry_after_s)
+
+    def _retry_after_locked(self) -> float:
+        """Retry-After hint from the observed queue drain rate: how long
+        until the backlog ahead of a retry has drained. Caller holds
+        ``_cond``."""
+        now = time.monotonic()
+        recent = [t for t in self._finish_times if now - t <= 30.0]
+        depth = max(1, len(self._queue))
+        if len(recent) >= 2:
+            span = max(1e-3, now - recent[0])
+            eta = depth / (len(recent) / span)
+        else:
+            eta = 5.0  # no drain history yet: a polite default
+        return float(min(120.0, max(1.0, eta)))
+
+    def _update_tier_queue_locked(self) -> None:
+        depths: Dict[tuple, int] = {}
+        for r in self._queue:
+            key = (r.params.tenant, r.params.qos)
+            depths[key] = depths.get(key, 0) + 1
+        self.metrics.set_tier_queue_depth(depths)
 
     def _terminate_locked(self, req: Request, state: str, reason: str,
                           error: Optional[str] = None):
@@ -383,9 +483,14 @@ class Router:
         t = self._tally[core.name]
         if state == RequestState.FINISHED:
             t["finished"] += 1
+            self._finish_times.append(time.monotonic())
+            self.metrics.observe_tier(req.params.tenant, req.params.qos,
+                                      "finished_total")
         if req.ttft_s is not None:
             t["ttft_sum"] += req.ttft_s
             t["ttft_n"] += 1
+            self.metrics.observe_tier(req.params.tenant, req.params.qos,
+                                      "ttft_s", req.ttft_s)
         if req.tpot_s is not None:
             t["tpot_sum"] += req.tpot_s
             t["tpot_n"] += 1
@@ -443,20 +548,35 @@ class Router:
             self._release_resv_locked(req.uid)
             self._terminate_locked(req, RequestState.TIMED_OUT, "timeout")
         self.metrics.set_gauge("queue_depth", len(self._queue))
+        self._update_tier_queue_locked()
 
     def _plan_admission_locked(self):
-        """FIFO head admission: the placement policy picks the decode
-        replica (per-replica free blocks, reservations included); in
-        disaggregated mode the least-loaded admissible prefill worker runs
-        the prefill and the decode budget is reserved on the target until
-        the handoff lands."""
+        """Head admission, best (priority, arrival) pair first — identical
+        to FIFO when every request rides the default tier. The placement
+        policy picks the decode replica (per-replica free blocks,
+        reservations included); in disaggregated mode the least-loaded
+        admissible prefill worker runs the prefill and the decode budget is
+        reserved on the target until the handoff lands. Returns a tagged
+        plan: ``("admit", req, pcore, pull)`` for a fresh request,
+        ``("resume", req, dcore)`` for a preemption checkpoint re-entering,
+        or ``("preempt", victim, vcore)`` when the head can't place but a
+        strictly-lower-tier decode could make room."""
         if not self._queue:
             return None
-        req = self._queue[0]
+        req = min(self._queue, key=lambda r: (r.priority, r.t_submit, r.uid))
         dcore = self._placement.choose(self.decode, req, self)
         if dcore is None:
+            plan = self._plan_preemption_locked(req)
+            if plan is not None:
+                return plan
             self.metrics.inc("admission_blocked_total")
             return None
+        if req._checkpoint is not None:
+            # a preempted stream re-entering: no prefill leg, no handoff
+            # reservation — the checkpoint imports straight onto the target
+            self._target[req.uid] = dcore
+            self._queue.remove(req)
+            return ("resume", req, dcore)
         if self.prefill:
             candidates = [c for c in self.prefill
                           if c.admissible(req, prefill_only=True)]
@@ -469,11 +589,58 @@ class Router:
             r = self._reserved[dcore.name]
             r[0] += blocks
             r[1] += 1
+            # _complete_handoff pops this; colocated admits have no handoff
+            # leg, so recording a "planned" replica there would leak the
+            # entry for the request's whole lifetime
+            self._target[req.uid] = dcore
         else:
             pcore = dcore
-        self._target[req.uid] = dcore
-        self._queue.popleft()
-        return (req, pcore, self._plan_prefix_pull_locked(req, pcore))
+        self._queue.remove(req)
+        return ("admit", req, pcore, self._plan_prefix_pull_locked(req, pcore))
+
+    def _plan_preemption_locked(self, req: Request):
+        """When the head of the queue can't place, look for a victim: a
+        DECODE-state request of a STRICTLY lower tier whose eviction would
+        (by block arithmetic) let the head fit on that replica. Among
+        fitting victims, the lowest tier loses first, youngest stream
+        first (it has the least sunk work). Returns ``("preempt", victim,
+        vcore)`` or None — equal-tier work is never preempted, so the
+        default-tier fleet behaves exactly as before."""
+        if self._elastic is None:
+            return None
+        best = None
+        for core in self.decode:
+            if core.retired:
+                continue
+            bs = int(core._kv_cfg("block_size", 1))
+            cap = int(core._kv_cfg("max_blocks_per_seq", 1 << 30))
+            need = core.blocks_needed(req)
+            resv = self._reserved[core.name][0]
+            free = core.free_blocks() - resv
+            committed = core.committed_blocks()
+            for victim in core.requests.values():
+                if victim.state != RequestState.DECODE:
+                    continue
+                if victim.priority <= req.priority:
+                    continue  # only strictly lower tiers are evictable
+                held = (len(victim.prompt_tokens) + victim.num_generated
+                        + bs - 1) // bs
+                budget = min((len(victim.prompt_tokens)
+                              + victim.params.max_new_tokens + bs - 1) // bs,
+                             cap)
+                # eviction returns the victim's current blocks AND its
+                # future claim; the head must fit under both ceilings (the
+                # same pair admissible() charges, else the planner preempts
+                # for a seat placement will still refuse)
+                if (need > free + held
+                        or need > core.kv_total - (committed - budget) - resv):
+                    continue  # evicting this one still wouldn't seat the head
+                key = (victim.priority, victim.t_first_token or 0.0)
+                if best is None or key > best[0]:
+                    best = (key, victim, core)
+        if best is None:
+            return None
+        return ("preempt", best[1], best[2])
 
     def _plan_prefix_pull_locked(self, req: Request, seed_core: EngineCore):
         """Directory consult for the core that will SEED this request (the
@@ -569,7 +736,18 @@ class Router:
                         poll = self.poll_interval_s * 5
                         timeout = min(poll, timeout) if timeout is not None else poll
                     self._cond.wait(timeout)
-            req, pcore, pull = plan
+            if plan[0] == "preempt":
+                _, victim, vcore = plan
+                if not self._execute_preemption(victim, vcore):
+                    # victim raced to a non-preemptible state: back off one
+                    # poll so the planner doesn't spin on it
+                    time.sleep(self.poll_interval_s)
+                continue
+            if plan[0] == "resume":
+                _, req, dcore = plan
+                self._execute_resume(req, dcore)
+                continue
+            _, req, pcore, pull = plan
             if pull is not None:
                 # seed the target's host tier from the peer BEFORE admission:
                 # submit()'s seed_from_cache then re-imports the pulled
@@ -609,6 +787,90 @@ class Router:
                 self.metrics.set_gauge("active_requests", len(self._owner))
                 self._cond.notify_all()
 
+    # -- QoS preemption / resume (elastic) -------------------------------
+    def _execute_preemption(self, victim: Request, vcore: EngineCore) -> bool:
+        """Checkpoint ``victim`` off ``vcore`` and put it back in the
+        admission queue (original ``t_submit``, so it re-enters at the
+        front of its own tier). Returns True when the preemption landed.
+        Lock order: vcore.step_lock -> self._cond."""
+        from deepspeed_tpu.serving.elastic.preemption import (
+            preempt_sequence, preemptible,
+        )
+        with vcore.step_lock:
+            with self._cond:
+                if victim.is_terminal or self._owner.get(victim.uid) is not vcore:
+                    return False
+            if not preemptible(vcore.engine, victim.uid):
+                return False  # mid-prefill or no pending token yet: not now
+            try:
+                ho = preempt_sequence(vcore.engine, victim.uid)
+            except Exception as e:
+                logger.warning(
+                    f"serving: preempting uid={victim.uid} on {vcore.name} "
+                    f"failed: {type(e).__name__}: {e}")
+                return False
+            vcore.release(victim.uid)
+            with self._cond:
+                victim._checkpoint = ho
+                victim.preemptions += 1
+                victim.state = RequestState.QUEUED
+                self._owner.pop(victim.uid, None)
+                self._queue.append(victim)
+                self.metrics.inc("requests_preempted_total")
+                self.metrics.observe_tier(victim.params.tenant,
+                                          victim.params.qos, "preempted_total")
+                self.metrics.set_gauge("queue_depth", len(self._queue))
+                self._update_tier_queue_locked()
+                self._cond.notify_all()
+        return True
+
+    def preempt(self, uid: int) -> bool:
+        """Forcibly checkpoint a running request back into the admission
+        queue (the test/operator entry point; the planner path preempts
+        on tier pressure automatically)."""
+        with self._cond:
+            req = self._by_uid.get(uid)
+            core = self._owner.get(uid)
+        if req is None or core is None:
+            return False
+        return self._execute_preemption(req, core)
+
+    def _execute_resume(self, req: Request, dcore: EngineCore) -> None:
+        """Import a preemption checkpoint onto its planned replica and make
+        the stream RUNNING again — the mirror of ``_complete_handoff``."""
+        from deepspeed_tpu.serving.elastic.preemption import resume_sequence
+        ho = req._checkpoint
+        with dcore.step_lock:
+            if req.is_terminal:
+                with self._cond:
+                    self._target.pop(req.uid, None)
+                return
+            try:
+                resume_sequence(dcore.engine, ho)
+            except Exception as e:
+                logger.warning(
+                    f"serving: resume of uid={req.uid} onto {dcore.name} "
+                    f"failed: {type(e).__name__}: {e}")
+                with self._cond:
+                    self._release_resv_locked(req.uid)
+                    self._by_uid.pop(req.uid, None)
+                    self._cancel_uids.discard(req.uid)
+                    self._terminate_locked(
+                        req, RequestState.FAILED, "error",
+                        error=f"resume import: {type(e).__name__}: {e}")
+                return
+            with self._cond:
+                dcore.requests[req.uid] = req
+                self._owner[req.uid] = dcore
+                self._target.pop(req.uid, None)
+                req._checkpoint = None
+                req.state = RequestState.DECODE
+                self.metrics.inc("requests_resumed_total")
+                self.metrics.set_gauge("queue_depth", len(self._queue))
+                self.metrics.set_gauge("active_requests", len(self._owner))
+                self._update_tier_queue_locked()
+                self._cond.notify_all()
+
     # -- handoff ---------------------------------------------------------
     def _complete_handoff(self, req: Request, ho):
         with self._cond:
@@ -641,6 +903,115 @@ class Router:
                 self.metrics.inc("kv_handoff_blocks_total", ho.n_blocks)
                 self.metrics.inc("kv_handoff_blocks_copied_total", copied)
                 self._cond.notify_all()
+
+    # -- elastic fleet (autoscaling) -------------------------------------
+    def scaling_signals(self):
+        """One control-loop sample of admission pressure (see
+        :class:`ScalingSignals`)."""
+        from deepspeed_tpu.serving.elastic.controller import ScalingSignals
+        with self._cond:
+            now = time.monotonic()
+            slacks = [r.deadline - now for r in self._queue
+                      if r.deadline is not None]
+            return ScalingSignals(
+                queue_depth=len(self._queue),
+                active_requests=len(self._owner),
+                n_decode=len(self.decode),
+                spares_available=(self._spares.available
+                                  if self._spares is not None else 0),
+                min_queue_slack_s=min(slacks) if slacks else None,
+            )
+
+    def add_decode_replica(self, engine=None) -> Optional[EngineCore]:
+        """Grow the decode fleet by one replica. Without an explicit
+        ``engine`` a warm spare is drawn from the pool (its post-warm trace
+        signature rides along as ``core._warm_baseline`` — the recompile
+        assertion's anchor). Returns the new core, or None when no engine
+        is available. Safe before or after ``start()``."""
+        baseline = None
+        if engine is None and self._spares is not None:
+            engine, baseline = self._spares.acquire()
+        if engine is None:
+            return None
+        tmpl = self.decode[0]
+        with self._cond:
+            name = f"d{self._decode_seq}"
+            self._decode_seq += 1
+        core = EngineCore(
+            engine, name=name, role=tmpl.role,
+            decode_steps=tmpl.decode_steps, kv_headroom=tmpl.kv_headroom,
+            spec_k=tmpl.spec_k, metrics=self.metrics,
+        )
+        core._warm_baseline = baseline
+        with self._cond:
+            self.decode.append(core)
+            self.cores.append(core)
+            self._reserved[core.name] = [0, 0]
+            self._tally[core.name] = {"finished": 0, "ttft_sum": 0.0,
+                                      "ttft_n": 0, "tpot_sum": 0.0,
+                                      "tpot_n": 0}
+            if self._threads and not self._stopping:
+                t = threading.Thread(target=self._worker, args=(core,),
+                                     name=f"serving-{core.name}", daemon=True)
+                self._threads.append(t)
+                t.start()
+            self.metrics.inc("scale_up_total")
+            self.metrics.set_gauge("decode_replicas", len(self.decode))
+            if self._spares is not None:
+                self.metrics.set_gauge("warm_spares", self._spares.available)
+            self._cond.notify_all()
+        return core
+
+    def remove_decode_replica(self) -> Optional[str]:
+        """Retire one IDLE decode replica (no resident requests, no
+        reservations, no planned targets, above the configured minimum) and
+        return its engine to the warm-spare pool (re-warmed — scale-down
+        must leave the spare as admission-ready as spawn did). Returns the
+        retired core's name or None when nothing is retirable."""
+        floor = (self._elastic.min_decode_replicas
+                 if self._elastic is not None else 1)
+        with self._cond:
+            if len(self.decode) <= floor:
+                return None
+            victim = None
+            for core in reversed(self.decode):
+                if core.retired or core.requests:
+                    continue
+                if any(self._reserved[core.name]):
+                    continue
+                if any(t is core for t in self._target.values()):
+                    continue
+                victim = core
+                break
+            if victim is None:
+                return None
+            victim.retired = True
+            self.decode.remove(victim)
+            self.cores.remove(victim)
+            self.metrics.inc("scale_down_total")
+            self.metrics.set_gauge("decode_replicas", len(self.decode))
+            self._cond.notify_all()
+        if self._spares is not None:
+            # re-warm under the victim's step lock: its worker may still be
+            # draining its final advert pass
+            with victim.step_lock:
+                self._spares.add(victim.engine)
+            with self._cond:
+                self.metrics.set_gauge("warm_spares", self._spares.available)
+        return victim.name
+
+    def assert_warm_replicas(self) -> int:
+        """Assert every scaled-up replica is still running ONLY programs it
+        traced at warm-up (the zero-compile admission contract). Returns
+        the number of replicas checked."""
+        from deepspeed_tpu.serving.elastic.spares import assert_no_new_traces
+        with self._cond:
+            cores = [c for c in self.decode
+                     if getattr(c, "_warm_baseline", None) is not None]
+        for core in cores:
+            assert_no_new_traces(core.engine, core._warm_baseline,
+                                 label=f"replica {core.name}")
+        return len(cores)
 
     # -- workers ---------------------------------------------------------
     def _core_flags_locked(self, core: EngineCore) -> bool:
@@ -717,6 +1088,8 @@ class Router:
                     if self._stopping and not self._queue and not self._by_uid:
                         self._cond.notify_all()
                         return
+                    if core.retired and not core.requests:
+                        return  # scaled down: the core's engine is pooled
                     work = self._core_flags_locked(core) or core.has_work()
                     now = time.monotonic()
                     deadline = self._core_deadline_locked(core)

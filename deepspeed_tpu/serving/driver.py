@@ -48,11 +48,16 @@ from deepspeed_tpu.utils.logging import logger
 
 
 class RequestRejected(Exception):
-    """Submit refused (queue full, draining, or the prompt can never fit)."""
+    """Submit refused (queue full, draining, shed, or the prompt can never
+    fit). ``retry_after_s`` — set for backpressure rejections — is the
+    server's ``Retry-After`` header, derived from the current queue drain
+    rate (how long until the queue has likely made room)."""
 
-    def __init__(self, reason: str, message: str = ""):
+    def __init__(self, reason: str, message: str = "",
+                 retry_after_s: Optional[float] = None):
         super().__init__(message or reason)
         self.reason = reason
+        self.retry_after_s = retry_after_s
 
 
 class ServingDriver:

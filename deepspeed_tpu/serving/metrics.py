@@ -131,6 +131,12 @@ class ServingMetrics:
             "spec_rounds_total": 0,
             "spec_draft_tokens_total": 0,
             "spec_accepted_tokens_total": 0,
+            # elastic control plane: QoS preemption + shedding + scaling
+            "requests_preempted_total": 0,
+            "requests_resumed_total": 0,
+            "requests_shed_total": 0,
+            "scale_up_total": 0,
+            "scale_down_total": 0,
         }
         self.gauges: Dict[str, float] = {
             "queue_depth": 0,
@@ -158,6 +164,11 @@ class ServingMetrics:
             "kv_host_tier_hit_rate": 0.0,
             "spec_acceptance_rate": 0.0,
             "spec_mean_accepted_per_round": 0.0,
+            # elastic control plane: live decode fleet size, parked warm
+            # spares, and the degradation ladder's current rung (0..3)
+            "decode_replicas": 0,
+            "warm_spares": 0,
+            "shed_level": 0,
         }
         # per-wire collective byte accounting (comm.quantized.wire_stats
         # via engine.comm_wire_info): tag -> {sites, wire_bytes_int8,
@@ -168,6 +179,11 @@ class ServingMetrics:
         # dstpu_serving_replica_* samples. The unlabeled kv_*/queue/latency
         # gauges stay the router-level rollup.
         self._replicas: Dict[str, Tuple[str, Dict[str, float]]] = {}
+        # per-(tenant, qos-tier) accounting: finished/preempted/shed
+        # counters, live queue depth, and a TTFT sum/count pair; rendered
+        # as tenant=/tier=-labeled dstpu_serving_tier_* samples so a burst
+        # trace can prove WHO was shed and WHOSE latency was protected.
+        self._tiers: Dict[Tuple[str, str], Dict[str, float]] = {}
 
     # -- writers ---------------------------------------------------------
     def inc(self, name: str, delta: float = 1) -> None:
@@ -242,6 +258,48 @@ class ServingMetrics:
         with self._lock:
             return {name: dict(st) for name, (_role, st) in self._replicas.items()}
 
+    def _tier_cell(self, tenant: str, tier: str) -> Dict[str, float]:
+        """Caller holds the lock."""
+        key = (str(tenant), str(tier))
+        cell = self._tiers.get(key)
+        if cell is None:
+            cell = self._tiers[key] = {
+                "finished_total": 0.0,
+                "preempted_total": 0.0,
+                "shed_total": 0.0,
+                "queue_depth": 0.0,
+                "ttft_sum_s": 0.0,
+                "ttft_count": 0.0,
+            }
+        return cell
+
+    def observe_tier(self, tenant: str, tier: str, stat: str,
+                     delta: float = 1.0) -> None:
+        """Bump one per-(tenant, tier) counter (``finished_total``,
+        ``preempted_total``, ``shed_total``) or fold a TTFT sample in
+        (``stat="ttft_s"``, delta = the latency)."""
+        with self._lock:
+            cell = self._tier_cell(tenant, tier)
+            if stat == "ttft_s":
+                cell["ttft_sum_s"] += float(delta)  # dstpu: noqa[host-sync-in-loop] host wall-clock float, not a device scalar
+                cell["ttft_count"] += 1.0
+            else:
+                cell[stat] = cell.get(stat, 0.0) + float(delta)  # dstpu: noqa[host-sync-in-loop] host counter delta, not a device scalar
+
+    def set_tier_queue_depth(self, depths: Dict[Tuple[str, str], int]) -> None:
+        """Replace the per-(tenant, tier) queue-depth gauges with a fresh
+        census (cells absent from ``depths`` drop to 0 — a drained tier
+        must not keep reporting its burst-time depth)."""
+        with self._lock:
+            for cell in self._tiers.values():
+                cell["queue_depth"] = 0.0
+            for (tenant, tier), depth in depths.items():
+                self._tier_cell(tenant, tier)["queue_depth"] = float(depth)  # dstpu: noqa[host-sync-in-loop] host int census, not a device scalar
+
+    def tier_snapshot(self) -> Dict[Tuple[str, str], Dict[str, float]]:
+        with self._lock:
+            return {key: dict(cell) for key, cell in self._tiers.items()}
+
     def update_prefix_cache(self, stats: Dict[str, float]) -> None:
         """Mirror a ``PrefixCache.stats()`` snapshot. The source counters
         are monotone, so assigning (not incrementing) keeps Prometheus
@@ -308,6 +366,9 @@ class ServingMetrics:
             for name, (_role, st) in self._replicas.items():
                 for key, value in st.items():
                     out[f"replica_{name}_{key}"] = value
+            for (tenant, tier), cell in self._tiers.items():
+                for key, value in cell.items():
+                    out[f"tier_{tenant}_{tier}_{key}"] = value
             return out
 
     def prometheus_text(self) -> str:
@@ -331,6 +392,12 @@ class ServingMetrics:
                 lbl = {"replica": name, "role": role}
                 for key in sorted(st):
                     samples.append((f"{p}_replica_{key}", lbl, st[key], "gauge"))
+            for tenant, tier in sorted(self._tiers):
+                cell = self._tiers[(tenant, tier)]
+                lbl = {"tenant": tenant, "tier": tier}
+                for key in sorted(cell):
+                    kind = "counter" if key.endswith("_total") else "gauge"
+                    samples.append((f"{p}_tier_{key}", lbl, cell[key], kind))
             for hname, hist in (
                 ("ttft_seconds", self.ttft),
                 ("tpot_seconds", self.tpot),

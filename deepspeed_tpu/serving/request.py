@@ -18,6 +18,13 @@ import numpy as np
 from deepspeed_tpu.serving.spec import SpecParams
 
 
+# QoS tiers, lowest number = highest priority. Admission serves the
+# best (priority, arrival) pair; preemption only ever evicts a STRICTLY
+# lower tier, and load shedding rejects from the bottom up.
+QOS_TIERS = {"interactive": 0, "standard": 1, "batch": 2}
+QOS_LOWEST = max(QOS_TIERS, key=QOS_TIERS.get)
+
+
 class RequestState:
     """Lifecycle states (string constants — cheap to compare and to export
     as a metric label; no enum dependency in hot paths)."""
@@ -60,6 +67,11 @@ class SamplingParams:
     # its draft length. Never changes WHAT the request generates (verify
     # rounds are bit-identical to plain decode), only how fast.
     spec: Optional[SpecParams] = None
+    # QoS class (QOS_TIERS) and billing/tenant label. The tier drives
+    # admission order, preemption victimhood, and shed order; the tenant
+    # only labels metrics (tenant=/tier= samples in /metrics).
+    qos: str = "standard"
+    tenant: str = "default"
 
     def __post_init__(self):
         if self.max_new_tokens < 1:
@@ -67,6 +79,11 @@ class SamplingParams:
         self.stop_token_ids = tuple(int(t) for t in self.stop_token_ids)
         if isinstance(self.spec, dict):  # JSON bodies arrive as dicts
             self.spec = SpecParams(**self.spec)
+        if self.qos not in QOS_TIERS:
+            raise ValueError(
+                f"unknown qos {self.qos!r} (one of {sorted(QOS_TIERS)})"
+            )
+        self.tenant = str(self.tenant)
 
 
 @dataclass
@@ -99,11 +116,22 @@ class Request:
 
     stream: Optional["TokenStream"] = None  # attached by the driver
     _done: threading.Event = field(default_factory=threading.Event, repr=False)
+    # preempt-and-resume (elastic serving): times this stream was evicted
+    # for a higher tier, and — while re-queued — the KV checkpoint its
+    # resume imports from (a ``KVHandoff``; None when never preempted or
+    # already resumed).
+    preemptions: int = 0
+    _checkpoint: Optional[object] = field(default=None, repr=False)
 
     def __post_init__(self):
         self.prompt_tokens = np.asarray(self.prompt_tokens, np.int32).reshape(-1)
 
     # -- state ----------------------------------------------------------
+    @property
+    def priority(self) -> int:
+        """Admission rank from the QoS tier (lower = served first)."""
+        return QOS_TIERS[self.params.qos]
+
     @property
     def is_terminal(self) -> bool:
         return self.state in RequestState.TERMINAL

@@ -54,6 +54,8 @@ def parse_generate(body: dict, tokenizer=None) -> Tuple[np.ndarray, SamplingPara
             ignore_eos=bool(body.get("ignore_eos", False)),
             stop_token_ids=tuple(body.get("stop_token_ids", ())),
             spec=spec,
+            qos=str(body.get("qos", "standard")),
+            tenant=str(body.get("tenant", "default")),
         )
     except TypeError as e:  # unknown spec key → client error, not a 500
         raise ValueError(f"bad spec params: {e}")
@@ -76,11 +78,13 @@ def make_handler(driver: ServingDriver, tokenizer=None):
             logger.debug("serving-http: " + fmt % args)
 
         # -- helpers ----------------------------------------------------
-        def _json(self, code: int, obj: dict):
+        def _json(self, code: int, obj: dict, headers: Optional[dict] = None):
             payload = json.dumps(obj).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(payload)))
+            for name, value in (headers or {}).items():
+                self.send_header(name, str(value))
             self.end_headers()
             self.wfile.write(payload)
 
@@ -118,8 +122,15 @@ def make_handler(driver: ServingDriver, tokenizer=None):
             try:
                 req = driver.submit(prompt, params=params, timeout_s=timeout_s)
             except RequestRejected as e:
-                code = 503 if e.reason in ("queue_full", "draining") else 400
-                self._json(code, {"error": str(e), "reason": e.reason})
+                code = 503 if e.reason in ("queue_full", "draining", "shed") else 400
+                out = {"error": str(e), "reason": e.reason}
+                headers = {}
+                if code == 503 and e.retry_after_s is not None:
+                    # RFC 7231 delay-seconds (integral, at least 1)
+                    retry = max(1, int(round(e.retry_after_s)))
+                    out["retry_after_s"] = retry
+                    headers["Retry-After"] = retry
+                self._json(code, out, headers=headers)
                 return
             if stream:
                 self._stream_response(req)

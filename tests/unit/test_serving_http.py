@@ -50,10 +50,46 @@ class TestParseGenerate:
         ({}, "needs"),
         ({"tokens": []}, "empty"),
         ({"tokens": [1], "timeout_s": -1}, "positive"),
+        ({"tokens": [1], "qos": "platinum"}, "unknown qos"),
     ])
     def test_invalid_bodies(self, body, msg):
         with pytest.raises(ValueError, match=msg):
             parse_generate(body)
+
+    def test_qos_and_tenant_fields(self):
+        _, params, _, _ = parse_generate(
+            {"tokens": [1, 2], "qos": "interactive", "tenant": "acme"})
+        assert params.qos == "interactive" and params.tenant == "acme"
+        _, params, _, _ = parse_generate({"tokens": [1]})
+        assert params.qos == "standard" and params.tenant == "default"
+
+
+class TestOverloadResponses:
+    def test_queue_full_503_with_retry_after(self):
+        """A Router rejection surfaces as 503 + RFC 7231 Retry-After (the
+        router is never started, so the queue occupancy is deterministic)."""
+        from deepspeed_tpu.serving.cluster import Router
+
+        router = Router(engines=[FakeEngine()], num_prefill_workers=0,
+                        max_queue=1)
+        router.submit(np.asarray([1], np.int32))
+        server = start_server(router, host="127.0.0.1", port=0, tokenizer=None)
+        host, port = server.server_address[:2]
+        try:
+            body = json.dumps({"tokens": [5], "max_new_tokens": 2}).encode()
+            req = urllib.request.Request(f"http://{host}:{port}/generate",
+                                         data=body, method="POST")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 503
+            retry = int(ei.value.headers["Retry-After"])
+            assert 1 <= retry <= 120
+            out = json.loads(ei.value.read())
+            assert out["reason"] == "queue_full"
+            assert out["retry_after_s"] == retry
+        finally:
+            server.shutdown()
+            router.shutdown(drain=False)
 
 
 @pytest.mark.slow

@@ -76,6 +76,14 @@ echo "== disaggregated-serving parity gate (router, 2 replicas) =="
 # runs the file unfiltered so the slow-marked int8 combo is included
 python -m pytest tests/unit/test_disagg.py -q -p no:cacheprovider
 
+echo "== elastic-serving parity gate (preempt/resume + warm scale-up) =="
+# preempted-and-resumed streams must be BIT-IDENTICAL to uninterrupted
+# ones (greedy + seeded, bf16 + int8 KV), scale-up from a warm spare must
+# trace ZERO new step programs (recompile-counter assertion), the QoS
+# ladder sheds lowest-tier-first; runs the file unfiltered so the
+# slow-marked int8 combo is included
+python -m pytest tests/unit/test_elastic.py -q -p no:cacheprovider
+
 echo "== donation/recompile verifier (Tier B) =="
 # includes the disagg pass: decode replicas' donated step programs must
 # survive the extracted scheduler + KV-handoff import path
