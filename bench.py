@@ -1409,6 +1409,12 @@ def bench_serving_load(
     if load_trace:
         elastic_report = {"elastic_burst": bench_elastic_burst(
             load_trace, cfg=cfg, params=params, seed=seed)}
+    # tracing-overhead rider: DSTPU_TRACE_AB=1 appends a tracing-on vs
+    # tracing-off decode tok/s comparison and asserts the <=2% gate
+    trace_report = {}
+    if os.environ.get("DSTPU_TRACE_AB", "") == "1":
+        trace_report = {"trace_overhead": bench_trace_overhead_ab(
+            cfg=cfg, params=params, seed=seed)}
     return {
         "mode": "serving_load",
         "n_requests": n_requests,
@@ -1431,6 +1437,107 @@ def bench_serving_load(
         **co_report,
         **disagg_report,
         **elastic_report,
+        **trace_report,
+    }
+
+
+def bench_trace_overhead_ab(cfg=None, params=None, seed=0, max_pct=None):
+    """Tracing-overhead A/B (``python bench.py --trace-overhead`` or riding
+    ``--serving-load`` via DSTPU_TRACE_AB=1): decode tok/s with the span
+    tracer fully on — request trees, engine dispatch/device_wait hooks,
+    the /debug/trace retention machinery — must stay within 2% of tracing
+    off.  One serving stack serves both arms (no compile variance);
+    trials alternate off/on so clock drift hits both equally, and each
+    arm reports its best trial.  The gate ASSERTS: blowing past
+    DSTPU_TRACE_AB_PCT (default 2.0) is a regression in the no-op path
+    or a hot-loop span leak, not noise to wave off.
+    Knobs: DSTPU_TRACE_N (requests/trial), DSTPU_TRACE_MAX_NEW,
+    DSTPU_TRACE_TRIALS (per arm)."""
+    from deepspeed_tpu.inference.config import RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.models import TransformerConfig, init_params
+    from deepspeed_tpu.observability import NULL_TRACER, SpanTracer, set_tracer
+    from deepspeed_tpu.serving.driver import ServingDriver
+    from deepspeed_tpu.serving.request import SamplingParams
+
+    # many SHORT trials, best-of per arm: scheduler/cgroup stalls only ever
+    # slow a trial down, so the per-arm maximum converges on the machine's
+    # true rate much faster than the mean of a few long trials does
+    n_requests = int(os.environ.get("DSTPU_TRACE_N", 4))
+    max_new = int(os.environ.get("DSTPU_TRACE_MAX_NEW", 32))
+    trials = int(os.environ.get("DSTPU_TRACE_TRIALS", 10))
+    max_pct = float(max_pct if max_pct is not None
+                    else os.environ.get("DSTPU_TRACE_AB_PCT", 2.0))
+    if cfg is None:
+        cfg = TransformerConfig(
+            vocab_size=256, hidden_size=256, n_layers=2, n_heads=4,
+            max_seq_len=1024, dtype="float32",
+        )
+        params = init_params(cfg, jax.random.key(0))
+    rc = RaggedInferenceEngineConfig.from_dict({
+        "dtype": cfg.dtype,
+        "kv_cache": {"block_size": 16, "num_blocks": 384,
+                     "max_blocks_per_seq": 16},
+        "state_manager": {"max_tracked_sequences": 64,
+                          "max_ragged_batch_size": 96,
+                          "max_ragged_sequence_count": 16,
+                          "max_context": 256},
+    })
+    engine = InferenceEngineV2(cfg, params, rc)
+    driver = ServingDriver(engine, max_queue=n_requests + 1).start()
+
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, cfg.vocab_size, size=(int(l),)).astype(np.int32)
+               for l in rng.integers(4, 12, size=n_requests)]
+
+    def trial():
+        # clock on process CPU time, not wall: cgroup throttling and noisy
+        # neighbours stall the wall clock but never accrue CPU, so tok per
+        # CPU-second isolates the work tracing itself adds (the wall-clock
+        # variance on a shared box dwarfs a 2% gate; CPU time does not)
+        c0 = time.process_time()
+        reqs = [driver.submit(p, params=SamplingParams(
+            max_new_tokens=max_new, ignore_eos=True)) for p in prompts]
+        for r in reqs:
+            r.wait(600)
+        cpu = time.process_time() - c0
+        toks = sum(len(r.generated) for r in reqs if r.state == "finished")
+        assert toks == n_requests * max_new, "trial did not finish cleanly"
+        return toks / cpu
+
+    try:
+        set_tracer(NULL_TRACER)
+        trial()  # warm the compiled shapes outside both arms
+        pairs = []
+        for _ in range(trials):
+            set_tracer(NULL_TRACER)
+            a = trial()
+            set_tracer(SpanTracer())
+            b = trial()
+            pairs.append((a, b))
+    finally:
+        set_tracer(NULL_TRACER)
+        driver.shutdown(drain=True, timeout=60)
+
+    # residual CPU-time noise (GC, allocator) is still one-sided, so judge
+    # the median ratio of the 3 calmest back-to-back pairs
+    calm = sorted(pairs, key=lambda p: p[0] + p[1], reverse=True)[:3]
+    ratios = sorted(b / a for a, b in calm)
+    overhead_pct = (1.0 - ratios[len(ratios) // 2]) * 100.0
+    off_best, on_best = calm[0]
+    if overhead_pct > max_pct:
+        raise AssertionError(
+            f"tracing overhead {overhead_pct:.2f}% exceeds the {max_pct}% "
+            f"gate (off {off_best:.1f} tok/s vs on {on_best:.1f} tok/s)")
+    return {
+        "n_requests": n_requests,
+        "max_new": max_new,
+        "trials_per_arm": trials,
+        "off_tok_s": round(off_best, 1),
+        "on_tok_s": round(on_best, 1),
+        "overhead_pct": round(overhead_pct, 3),
+        "gate_pct": max_pct,
+        "within_gate": True,
     }
 
 
@@ -1439,5 +1546,7 @@ if __name__ == "__main__":
 
     if "--serving-load" in sys.argv[1:]:
         print(json.dumps(bench_serving_load()))
+    elif "--trace-overhead" in sys.argv[1:]:
+        print(json.dumps(bench_trace_overhead_ab()))
     else:
         main()

@@ -261,6 +261,16 @@ def serve_parse_args(argv=None):
                    "re-prefilling; int8 pools pack ~2x the blocks per byte")
     p.add_argument("--kv-host-tier-chunk-blocks", type=int, default=8,
                    help="blocks per double-buffered re-import window")
+    p.add_argument("--trace", action="store_true",
+                   help="enable end-to-end request tracing: per-request "
+                   "span trees + engine-step timeline, served at "
+                   "/debug/trace and dumpable with `dstpu trace dump`")
+    p.add_argument("--trace-buffer-events", type=int, default=65536,
+                   help="total span budget across retained traces and the "
+                   "engine timeline ring")
+    p.add_argument("--trace-capture", default="all", choices=("all", "slow"),
+                   help="retention policy: 'slow' keeps only requests at/"
+                   "above the p90 e2e latency plus errors and preemptions")
     p.add_argument("--sample", action="store_true")
     p.add_argument("--temperature", type=float, default=1.0)
     p.add_argument("--top-k", type=int, default=0)
@@ -281,6 +291,14 @@ def build_serving_stack(args, cfg=None, params=None, tok=None):
     from deepspeed_tpu.serving.cluster import Router
     from deepspeed_tpu.serving.driver import ServingDriver
 
+    if getattr(args, "trace", False):
+        from deepspeed_tpu.observability import configure_tracing
+
+        configure_tracing(
+            enabled=True,
+            max_events=int(getattr(args, "trace_buffer_events", 65536)),
+            capture=getattr(args, "trace_capture", "all"),
+        )
     if cfg is None or params is None:
         from deepspeed_tpu.models import load_hf_model
 
@@ -411,8 +429,11 @@ def serve_main(argv=None) -> int:
     driver.start()
     server = start_server(driver, host=args.host, port=args.port, tokenizer=tok)
     host, port = server.server_address[:2]
+    endpoints = "/generate, /health, /metrics"
+    if getattr(args, "trace", False):
+        endpoints += ", /debug/trace, /debug/events"
     print(f"dstpu serve: listening on http://{host}:{port} "
-          f"(/generate, /health, /metrics)", file=sys.stderr)
+          f"({endpoints})", file=sys.stderr)
     try:
         while True:
             import time

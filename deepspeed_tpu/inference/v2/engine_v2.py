@@ -22,7 +22,9 @@ from deepspeed_tpu.inference.config import RaggedInferenceEngineConfig
 from deepspeed_tpu.inference.v2.ragged_manager import DSStateManager
 from deepspeed_tpu.inference.v2.scheduler import RaggedBatch, RaggedScheduler
 from deepspeed_tpu.models import transformer as T
+from deepspeed_tpu.observability.tracing import get_tracer
 from deepspeed_tpu.utils.logging import log_dist
+from deepspeed_tpu.utils.timer import device_synchronize
 
 _CHUNK_BUCKETS = (1, 8, 32, 64, 128, 256, 512)
 
@@ -530,6 +532,11 @@ class InferenceEngineV2:
         store = self._host_tier
         if store is None:
             return
+        tr = get_tracer()
+        if tr.enabled:
+            tr.instant("host_tier.spill",
+                       track=getattr(self, "_trace_name", "engine"),
+                       args={"block": int(block)})
         payload = self.export_kv_blocks([block])
         store.put(hkey, {name: plane[:, 0] for name, plane in payload.items()})
 
@@ -577,7 +584,11 @@ class InferenceEngineV2:
             name: np.stack([p[name] for p in payloads], axis=1)
             for name in payloads[0]
         }
-        self.import_kv_blocks_chunked(fresh, stacked)
+        tr = get_tracer()
+        with tr.span("host_tier.readmit",
+                     track=getattr(self, "_trace_name", "engine"),
+                     args={"blocks": run} if tr.enabled else None):
+            self.import_kv_blocks_chunked(fresh, stacked)
         seq.seen_tokens = n_cached + run * bs
         store.note_readmits(run)
         # re-register the readmitted prefix: the trie takes its own
@@ -1430,6 +1441,8 @@ class InferenceEngineV2:
             uids.append(uid)
         if not uids:
             return {}
+        tr = get_tracer()
+        t0 = tr.now() if tr.enabled else 0.0
         kv = self.config.kv_cache
         B = kv.max_blocks_per_seq
         trash = kv.num_blocks
@@ -1464,6 +1477,15 @@ class InferenceEngineV2:
         toks_out, logps_out, self._k_cache, self._v_cache = outs[:4]
         if self._kv_int8:
             self._ks_cache, self._vs_cache = outs[4], outs[5]
+        if tr.enabled:
+            # dispatch (staging + async launch) vs device wait, on this
+            # replica's engine track
+            track = getattr(self, "_trace_name", "engine")
+            tr.complete("engine.dispatch", t0, track=track,
+                        args={"rows": len(uids), "steps": n})
+            t1 = tr.now()
+            device_synchronize((toks_out, logps_out))
+            tr.complete("engine.device_wait", t1, track=track)
         toks_out = np.asarray(toks_out)  # [n, R]
         logps_out = np.asarray(logps_out)
         results: Dict[int, np.ndarray] = {}
@@ -1694,6 +1716,8 @@ class InferenceEngineV2:
             n_input[i] = 1 + len(d)
         if k not in self._verify_jit:
             self._verify_jit[k] = self._build_verify_step(k)
+        tr = get_tracer()
+        t0 = tr.now() if tr.enabled else 0.0
         outs = self._verify_jit[k](
             self.params,
             jnp.asarray(tokens),
@@ -1711,6 +1735,13 @@ class InferenceEngineV2:
         tgt, n_emit, logp, self._k_cache, self._v_cache = outs[:5]
         if self._kv_int8:
             self._ks_cache, self._vs_cache = outs[5], outs[6]
+        if tr.enabled:
+            track = getattr(self, "_trace_name", "engine")
+            tr.complete("engine.dispatch", t0, track=track,
+                        args={"rows": len(uids), "k": k})
+            t1 = tr.now()
+            device_synchronize((tgt, n_emit, logp))
+            tr.complete("engine.device_wait", t1, track=track)
         tgt = np.asarray(tgt)
         n_emit = np.asarray(n_emit)
         logp = np.asarray(logp)
@@ -1754,9 +1785,33 @@ class InferenceEngineV2:
         completed a prompt or decode token — the serving driver's step
         primitive. Takes the IN-PROGRAM sampled token (greedy or sampled per
         the engine's static sampling config), never a host argmax, so driven
-        serving reproduces ``generate()`` token-for-token."""
-        out: Dict[int, int] = {}
-        for uid, tok in _materialize_rows(self._step_device(), want_tokens=True).items():
+        serving reproduces ``generate()`` token-for-token.
+
+        When tracing is on, the step is bracketed into an ``engine.dispatch``
+        span (host-side staging + async program launch) and an
+        ``engine.device_wait`` span (blocking on the result arrays), so
+        host-side queueing and device time separate on the timeline. The
+        hooks deliberately wrap the CALLER of ``_step_device`` — that
+        function itself must stay sync-free so ``generate()``'s prefill
+        pipelining is untouched."""
+        tr = get_tracer()
+        if not tr.enabled:
+            out: Dict[int, int] = {}
+            for uid, tok in _materialize_rows(self._step_device(), want_tokens=True).items():
+                out[uid] = int(tok) if np.ndim(tok) == 0 else int(np.argmax(tok))
+            return out
+        track = getattr(self, "_trace_name", "engine")
+        t0 = tr.now()
+        res = self._step_device()
+        tr.complete("engine.dispatch", t0, track=track, args={
+            "rows": len(res),
+            "tokens": int(getattr(self, "last_scheduled_tokens", 0) or 0),
+        })
+        t1 = tr.now()
+        device_synchronize(list(res.values()))
+        tr.complete("engine.device_wait", t1, track=track)
+        out = {}
+        for uid, tok in _materialize_rows(res, want_tokens=True).items():
             out[uid] = int(tok) if np.ndim(tok) == 0 else int(np.argmax(tok))
         return out
 

@@ -282,6 +282,11 @@ def main(argv=None) -> int:
         from deepspeed_tpu.analysis.cli import lint_main
 
         return lint_main(argv[1:])
+    if argv and argv[0] == "trace":
+        # timeline tooling: dstpu trace dump --url http://HOST:PORT --out X
+        from deepspeed_tpu.observability.cli import trace_main
+
+        return trace_main(argv[1:])
     args = parse_args(argv)
     if args.autotuning:
         return run_autotuning(args)

@@ -30,6 +30,19 @@ def prometheus_metric_name(name: str) -> str:
     return name
 
 
+def escape_label_value(value) -> str:
+    """Escape a label VALUE per the exposition-format spec: backslash,
+    double quote, and line feed. Label values can be user-supplied
+    (tenant/tier strings off HTTP bodies) — an unescaped newline would
+    let one request break every scraper of the shared ``/metrics``."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 def render_prometheus_text(samples: List[Tuple]) -> str:
     """Render ``(name, labels_dict_or_None, value, type_or_None)`` samples as
     Prometheus text exposition. Consecutive samples of one metric share a
@@ -46,7 +59,7 @@ def render_prometheus_text(samples: List[Tuple]) -> str:
         label_s = ""
         if labels:
             inner = ",".join(
-                '%s="%s"' % (k, str(v).replace("\\", "\\\\").replace('"', '\\"'))
+                '%s="%s"' % (k, escape_label_value(v))
                 for k, v in labels.items()
             )
             label_s = "{" + inner + "}"

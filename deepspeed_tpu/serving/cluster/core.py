@@ -29,6 +29,7 @@ would be silently dropped when the step's donated carry lands.
 import threading
 from typing import Dict, Optional
 
+from deepspeed_tpu.observability.tracing import get_tracer
 from deepspeed_tpu.serving.request import Request
 from deepspeed_tpu.utils.logging import logger
 
@@ -67,6 +68,12 @@ class EngineCore:
         self.kv_info: Dict = {}
         if hasattr(engine, "kv_pool_info"):
             self.kv_info = dict(engine.kv_pool_info())
+        # name the engine's timeline track after the core so its internal
+        # dispatch/device_wait spans land on this replica's row
+        try:
+            engine._trace_name = self.name
+        except (AttributeError, TypeError):  # slotted/frozen fakes
+            pass
         # per-replica tallies for the labeled /metrics gauges
         self.decode_tokens = 0
         self.handoffs_in = 0
@@ -285,14 +292,36 @@ class EngineCore:
             drafts[uid] = list(self.proposer.propose(hist, k))
         return drafts
 
+    def _trace_round(self, tr, name: str, t0: float, t1: float,
+                     uids, args: Dict) -> None:
+        """Record one step round on this core's engine track AND mirror it
+        into every participating traced request's tree (parented on the
+        request's current lifecycle phase), so a single request timeline
+        shows exactly which rounds moved it."""
+        tr.complete(name, t0, t1, track=self.name, args=args)
+        for uid in uids:
+            req = self.requests.get(uid)
+            if req is not None and req.trace is not None:
+                tr.complete(name, t0, t1, key=uid, parent=req.trace.phase)
+
     def _spec_step(self, sink, sched) -> bool:
         """One speculative verify round: propose drafts, verify K+1 tokens
         per row in one program, deliver the accepted burst. Returns True
         when the round ran (progress or not); the caller falls through to
         plain stepping when no row drafted anything."""
-        drafts = self._build_drafts()
+        tr = get_tracer()
+        if tr.enabled:
+            t0 = tr.now()
+            drafts = self._build_drafts()
+            tr.complete("spec.draft", t0, track=self.name, args={
+                "rows": len(drafts),
+                "draft_tokens": sum(len(d) for d in drafts.values()),
+            })
+        else:
+            drafts = self._build_drafts()
         if not any(drafts.values()):
             return False  # nothing to verify: fused decode round is cheaper
+        t0 = tr.now() if tr.enabled else 0.0
         round_res = self.engine.spec_round(self.spec_k, drafts=drafts)
         if not round_res:
             # every row was skipped (context/block caps, pool exhaustion):
@@ -300,6 +329,13 @@ class EngineCore:
             return False
         self._inc("engine_steps_total")
         per_uid = dict(self.engine.last_spec.get("per_uid", {}))
+        if tr.enabled:
+            last = getattr(self.engine, "last_spec", None) or {}
+            self._trace_round(tr, "round.verify", t0, tr.now(), round_res, {
+                "rows": len(round_res),
+                "drafted": int(last.get("drafted", 0)),
+                "accepted": int(last.get("accepted", 0)),
+            })
         if self.metrics is not None:
             self.metrics.observe_spec_round(per_uid)
         for uid, (drafted, accepted) in per_uid.items():
@@ -334,13 +370,22 @@ class EngineCore:
             and bool(sched.running_uids())
         )
         progress = False
+        tr = get_tracer()
         try:
             if use_spec and self._spec_step(sink, sched):
                 return True
             if use_round:
+                t0 = tr.now() if tr.enabled else 0.0
                 round_res = self.engine.decode_round(self.decode_steps)
                 if round_res:
                     self._inc("engine_steps_total")
+                    if tr.enabled:
+                        self._trace_round(tr, "round.fused", t0, tr.now(),
+                                          round_res, {
+                            "rows": len(round_res),
+                            "steps": self.decode_steps,
+                            "tokens": sum(len(t) for t in round_res.values()),
+                        })
                     for uid, toks in round_res.items():
                         req = self.requests.get(uid)
                         if req is None:
@@ -352,8 +397,15 @@ class EngineCore:
                                 break
                     self._reap_capped(sink)
                     return progress
+            t0 = tr.now() if tr.enabled else 0.0
             results = self.engine.step_tokens()
             self._inc("engine_steps_total")
+            if tr.enabled:
+                self._trace_round(tr, "step.split", t0, tr.now(), results, {
+                    "rows": len(results),
+                    "tokens": int(getattr(self.engine,
+                                          "last_scheduled_tokens", 0) or 0),
+                })
         except Exception as e:
             # engine-level failure: per-request state is unknowable, so the
             # in-flight set fails — but the owner survives for new requests

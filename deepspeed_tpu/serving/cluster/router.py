@@ -36,6 +36,16 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from deepspeed_tpu.observability.events import log_event
+from deepspeed_tpu.observability.tracing import (
+    begin_request_trace,
+    finish_request_trace,
+    get_tracer,
+    mark_admitted,
+    mark_first_token,
+    mark_preempted,
+    mark_resumed,
+)
 from deepspeed_tpu.serving.cluster.core import EngineCore
 from deepspeed_tpu.serving.cluster.handoff import export_sequence, import_sequence
 from deepspeed_tpu.serving.cluster.placement import get_placement
@@ -153,6 +163,9 @@ class Router:
             )
             self._shed = DegradationLadder(elastic)
             self._controller = ElasticController(self, elastic)
+        # the ladder is stateless per call; the router remembers the last
+        # rung so level CHANGES land in the control-plane event log
+        self._last_shed_level = 0
         self._decode_seq = len(self.decode)  # next dN replica name
         self._finish_times: deque = deque(maxlen=64)  # Retry-After drain rate
 
@@ -242,6 +255,13 @@ class Router:
                 decision = self._shed.apply(params, len(self._queue),
                                             self.max_queue)
                 self.metrics.set_gauge("shed_level", decision.level)
+                if decision.level != self._last_shed_level:
+                    log_event("shed_level",
+                              level=decision.level,
+                              prev=self._last_shed_level,
+                              queue_depth=len(self._queue),
+                              max_queue=self.max_queue)
+                    self._last_shed_level = decision.level
                 if decision.reject:
                     self.metrics.inc("requests_shed_total")
                     self.metrics.observe_tier(params.tenant, params.qos,
@@ -268,6 +288,12 @@ class Router:
             )
             self._next_uid += 1
             req.stream = TokenStream(req.uid)
+            tracer = get_tracer()
+            if tracer.enabled:
+                extra = None
+                if self._shed is not None and self._last_shed_level:
+                    extra = {"shed_level": self._last_shed_level}
+                begin_request_trace(tracer, req, extra=extra)
             self._queue.append(req)
             self._by_uid[req.uid] = req
             self._idle.clear()
@@ -450,7 +476,13 @@ class Router:
         if req.stream is not None:
             req.stream.close(reason, error=error)
         req._done.set()
-        self.metrics.observe_request(req)
+        if req.trace is not None:
+            # traced path: histograms fold from the SPAN endpoints (same
+            # numbers — the spans carry the request's own stamps)
+            self.metrics.observe_trace(req)
+            finish_request_trace(req, reason=reason)
+        else:
+            self.metrics.observe_request(req)
         key = {
             RequestState.FINISHED: "requests_finished_total",
             RequestState.CANCELLED: "requests_cancelled_total",
@@ -504,6 +536,8 @@ class Router:
                 if req.t_first_token is None:
                     req.t_first_token = now
                     req.state = RequestState.DECODE
+                    if req.trace is not None:
+                        mark_first_token(req)
                 req.generated.append(int(token))
                 self.metrics.inc("decode_tokens_total")
                 core.decode_tokens += 1
@@ -527,6 +561,8 @@ class Router:
         return not req.is_terminal
 
     def engine_failed(self, core: EngineCore, error: str):
+        log_event("engine_failed", replica=core.name, error=error,
+                  in_flight=len(core.requests))
         with self._cond:
             self._handoff_out.pop(core.name, None)
             for req in list(core.requests.values()):
@@ -564,6 +600,8 @@ class Router:
         if not self._queue:
             return None
         req = min(self._queue, key=lambda r: (r.priority, r.t_submit, r.uid))
+        tr = get_tracer()
+        t_place = tr.now() if (tr.enabled and req.trace is not None) else None
         dcore = self._placement.choose(self.decode, req, self)
         if dcore is None:
             plan = self._plan_preemption_locked(req)
@@ -576,6 +614,10 @@ class Router:
             # reservation — the checkpoint imports straight onto the target
             self._target[req.uid] = dcore
             self._queue.remove(req)
+            if t_place is not None:
+                tr.complete("placement", t_place, key=req.uid,
+                            parent=req.trace.phase,
+                            args={"decode": dcore.name, "resume": True})
             return ("resume", req, dcore)
         if self.prefill:
             candidates = [c for c in self.prefill
@@ -596,6 +638,10 @@ class Router:
         else:
             pcore = dcore
         self._queue.remove(req)
+        if t_place is not None:
+            tr.complete("placement", t_place, key=req.uid,
+                        parent=req.trace.phase,
+                        args={"prefill": pcore.name, "decode": dcore.name})
         return ("admit", req, pcore, self._plan_prefix_pull_locked(req, pcore))
 
     def _plan_preemption_locked(self, req: Request):
@@ -775,6 +821,8 @@ class Router:
                 if err is None:
                     req.state = RequestState.PREFILL
                     req.t_admitted = time.monotonic()
+                    if req.trace is not None:
+                        mark_admitted(req, core=pcore.name)
                     self._owner[req.uid] = pcore
                     self.metrics.inc("prefill_tokens_total", len(req.prompt_tokens))
                 else:
@@ -802,6 +850,8 @@ class Router:
                     return False
             if not preemptible(vcore.engine, victim.uid):
                 return False  # mid-prefill or no pending token yet: not now
+            tr = get_tracer()
+            t0 = tr.now() if (tr.enabled and victim.trace is not None) else None
             try:
                 ho = preempt_sequence(vcore.engine, victim.uid)
             except Exception as e:
@@ -810,10 +860,20 @@ class Router:
                     f"failed: {type(e).__name__}: {e}")
                 return False
             vcore.release(victim.uid)
+            if t0 is not None:
+                tr.complete("preempt", t0, key=victim.uid,
+                            parent=victim.trace.phase,
+                            args={"replica": vcore.name,
+                                  "blocks": getattr(ho, "n_blocks", 0)})
+            log_event("preempt", uid=victim.uid, replica=vcore.name,
+                      qos=victim.params.qos,
+                      tokens=len(victim.generated))
             with self._cond:
                 victim._checkpoint = ho
                 victim.preemptions += 1
                 victim.state = RequestState.QUEUED
+                if victim.trace is not None:
+                    mark_preempted(victim)
                 self._owner.pop(victim.uid, None)
                 self._queue.append(victim)
                 self.metrics.inc("requests_preempted_total")
@@ -845,6 +905,8 @@ class Router:
                 with self._cond:
                     self._target.pop(req.uid, None)
                 return
+            tr = get_tracer()
+            t0 = tr.now() if (tr.enabled and req.trace is not None) else None
             try:
                 resume_sequence(dcore.engine, ho)
             except Exception as e:
@@ -859,12 +921,21 @@ class Router:
                         req, RequestState.FAILED, "error",
                         error=f"resume import: {type(e).__name__}: {e}")
                 return
+            if t0 is not None:
+                tr.complete("resume", t0, key=req.uid,
+                            parent=req.trace.phase,
+                            args={"replica": dcore.name,
+                                  "blocks": getattr(ho, "n_blocks", 0)})
+            log_event("resume", uid=req.uid, replica=dcore.name,
+                      qos=req.params.qos)
             with self._cond:
                 dcore.requests[req.uid] = req
                 self._owner[req.uid] = dcore
                 self._target.pop(req.uid, None)
                 req._checkpoint = None
                 req.state = RequestState.DECODE
+                if req.trace is not None:
+                    mark_resumed(req, core=dcore.name)
                 self.metrics.inc("requests_resumed_total")
                 self.metrics.set_gauge("queue_depth", len(self._queue))
                 self.metrics.set_gauge("active_requests", len(self._owner))
@@ -880,9 +951,13 @@ class Router:
         with target.step_lock:
             if req.is_terminal:
                 return
+            tr = get_tracer()
+            t0 = tr.now() if (tr.enabled and req.trace is not None) else None
             try:
                 copied = import_sequence(target.engine, ho)
             except Exception as e:
+                log_event("handoff_failed", uid=req.uid, target=target.name,
+                          error=f"{type(e).__name__}: {e}")
                 logger.warning(
                     f"serving: handoff import of uid={req.uid} onto "
                     f"{target.name} failed: {type(e).__name__}: {e}")
@@ -894,6 +969,11 @@ class Router:
                         req, RequestState.FAILED, "error",
                         error=f"handoff import: {type(e).__name__}: {e}")
                 return
+            if t0 is not None:
+                tr.complete("handoff.import", t0, key=req.uid,
+                            parent=req.trace.phase,
+                            args={"target": target.name,
+                                  "blocks": ho.n_blocks, "copied": copied})
             with self._cond:
                 target.requests[req.uid] = req
                 self._owner[req.uid] = target
@@ -959,6 +1039,9 @@ class Router:
             self.metrics.set_gauge("decode_replicas", len(self.decode))
             if self._spares is not None:
                 self.metrics.set_gauge("warm_spares", self._spares.available)
+            log_event("scale_up", replica=core.name,
+                      decode_replicas=len(self.decode),
+                      warm=baseline is not None)
             self._cond.notify_all()
         return core
 
@@ -990,6 +1073,8 @@ class Router:
             self.cores.remove(victim)
             self.metrics.inc("scale_down_total")
             self.metrics.set_gauge("decode_replicas", len(self.decode))
+            log_event("scale_down", replica=victim.name,
+                      decode_replicas=len(self.decode))
             self._cond.notify_all()
         if self._spares is not None:
             # re-warm under the victim's step lock: its worker may still be
@@ -1123,17 +1208,28 @@ class Router:
                 # donated pool reassignment), then release the source seq
                 with self._cond:
                     pending = self._handoff_out.pop(core.name, [])
+                tr = get_tracer()
                 for req, tok in pending:
                     if req.is_terminal:
                         continue
+                    t0 = (tr.now()
+                          if (tr.enabled and req.trace is not None) else None)
                     try:
                         ho = export_sequence(core.engine, req.uid, tok)
                     except Exception as e:
+                        log_event("handoff_failed", uid=req.uid,
+                                  source=core.name,
+                                  error=f"{type(e).__name__}: {e}")
                         with self._cond:
                             self._finish_on_locked(
                                 core, req, RequestState.FAILED, "error",
                                 error=f"handoff export: {type(e).__name__}: {e}")
                         continue
+                    if t0 is not None:
+                        tr.complete("handoff.export", t0, key=req.uid,
+                                    parent=req.trace.phase,
+                                    args={"source": core.name,
+                                          "blocks": ho.n_blocks})
                     core.release(req.uid)
                     with self._cond:
                         self._owner.pop(req.uid, None)

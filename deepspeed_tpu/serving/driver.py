@@ -41,6 +41,13 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from deepspeed_tpu.observability.tracing import (
+    begin_request_trace,
+    finish_request_trace,
+    get_tracer,
+    mark_admitted,
+    mark_first_token,
+)
 from deepspeed_tpu.serving.metrics import ServingMetrics
 from deepspeed_tpu.serving.request import Request, RequestState, SamplingParams
 from deepspeed_tpu.serving.streaming import TokenStream
@@ -198,6 +205,9 @@ class ServingDriver:
             )
             self._next_uid += 1
             req.stream = TokenStream(req.uid)
+            tracer = get_tracer()
+            if tracer.enabled:
+                begin_request_trace(tracer, req)
             self._queue.append(req)
             self._idle.clear()
             self.metrics.inc("requests_submitted_total")
@@ -306,7 +316,14 @@ class ServingDriver:
         if req.stream is not None:
             req.stream.close(reason, error=error)
         req._done.set()
-        self.metrics.observe_request(req)
+        if req.trace is not None:
+            # traced path: histograms fold from the SPAN endpoints (same
+            # numbers — the spans carry the request's own stamps), then
+            # the tree is closed and retention policy runs
+            self.metrics.observe_trace(req)
+            finish_request_trace(req, reason=reason)
+        else:
+            self.metrics.observe_request(req)
         key = {
             RequestState.FINISHED: "requests_finished_total",
             RequestState.CANCELLED: "requests_cancelled_total",
@@ -348,6 +365,8 @@ class ServingDriver:
                 continue
             req.state = RequestState.PREFILL
             req.t_admitted = time.monotonic()
+            if req.trace is not None:
+                mark_admitted(req, core=self.core.name)
             self.metrics.inc("prefill_tokens_total", len(req.prompt_tokens))
             admitted = True
         self.metrics.set_gauge("queue_depth", len(self._queue))
@@ -384,6 +403,8 @@ class ServingDriver:
         if req.t_first_token is None:
             req.t_first_token = now
             req.state = RequestState.DECODE
+            if req.trace is not None:
+                mark_first_token(req)
         req.generated.append(int(token))
         self.metrics.inc("decode_tokens_total")
         self.core.decode_tokens += 1

@@ -74,7 +74,10 @@ class Histogram:
             seen += self.counts[i]
             if seen >= target:
                 return b
-        return float("inf")
+        # target lands in the +Inf bucket: clamp to the largest finite
+        # edge (mirrors _safe_rate) so bench JSON and /metrics-derived
+        # reports stay finite
+        return self.buckets[-1] if self.buckets else 0.0
 
     def prom_samples(self, name: str) -> List[Tuple]:
         out = []
@@ -203,6 +206,33 @@ class ServingMetrics:
                 self.tpot.observe(req.tpot_s)
             if req.e2e_s is not None:
                 self.e2e.observe(req.e2e_s)
+
+    def observe_trace(self, req) -> None:
+        """Histogram bridge from SPAN endpoints, for traced requests.
+
+        The trace helpers stamp phase boundaries with the request's own
+        monotonic stamps, so this folds numbers numerically identical to
+        ``observe_request`` (a unit test asserts it) — but when tracing
+        is on the span tree is the source of truth, so the timeline view
+        and the histogram view cannot drift apart.  Falls back to
+        ``observe_request`` when the request carries no trace.
+        """
+        ctx = getattr(req, "trace", None)
+        if ctx is None:
+            self.observe_request(req)
+            return
+        t_submit = ctx.root.t0
+        t_first = ctx.t_first
+        t_finish = ctx.root.t1 if ctx.root.t1 is not None else req.t_finish
+        with self._lock:
+            if t_first is not None:
+                self.ttft.observe(t_first - t_submit)
+            if t_first is not None and t_finish is not None:
+                n = len(req.generated) - 1
+                if n >= 1:
+                    self.tpot.observe((t_finish - t_first) / n)
+            if t_finish is not None:
+                self.e2e.observe(t_finish - t_submit)
 
     def update_kv(self, free_blocks: int, total_blocks: int) -> None:
         with self._lock:
@@ -425,6 +455,16 @@ class ServingMetrics:
                 if hist.count:
                     events.append((f"Serving/{hname}_mean", hist.mean, step))
                     events.append((f"Serving/{hname}_p95", hist.quantile(0.95), step))
+            # labeled families, flattened the same way snapshot() does, so
+            # replica and tenant/tier telemetry reaches the file-backed
+            # writers (CSV/TensorBoard/...) and not just /metrics
+            for name, (_role, st) in self._replicas.items():
+                for key, value in st.items():
+                    events.append((f"Serving/replica_{name}_{key}", value, step))
+            for (tenant, tier), cell in self._tiers.items():
+                for key, value in cell.items():
+                    events.append(
+                        (f"Serving/tier_{tenant}_{tier}_{key}", value, step))
             return events
 
 

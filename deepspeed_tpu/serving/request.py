@@ -25,6 +25,25 @@ QOS_TIERS = {"interactive": 0, "standard": 1, "batch": 2}
 QOS_LOWEST = max(QOS_TIERS, key=QOS_TIERS.get)
 
 
+def _validate_label_value(name: str, value: str) -> str:
+    """Bound user-supplied strings that end up as metric label values.
+
+    These arrive straight off HTTP bodies and are interpolated into the
+    Prometheus text exposition and trace args; rejecting control
+    characters and unbounded lengths here keeps a hostile tenant string
+    from smuggling label syntax or bloating every sample line (the
+    renderer additionally escapes ``\\``, ``\"`` and newlines — this is
+    defense in depth, not the only line).
+    """
+    if not value:
+        raise ValueError(f"{name} must be non-empty")
+    if len(value) > 64:
+        raise ValueError(f"{name} too long ({len(value)} chars, max 64)")
+    if any(ord(c) < 0x20 or ord(c) == 0x7F for c in value):
+        raise ValueError(f"{name} contains control characters")
+    return value
+
+
 class RequestState:
     """Lifecycle states (string constants — cheap to compare and to export
     as a metric label; no enum dependency in hot paths)."""
@@ -72,6 +91,9 @@ class SamplingParams:
     # only labels metrics (tenant=/tier= samples in /metrics).
     qos: str = "standard"
     tenant: str = "default"
+    # caller-supplied correlation id, carried into the span tracer's root
+    # span args so external systems can join their traces to ours
+    trace_id: Optional[str] = None
 
     def __post_init__(self):
         if self.max_new_tokens < 1:
@@ -83,7 +105,9 @@ class SamplingParams:
             raise ValueError(
                 f"unknown qos {self.qos!r} (one of {sorted(QOS_TIERS)})"
             )
-        self.tenant = str(self.tenant)
+        self.tenant = _validate_label_value("tenant", str(self.tenant))
+        if self.trace_id is not None:
+            self.trace_id = _validate_label_value("trace_id", str(self.trace_id))
 
 
 @dataclass
@@ -122,6 +146,10 @@ class Request:
     # already resumed).
     preemptions: int = 0
     _checkpoint: Optional[object] = field(default=None, repr=False)
+    # span-tracer context (observability.TraceContext) — None when tracing
+    # is off or after the trace is finalized; drivers guard every trace
+    # touch on ``req.trace is not None`` so the off path stays free
+    trace: Optional[object] = field(default=None, repr=False)
 
     def __post_init__(self):
         self.prompt_tokens = np.asarray(self.prompt_tokens, np.int32).reshape(-1)

@@ -8,6 +8,12 @@ Endpoints (the MII/FastGen RESTful surface, minus the gRPC layer):
     otherwise the full completion returns as one JSON object.
   * ``GET /health``  — driver liveness + queue/KV occupancy JSON.
   * ``GET /metrics`` — Prometheus text exposition (ServingMetrics).
+  * ``GET /debug/trace`` — tracing index (enabled, active uids, retained
+    trace summaries).  ``?uid=N`` returns one request's span tree as a
+    Chrome-trace JSON document; ``?format=chrome`` dumps every retained
+    trace plus the engine ring and control events (what ``dstpu trace
+    dump`` fetches and Perfetto opens).
+  * ``GET /debug/events`` — recent control-plane events, newest first.
 
 No framework, no sockets beyond ``http.server``: the handler is a thin
 adapter over ``ServingDriver.submit`` + ``TokenStream``, so everything
@@ -18,11 +24,18 @@ and the server itself is one ``ThreadingHTTPServer`` away.
 import json
 import socket
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
 
 import numpy as np
 
+from deepspeed_tpu.observability import (
+    get_event_log,
+    get_tracer,
+    to_chrome_trace,
+    trace_to_chrome,
+)
 from deepspeed_tpu.serving.driver import RequestRejected, ServingDriver
 from deepspeed_tpu.serving.request import SamplingParams
 from deepspeed_tpu.serving.streaming import IncrementalDetokenizer
@@ -56,6 +69,7 @@ def parse_generate(body: dict, tokenizer=None) -> Tuple[np.ndarray, SamplingPara
             spec=spec,
             qos=str(body.get("qos", "standard")),
             tenant=str(body.get("tenant", "default")),
+            trace_id=(str(body["trace_id"]) if body.get("trace_id") is not None else None),
         )
     except TypeError as e:  # unknown spec key → client error, not a 500
         raise ValueError(f"bad spec params: {e}")
@@ -96,22 +110,54 @@ def make_handler(driver: ServingDriver, tokenizer=None):
 
         # -- endpoints ---------------------------------------------------
         def do_GET(self):
-            if self.path == "/health":
+            url = urllib.parse.urlsplit(self.path)
+            if url.path == "/health":
                 self._json(200, driver.health())
-            elif self.path == "/metrics":
+            elif url.path == "/metrics":
                 text = driver.metrics.prometheus_text().encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "text/plain; version=0.0.4")
                 self.send_header("Content-Length", str(len(text)))
                 self.end_headers()
                 self.wfile.write(text)
+            elif url.path == "/debug/trace":
+                self._debug_trace(urllib.parse.parse_qs(url.query))
+            elif url.path == "/debug/events":
+                self._json(200, {"events": get_event_log().recent()})
             else:
                 self._json(404, {"error": f"no such path {self.path}"})
+
+        def _debug_trace(self, query: dict):
+            tracer = get_tracer()
+            uid_q = query.get("uid", [None])[0]
+            if uid_q is not None:
+                try:
+                    uid = int(uid_q)
+                except ValueError:
+                    self._json(400, {"error": f"bad uid {uid_q!r}"})
+                    return
+                trace = tracer.trace(uid)
+                if trace is None:
+                    self._json(404, {"error": f"no trace for uid {uid}"})
+                    return
+                self._json(200, trace_to_chrome(trace))
+            elif query.get("format", [None])[0] == "chrome":
+                self._json(200, to_chrome_trace(tracer=tracer, event_log=get_event_log()))
+            else:
+                active = tracer.active_keys() if tracer.enabled else []
+                self._json(200, {
+                    "enabled": tracer.enabled,
+                    "stats": tracer.stats(),
+                    "active": active,
+                    "completed": tracer.recent(),
+                })
 
         def do_POST(self):
             if self.path != "/generate":
                 self._json(404, {"error": f"no such path {self.path}"})
                 return
+            tracer = get_tracer()
+            t_parse0 = tracer.now() if tracer.enabled else 0.0
             try:
                 length = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(length) or b"{}")
@@ -119,6 +165,7 @@ def make_handler(driver: ServingDriver, tokenizer=None):
             except (ValueError, json.JSONDecodeError) as e:
                 self._json(400, {"error": str(e)})
                 return
+            t_parse1 = tracer.now() if tracer.enabled else 0.0
             try:
                 req = driver.submit(prompt, params=params, timeout_s=timeout_s)
             except RequestRejected as e:
@@ -132,6 +179,12 @@ def make_handler(driver: ServingDriver, tokenizer=None):
                     headers["Retry-After"] = retry
                 self._json(code, out, headers=headers)
                 return
+            if req.trace is not None:
+                # parse happened just before submit rooted the tree, so
+                # this slice sits a hair left of the root in the timeline
+                tracer.complete("server.parse", t_parse0, t_parse1,
+                                key=req.uid, parent=req.trace.root,
+                                args={"bytes": length})
             if stream:
                 self._stream_response(req)
             else:

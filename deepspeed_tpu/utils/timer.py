@@ -23,14 +23,44 @@ STEP_MICRO_TIMER = "step_microstep"
 STEP_GLOBAL_TIMER = "step"
 
 
-def _device_synchronize():
+# Cached jitted barrier computation. Caching the RESULT array would be
+# wrong — block_until_ready on an already-ready array returns immediately
+# without draining the dispatch queue; what we cache is the compiled
+# function, and each sync blocks on a FRESH invocation, which on TPU is
+# ordered after all previously dispatched work.
+_SYNC_FN = None
+
+
+def device_synchronize(tree=None):
+    """Drain outstanding async dispatch (the shared sync-barrier helper).
+
+    With ``tree`` given, blocks until those specific arrays are ready
+    (cheaper than a full barrier; used by the engine-step device_wait
+    hooks). With no argument, dispatches a cached trivial computation and
+    blocks on it, which orders after the whole queue.
+    """
+    global _SYNC_FN
     try:
         import jax
-        import jax.numpy as jnp
+    except ImportError:
+        return  # CPU-only / jax-less environment: nothing to drain
+    try:
+        if tree is not None:
+            jax.block_until_ready(tree)
+            return
+        if _SYNC_FN is None:
+            import jax.numpy as jnp
 
-        jax.block_until_ready(jnp.zeros(()))
-    except Exception:
+            _SYNC_FN = jax.jit(lambda: jnp.zeros(()))
+        jax.block_until_ready(_SYNC_FN())
+    except RuntimeError:
+        # backend not initialized (e.g. forked worker before first use);
+        # a timer barrier is best-effort, never fatal
         pass
+
+
+# legacy alias (pre-existing internal call sites)
+_device_synchronize = device_synchronize
 
 
 class SynchronizedWallClockTimer:

@@ -11,6 +11,12 @@ import urllib.request
 import numpy as np
 import pytest
 
+from deepspeed_tpu.observability import (
+    NULL_TRACER,
+    SpanTracer,
+    set_tracer,
+    validate_chrome_trace,
+)
 from deepspeed_tpu.serving.request import SamplingParams
 from deepspeed_tpu.serving.server import parse_generate, start_server
 from tests.unit.test_serving import FakeEngine
@@ -62,6 +68,99 @@ class TestParseGenerate:
         assert params.qos == "interactive" and params.tenant == "acme"
         _, params, _, _ = parse_generate({"tokens": [1]})
         assert params.qos == "standard" and params.tenant == "default"
+
+    def test_trace_id_passthrough_and_validation(self):
+        _, params, _, _ = parse_generate(
+            {"tokens": [1], "trace_id": "ext-7f3a"})
+        assert params.trace_id == "ext-7f3a"
+        _, params, _, _ = parse_generate({"tokens": [1]})
+        assert params.trace_id is None
+        with pytest.raises(ValueError, match="trace_id"):
+            parse_generate({"tokens": [1], "trace_id": "a\nb"})
+        with pytest.raises(ValueError, match="tenant"):
+            parse_generate({"tokens": [1], "tenant": 'x"}\ninjected 1'})
+
+
+class TestDebugTraceEndpoints:
+    """The /debug/trace family over a real loopback socket (fast: the
+    FakeEngine finishes a 3-token request in milliseconds)."""
+
+    def _get(self, url, timeout=10):
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return json.loads(r.read())
+
+    def test_trace_index_dump_and_events(self):
+        from deepspeed_tpu.observability import get_event_log, log_event
+        from deepspeed_tpu.serving.driver import ServingDriver
+
+        tracer = set_tracer(SpanTracer())
+        driver = ServingDriver(FakeEngine(), max_queue=16)
+        driver.start()
+        server = start_server(driver, host="127.0.0.1", port=0, tokenizer=None)
+        host, port = server.server_address[:2]
+        base = f"http://{host}:{port}"
+        try:
+            body = json.dumps({"tokens": [9], "max_new_tokens": 3,
+                               "ignore_eos": True,
+                               "trace_id": "ext-42"}).encode()
+            req = urllib.request.Request(f"{base}/generate", data=body,
+                                         method="POST")
+            with urllib.request.urlopen(req, timeout=30) as r:
+                out = json.loads(r.read())
+            assert out["tokens"] == [10, 11, 12]
+            uid = out["uid"]
+
+            index = self._get(f"{base}/debug/trace")
+            assert index["enabled"] is True
+            assert index["stats"]["completed_traces"] == 1
+            assert index["completed"][0]["key"] == uid
+
+            doc = self._get(f"{base}/debug/trace?uid={uid}")
+            assert validate_chrome_trace(doc) == []
+            names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+            for required in ("request", "server.parse", "queued",
+                             "prefill", "decode"):
+                assert required in names, f"missing {required} in {names}"
+            root = next(e for e in doc["traceEvents"]
+                        if e["name"] == "request")
+            assert root["args"]["trace_id"] == "ext-42"
+
+            full = self._get(f"{base}/debug/trace?format=chrome")
+            assert validate_chrome_trace(full) == []
+            assert len(full["traceEvents"]) >= len(doc["traceEvents"])
+
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{base}/debug/trace?uid=999999",
+                                       timeout=10)
+            assert ei.value.code == 404
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{base}/debug/trace?uid=nope",
+                                       timeout=10)
+            assert ei.value.code == 400
+
+            log_event("shed_level", level=1, prev=0)
+            events = self._get(f"{base}/debug/events")["events"]
+            assert events[0]["kind"] == "shed_level"
+        finally:
+            server.shutdown()
+            driver.shutdown(drain=False)
+            set_tracer(NULL_TRACER)
+            get_event_log().clear()
+
+    def test_debug_trace_reports_disabled_when_off(self):
+        from deepspeed_tpu.serving.driver import ServingDriver
+
+        set_tracer(NULL_TRACER)
+        driver = ServingDriver(FakeEngine(), max_queue=4)
+        server = start_server(driver, host="127.0.0.1", port=0, tokenizer=None)
+        host, port = server.server_address[:2]
+        try:
+            index = self._get(f"http://{host}:{port}/debug/trace")
+            assert index["enabled"] is False
+            assert index["active"] == [] and index["completed"] == []
+        finally:
+            server.shutdown()
+            driver.shutdown(drain=False)
 
 
 class TestOverloadResponses:

@@ -84,6 +84,46 @@ echo "== elastic-serving parity gate (preempt/resume + warm scale-up) =="
 # slow-marked int8 combo is included
 python -m pytest tests/unit/test_elastic.py -q -p no:cacheprovider
 
+echo "== request-tracing gate (span trees + Perfetto export) =="
+# span tracer semantics, capture policy, the driver/router span threading
+# (single rooted tree through placement/handoff/preempt), histogram
+# bridge equality, and the /debug/trace HTTP surface
+python -m pytest tests/unit/test_tracing.py tests/unit/test_serving_http.py \
+    -q -m 'not slow' -p no:cacheprovider
+# end-to-end: serve a traced request over a real socket, dump the
+# timeline through the same URL `dstpu trace dump` hits, and validate
+# the Chrome-trace schema + the required span set
+python - <<'EOF'
+import json, urllib.request
+import numpy as np
+from deepspeed_tpu.observability import SpanTracer, set_tracer
+from deepspeed_tpu.observability.export import validate_chrome_trace
+from deepspeed_tpu.serving.driver import ServingDriver
+from deepspeed_tpu.serving.server import start_server
+from tests.unit.test_serving import FakeEngine
+
+tracer = set_tracer(SpanTracer())
+driver = ServingDriver(FakeEngine(), max_queue=8)
+driver.start()
+server = start_server(driver, host="127.0.0.1", port=0)
+host, port = server.server_address[:2]
+body = json.dumps({"tokens": [5], "max_new_tokens": 4,
+                   "ignore_eos": True}).encode()
+req = urllib.request.Request(f"http://{host}:{port}/generate", data=body,
+                             method="POST")
+uid = json.loads(urllib.request.urlopen(req, timeout=30).read())["uid"]
+doc = json.loads(urllib.request.urlopen(
+    f"http://{host}:{port}/debug/trace?uid={uid}", timeout=10).read())
+errs = validate_chrome_trace(doc)
+assert not errs, errs
+names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+missing = {"request", "server.parse", "queued", "prefill", "decode"} - names
+assert not missing, f"span set incomplete: missing {missing}"
+server.shutdown()
+driver.shutdown(drain=False)
+print(f"trace gate: {len(doc['traceEvents'])} events, span set complete")
+EOF
+
 echo "== donation/recompile verifier (Tier B) =="
 # includes the disagg pass: decode replicas' donated step programs must
 # survive the extracted scheduler + KV-handoff import path
