@@ -1415,6 +1415,13 @@ def bench_serving_load(
     if os.environ.get("DSTPU_TRACE_AB", "") == "1":
         trace_report = {"trace_overhead": bench_trace_overhead_ab(
             cfg=cfg, params=params, seed=seed)}
+    # chaos rider: DSTPU_CHAOS=1 appends a fault-free vs faulted A/B on a
+    # 2-replica router — recovery latency, goodput retention, and a
+    # zero-divergence assertion on every recovered stream
+    chaos_report = {}
+    if os.environ.get("DSTPU_CHAOS", "") == "1":
+        chaos_report = {"chaos": bench_chaos_ab(
+            cfg=cfg, params=params, seed=seed)}
     return {
         "mode": "serving_load",
         "n_requests": n_requests,
@@ -1438,6 +1445,132 @@ def bench_serving_load(
         **disagg_report,
         **elastic_report,
         **trace_report,
+        **chaos_report,
+    }
+
+
+def bench_chaos_ab(cfg=None, params=None, seed=0):
+    """Chaos A/B (``python bench.py --chaos`` or riding ``--serving-load``
+    via DSTPU_CHAOS=1): the SAME workload served by a 2-replica resilient
+    Router twice — arm A fault-free, arm B under a deterministic fault
+    schedule (a replica worker killed mid-stream plus one faulted
+    handoff/checkpoint import). Reports the numbers an operator SLOs a
+    failure on: recovery latency (injected fault -> each stream re-queued
+    on a survivor, from the control-plane event log), goodput retention
+    (faulted tok/s over fault-free tok/s), and recovery-route counts —
+    and ASSERTS zero divergence: every recovered stream must be
+    bit-identical to its fault-free twin (sampling keys are
+    (seed, uid, position)-addressed, so a replica death must never change
+    a single token). Knobs: DSTPU_CHAOS_N (requests), DSTPU_CHAOS_MAX_NEW
+    (tokens per request), DSTPU_CHAOS_CRASH_NTH (worker-pass arrival that
+    dies; later = deeper mid-stream)."""
+    from deepspeed_tpu.inference.config import RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.models import TransformerConfig, init_params
+    from deepspeed_tpu.observability.events import get_event_log
+    from deepspeed_tpu.serving import Router
+    from deepspeed_tpu.serving.request import SamplingParams
+    from deepspeed_tpu.serving.resilience import (
+        FaultSpec, ResilienceConfig, inject)
+
+    n_requests = int(os.environ.get("DSTPU_CHAOS_N", 8))
+    max_new = int(os.environ.get("DSTPU_CHAOS_MAX_NEW", 24))
+    crash_nth = int(os.environ.get("DSTPU_CHAOS_CRASH_NTH", 12))
+    if cfg is None:
+        cfg = TransformerConfig(
+            vocab_size=512, hidden_size=128, n_layers=2, n_heads=4,
+            max_seq_len=512, dtype="float32",
+        )
+        params = init_params(cfg, jax.random.key(0))
+    rc_dict = {
+        "dtype": cfg.dtype,
+        "kv_cache": {"block_size": 16, "num_blocks": 192,
+                     "max_blocks_per_seq": 16},
+        "state_manager": {"max_tracked_sequences": 32,
+                          "max_ragged_batch_size": 96,
+                          "max_ragged_sequence_count": 8,
+                          "max_context": 256},
+    }
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, cfg.vocab_size, size=(int(l),)).astype(np.int32)
+               for l in rng.integers(8, 24, size=n_requests)]
+    rcfg = ResilienceConfig(hung_step_s=5.0, probe_backoff_s=0.05,
+                            retry_backoff_s=0.005)
+
+    def run(schedule):
+        engines = [
+            InferenceEngineV2(cfg, params,
+                              RaggedInferenceEngineConfig.from_dict(rc_dict))
+            for _ in range(2)
+        ]
+        router = Router(engines=engines, num_prefill_workers=0,
+                        max_queue=n_requests + 1, kv_headroom=0.05,
+                        resilience=rcfg).start()
+        try:
+            warm = router.submit(prompts[0], params=SamplingParams(
+                max_new_tokens=2, ignore_eos=True))
+            warm.wait(300)
+            with inject(*schedule) as inj:
+                t0 = time.perf_counter()
+                reqs = [router.submit(p, params=SamplingParams(
+                    max_new_tokens=max_new, ignore_eos=True))
+                    for p in prompts]
+                for r in reqs:
+                    r.wait(600)
+                wall = time.perf_counter() - t0
+            health = router.health()
+        finally:
+            router.shutdown(drain=True, timeout=60)
+        done = [r for r in reqs if r.state == "finished"]
+        return {
+            "streams": [list(r.generated) for r in reqs],
+            "completed": len(done),
+            "tok_s": sum(len(r.generated) for r in done) / wall,
+            "resilience": health["resilience"],
+            "fired": inj.fired(),
+        }
+
+    base = run(())
+    faulted = run((
+        FaultSpec("worker.crash", nth=crash_nth, replica="d0"),
+        FaultSpec("handoff.import", nth=1),
+    ))
+
+    divergent = sum(
+        1 for a, b in zip(base["streams"], faulted["streams"]) if a != b)
+    if divergent:
+        raise AssertionError(
+            f"chaos A/B: {divergent}/{n_requests} streams diverged after "
+            "recovery — bit-identity is the contract, not a best effort")
+    # recovery latency off the control-plane journal: injected-fault fire
+    # time -> each request_recovered event it caused
+    fired_ts = [f["t"] for f in faulted["fired"]]
+    lat = []
+    if fired_ts:
+        t_fault = min(fired_ts)
+        lat = sorted(e["t"] - t_fault
+                     for e in get_event_log().recent()
+                     if e.get("kind") == "request_recovered"
+                     and e["t"] >= t_fault)
+    res = faulted["resilience"]
+    return {
+        "n_requests": n_requests,
+        "max_new": max_new,
+        "faults_fired": [{k: f[k] for k in ("site", "replica", "nth")}
+                         for f in faulted["fired"]],
+        "completed": [base["completed"], faulted["completed"]],
+        "divergent_streams": divergent,
+        "recoveries": res["recoveries"],
+        "recovery_checkpoints": res["recovery_checkpoints"],
+        "recovery_replays": res["recovery_replays"],
+        "quarantines": res["quarantines"],
+        "handoff_retries": res["handoff_retries"],
+        "recovery_latency_first_s": round(lat[0], 4) if lat else None,
+        "recovery_latency_last_s": round(lat[-1], 4) if lat else None,
+        "baseline_tok_s": round(base["tok_s"], 1),
+        "faulted_tok_s": round(faulted["tok_s"], 1),
+        "goodput_retention": (round(faulted["tok_s"] / base["tok_s"], 3)
+                              if base["tok_s"] else None),
     }
 
 
@@ -1548,5 +1681,7 @@ if __name__ == "__main__":
         print(json.dumps(bench_serving_load()))
     elif "--trace-overhead" in sys.argv[1:]:
         print(json.dumps(bench_trace_overhead_ab()))
+    elif "--chaos" in sys.argv[1:]:
+        print(json.dumps(bench_chaos_ab()))
     else:
         main()

@@ -12,4 +12,5 @@ from deepspeed_tpu.analysis.rules import (  # noqa: F401
     jit_purity,
     raw_collective,
     shard_specs,
+    swallowed_errors,
 )

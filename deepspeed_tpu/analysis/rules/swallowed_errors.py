@@ -1,0 +1,103 @@
+"""Rule ``swallowed-thread-exception``: broad except handlers inside
+serving thread loops that only log (or pass) and carry on.
+
+A serving worker/controller thread runs a ``while True`` loop; an
+``except Exception`` in that loop whose handler merely logs and
+continues turns a dead replica into a live-looking corpse — it keeps
+taking placements while serving nothing (the failure mode the
+resilience layer's ``Router._worker_failed`` exists to prevent). A
+handler in a thread loop must DO something with the failure: mark the
+replica's health, fail or recover the affected requests, append a
+control-plane event, or re-raise. Handlers that only call ``logger.*``
+/ ``logging.*`` / ``print`` / ``time.sleep`` (plus bare ``pass`` /
+``continue``) are flagged. A loop that genuinely wants log-and-continue
+semantics (e.g. an idempotent retry of a pure side-effect) documents it
+with ``# dstpu: noqa[swallowed-thread-exception]`` on the handler line.
+"""
+
+import ast
+
+from deepspeed_tpu.analysis.framework import Rule, register
+from deepspeed_tpu.analysis.rules._common import dotted_name
+
+#: call prefixes that count as "only telling a human", not handling
+_LOG_PREFIXES = ("logger.", "logging.", "log.", "warnings.")
+_LOG_BARE = {"print"}
+_SLEEP_CALLS = {"time.sleep", "sleep"}
+
+
+def _is_broad_handler(handler: ast.ExceptHandler) -> bool:
+    """Bare ``except:`` or ``except Exception`` / ``BaseException``."""
+    if handler.type is None:
+        return True
+    name = dotted_name(handler.type)
+    return name in ("Exception", "BaseException")
+
+
+def _swallow_only(body) -> bool:
+    """True when every statement is logging/pass/continue/sleep — nothing
+    that could mark health, fail a request, or surface the error."""
+    for stmt in body:
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            name = dotted_name(stmt.value.func) or ""
+            if (name in _LOG_BARE or name in _SLEEP_CALLS
+                    or name.startswith(_LOG_PREFIXES)):
+                continue
+            # self.logger.warning(...) and friends
+            if any(seg in ("logger", "logging") for seg in name.split(".")):
+                continue
+        return False
+    return True
+
+
+@register
+class SwallowedThreadExceptionRule(Rule):
+    name = "swallowed-thread-exception"
+    severity = "warning"
+    description = (
+        "broad except inside a serving thread loop that only logs and "
+        "continues — the failure never reaches health tracking or the "
+        "affected requests, leaving a dead replica looking alive"
+    )
+
+    def check(self, ctx):
+        if "serving/" not in ctx.path.replace("\\", "/"):
+            return []
+        rule = self
+        findings = []
+
+        class V(ast.NodeVisitor):
+            def __init__(self):
+                self.while_depth = 0
+
+            def visit_While(self, node):
+                self.while_depth += 1
+                self.generic_visit(node)
+                self.while_depth -= 1
+
+            def visit_FunctionDef(self, node):
+                # a def inside the loop body runs in its caller's context,
+                # not per-iteration of THIS loop
+                saved, self.while_depth = self.while_depth, 0
+                self.generic_visit(node)
+                self.while_depth = saved
+
+            visit_AsyncFunctionDef = visit_Lambda = visit_FunctionDef
+
+            def visit_Try(self, node):
+                if self.while_depth > 0:
+                    for handler in node.handlers:
+                        if (_is_broad_handler(handler)
+                                and _swallow_only(handler.body)):
+                            findings.append(ctx.finding(
+                                rule, handler,
+                                "broad except in a thread loop swallows the "
+                                "failure (handler only logs/sleeps); mark "
+                                "replica health, fail/recover the requests, "
+                                "or re-raise"))
+                self.generic_visit(node)
+
+        V().visit(ctx.tree)
+        return findings

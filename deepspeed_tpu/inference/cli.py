@@ -261,6 +261,17 @@ def serve_parse_args(argv=None):
                    "re-prefilling; int8 pools pack ~2x the blocks per byte")
     p.add_argument("--kv-host-tier-chunk-blocks", type=int, default=8,
                    help="blocks per double-buffered re-import window")
+    p.add_argument("--resilience", action="store_true",
+                   help="fault-tolerant serving: step watchdog + replica "
+                   "quarantine with probation probes, bit-identical request "
+                   "recovery off failed replicas, bounded handoff/pull "
+                   "retries (off = legacy fail-fast)")
+    p.add_argument("--hung-step-s", type=float, default=5.0,
+                   help="watchdog deadline: an engine step older than this "
+                   "quarantines its replica and recovers its residents")
+    p.add_argument("--max-recoveries", type=int, default=3,
+                   help="per-request recovery budget before the stream "
+                   "fails instead of ping-ponging across dying replicas")
     p.add_argument("--trace", action="store_true",
                    help="enable end-to-end request tracing: per-request "
                    "span trees + engine-step timeline, served at "
@@ -376,7 +387,16 @@ def build_serving_stack(args, cfg=None, params=None, tok=None):
             shed_reject_at=getattr(args, "shed_reject_at", 0.9),
         )
         n_decode = max(n_decode, elastic_cfg.min_decode_replicas)
-    if n_prefill == 0 and n_decode == 1 and elastic_cfg is None:
+    resilience_cfg = None
+    if getattr(args, "resilience", False):
+        from deepspeed_tpu.serving.resilience import ResilienceConfig
+
+        resilience_cfg = ResilienceConfig(
+            hung_step_s=float(getattr(args, "hung_step_s", 5.0)),
+            max_recoveries=int(getattr(args, "max_recoveries", 3)),
+        )
+    if (n_prefill == 0 and n_decode == 1 and elastic_cfg is None
+            and resilience_cfg is None):
         engine = InferenceEngineV2(cfg, params, rc)
         driver = ServingDriver(
             engine,
@@ -417,6 +437,7 @@ def build_serving_stack(args, cfg=None, params=None, tok=None):
         placement=getattr(args, "placement", "slo"),
         elastic=elastic_cfg,
         spare_pool=spare_pool,
+        resilience=resilience_cfg,
     )
     return router, tok
 

@@ -552,6 +552,20 @@ class InferenceEngineV2:
         cache = self.state_manager.prefix_cache
         if store is None or cache is None or len(store) == 0:
             return n_cached
+        from deepspeed_tpu.serving.resilience.faults import (
+            InjectedFault, get_fault_injector)
+
+        faults = get_fault_injector()
+        if faults.enabled:
+            try:
+                faults.check("host_tier.readmit",
+                             replica=getattr(self, "_trace_name", None))
+            except InjectedFault:
+                # a faulted readmit degrades to re-prefilling the tail —
+                # bit-identical by construction (the tier is best-effort),
+                # just slower; firing BEFORE extend() keeps the pool
+                # untouched on the faulted path
+                return n_cached
         from deepspeed_tpu.inference.v2.host_tier import chain_hashes
 
         toks = np.asarray(prompt_tokens).reshape(-1)

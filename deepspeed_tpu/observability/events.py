@@ -39,13 +39,27 @@ class EventLog:
         self._events: deque = deque(maxlen=maxlen)
         self._lock = threading.Lock()
         self.total = 0
+        # events silently evicted by the bounded deque; a nonzero value
+        # on /debug/events means the journal wrapped and incident
+        # timelines may be missing their oldest entries
+        self.dropped = 0
 
     def emit(self, kind: str, **fields) -> Event:
         ev = Event(time.monotonic(), kind, fields)
         with self._lock:
+            if len(self._events) == self._events.maxlen:
+                self.dropped += 1
             self._events.append(ev)
             self.total += 1
         return ev
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "total": self.total,
+                "retained": len(self._events),
+                "dropped": self.dropped,
+            }
 
     def recent(self, n: Optional[int] = None) -> List[dict]:
         """Newest-first event dicts (all retained when ``n`` is None)."""

@@ -27,10 +27,13 @@ would be silently dropped when the step's donated carry lands.
 """
 
 import threading
+import time
 from typing import Dict, Optional
 
 from deepspeed_tpu.observability.tracing import get_tracer
 from deepspeed_tpu.serving.request import Request
+from deepspeed_tpu.serving.resilience.faults import get_fault_injector
+from deepspeed_tpu.serving.resilience.health import ReplicaHealth
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -60,6 +63,14 @@ class EngineCore:
         # elastic scale-down: a retired core takes no new admissions and its
         # worker thread exits once the resident set drains
         self.retired = False
+        # failure detection: per-replica health state machine, the step
+        # watchdog stamp (monotonic start of the step in flight, None
+        # between steps — the coordinator reads it without the step lock,
+        # which is the point: a wedged step never releases that lock), and
+        # the step-failed flag the wrapper uses to drive note_success
+        self.health = ReplicaHealth(self.name)
+        self.step_started_at: Optional[float] = None
+        self._step_failed = False
         # serializes engine stepping against KV import/export (both
         # reassign the donated pool arrays) and scheduler mutation from
         # other threads (admission, cancel cleanup)
@@ -229,8 +240,9 @@ class EngineCore:
     def admit(self, req: Request) -> None:
         """Hand the request to this engine's scheduler (raises on late
         inadmissibility) and make it resident here. Caller holds
-        ``step_lock``."""
-        self.engine.scheduler.submit(req.uid, req.prompt_tokens)
+        ``step_lock``. Submits ``engine_prompt`` (== ``prompt_tokens``
+        except while a replay recovery is in flight)."""
+        self.engine.scheduler.submit(req.uid, req.engine_prompt)
         self.requests[req.uid] = req
 
     def release(self, uid: int, scheduler_done: bool = False) -> None:
@@ -356,7 +368,24 @@ class EngineCore:
     def step_once(self, sink) -> bool:
         """One engine step (or fused decode / speculative verify round).
         Returns True if any token landed / request advanced (progress).
-        Caller holds ``step_lock``."""
+        Caller holds ``step_lock``.
+
+        Wraps the step in the watchdog window — ``step_started_at`` is
+        the monotonic stamp the coordinator's hung-step scan reads
+        WITHOUT the step lock (a wedged step never releases it) — and
+        feeds the health state machine: a clean step resets the error
+        streak; the failure handler advances it before telling the
+        sink."""
+        self._step_failed = False
+        self.step_started_at = time.monotonic()
+        try:
+            return self._step_locked(sink)
+        finally:
+            self.step_started_at = None
+            if not self._step_failed:
+                self.health.note_success()
+
+    def _step_locked(self, sink) -> bool:
         sched = self.engine.scheduler
         use_spec = (
             self.spec_ctl is not None
@@ -372,6 +401,14 @@ class EngineCore:
         progress = False
         tr = get_tracer()
         try:
+            faults = get_fault_injector()
+            if faults.enabled:
+                # chaos seam: a hang spec sleeps here INSIDE the watchdog
+                # window (step_started_at is set); an error spec raises
+                # into the engine-failure handler below, exactly like a
+                # real step fault
+                faults.check("step.hang", replica=self.name)
+                faults.check("engine.step", replica=self.name)
             if use_spec and self._spec_step(sink, sched):
                 return True
             if use_round:
@@ -408,11 +445,15 @@ class EngineCore:
                 })
         except Exception as e:
             # engine-level failure: per-request state is unknowable, so the
-            # in-flight set fails — but the owner survives for new requests
-            logger.warning(
-                f"serving[{self.name}]: engine step failed: {type(e).__name__}: {e}"
-            )
-            sink.engine_failed(self, f"{type(e).__name__}: {e}")
+            # in-flight set fails (or, under a resilience-enabled router,
+            # is recovered by replay) — but the owner survives
+            err = f"{type(e).__name__}: {e}"
+            logger.warning(f"serving[{self.name}]: engine step failed: {err}")
+            self._step_failed = True
+            # advance health BEFORE the sink runs so engine_failed sees the
+            # post-transition state (quarantine side-effects fire once)
+            self.health.note_error(err)
+            sink.engine_failed(self, err)
             cache = self.prefix_cache()
             if cache is not None:
                 # the failed step may have left cached blocks' device KV
@@ -437,6 +478,27 @@ class EngineCore:
             sink.deliver(self, req, int(tok))
         self._reap_capped(sink)
         return progress
+
+    # -- probation probes -------------------------------------------------
+    def probe(self, lock_timeout_s: float = 0.5) -> None:
+        """Synthetic probation probe; raises on failure. A probe cannot
+        lie about a wedged replica: it fails outright if a step is still
+        in flight or the step lock can't be acquired quickly (a hung step
+        owns it forever). Otherwise it runs one empty engine step through
+        the fault seam — so a scheduled ``engine.step`` fault at probe
+        time deterministically fails the probe, and a real engine that
+        can't even step an empty batch stays quarantined."""
+        if self.step_started_at is not None:
+            raise RuntimeError(f"probe({self.name}): a step is still in flight")
+        if not self.step_lock.acquire(timeout=lock_timeout_s):
+            raise RuntimeError(f"probe({self.name}): step lock unavailable")
+        try:
+            faults = get_fault_injector()
+            if faults.enabled:
+                faults.check("engine.step", replica=self.name)
+            self.engine.step_tokens()
+        finally:
+            self.step_lock.release()
 
     # -- observability ---------------------------------------------------
     def replica_stats(self) -> Dict[str, float]:

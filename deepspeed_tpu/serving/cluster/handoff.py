@@ -32,6 +32,8 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from deepspeed_tpu.serving.resilience.faults import get_fault_injector
+
 
 class HandoffError(RuntimeError):
     """KV-block import/export failed (pool exhausted, dead sequence, ...)."""
@@ -54,6 +56,9 @@ def export_sequence(engine, uid: int, pending_token: int) -> KVHandoff:
     KV cursor, and the pool payload for its block table. The payload is a
     host copy, so the caller releases the source sequence (freeing its
     blocks) immediately after. Caller holds the source core's step lock."""
+    faults = get_fault_injector()
+    if faults.enabled:
+        faults.check("handoff.export", replica=getattr(engine, "_trace_name", None))
     seq = engine.state_manager.get_sequence(uid)
     if seq is None or seq.finished:
         raise HandoffError(f"export({uid}): no live sequence")
@@ -94,6 +99,14 @@ def import_sequence(engine, handoff: KVHandoff) -> int:
         seq.tokens = list(handoff.tokens)
         seq.seen_tokens = int(handoff.seen_tokens)
         fresh = [int(b) for b in seq.block_table[n_cached:]]
+        # chaos seam: firing AFTER seed+extend means an injected import
+        # fault exercises the full unwind — every seeded and freshly
+        # allocated destination block must free through the except below
+        # (the pool-conservation regression in test_resilience.py)
+        faults = get_fault_injector()
+        if faults.enabled:
+            faults.check("handoff.import",
+                         replica=getattr(engine, "_trace_name", None))
         # prefer the double-buffered chunked scatter, and force its
         # FIXED-size windows even below one chunk: every handoff/resume
         # then rides the single-shape readmit program, so an import never

@@ -36,7 +36,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from deepspeed_tpu.observability.events import log_event
+from deepspeed_tpu.observability.events import get_event_log, log_event
 from deepspeed_tpu.observability.tracing import (
     begin_request_trace,
     finish_request_trace,
@@ -53,6 +53,14 @@ from deepspeed_tpu.serving.cluster.prefix_directory import PrefixDirectory
 from deepspeed_tpu.serving.driver import RequestRejected
 from deepspeed_tpu.serving.metrics import ServingMetrics
 from deepspeed_tpu.serving.request import Request, RequestState, SamplingParams
+from deepspeed_tpu.serving.resilience.faults import get_fault_injector
+from deepspeed_tpu.serving.resilience.health import (
+    PROBATION,
+    QUARANTINED,
+    ResilienceConfig,
+)
+from deepspeed_tpu.serving.resilience.recovery import plan_recovery, replay_prompt
+from deepspeed_tpu.serving.resilience.retry import with_retries
 from deepspeed_tpu.serving.streaming import TokenStream
 from deepspeed_tpu.utils.logging import logger
 
@@ -78,6 +86,7 @@ class Router:
         placement: str = "slo",
         elastic=None,
         spare_pool=None,
+        resilience: Optional[ResilienceConfig] = None,
     ):
         """Engines either pre-split (``prefill_engines``/``decode_engines``)
         or one flat ``engines`` list whose first ``num_prefill_workers``
@@ -88,7 +97,15 @@ class Router:
         decode side between the configured bounds (drawing warm engines
         from ``spare_pool``), the QoS ladder degrades/sheds admissions by
         queue occupancy, and higher tiers preempt lower-tier decodes when
-        placement can't seat them."""
+        placement can't seat them.
+
+        ``resilience`` (a :class:`ResilienceConfig`) arms fault tolerance:
+        replica failures (step errors, worker crashes, hung steps) recover
+        their in-flight streams onto surviving replicas instead of
+        failing them, quarantined replicas are excluded from placement
+        until a probation probe passes, and handoff/peer-pull edges retry
+        with backoff. ``None`` (the default) keeps the legacy fail-fast
+        behavior exactly — health is still TRACKED, never acted on."""
         if engines is not None:
             p = int(num_prefill_workers)
             prefill_engines = list(engines[:p])
@@ -120,6 +137,13 @@ class Router:
         ]
         self.cores = self.prefill + self.decode
         self.spec_k = self.decode[0].spec_k
+        # fault tolerance: None = legacy fail-fast (health tracked only)
+        self._resilience = resilience
+        self._retry_policy = (resilience.retry_policy()
+                              if resilience is not None else None)
+        if resilience is not None:
+            for core in self.cores:
+                core.health.configure(resilience)
         # cluster-wide prefix store: replicas advertise the chain hashes
         # they hold (device trie ∪ host tier) after each step; admission
         # pulls a hot prefix's uncovered tail from the best peer into the
@@ -376,6 +400,7 @@ class Router:
                     st["ttft_mean_s"] = round(t["ttft_sum"] / t["ttft_n"], 6)
                 if t["tpot_n"]:
                     st["tpot_mean_s"] = round(t["tpot_sum"] / t["tpot_n"], 6)
+                st["health"] = core.health.snapshot()
                 replicas[core.name] = st
             kv_info = self.decode[0].kv_info
             spec = next((c.spec_ctl for c in self.decode), None)
@@ -427,6 +452,29 @@ class Router:
                     "accepted_tokens": int(snap["spec_accepted_tokens_total"]),
                     "acceptance_rate": snap["spec_acceptance_rate"],
                 },
+                "resilience": {
+                    "enabled": self._resilience is not None,
+                    "placeable_replicas": sum(
+                        1 for c in self.decode if c.health.placeable),
+                    "replica_failures": int(
+                        snap.get("replica_failures_total", 0)),
+                    "quarantines": int(
+                        snap.get("replica_quarantines_total", 0)),
+                    "probes": int(snap.get("replica_probes_total", 0)),
+                    "probe_failures": int(
+                        snap.get("replica_probe_failures_total", 0)),
+                    "recoveries": int(
+                        snap.get("requests_recovered_total", 0)),
+                    "recovery_checkpoints": int(
+                        snap.get("recovery_checkpoints_total", 0)),
+                    "recovery_replays": int(
+                        snap.get("recovery_replays_total", 0)),
+                    "handoff_retries": int(
+                        snap.get("handoff_retries_total", 0)),
+                    "peer_pull_retries": int(
+                        snap.get("peer_pull_retries_total", 0)),
+                },
+                "events": get_event_log().stats(),
             }
 
     def _host_tier_health_locked(self) -> Dict:
@@ -527,6 +575,214 @@ class Router:
             t["tpot_sum"] += req.tpot_s
             t["tpot_n"] += 1
 
+    # -- fault tolerance --------------------------------------------------
+    def _placeable(self, core: EngineCore) -> bool:
+        """Whether placement/pulls/preemption may touch ``core``. Without a
+        resilience config health never gates anything (legacy behavior);
+        with one, quarantined/probation replicas receive nothing until
+        their probe passes."""
+        return self._resilience is None or core.health.placeable
+
+    def _note_quarantine_locked(self, core: EngineCore) -> None:
+        """Quarantine side-effects, exactly once per transition (the
+        health machine may be advanced by worker AND coordinator for the
+        same incident): metrics, event log, and dropping the replica's
+        prefix advertisement so no peer plans pulls from it. Caller holds
+        ``_cond``."""
+        if core.health.state != QUARANTINED:
+            return
+        if getattr(core, "_quarantine_seq", 0) == core.health.quarantines:
+            return
+        core._quarantine_seq = core.health.quarantines
+        self.metrics.inc("replica_quarantines_total")
+        self.directory.forget(core.name)
+        log_event("quarantine", replica=core.name,
+                  error=core.health.last_error,
+                  quarantines=core.health.quarantines)
+
+    def _recover_resident_locked(self, core: EngineCore, req: Request,
+                                 pool_readable: bool, cause: str,
+                                 detach_only: bool = False) -> None:
+        """Rebuild one in-flight request off failed replica ``core``:
+        checkpoint route when the pool is readable and the row is steady
+        decode state, replay route (prompt + delivered tokens; sampling
+        keys are position-addressed so the continuation is bit-identical)
+        otherwise. Caller holds ``_cond``, and ``core.step_lock`` unless
+        ``detach_only`` — a HUNG replica's lock is owned by its wedged
+        step, so that path only detaches bookkeeping (``core.requests`` /
+        spec history) and never touches the engine; the stale step's
+        ``req is None -> sched.finish(uid)`` cleanup frees its scheduler
+        state if it ever returns. ``pool_readable`` additionally gates
+        the checkpoint export: a replica whose STEP failed can still free
+        scheduler state, but its pool content is unknowable — replay."""
+        cfg = self._resilience
+        uid = req.uid
+        if req.is_terminal:
+            return
+        if uid in self._cancel_uids:
+            self._finish_on_locked(core, req, RequestState.CANCELLED,
+                                   "cancelled", scheduler_done=detach_only)
+            return
+        if req.recoveries >= cfg.max_recoveries:
+            self._finish_on_locked(
+                core, req, RequestState.FAILED, "error",
+                error=f"recovery budget ({cfg.max_recoveries}) exhausted; "
+                      f"last failure: {cause}",
+                scheduler_done=detach_only)
+            return
+        route, arg = plan_recovery(core, req, pool_readable)
+        if route == "fail":
+            if arg == "complete":
+                # every budgeted token was already delivered — the stream
+                # just never saw its terminal transition
+                self._finish_on_locked(core, req, RequestState.FINISHED,
+                                       "max_tokens",
+                                       scheduler_done=detach_only)
+            else:
+                self._finish_on_locked(
+                    core, req, RequestState.FAILED, "error",
+                    error=f"unrecoverable after {cause}: {arg}",
+                    scheduler_done=detach_only)
+            return
+        core.release(uid, scheduler_done=detach_only)
+        self._owner.pop(uid, None)
+        self._release_resv_locked(uid)
+        if route == "checkpoint":
+            req._checkpoint = arg
+            req._replay_prompt = None
+            self.metrics.inc("recovery_checkpoints_total")
+        else:
+            req._checkpoint = None
+            req._replay_prompt = arg
+            self.metrics.inc("recovery_replays_total")
+        req.recoveries += 1
+        req.state = RequestState.QUEUED
+        if req.trace is not None:
+            mark_preempted(req, reason="recovered")
+        self._queue.append(req)
+        self.metrics.inc("requests_recovered_total")
+        self.metrics.set_gauge("queue_depth", len(self._queue))
+        self._update_tier_queue_locked()
+        log_event("request_recovered", uid=uid, replica=core.name,
+                  route=route, tokens=len(req.generated),
+                  recoveries=req.recoveries, cause=cause)
+
+    def _requeue_for_replay_locked(self, req: Request, cause: str) -> bool:
+        """Replay-recover a request that is resident NOWHERE (a handoff or
+        resume import failed after its source released the sequence).
+        Returns False when the recovery budget is spent — the caller then
+        fails the request. Caller holds ``_cond``."""
+        cfg = self._resilience
+        if cfg is None or req.is_terminal or req.uid in self._cancel_uids:
+            return False
+        if req.recoveries >= cfg.max_recoveries:
+            return False
+        self._release_resv_locked(req.uid)
+        req._checkpoint = None
+        req._replay_prompt = replay_prompt(req)
+        req.recoveries += 1
+        req.state = RequestState.QUEUED
+        if req.trace is not None:
+            mark_preempted(req, reason="recovered")
+        self._queue.append(req)
+        self.metrics.inc("recovery_replays_total")
+        self.metrics.inc("requests_recovered_total")
+        self.metrics.set_gauge("queue_depth", len(self._queue))
+        self._update_tier_queue_locked()
+        log_event("request_recovered", uid=req.uid, replica=None,
+                  route="replay", tokens=len(req.generated),
+                  recoveries=req.recoveries, cause=cause)
+        return True
+
+    def _scan_hangs_locked(self) -> None:
+        """Step watchdog (coordinator): a core whose in-flight step is
+        older than the hung-step deadline is quarantined and its residents
+        recovered by replay. Reads ``step_started_at`` WITHOUT the step
+        lock — the wedged step owns that lock and may never release it.
+        Caller holds ``_cond``."""
+        cfg = self._resilience
+        now = time.monotonic()
+        for core in self.cores:
+            t0 = core.step_started_at
+            if t0 is None or now - t0 < cfg.hung_step_s:
+                continue
+            if core.health.state in (QUARANTINED, PROBATION):
+                continue  # this hang was already handled
+            err = (f"hung step: {now - t0:.2f}s in flight "
+                   f"(deadline {cfg.hung_step_s}s)")
+            core.health.note_hang(err)
+            self.metrics.inc("replica_failures_total")
+            self._note_quarantine_locked(core)
+            self._handoff_out.pop(core.name, None)
+            log_event("step_hang", replica=core.name,
+                      age_s=round(now - t0, 3),
+                      in_flight=len(core.requests))
+            for req in list(core.requests.values()):
+                self._recover_resident_locked(core, req, pool_readable=False,
+                                              cause=err, detach_only=True)
+
+    def _probe_plan_locked(self):
+        """Pick one quarantined core whose probation backoff elapsed and
+        move it to PROBATION (so a second coordinator pass can't double-
+        probe). The probe itself runs outside ``_cond`` — it takes the
+        core's step lock, and lock order is step_lock -> _cond."""
+        for core in self.cores:
+            if core.health.probe_due():
+                core.health.begin_probe()
+                return ("probe", core)
+        return None
+
+    def _execute_probe(self, core: EngineCore) -> None:
+        """Run the synthetic probation probe and settle the circuit
+        breaker: pass -> healthy (placement resumes on the next plan
+        pass), fail -> quarantined with the backoff doubled."""
+        self.metrics.inc("replica_probes_total")
+        try:
+            core.probe()
+        except Exception as e:
+            err = f"{type(e).__name__}: {e}"
+            core.health.probe_failed(err)
+            self.metrics.inc("replica_probe_failures_total")
+            log_event("probe_failed", replica=core.name, error=err,
+                      probe_failures=core.health.probe_failures)
+            return
+        core.health.probe_passed()
+        log_event("probe_passed", replica=core.name,
+                  probes=core.health.probes)
+        with self._cond:
+            self._cond.notify_all()  # placeable again: replan admissions
+
+    def _note_retry(self, counter: str, site: str, detail: str,
+                    attempt: int, err: BaseException) -> None:
+        self.metrics.inc(counter)
+        log_event("transfer_retry", site=site, detail=detail,
+                  attempt=attempt, error=f"{type(err).__name__}: {err}")
+
+    def _edge_retries(self, fn, counter: str, site: str, detail: str):
+        """Run a transfer-edge callable under the bounded retry policy —
+        or exactly once when resilience is off (legacy single-try)."""
+        if self._retry_policy is None:
+            return fn()
+        return with_retries(
+            fn, self._retry_policy, label=site,
+            on_retry=lambda attempt, e: self._note_retry(
+                counter, site, detail, attempt, e),
+        )
+
+    def _resilience_wait_bound_locked(self, now: float) -> Optional[float]:
+        """Earliest future instant the coordinator must wake for: a step
+        crossing the hung deadline, or a quarantine backoff expiring."""
+        cfg = self._resilience
+        waits = []
+        for core in self.cores:
+            t0 = core.step_started_at
+            if t0 is not None:
+                waits.append(max(0.0, t0 + cfg.hung_step_s - now))
+            h = core.health
+            if h.state == QUARANTINED and h.next_probe_at is not None:
+                waits.append(max(0.0, h.next_probe_at - now))
+        return min(waits) if waits else None
+
     # -- EngineCore sink protocol ----------------------------------------
     def deliver(self, core: EngineCore, req: Request, token: int,
                 feedback: bool = True) -> bool:
@@ -561,13 +817,30 @@ class Router:
         return not req.is_terminal
 
     def engine_failed(self, core: EngineCore, error: str):
+        """Engine-level step failure (called from inside ``step_once``'s
+        handler, under ``core.step_lock``; health already advanced).
+        Legacy: the resident set fails. With a resilience config: the
+        residents recover by REPLAY — the failed step left per-request
+        pool/scheduler state unknowable, so nothing is exported; each
+        stream is re-derived from its delivered tokens on a surviving
+        replica, bit-identically."""
         log_event("engine_failed", replica=core.name, error=error,
-                  in_flight=len(core.requests))
+                  in_flight=len(core.requests), health=core.health.state)
         with self._cond:
             self._handoff_out.pop(core.name, None)
+            if self._resilience is None:
+                for req in list(core.requests.values()):
+                    self._finish_on_locked(core, req, RequestState.FAILED,
+                                           "engine_error", error=error)
+                return
+            self.metrics.inc("replica_failures_total")
+            self._note_quarantine_locked(core)
             for req in list(core.requests.values()):
-                self._finish_on_locked(core, req, RequestState.FAILED,
-                                       "engine_error", error=error)
+                # step_lock IS held here, but the pool is NOT readable:
+                # the failed step may have half-written it
+                self._recover_resident_locked(core, req, pool_readable=False,
+                                              cause=f"engine step: {error}")
+            self._cond.notify_all()
 
     def finish_capped(self, core: EngineCore, req: Request):
         with self._cond:
@@ -602,7 +875,10 @@ class Router:
         req = min(self._queue, key=lambda r: (r.priority, r.t_submit, r.uid))
         tr = get_tracer()
         t_place = tr.now() if (tr.enabled and req.trace is not None) else None
-        dcore = self._placement.choose(self.decode, req, self)
+        # quarantined/probation replicas take no placements (the identity
+        # filter when resilience is off — legacy placement is untouched)
+        dcore = self._placement.choose(
+            [c for c in self.decode if self._placeable(c)], req, self)
         if dcore is None:
             plan = self._plan_preemption_locked(req)
             if plan is not None:
@@ -621,7 +897,8 @@ class Router:
             return ("resume", req, dcore)
         if self.prefill:
             candidates = [c for c in self.prefill
-                          if c.admissible(req, prefill_only=True)]
+                          if self._placeable(c)
+                          and c.admissible(req, prefill_only=True)]
             if not candidates:
                 self.metrics.inc("admission_blocked_total")
                 return None
@@ -656,7 +933,7 @@ class Router:
             return None
         best = None
         for core in self.decode:
-            if core.retired:
+            if core.retired or not self._placeable(core):
                 continue
             bs = int(core._kv_cfg("block_size", 1))
             cap = int(core._kv_cfg("max_blocks_per_seq", 1 << 30))
@@ -706,7 +983,7 @@ class Router:
         if peer is None:
             return None
         src = next((c for c in self.cores if c.name == peer[0]), None)
-        if src is None:
+        if src is None or not self._placeable(src):
             return None
         return (src, seed_core, keys[covered:peer[1]])
 
@@ -719,6 +996,9 @@ class Router:
         advertisement just shortens (or empties) the pulled run; the
         request then re-prefills the remainder — correctness never depends
         on the pull."""
+        faults = get_fault_injector()
+        if faults.enabled:
+            faults.check("peer_pull", replica=src.name)
         pulled = []
         with src.step_lock:
             tier = src.host_tier()
@@ -763,9 +1043,17 @@ class Router:
                         self._cond.notify_all()
                         return
                     self._expire_queue_locked()
+                    if self._resilience is not None:
+                        # watchdog first: a hang recovery requeues streams
+                        # the admission pass below can immediately place
+                        self._scan_hangs_locked()
                     plan = self._plan_admission_locked()
                     if plan is not None:
                         break
+                    if self._resilience is not None:
+                        plan = self._probe_plan_locked()
+                        if plan is not None:
+                            break
                     if not self._queue and not self._by_uid:
                         self._idle.set()
                         self._flush_monitor()
@@ -781,7 +1069,15 @@ class Router:
                         # poll is only a backstop against missed wakeups
                         poll = self.poll_interval_s * 5
                         timeout = min(poll, timeout) if timeout is not None else poll
+                    if self._resilience is not None:
+                        bound = self._resilience_wait_bound_locked(now)
+                        if bound is not None:
+                            timeout = (min(timeout, bound)
+                                       if timeout is not None else bound)
                     self._cond.wait(timeout)
+            if plan[0] == "probe":
+                self._execute_probe(plan[1])
+                continue
             if plan[0] == "preempt":
                 _, victim, vcore = plan
                 if not self._execute_preemption(victim, vcore):
@@ -800,9 +1096,17 @@ class Router:
                 # blocks instead of re-prefilling them
                 src, dst, keys = pull
                 try:
-                    n_pulled = self._execute_prefix_pull(src, dst, keys)
+                    n_pulled = self._edge_retries(
+                        lambda: self._execute_prefix_pull(src, dst, keys),
+                        "peer_pull_retries_total", "peer_pull",
+                        f"{src.name}->{dst.name}")
                 except Exception as e:
+                    # a pull is an optimization, never a correctness
+                    # dependency: the request re-prefills what it covers
                     n_pulled = 0
+                    log_event("peer_pull_failed", source=src.name,
+                              target=dst.name,
+                              error=f"{type(e).__name__}: {e}")
                     logger.warning(
                         f"serving: prefix pull {src.name}->{dst.name} failed: "
                         f"{type(e).__name__}: {e}")
@@ -824,7 +1128,8 @@ class Router:
                     if req.trace is not None:
                         mark_admitted(req, core=pcore.name)
                     self._owner[req.uid] = pcore
-                    self.metrics.inc("prefill_tokens_total", len(req.prompt_tokens))
+                    self.metrics.inc("prefill_tokens_total",
+                                     len(req.engine_prompt))
                 else:
                     self._release_resv_locked(req.uid)
                     self._by_uid.pop(req.uid, None)
@@ -908,12 +1213,21 @@ class Router:
             tr = get_tracer()
             t0 = tr.now() if (tr.enabled and req.trace is not None) else None
             try:
-                resume_sequence(dcore.engine, ho)
+                self._edge_retries(
+                    lambda: resume_sequence(dcore.engine, ho),
+                    "handoff_retries_total", "handoff.import",
+                    f"resume:{dcore.name}")
             except Exception as e:
                 logger.warning(
                     f"serving: resume of uid={req.uid} onto {dcore.name} "
                     f"failed: {type(e).__name__}: {e}")
                 with self._cond:
+                    # resilience: the checkpoint import died but the stream
+                    # is still fully re-derivable — replay it
+                    if self._requeue_for_replay_locked(
+                            req, f"resume import: {type(e).__name__}: {e}"):
+                        self._cond.notify_all()
+                        return
                     self._release_resv_locked(req.uid)
                     self._by_uid.pop(req.uid, None)
                     self._cancel_uids.discard(req.uid)
@@ -954,7 +1268,13 @@ class Router:
             tr = get_tracer()
             t0 = tr.now() if (tr.enabled and req.trace is not None) else None
             try:
-                copied = import_sequence(target.engine, ho)
+                # safe to retry: a failed import_sequence unwinds its own
+                # allocations (sched.finish in its except), so every
+                # attempt starts from a clean target
+                copied = self._edge_retries(
+                    lambda: import_sequence(target.engine, ho),
+                    "handoff_retries_total", "handoff.import",
+                    f"{target.name}")
             except Exception as e:
                 log_event("handoff_failed", uid=req.uid, target=target.name,
                           error=f"{type(e).__name__}: {e}")
@@ -962,6 +1282,12 @@ class Router:
                     f"serving: handoff import of uid={req.uid} onto "
                     f"{target.name} failed: {type(e).__name__}: {e}")
                 with self._cond:
+                    # resilience: the first token was already delivered and
+                    # the prompt is intact — replay seats it elsewhere
+                    if self._requeue_for_replay_locked(
+                            req, f"handoff import: {type(e).__name__}: {e}"):
+                        self._cond.notify_all()
+                        return
                     self._release_resv_locked(req.uid)
                     self._by_uid.pop(req.uid, None)
                     self._cancel_uids.discard(req.uid)
@@ -993,13 +1319,18 @@ class Router:
             now = time.monotonic()
             slacks = [r.deadline - now for r in self._queue
                       if r.deadline is not None]
+            # quarantined replicas are dead capacity: the controller sees
+            # only the PLACEABLE fleet, so a failure mid-burst reads as
+            # pressure (scale up) instead of idle surplus (scale down)
+            placeable = sum(1 for c in self.decode if self._placeable(c))
             return ScalingSignals(
                 queue_depth=len(self._queue),
                 active_requests=len(self._owner),
-                n_decode=len(self.decode),
+                n_decode=placeable,
                 spares_available=(self._spares.available
                                   if self._spares is not None else 0),
                 min_queue_slack_s=min(slacks) if slacks else None,
+                n_quarantined=len(self.decode) - placeable,
             )
 
     def add_decode_replica(self, engine=None) -> Optional[EngineCore]:
@@ -1023,6 +1354,8 @@ class Router:
             spec_k=tmpl.spec_k, metrics=self.metrics,
         )
         core._warm_baseline = baseline
+        if self._resilience is not None:
+            core.health.configure(self._resilience)
         with self._cond:
             self.decode.append(core)
             self.cores.append(core)
@@ -1168,81 +1501,148 @@ class Router:
     def _worker(self, core: EngineCore):
         stall_wait = False
         while True:
+            try:
+                status = self._worker_pass(core, stall_wait)
+            except Exception as e:
+                # a dying worker thread must NEVER look like a live
+                # replica: mark it failed, recover (or fail) its
+                # residents, and keep the thread alive — after a passed
+                # probation probe the replica serves again
+                self._worker_failed(core, e)
+                stall_wait = False
+                time.sleep(self.poll_interval_s)
+                continue
+            if status is None:
+                return  # stopping, or retired and drained
+            stall_wait = status
+
+    def _worker_failed(self, core: EngineCore, e: BaseException) -> None:
+        """A worker-thread pass died OUTSIDE the step path (the step has
+        its own handler). The thread held no locks when the exception
+        surfaced, so the replica's pool is still readable: residents
+        recover via checkpoint export where possible. Unconditionally
+        (resilience on or off) the replica is marked failed and
+        ``last_error`` surfaces in ``health()`` — a silently dead thread
+        previously left a live-looking corpse taking placements."""
+        err = f"{type(e).__name__}: {e}"
+        logger.warning(f"serving[{core.name}]: worker thread failed: {err}")
+        state = core.health.note_crash(err)
+        log_event("worker_crash", replica=core.name, error=err, health=state)
+        self.metrics.inc("replica_failures_total")
+        with core.step_lock:
             with self._cond:
-                while True:
-                    if self._stopping and not self._queue and not self._by_uid:
-                        self._cond.notify_all()
-                        return
-                    if core.retired and not core.requests:
-                        return  # scaled down: the core's engine is pooled
-                    work = self._core_flags_locked(core) or core.has_work()
-                    now = time.monotonic()
-                    deadline = self._core_deadline_locked(core)
-                    if deadline is not None and now >= deadline:
-                        break
-                    if work and not stall_wait:
-                        break
-                    timeout = None
-                    if deadline is not None:
-                        timeout = max(0.0, deadline - now)
-                    if stall_wait:
-                        timeout = (min(self.poll_interval_s, timeout)
-                                   if timeout is not None else self.poll_interval_s)
-                    self._cond.wait(timeout)
-                    stall_wait = False
-            stepped = False
-            handoffs = []
-            advert = None
-            with core.step_lock:
-                with self._cond:
-                    self._expire_core_locked(core)
-                if core.has_work():
-                    stepped = core.step_once(self)
-                # directory advertisement: snapshot the held prefix hashes
-                # (device trie ∪ host tier) under the step lock — the trie
-                # only mutates under stepping, so this is race-free
-                if core.prefix_cache() is not None or core.host_tier() is not None:
-                    advert = core.prefix_hashes()
-                # export finished prefills while still under the SOURCE
-                # lock (the payload gather must not race the next step's
-                # donated pool reassignment), then release the source seq
-                with self._cond:
-                    pending = self._handoff_out.pop(core.name, [])
-                tr = get_tracer()
-                for req, tok in pending:
-                    if req.is_terminal:
-                        continue
-                    t0 = (tr.now()
-                          if (tr.enabled and req.trace is not None) else None)
-                    try:
-                        ho = export_sequence(core.engine, req.uid, tok)
-                    except Exception as e:
-                        log_event("handoff_failed", uid=req.uid,
-                                  source=core.name,
-                                  error=f"{type(e).__name__}: {e}")
-                        with self._cond:
+                self._handoff_out.pop(core.name, None)
+                self._note_quarantine_locked(core)
+                for req in list(core.requests.values()):
+                    if self._resilience is not None:
+                        self._recover_resident_locked(
+                            core, req, pool_readable=True,
+                            cause=f"worker crash: {err}")
+                    else:
+                        self._finish_on_locked(core, req, RequestState.FAILED,
+                                               "engine_error", error=err)
+                self._cond.notify_all()
+
+    def _worker_pass(self, core: EngineCore, stall_wait: bool) -> Optional[bool]:
+        """One wait-step-export-advertise pass of ``core``'s worker.
+        Returns None to exit the thread, else the next ``stall_wait``."""
+        with self._cond:
+            while True:
+                if self._stopping and not self._queue and not self._by_uid:
+                    self._cond.notify_all()
+                    return None
+                if core.retired and not core.requests:
+                    return None  # scaled down: the core's engine is pooled
+                work = self._core_flags_locked(core) or core.has_work()
+                now = time.monotonic()
+                deadline = self._core_deadline_locked(core)
+                if deadline is not None and now >= deadline:
+                    break
+                if work and not stall_wait:
+                    break
+                timeout = None
+                if deadline is not None:
+                    timeout = max(0.0, deadline - now)
+                if stall_wait:
+                    timeout = (min(self.poll_interval_s, timeout)
+                               if timeout is not None else self.poll_interval_s)
+                self._cond.wait(timeout)
+                stall_wait = False
+        # chaos seam: fires when the worker has work to do, OUTSIDE the
+        # step lock — the crash surfaces between steps, so the pool is
+        # readable and recovery takes the checkpoint route
+        faults = get_fault_injector()
+        if faults.enabled:
+            faults.check("worker.crash", replica=core.name)
+        stepped = False
+        handoffs = []
+        advert = None
+        with core.step_lock:
+            with self._cond:
+                self._expire_core_locked(core)
+            if core.has_work():
+                stepped = core.step_once(self)
+            # directory advertisement: snapshot the held prefix hashes
+            # (device trie ∪ host tier) under the step lock — the trie
+            # only mutates under stepping, so this is race-free
+            if core.prefix_cache() is not None or core.host_tier() is not None:
+                advert = core.prefix_hashes()
+            # export finished prefills while still under the SOURCE
+            # lock (the payload gather must not race the next step's
+            # donated pool reassignment), then release the source seq
+            with self._cond:
+                pending = self._handoff_out.pop(core.name, [])
+            tr = get_tracer()
+            for req, tok in pending:
+                if req.is_terminal:
+                    continue
+                t0 = (tr.now()
+                      if (tr.enabled and req.trace is not None) else None)
+                try:
+                    # export is a read-only gather, so attempts are
+                    # free to repeat; uid/tok bind per iteration
+                    ho = self._edge_retries(
+                        lambda uid=req.uid, t=tok: export_sequence(
+                            core.engine, uid, t),
+                        "handoff_retries_total", "handoff.export",
+                        f"{core.name}")
+                except Exception as e:
+                    log_event("handoff_failed", uid=req.uid,
+                              source=core.name,
+                              error=f"{type(e).__name__}: {e}")
+                    with self._cond:
+                        # the sequence is still resident and intact:
+                        # under resilience, recover it (checkpoint or
+                        # replay) instead of failing the stream
+                        if self._resilience is not None:
+                            self._recover_resident_locked(
+                                core, req, pool_readable=True,
+                                cause=("handoff export: "
+                                       f"{type(e).__name__}: {e}"))
+                        else:
                             self._finish_on_locked(
                                 core, req, RequestState.FAILED, "error",
-                                error=f"handoff export: {type(e).__name__}: {e}")
-                        continue
-                    if t0 is not None:
-                        tr.complete("handoff.export", t0, key=req.uid,
-                                    parent=req.trace.phase,
-                                    args={"source": core.name,
-                                          "blocks": ho.n_blocks})
-                    core.release(req.uid)
-                    with self._cond:
-                        self._owner.pop(req.uid, None)
-                        core.handoffs_out += 1
-                    handoffs.append((req, ho))
-            # imports take each TARGET's own lock; source lock released so
-            # the prefill worker never blocks a decode replica's step
-            for req, ho in handoffs:
-                self._complete_handoff(req, ho)
-            with self._cond:
-                if advert is not None:
-                    self.directory.advertise(core.name, advert)
-                self._refresh_metrics_locked(core)
-                self._maybe_idle_locked()
-                self._cond.notify_all()
-            stall_wait = not stepped
+                                error=("handoff export: "
+                                       f"{type(e).__name__}: {e}"))
+                    continue
+                if t0 is not None:
+                    tr.complete("handoff.export", t0, key=req.uid,
+                                parent=req.trace.phase,
+                                args={"source": core.name,
+                                      "blocks": ho.n_blocks})
+                core.release(req.uid)
+                with self._cond:
+                    self._owner.pop(req.uid, None)
+                    core.handoffs_out += 1
+                handoffs.append((req, ho))
+        # imports take each TARGET's own lock; source lock released so
+        # the prefill worker never blocks a decode replica's step
+        for req, ho in handoffs:
+            self._complete_handoff(req, ho)
+        with self._cond:
+            if advert is not None and self._placeable(core):
+                self.directory.advertise(core.name, advert)
+            self._refresh_metrics_locked(core)
+            self._maybe_idle_locked()
+            self._cond.notify_all()
+        return not stepped

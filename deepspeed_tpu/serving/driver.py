@@ -41,6 +41,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from deepspeed_tpu.observability.events import get_event_log
 from deepspeed_tpu.observability.tracing import (
     begin_request_trace,
     finish_request_trace,
@@ -272,6 +273,7 @@ class ServingDriver:
             snap = self.metrics.snapshot()
             replica = self.core.replica_stats()
             replica["role"] = self.core.role
+            replica["health"] = self.core.health.snapshot()
             return {
                 "status": "draining" if self._draining else "ok",
                 "queue_depth": len(self._queue),
@@ -293,6 +295,7 @@ class ServingDriver:
                     "accepted_tokens": int(snap["spec_accepted_tokens_total"]),
                     "acceptance_rate": snap["spec_acceptance_rate"],
                 },
+                "events": get_event_log().stats(),
             }
 
     def _host_tier_health(self) -> Dict:

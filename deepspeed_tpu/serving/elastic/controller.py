@@ -15,6 +15,7 @@ import threading
 from dataclasses import dataclass
 from typing import Optional, Tuple
 
+from deepspeed_tpu.observability.events import log_event
 from deepspeed_tpu.serving.elastic.config import ElasticServingConfig
 from deepspeed_tpu.utils.logging import logger
 
@@ -30,6 +31,11 @@ class ScalingSignals:
     # tightest (deadline - now) among QUEUED requests; None when no queued
     # request carries a deadline
     min_queue_slack_s: Optional[float] = None
+    # replicas excluded from placement by the health state machine; the
+    # router reports n_decode as the PLACEABLE count so quarantined
+    # capacity never suppresses a needed scale-up — this field only
+    # surfaces the exclusion for logging/telemetry
+    n_quarantined: int = 0
 
 
 def plan_scaling(
@@ -134,3 +140,5 @@ class ElasticController:
                 logger.warning(
                     f"elastic: control step failed: {type(e).__name__}: {e}"
                 )
+                log_event("elastic_step_failed",
+                          error=f"{type(e).__name__}: {e}")

@@ -140,6 +140,18 @@ class ServingMetrics:
             "requests_shed_total": 0,
             "scale_up_total": 0,
             "scale_down_total": 0,
+            # fault tolerance: replica health transitions and request
+            # recovery (checkpoint = KV export reused, replay = prompt +
+            # generated resubmitted), plus bounded transfer retries
+            "replica_failures_total": 0,
+            "replica_quarantines_total": 0,
+            "replica_probes_total": 0,
+            "replica_probe_failures_total": 0,
+            "requests_recovered_total": 0,
+            "recovery_checkpoints_total": 0,
+            "recovery_replays_total": 0,
+            "handoff_retries_total": 0,
+            "peer_pull_retries_total": 0,
         }
         self.gauges: Dict[str, float] = {
             "queue_depth": 0,

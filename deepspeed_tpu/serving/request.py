@@ -146,6 +146,13 @@ class Request:
     # already resumed).
     preemptions: int = 0
     _checkpoint: Optional[object] = field(default=None, repr=False)
+    # replica-failure recovery: times this stream was rebuilt on another
+    # replica, and — when the replay route was taken — the prompt the
+    # engine actually prefills (original prompt + every delivered token;
+    # sampling keys are position-addressed, so the first token sampled
+    # past it IS the next token of the original stream).
+    recoveries: int = 0
+    _replay_prompt: Optional[np.ndarray] = field(default=None, repr=False)
     # span-tracer context (observability.TraceContext) — None when tracing
     # is off or after the trace is finalized; drivers guard every trace
     # touch on ``req.trace is not None`` so the off path stays free
@@ -171,6 +178,16 @@ class Request:
     @property
     def remaining_tokens(self) -> int:
         return max(0, self.params.max_new_tokens - len(self.generated))
+
+    @property
+    def engine_prompt(self) -> np.ndarray:
+        """What the engine prefills for this request: the replay prompt
+        while a failure recovery is in flight, the original otherwise.
+        Block accounting is unchanged by replay — ``len(engine_prompt) +
+        remaining_tokens == len(prompt_tokens) + max_new_tokens``."""
+        if self._replay_prompt is not None:
+            return self._replay_prompt
+        return self.prompt_tokens
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         """Block until the request reaches a terminal state."""

@@ -123,7 +123,8 @@ def make_handler(driver: ServingDriver, tokenizer=None):
             elif url.path == "/debug/trace":
                 self._debug_trace(urllib.parse.parse_qs(url.query))
             elif url.path == "/debug/events":
-                self._json(200, {"events": get_event_log().recent()})
+                log = get_event_log()
+                self._json(200, {**log.stats(), "events": log.recent()})
             else:
                 self._json(404, {"error": f"no such path {self.path}"})
 

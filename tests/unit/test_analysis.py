@@ -538,6 +538,7 @@ class TestFramework:
             "impure-jit",
             "raw-collective-in-hot-path",
             "shard-map-axis-coverage",
+            "swallowed-thread-exception",
             "unlocked-shared-mutation",
         }
 

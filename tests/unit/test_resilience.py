@@ -1,0 +1,740 @@
+"""Fault-tolerant serving cluster tests.
+
+Unit tiers drive the building blocks directly — the deterministic fault
+injector, the replica-health state machine (fake clock), the bounded
+retry policy (fake sleep), the recovery planner — then the Router tiers
+prove the acceptance bars on the compute-free ``FakeEngine`` (real
+scheduler + allocator, so pool conservation is real) and finally on the
+real engine: a replica killed mid-stream, a faulted handoff import, and
+a faulted peer pull must leave every accepted request's token stream
+BIT-IDENTICAL to the fault-free run, with quarantined replicas taking
+no placements until a probation probe passes.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.observability.events import EventLog, get_event_log
+from deepspeed_tpu.serving import Router, SamplingParams, ServingDriver
+from deepspeed_tpu.serving.request import RequestState
+from deepspeed_tpu.serving.resilience import (
+    DEGRADED,
+    HEALTHY,
+    PROBATION,
+    QUARANTINED,
+    FaultInjector,
+    FaultSpec,
+    InjectedFault,
+    ReplicaHealth,
+    ResilienceConfig,
+    RetryPolicy,
+    inject,
+    plan_recovery,
+    replay_prompt,
+    seeded_schedule,
+    with_retries,
+)
+from tests.unit.test_serving import FakeEngine, _expected_tokens
+
+
+def _params(n):
+    return SamplingParams(max_new_tokens=n, ignore_eos=True)
+
+
+def _run_all(front, prompts, n_new, timeout=60):
+    reqs = [front.submit(p, params=_params(n_new)) for p in prompts]
+    for r in reqs:
+        assert r.wait(timeout), f"uid={r.uid} never finished ({r.state})"
+    return reqs
+
+
+def _fast_cfg(**kw):
+    base = dict(hung_step_s=5.0, probe_backoff_s=0.05,
+                retry_backoff_s=0.001)
+    base.update(kw)
+    base.setdefault("probe_backoff_max_s", max(30.0, base["probe_backoff_s"]))
+    return ResilienceConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# fault injector: the determinism anchor
+# ---------------------------------------------------------------------------
+class TestFaultInjector:
+    def test_nth_arrival_fires_exactly_once(self):
+        inj = FaultInjector([FaultSpec("engine.step", nth=3)])
+        inj.check("engine.step")
+        inj.check("engine.step")
+        with pytest.raises(InjectedFault) as ei:
+            inj.check("engine.step")
+        assert ei.value.site == "engine.step" and ei.value.nth == 3
+        inj.check("engine.step")  # arrival 4: past the spec, clean
+        assert inj.arrivals("engine.step") == 4
+        assert len(inj.fired()) == 1
+
+    def test_per_replica_counting_is_independent(self):
+        inj = FaultInjector([FaultSpec("engine.step", nth=2, replica="d1")])
+        inj.check("engine.step", replica="d0")
+        inj.check("engine.step", replica="d0")  # d0's 2nd: no match
+        inj.check("engine.step", replica="d1")
+        with pytest.raises(InjectedFault):
+            inj.check("engine.step", replica="d1")  # d1's 2nd: fires
+
+    def test_unknown_site_rejected_loudly(self):
+        with pytest.raises(ValueError, match="unknown fault site"):
+            FaultSpec("engine.stp")
+        with pytest.raises(ValueError, match="unknown fault site"):
+            FaultInjector([]).check("nope")
+
+    def test_hang_spec_sleeps_instead_of_raising(self):
+        inj = FaultInjector([FaultSpec("step.hang", nth=1, hang_s=0.05)])
+        t0 = time.monotonic()
+        inj.check("step.hang")  # no raise
+        assert time.monotonic() - t0 >= 0.05
+        assert inj.fired()[0]["kind"] == "hang"
+
+    def test_seeded_schedule_reproducible(self):
+        sites = {"worker.crash": 1, "handoff.import": 2}
+        a = seeded_schedule(11, sites, replicas=["d0", "d1"])
+        b = seeded_schedule(11, sites, replicas=["d0", "d1"])
+        c = seeded_schedule(12, sites, replicas=["d0", "d1"])
+        assert a == b
+        assert a != c
+        assert all(s.site in sites for s in a)
+
+    def test_thread_safe_counting(self):
+        inj = FaultInjector([])
+        n, threads = 200, []
+        for _ in range(8):
+            t = threading.Thread(
+                target=lambda: [inj.check("peer_pull") for _ in range(n)])
+            threads.append(t)
+            t.start()
+        for t in threads:
+            t.join()
+        assert inj.arrivals("peer_pull") == 8 * n
+
+
+# ---------------------------------------------------------------------------
+# replica health state machine (fake clock: no sleeps)
+# ---------------------------------------------------------------------------
+class TestReplicaHealth:
+    def _mk(self, **cfg_kw):
+        clock = {"t": 100.0}
+        cfg = ResilienceConfig(**{"degrade_after": 1, "quarantine_after": 3,
+                                  "probe_backoff_s": 1.0,
+                                  "probe_backoff_max_s": 4.0, **cfg_kw})
+        h = ReplicaHealth("d0", cfg, clock=lambda: clock["t"])
+        return h, clock
+
+    def test_error_streak_walks_the_ladder(self):
+        h, _ = self._mk()
+        assert h.state == HEALTHY and h.placeable
+        assert h.note_error("e1") == DEGRADED
+        assert h.placeable  # degraded still serves
+        h.note_error("e2")
+        assert h.note_error("e3") == QUARANTINED
+        assert not h.placeable
+        assert h.quarantines == 1 and h.last_error == "e3"
+
+    def test_success_resets_streak_but_not_quarantine(self):
+        h, _ = self._mk()
+        h.note_error("e1")
+        h.note_success()
+        assert h.state == HEALTHY and h.consecutive_errors == 0
+        for i in range(3):
+            h.note_error(f"e{i}")
+        h.note_success()  # a late-returning step cannot un-quarantine
+        assert h.state == QUARANTINED
+
+    def test_crash_and_hang_quarantine_immediately(self):
+        for note in ("note_crash", "note_hang"):
+            h, _ = self._mk()
+            assert getattr(h, note)("boom") == QUARANTINED
+
+    def test_probe_lifecycle_and_backoff_doubling(self):
+        h, clock = self._mk()
+        h.note_crash("dead")
+        assert h.next_probe_at == 101.0  # now + probe_backoff_s
+        assert not h.probe_due()
+        clock["t"] = 101.0
+        assert h.probe_due()
+        h.begin_probe()
+        assert h.state == PROBATION and not h.placeable
+        assert not h.probe_due()  # probation never double-probes
+        h.probe_failed("still dead")
+        assert h.state == QUARANTINED
+        assert h.next_probe_at == 103.0  # backoff doubled to 2.0
+        clock["t"] = 103.0
+        h.begin_probe()
+        h.probe_failed("still dead")
+        assert h.next_probe_at == 107.0  # doubled to 4.0 (the cap)
+        clock["t"] = 107.0
+        h.begin_probe()
+        h.probe_failed("still dead")
+        assert h.next_probe_at == 111.0  # capped, not 8.0
+        clock["t"] = 111.0
+        h.begin_probe()
+        h.probe_passed()
+        assert h.state == HEALTHY and h.placeable
+        assert h.next_probe_at is None
+        assert h.probes == 4 and h.probe_failures == 3
+
+    def test_begin_probe_guards_state(self):
+        h, _ = self._mk()
+        with pytest.raises(RuntimeError, match="begin_probe"):
+            h.begin_probe()
+
+    def test_error_during_probation_requarantines_doubled(self):
+        h, clock = self._mk()
+        h.note_crash("dead")
+        clock["t"] = 101.0
+        h.begin_probe()
+        h.note_error("raced")  # a real step failed while probing
+        assert h.state == QUARANTINED
+        assert h.next_probe_at == 103.0  # doubled
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="quarantine_after"):
+            ResilienceConfig(degrade_after=3, quarantine_after=2)
+        with pytest.raises(ValueError, match="hung_step_s"):
+            ResilienceConfig(hung_step_s=0)
+        with pytest.raises(ValueError, match="unknown resilience"):
+            ResilienceConfig.from_dict({"hung_stp_s": 1})
+        assert ResilienceConfig.from_dict(
+            {"hung_step_s": 2.5}).hung_step_s == 2.5
+
+
+# ---------------------------------------------------------------------------
+# bounded retry-with-backoff (fake sleep: no wall time)
+# ---------------------------------------------------------------------------
+class TestRetries:
+    def test_succeeds_after_transient_failures(self):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise RuntimeError(f"transient {calls['n']}")
+            return "ok"
+
+        slept = []
+        assert with_retries(flaky, RetryPolicy(attempts=3, backoff_s=0.1),
+                            sleep=slept.append) == "ok"
+        assert calls["n"] == 3
+        assert slept == [0.1, 0.2]  # doubling backoff between attempts
+
+    def test_attempts_bounded_and_last_error_reraised(self):
+        calls = {"n": 0}
+
+        def dead():
+            calls["n"] += 1
+            raise RuntimeError(f"always {calls['n']}")
+
+        with pytest.raises(RuntimeError, match="always 3"):
+            with_retries(dead, RetryPolicy(attempts=3, backoff_s=0.0),
+                         sleep=lambda s: None)
+        assert calls["n"] == 3
+
+    def test_on_retry_sees_each_failure(self):
+        seen = []
+        with pytest.raises(ValueError):
+            with_retries(
+                lambda: (_ for _ in ()).throw(ValueError("x")),
+                RetryPolicy(attempts=3, backoff_s=0.0),
+                on_retry=lambda attempt, e: seen.append(attempt),
+                sleep=lambda s: None)
+        assert seen == [1, 2]  # no callback after the final attempt
+
+    def test_backoff_capped(self):
+        p = RetryPolicy(attempts=6, backoff_s=0.1, backoff_mult=10.0,
+                        max_backoff_s=0.5)
+        assert [p.delay(i) for i in range(1, 4)] == [0.1, 0.5, 0.5]
+
+    def test_policy_validation(self):
+        with pytest.raises(ValueError):
+            RetryPolicy(attempts=0)
+        with pytest.raises(ValueError):
+            RetryPolicy(backoff_mult=0.5)
+
+
+# ---------------------------------------------------------------------------
+# recovery planning
+# ---------------------------------------------------------------------------
+class TestRecoveryPlan:
+    def test_replay_prompt_is_prompt_plus_generated(self):
+        class R:
+            prompt_tokens = np.asarray([1, 2, 3], np.int32)
+            generated = [4, 5]
+
+        toks = replay_prompt(R())
+        assert toks.dtype == np.int32
+        assert list(toks) == [1, 2, 3, 4, 5]
+
+    def test_fully_delivered_stream_plans_fail_complete(self):
+        eng = FakeEngine()
+        core = type("C", (), {"engine": eng, "name": "d0"})()
+        req = type("R", (), {
+            "uid": 1, "is_terminal": False,
+            "prompt_tokens": np.asarray([1, 2], np.int32),
+            "generated": [3, 4], "params": _params(2)})()
+        route, arg = plan_recovery(core, req, pool_readable=False)
+        assert (route, arg) == ("fail", "complete")
+
+    def test_unseated_request_plans_replay(self):
+        eng = FakeEngine()
+        core = type("C", (), {"engine": eng, "name": "d0"})()
+        req = type("R", (), {
+            "uid": 2, "is_terminal": False,
+            "prompt_tokens": np.asarray([1, 2], np.int32),
+            "generated": [3], "params": _params(4)})()
+        route, toks = plan_recovery(core, req, pool_readable=False)
+        assert route == "replay"
+        assert list(toks) == [1, 2, 3]
+
+    def test_replay_over_admission_ceiling_fails(self):
+        # block_size=4, max_blocks_per_seq=2: a 12-token replay needs 3
+        # blocks — permanently inadmissible, so recovery fails the stream
+        # instead of re-queueing it forever
+        eng = FakeEngine(max_blocks_per_seq=2)
+        core = type("C", (), {"engine": eng, "name": "d0"})()
+        req = type("R", (), {
+            "uid": 3, "is_terminal": False,
+            "prompt_tokens": np.asarray([1, 2, 3, 4], np.int32),
+            "generated": [5], "params": _params(8)})()
+        route, reason = plan_recovery(core, req, pool_readable=False)
+        assert route == "fail" and "replay over max_context" in reason
+
+
+# ---------------------------------------------------------------------------
+# event log accounting (the /debug/events dropped counter)
+# ---------------------------------------------------------------------------
+class TestEventLogDropped:
+    def test_dropped_counts_evictions(self):
+        log = EventLog(maxlen=4)
+        for i in range(4):
+            log.emit("e", i=i)
+        assert log.stats() == {"total": 4, "retained": 4, "dropped": 0}
+        log.emit("e", i=4)
+        log.emit("e", i=5)
+        assert log.stats() == {"total": 6, "retained": 4, "dropped": 2}
+        # the retained window is the newest events
+        assert [e["i"] for e in log.recent()] == [5, 4, 3, 2]
+
+    def test_global_log_stats_surface_in_health(self):
+        eng = FakeEngine()
+        driver = ServingDriver(eng).start()
+        try:
+            h = driver.health()
+            assert set(h["events"]) == {"total", "retained", "dropped"}
+            assert h["events"]["total"] == get_event_log().stats()["total"]
+            assert h["replicas"][driver.core.name]["health"]["state"] == HEALTHY
+        finally:
+            driver.shutdown(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# router chaos on the compute-free FakeEngine (real allocator/scheduler)
+# ---------------------------------------------------------------------------
+class TestRouterRecovery:
+    def test_engine_step_failure_replays_bit_identically(self):
+        """The pool after a failed step is unknowable, so residents
+        recover by REPLAY — and the continuation must be bit-identical
+        because sampling keys are (seed, uid, position)-addressed."""
+        engines = [FakeEngine(step_delay=0.002) for _ in range(2)]
+        router = Router(engines=engines, num_prefill_workers=0,
+                        resilience=_fast_cfg()).start()
+        try:
+            prompts = [np.arange(1 + 10 * i, 5 + 10 * i, dtype=np.int32)
+                       for i in range(4)]
+            reqs = [router.submit(p, params=_params(24)) for p in prompts]
+            for r in reqs:
+                r.stream.get(timeout=30)  # every stream mid-decode
+            engines[0].fail_next = 1
+            for r in reqs:
+                assert r.wait(60), f"uid={r.uid} stuck in {r.state}"
+            for r, p in zip(reqs, prompts):
+                assert list(r.generated) == _expected_tokens(p, 24)
+            res = router.health()["resilience"]
+            assert res["replica_failures"] >= 1
+            assert res["recovery_replays"] >= 1
+            assert all(r.recoveries <= 1 for r in reqs)
+        finally:
+            router.shutdown()
+        for e in engines:
+            assert e.state_manager.free_blocks == e.config.kv_cache.num_blocks
+
+    def test_worker_crash_recovers_by_checkpoint_and_surfaces_error(self):
+        """A dying worker thread quarantines its replica (satellite: the
+        thread must never leave a live-looking corpse), residents recover
+        via KV-checkpoint export (the pool is intact between steps), and
+        ``health()`` carries the crash in ``last_error``."""
+        engines = [FakeEngine(step_delay=0.002) for _ in range(2)]
+        with inject(FaultSpec("worker.crash", nth=8, replica="d0")):
+            router = Router(engines=engines, num_prefill_workers=0,
+                            resilience=_fast_cfg(probe_backoff_s=60)).start()
+            try:
+                prompts = [np.arange(1 + 10 * i, 5 + 10 * i, dtype=np.int32)
+                           for i in range(4)]
+                reqs = _run_all(router, prompts, 30)
+                for r, p in zip(reqs, prompts):
+                    assert list(r.generated) == _expected_tokens(p, 30)
+                h = router.health()
+                res = h["resilience"]
+                assert res["quarantines"] == 1
+                assert res["recovery_checkpoints"] >= 1
+                d0 = h["replicas"]["d0"]["health"]
+                assert d0["state"] == QUARANTINED
+                assert "worker crash" in d0["last_error"]
+                assert "InjectedFault" in d0["last_error"]
+            finally:
+                router.shutdown()
+
+    def test_quarantined_replica_takes_no_placements_until_probe(self):
+        """The acceptance bar for the circuit breaker: while d0 is
+        quarantined every new request lands on d1; only a PASSED probe
+        restores placements."""
+        engines = [FakeEngine(step_delay=0.001) for _ in range(2)]
+        with inject(FaultSpec("worker.crash", nth=4, replica="d0")):
+            router = Router(engines=engines, num_prefill_workers=0,
+                            placement="round_robin",
+                            resilience=_fast_cfg(probe_backoff_s=0.2)).start()
+            try:
+                # trip the crash, then drain
+                first = _run_all(router, [np.asarray([5], np.int32)], 12)
+                assert list(first[0].generated) == _expected_tokens([5], 12)
+                deadline = time.monotonic() + 10
+                while router.health()["replicas"]["d0"]["health"]["state"] \
+                        != QUARANTINED:
+                    assert time.monotonic() < deadline, "d0 never quarantined"
+                    time.sleep(0.005)
+
+                # while quarantined (probe backoff not yet elapsed): every
+                # placement must go to d1, even under round-robin
+                before = router.health()["replicas"]
+                prompts = [np.asarray([100 + i], np.int32) for i in range(4)]
+                reqs = _run_all(router, prompts, 4)
+                for r, p in zip(reqs, prompts):
+                    assert list(r.generated) == _expected_tokens(p, 4)
+                after = router.health()["replicas"]
+                served_d0 = (after["d0"]["requests_finished_total"]
+                             - before["d0"]["requests_finished_total"])
+                assert served_d0 == 0, "quarantined replica took placements"
+                assert (after["d1"]["requests_finished_total"]
+                        - before["d1"]["requests_finished_total"]) == 4
+
+                # probe re-admission: wait for the breaker to close, then
+                # round-robin must reach d0 again
+                deadline = time.monotonic() + 10
+                while router.health()["resilience"]["placeable_replicas"] < 2:
+                    assert time.monotonic() < deadline, "probe never passed"
+                    time.sleep(0.01)
+                _run_all(router, [np.asarray([200 + i], np.int32)
+                                  for i in range(4)], 4)
+                final = router.health()["replicas"]
+                assert (final["d0"]["requests_finished_total"]
+                        - after["d0"]["requests_finished_total"]) >= 1
+                assert router.health()["resilience"]["probes"] >= 1
+            finally:
+                router.shutdown()
+
+    def test_step_hang_watchdog_quarantines_and_replays(self):
+        """A wedged step (its thread owns the step lock) is detected by
+        the coordinator watchdog; residents recover by replay WITHOUT
+        touching the hung replica's engine."""
+        engines = [FakeEngine(step_delay=0.002) for _ in range(2)]
+        cfg = _fast_cfg(hung_step_s=0.15, probe_backoff_s=60)
+        with inject(FaultSpec("step.hang", nth=6, replica="d1",
+                              hang_s=0.8)) as inj:
+            router = Router(engines=engines, num_prefill_workers=0,
+                            resilience=cfg).start()
+            try:
+                prompts = [np.arange(1 + 10 * i, 5 + 10 * i, dtype=np.int32)
+                           for i in range(4)]
+                reqs = _run_all(router, prompts, 30)
+                for r, p in zip(reqs, prompts):
+                    assert list(r.generated) == _expected_tokens(p, 30)
+                res = router.health()["resilience"]
+                assert any(f["site"] == "step.hang" for f in inj.fired())
+                assert res["quarantines"] >= 1
+                assert res["recovery_replays"] >= 1
+            finally:
+                router.shutdown()
+
+    def test_handoff_import_fault_retries_transparently(self):
+        """A transient import failure is retried under the bounded policy
+        and the stream completes as if nothing happened (import_sequence
+        unwinds its own allocations, so attempts are safe to repeat)."""
+        engines = [FakeEngine(step_delay=0.001) for _ in range(3)]
+        with inject(FaultSpec("handoff.import", nth=2)):
+            router = Router(engines=engines, num_prefill_workers=1,
+                            resilience=_fast_cfg()).start()
+            try:
+                prompts = [np.arange(1 + 10 * i, 7 + 10 * i, dtype=np.int32)
+                           for i in range(5)]
+                reqs = _run_all(router, prompts, 12)
+                for r, p in zip(reqs, prompts):
+                    assert list(r.generated) == _expected_tokens(p, 12)
+                res = router.health()["resilience"]
+                assert res["handoff_retries"] >= 1
+                assert res["replica_failures"] == 0  # edge fault, not replica
+            finally:
+                router.shutdown()
+        for e in engines:
+            assert e.state_manager.free_blocks == e.config.kv_cache.num_blocks
+
+    def test_handoff_export_fault_recovers_resident(self):
+        """Export fails past the retry budget: the sequence is still
+        resident and intact on the prefill worker, so recovery re-queues
+        it instead of failing the stream."""
+        engines = [FakeEngine(step_delay=0.001) for _ in range(2)]
+        # nth 1..3 exhausts all three retry attempts of the first export
+        specs = [FaultSpec("handoff.export", nth=n) for n in (1, 2, 3)]
+        with inject(*specs):
+            router = Router(engines=engines, num_prefill_workers=1,
+                            resilience=_fast_cfg()).start()
+            try:
+                p = np.arange(1, 7, dtype=np.int32)
+                (r,) = _run_all(router, [p], 12)
+                assert list(r.generated) == _expected_tokens(p, 12)
+                assert r.recoveries == 1
+                res = router.health()["resilience"]
+                assert res["recoveries"] >= 1
+                assert res["handoff_retries"] >= 2
+            finally:
+                router.shutdown()
+        for e in engines:
+            assert e.state_manager.free_blocks == e.config.kv_cache.num_blocks
+
+    def test_recovery_budget_exhausted_fails_request(self):
+        """max_recoveries=0: the first replica failure fails the stream
+        with the budget in the error (no infinite ping-pong)."""
+        engines = [FakeEngine(step_delay=0.002) for _ in range(2)]
+        router = Router(engines=engines, num_prefill_workers=0,
+                        resilience=_fast_cfg(max_recoveries=0)).start()
+        try:
+            p = np.asarray([5], np.int32)
+            r = router.submit(p, params=_params(24))
+            r.stream.get(timeout=30)
+            owner = next(e for e in engines
+                         if e.state_manager.n_tracked_sequences)
+            owner.fail_next = 1
+            assert r.wait(60)
+            assert r.state == RequestState.FAILED
+            assert "recovery budget" in r.error
+        finally:
+            router.shutdown()
+
+    def test_legacy_mode_unchanged_but_health_tracked(self):
+        """No resilience config: engine failure still fails the resident
+        set exactly as before — but the health machine observed it."""
+        engines = [FakeEngine(step_delay=0.002) for _ in range(2)]
+        router = Router(engines=engines, num_prefill_workers=0).start()
+        try:
+            p = np.asarray([5], np.int32)
+            r = router.submit(p, params=_params(24))
+            r.stream.get(timeout=30)
+            owner = next(e for e in engines
+                         if e.state_manager.n_tracked_sequences)
+            owner.fail_next = 1
+            assert r.wait(60)
+            assert r.state == RequestState.FAILED
+            h = router.health()
+            res = h["resilience"]
+            assert res["enabled"] is False
+            assert res["recoveries"] == 0
+            failed = [st["health"] for st in h["replicas"].values()
+                      if st["health"]["last_error"]]
+            assert failed and "injected engine failure" in failed[0]["last_error"]
+            # health is tracked but never gates legacy placement
+            assert res["placeable_replicas"] == 2
+        finally:
+            router.shutdown()
+
+    def test_seeded_schedule_acceptance_scenario(self):
+        """The PR acceptance scenario: a seeded schedule combining a
+        replica kill mid-stream with a faulted handoff import — every
+        accepted request completes byte-identical, >=1 recovery and >=1
+        quarantine observed, pools conserved."""
+        schedule = [FaultSpec("worker.crash", nth=10, replica="d0")]
+        schedule += [s for s in seeded_schedule(7, {"handoff.import": 1})]
+        engines = [FakeEngine(step_delay=0.001) for _ in range(2)]
+        with inject(*schedule) as inj:
+            router = Router(engines=engines, num_prefill_workers=0,
+                            resilience=_fast_cfg()).start()
+            try:
+                prompts = [np.arange(1 + 10 * i, 6 + 10 * i, dtype=np.int32)
+                           for i in range(6)]
+                reqs = _run_all(router, prompts, 20)
+                for r, p in zip(reqs, prompts):
+                    assert list(r.generated) == _expected_tokens(p, 20)
+                res = router.health()["resilience"]
+                assert res["recoveries"] >= 1
+                assert res["quarantines"] >= 1
+                assert {f["site"] for f in inj.fired()} >= {"worker.crash"}
+            finally:
+                router.shutdown()
+        for e in engines:
+            assert e.state_manager.free_blocks == e.config.kv_cache.num_blocks
+
+    def test_scaling_signals_exclude_quarantined(self):
+        engines = [FakeEngine(step_delay=0.001) for _ in range(2)]
+        with inject(FaultSpec("worker.crash", nth=4, replica="d0")):
+            router = Router(engines=engines, num_prefill_workers=0,
+                            resilience=_fast_cfg(probe_backoff_s=60)).start()
+            try:
+                _run_all(router, [np.asarray([5], np.int32)], 12)
+                deadline = time.monotonic() + 10
+                while router.health()["replicas"]["d0"]["health"]["state"] \
+                        != QUARANTINED:
+                    assert time.monotonic() < deadline
+                    time.sleep(0.005)
+                sig = router.scaling_signals()
+                assert sig.n_decode == 1
+                assert sig.n_quarantined == 1
+            finally:
+                router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# real-engine tiers: import-unwind conservation + recovery bit-identity
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def tiny_model():
+    import jax
+
+    from deepspeed_tpu.models import get_config, init_params
+
+    cfg = get_config("tiny", n_layers=2, dtype="float32", max_seq_len=512)
+    return cfg, init_params(cfg, jax.random.key(0))
+
+
+def _real_engine(tiny_model, kv_dtype, sampling):
+    from deepspeed_tpu.inference.config import RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+
+    cfg, params = tiny_model
+    rc = RaggedInferenceEngineConfig.from_dict({
+        "dtype": "float32",
+        "seed": 7,
+        "kv_cache": {"block_size": 16, "num_blocks": 64,
+                     "max_blocks_per_seq": 8, "kv_cache_dtype": kv_dtype},
+        "state_manager": {"max_tracked_sequences": 8,
+                          "max_ragged_batch_size": 128,
+                          "max_ragged_sequence_count": 4,
+                          "max_context": 256},
+    })
+    eng = InferenceEngineV2(cfg, params, rc)
+    eng.set_sampling(**sampling)
+    return eng
+
+
+class TestImportUnwind:
+    def test_fault_mid_import_conserves_target_pool(self, tiny_model):
+        """The satellite regression: a fault injected AFTER the import's
+        seed+extend (i.e. with destination blocks already allocated, just
+        before the chunked scatter) must unwind every seeded and freshly
+        allocated block — refcount conservation on the target, with a
+        real payload in flight."""
+        from deepspeed_tpu.serving.cluster.handoff import (
+            export_sequence, import_sequence)
+
+        src = _real_engine(tiny_model, "bf16", {"greedy": True})
+        tgt = _real_engine(tiny_model, "bf16", {"greedy": True})
+        uid = 7
+        src.scheduler.submit(uid, np.arange(1, 25, dtype=np.int32))
+        tok = src.step_tokens()[uid]  # single-chunk prefill: token ready
+        ho = export_sequence(src, uid, int(tok))
+        src.scheduler.finish(uid)
+        assert src.state_manager.free_blocks == 64
+        assert ho.payload is not None and ho.n_blocks >= 1
+
+        free_before = tgt.state_manager.free_blocks
+        with inject(FaultSpec("handoff.import", nth=1)):
+            with pytest.raises(InjectedFault):
+                import_sequence(tgt, ho)
+        acct = tgt.state_manager.kv_block_accounting()
+        assert acct["free"] == free_before
+        assert acct["free"] + acct["live"] + acct["cached_only"] \
+            == acct["total"]
+        assert tgt.state_manager.get_sequence(uid) is None
+
+        # the unwound target still imports cleanly afterwards, and the
+        # resumed row carries the exact pending token
+        assert import_sequence(tgt, ho) >= 1
+        assert tgt.scheduler.peek_next_token(uid) == ho.pending_token
+        tgt.scheduler.finish(uid)
+        assert tgt.state_manager.free_blocks == free_before
+
+
+def _recovery_parity_roundtrip(tiny_model, kv_dtype, sampling):
+    """Acceptance on the real engine: the same workload with a replica
+    killed mid-stream (checkpoint route) must stream bit-identically to
+    the single-engine driver."""
+    prompts = [np.arange(1 + 3 * i, 25 + 3 * i, dtype=np.int32)
+               for i in range(3)]
+    single = _real_engine(tiny_model, kv_dtype, sampling)
+    drv = ServingDriver(single).start()
+    want = [list(r.generated)
+            for r in _run_all(drv, prompts, 8, timeout=300)]
+    drv.shutdown()
+
+    cluster = [_real_engine(tiny_model, kv_dtype, sampling)
+               for _ in range(2)]
+    with inject(FaultSpec("worker.crash", nth=6, replica="d0")) as inj:
+        router = Router(engines=cluster, num_prefill_workers=0,
+                        resilience=_fast_cfg()).start()
+        try:
+            got = [list(r.generated)
+                   for r in _run_all(router, prompts, 8, timeout=300)]
+            res = router.health()["resilience"]
+        finally:
+            router.shutdown()
+    assert got == want, f"recovered streams diverged ({kv_dtype}, {sampling})"
+    assert any(f["site"] == "worker.crash" for f in inj.fired())
+    assert res["recoveries"] >= 1
+    for e in cluster:
+        assert e.state_manager.free_blocks == 64
+
+
+class TestServeCLI:
+    def test_resilience_flag_builds_fault_tolerant_router(self, tiny_model):
+        """--resilience arms the health/recovery plane (even for one
+        replica: the Router is the resilient frontend, the plain driver
+        stays the legacy fail-fast path)."""
+        from types import SimpleNamespace
+
+        from deepspeed_tpu.inference.cli import (
+            build_serving_stack, serve_parse_args)
+
+        cfg, params = tiny_model
+        tok = SimpleNamespace(eos_token_id=None)
+        args = serve_parse_args([
+            "--model", "unused", "--dtype", "float32",
+            "--block-size", "16", "--num-blocks", "64",
+            "--max-blocks-per-seq", "8", "--max-context", "256",
+            "--max-concurrent", "8",
+            "--resilience", "--hung-step-s", "2.5", "--max-recoveries", "5"])
+        front, _ = build_serving_stack(args, cfg=cfg, params=params, tok=tok)
+        assert isinstance(front, Router)
+        assert front._resilience.hung_step_s == 2.5
+        assert front._resilience.max_recoveries == 5
+        assert front.health()["resilience"]["enabled"] is True
+
+
+class TestRecoveryRealEngine:
+    def test_recovery_parity_bf16(self, tiny_model):
+        _recovery_parity_roundtrip(tiny_model, "bf16", {"greedy": True})
+        _recovery_parity_roundtrip(
+            tiny_model, "bf16",
+            {"greedy": False, "temperature": 0.8, "seed": 123})
+
+    @pytest.mark.slow
+    def test_recovery_parity_int8_seeded(self, tiny_model):
+        """int8 KV: quantized codes + scale planes checkpoint and re-seat
+        bit-exactly, so the seeded recovered stream still matches."""
+        _recovery_parity_roundtrip(
+            tiny_model, "int8",
+            {"greedy": False, "temperature": 0.8, "seed": 123})

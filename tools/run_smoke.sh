@@ -69,6 +69,13 @@ echo "== host-sync annotation gate (Tier A, hot serving modules) =="
 ./bin/dstpu lint deepspeed_tpu/inference/v2 deepspeed_tpu/serving \
     --select host-sync-in-loop --fail-on warning
 
+echo "== swallowed-exception gate (Tier A, serving thread loops) =="
+# a broad 'except Exception' in a serving thread loop whose handler only
+# logs leaves a dead replica looking alive; every such handler must mark
+# health / recover requests / journal an event (or carry a reasoned noqa)
+./bin/dstpu lint deepspeed_tpu/serving \
+    --select swallowed-thread-exception --fail-on warning
+
 echo "== disaggregated-serving parity gate (router, 2 replicas) =="
 # 1 prefill worker + 2 decode replicas on CPU must stream BIT-IDENTICAL
 # tokens to the single-engine driver (greedy + seeded, bf16 + int8 KV),
@@ -83,6 +90,53 @@ echo "== elastic-serving parity gate (preempt/resume + warm scale-up) =="
 # ladder sheds lowest-tier-first; runs the file unfiltered so the
 # slow-marked int8 combo is included
 python -m pytest tests/unit/test_elastic.py -q -p no:cacheprovider
+
+echo "== chaos gate (deterministic fault schedule, bit-identical recovery) =="
+# fault-injection state machine + recovery units, then the acceptance
+# scenario end to end: a seeded schedule (replica kill mid-stream, a
+# faulted handoff import, a faulted peer transfer) against a 2-replica
+# router — every accepted request must complete BYTE-IDENTICAL to the
+# fault-free run, with >=1 recovery and >=1 quarantine observed
+python -m pytest tests/unit/test_resilience.py -q -m 'not slow' -p no:cacheprovider
+python - <<'EOF'
+import numpy as np
+from deepspeed_tpu.serving import Router, SamplingParams
+from deepspeed_tpu.serving.resilience import (
+    FaultSpec, ResilienceConfig, inject)
+from tests.unit.test_serving import FakeEngine, _expected_tokens
+
+prompts = [np.arange(1 + 10 * i, 6 + 10 * i, dtype=np.int32)
+           for i in range(6)]
+want = [_expected_tokens(p, 20) for p in prompts]
+schedule = (
+    FaultSpec("worker.crash", nth=10, replica="d0"),  # kill mid-stream
+    FaultSpec("handoff.import", nth=2),               # one faulted import
+    FaultSpec("peer_pull", nth=1),                    # one faulted pull
+)
+cfg = ResilienceConfig(hung_step_s=2.0, probe_backoff_s=0.05,
+                       retry_backoff_s=0.001)
+with inject(*schedule) as inj:
+    router = Router(engines=[FakeEngine(step_delay=0.001) for _ in range(2)],
+                    num_prefill_workers=0, resilience=cfg).start()
+    try:
+        reqs = [router.submit(p, params=SamplingParams(
+                    max_new_tokens=20, ignore_eos=True)) for p in prompts]
+        for r in reqs:
+            assert r.wait(60), f"uid={r.uid} never finished ({r.state})"
+        for r, w in zip(reqs, want):
+            assert list(r.generated) == w, (
+                f"uid={r.uid} diverged after recovery: "
+                f"{list(r.generated)[:6]}... != {w[:6]}...")
+        h = router.health()["resilience"]
+        assert h["recoveries"] >= 1, h
+        assert h["quarantines"] >= 1, h
+    finally:
+        router.shutdown()
+fired = {f["site"] for f in inj.fired()}
+assert "worker.crash" in fired, fired
+print(f"chaos gate: {len(prompts)} streams bit-identical through "
+      f"{len(inj.fired())} injected fault(s) ({sorted(fired)})")
+EOF
 
 echo "== request-tracing gate (span trees + Perfetto export) =="
 # span tracer semantics, capture policy, the driver/router span threading
