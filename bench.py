@@ -810,6 +810,142 @@ def bench_host_tier_ab(cfg=None, params=None, seed=0):
     }
 
 
+def bench_kv_transport_ab(cfg=None, params=None, seed=0):
+    """KV-transport A/B (riding ``--serving-load`` via the
+    DSTPU_KV_TRANSPORT env knob): the SAME disaggregated revisit workload
+    — 1 prefill worker handing off to 1 decode replica, every prompt
+    sharing a hot multi-block prefix so revisit handoffs arrive with the
+    prefix already trie-covered on the decode side — served twice: once
+    over the baseline ``host`` wire (numpy bounce) and once over the
+    requested transport. The ``device`` wire keeps exported blocks
+    jax-resident (int8 scale planes riding along) and ships them as
+    pipelined chunked windows, so the decode replica seeds the covered
+    prefix and takes its first decode step while tail windows are still
+    in flight. Reports the two numbers the wire owns: per-handoff latency
+    (mean/p95 from the router histogram) and time-to-first-decode-token
+    on the revisit rounds, plus bytes moved per handoff. Token streams
+    must be BIT-identical across transports (the wire moves bytes, never
+    changes them) — any divergence raises. Knobs: DSTPU_KV_TRANSPORT
+    (``device``/``in_process`` enables), DSTPU_KVT_N (revisit rounds),
+    DSTPU_KVT_MAX_NEW, DSTPU_KVT_KV_DTYPE (bf16|int8)."""
+    from deepspeed_tpu.inference.config import RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.models import TransformerConfig, init_params
+    from deepspeed_tpu.serving.cluster import Router
+    from deepspeed_tpu.serving.cluster.handoff import KV_TRANSPORTS
+    from deepspeed_tpu.serving.request import SamplingParams
+
+    transport = os.environ.get("DSTPU_KV_TRANSPORT", "device")
+    if transport not in KV_TRANSPORTS:
+        raise ValueError(
+            f"DSTPU_KV_TRANSPORT={transport!r}: choose from {KV_TRANSPORTS}")
+    n_revisits = int(os.environ.get("DSTPU_KVT_N", 6))
+    max_new = int(os.environ.get("DSTPU_KVT_MAX_NEW", 8))
+    kv_dtype = os.environ.get("DSTPU_KVT_KV_DTYPE", "bf16")
+    if cfg is None:
+        cfg = TransformerConfig(
+            vocab_size=512, hidden_size=128, n_layers=2, n_heads=4,
+            max_seq_len=512, dtype="float32",
+        )
+        params = init_params(cfg, jax.random.key(0))
+    rng = np.random.default_rng(seed)
+    block_size = 16
+    # 4-block hot prefix + 2-block unique tail = 6 blocks per handoff;
+    # chunk width 2 → three pipelined windows per export on the device wire
+    hot = rng.integers(0, cfg.vocab_size, size=(64,)).astype(np.int32)
+    tails = [rng.integers(0, cfg.vocab_size, size=(24,)).astype(np.int32)
+             for _ in range(n_revisits + 1)]
+    rc_dict = {
+        "dtype": cfg.dtype,
+        "kv_cache": {"block_size": block_size, "num_blocks": 96,
+                     "max_blocks_per_seq": 12, "prefix_cache": True,
+                     "kv_cache_dtype": kv_dtype,
+                     "host_tier_chunk_blocks": 2},
+        "state_manager": {"max_tracked_sequences": 16,
+                          "max_ragged_batch_size": 96,
+                          "max_ragged_sequence_count": 8,
+                          "max_context": 256},
+    }
+
+    def run(wire):
+        engines = [
+            InferenceEngineV2(cfg, params,
+                              RaggedInferenceEngineConfig.from_dict(rc_dict))
+            for _ in range(2)
+        ]
+        router = Router(engines=engines, num_prefill_workers=1,
+                        kv_transport=wire, max_queue=16).start()
+        outputs, revisit_ttfts = [], []
+        try:
+            def go(prompt):
+                r = router.submit(prompt, params=SamplingParams(
+                    max_new_tokens=max_new, ignore_eos=True))
+                r.wait(300)
+                outputs.append(list(r.generated))
+                return r
+
+            # seed round: compiles both engines' step shapes AND leaves the
+            # hot prefix trie-covered on the decode replica, so every
+            # measured revisit handoff exercises the covered-prefix seed +
+            # pipelined-tail path
+            go(np.concatenate([hot, tails[0]]))
+            for t in tails[1:]:
+                r = go(np.concatenate([hot, t]))
+                if r.ttft_s is not None:
+                    revisit_ttfts.append(r.ttft_s)
+            kt = router.health()["kv_transport"]
+            cell = kt["per_transport"].get(wire, {})
+        finally:
+            router.shutdown(drain=True, timeout=60)
+        handoffs = max(1.0, cell.get("handoffs", 0.0))
+        return {
+            "outputs": outputs,
+            "ttft_revisit_mean_s": (float(np.mean(revisit_ttfts))
+                                    if revisit_ttfts else None),
+            "handoff_mean_s": kt["latency_mean_s"],
+            "handoff_p95_s": kt["latency_p95_s"],
+            "bytes_per_handoff": cell.get("bytes", 0.0) / handoffs,
+            "windows_per_handoff": cell.get("chunks", 0.0) / handoffs,
+            "handoffs": int(cell.get("handoffs", 0.0)),
+        }
+
+    base = run("host")
+    arm = run(transport)
+    if base["outputs"] != arm["outputs"]:
+        raise RuntimeError(
+            f"kv-transport A/B streams diverged (host vs {transport}): the "
+            "wire must be bit-invisible — it moves KV bytes, never changes "
+            "them"
+        )
+    if not arm["handoffs"]:
+        raise RuntimeError(
+            "kv-transport A/B measured nothing: no handoffs reached the "
+            f"{transport!r} wire — is the prefill worker routing?"
+        )
+    off_t, on_t = base["ttft_revisit_mean_s"], arm["ttft_revisit_mean_s"]
+    return {
+        "transport": transport,
+        "kv_dtype": kv_dtype,
+        "handoffs_per_arm": arm["handoffs"],
+        "handoff_host_mean_s": round(base["handoff_mean_s"], 6),
+        "handoff_host_p95_s": round(base["handoff_p95_s"], 6),
+        f"handoff_{transport}_mean_s": round(arm["handoff_mean_s"], 6),
+        f"handoff_{transport}_p95_s": round(arm["handoff_p95_s"], 6),
+        "handoff_speedup": (round(base["handoff_mean_s"]
+                                  / arm["handoff_mean_s"], 3)
+                            if arm["handoff_mean_s"] else None),
+        "bytes_per_handoff_host": int(base["bytes_per_handoff"]),
+        f"bytes_per_handoff_{transport}": int(arm["bytes_per_handoff"]),
+        f"windows_per_handoff_{transport}": round(
+            arm["windows_per_handoff"], 2),
+        "ttft_revisit_host_s": round(off_t, 4) if off_t is not None else None,
+        f"ttft_revisit_{transport}_s": (round(on_t, 4)
+                                        if on_t is not None else None),
+        "ttft_speedup": (round(off_t / on_t, 3) if off_t and on_t else None),
+        "outputs_bit_identical": True,
+    }
+
+
 def bench_comm_quant_ab(cfg=None, params=None, seed=0):
     """Quantized-collectives A/B (riding ``--serving-load`` via the
     DSTPU_COMM_QUANT=int8 env knob): the SAME TP-decode workload served
@@ -1382,6 +1518,13 @@ def bench_serving_load(
     ht_report = {}
     if int(os.environ.get("DSTPU_KV_HOST_TIER_BYTES", "0") or 0) > 0:
         ht_report = {"kv_host_tier": bench_host_tier_ab(seed=seed)}
+    # KV-transport A/B rider: DSTPU_KV_TRANSPORT=device|in_process appends
+    # a disagg revisit-workload comparison vs the host numpy wire —
+    # per-handoff latency, bytes/windows per handoff, revisit TTFT
+    # (streams must stay bit-identical across transports)
+    kvt_report = {}
+    if os.environ.get("DSTPU_KV_TRANSPORT", ""):
+        kvt_report = {"kv_transport": bench_kv_transport_ab(seed=seed)}
     # quantized-collectives A/B rider: DSTPU_COMM_QUANT=int8 appends a
     # TP-decode tok/s + per-wire byte-reduction comparison vs full width
     cq_report = {}
@@ -1440,6 +1583,7 @@ def bench_serving_load(
         **spec_report,
         **kv_report,
         **ht_report,
+        **kvt_report,
         **cq_report,
         **co_report,
         **disagg_report,

@@ -10,6 +10,7 @@ from deepspeed_tpu.analysis.rules import (  # noqa: F401
     donation,
     host_sync,
     jit_purity,
+    kv_host_bounce,
     raw_collective,
     shard_specs,
     swallowed_errors,

@@ -1,0 +1,65 @@
+"""Rule ``kv-host-bounce``: host materialization of KV payloads in the
+cluster handoff hot path.
+
+The whole point of the ``device`` KV transport is that a prefill->decode
+handoff never round-trips blocks through host numpy — exported windows
+stay resident as jax device arrays and land in the target pool via the
+donated scatter. A stray ``np.asarray``/``jax.device_get`` in
+``serving/cluster/`` silently reintroduces the PCIe bounce (and the sync)
+the transport seam exists to remove, and nothing else would catch it: the
+payload still scatters correctly, just ~10x slower per handoff.
+
+The rule fires on every host-copy call in ``serving/cluster/`` modules,
+loop or not — ONE bounce per handoff is already the regression. Sites
+that deliberately touch host data (token staging, chain hashing over
+prompt tokens, the host transport itself) are annotated with
+``# dstpu: noqa[kv-host-bounce]``, which doubles as documentation of why
+the copy is not a KV payload.
+"""
+
+import ast
+
+from deepspeed_tpu.analysis.framework import Rule, register
+from deepspeed_tpu.analysis.rules._common import dotted_name
+
+_BOUNCE_CALLS = {
+    "jax.device_get", "device_get",
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "jnp.asarray",
+}
+
+_CLUSTER_FRAGMENT = "serving/cluster/"
+
+
+@register
+class KVHostBounceRule(Rule):
+    name = "kv-host-bounce"
+    severity = "warning"
+    description = (
+        "host-copy call (np.asarray/np.array/jax.device_get) in a "
+        "serving/cluster/ module bounces KV payloads through host memory, "
+        "defeating the device handoff transport"
+    )
+
+    def check(self, ctx):
+        norm = ctx.path.replace("\\", "/")
+        if _CLUSTER_FRAGMENT not in norm:
+            return []
+        rule = self
+        findings = []
+
+        class V(ast.NodeVisitor):
+            def visit_Call(self, node):
+                name = dotted_name(node.func)
+                if name in _BOUNCE_CALLS:
+                    findings.append(ctx.finding(
+                        rule, node,
+                        f"{name}() materializes a host copy on the cluster "
+                        "handoff path; keep KV payloads as device arrays "
+                        "(device transport) or annotate the deliberate "
+                        "host touch with # dstpu: noqa[kv-host-bounce]",
+                    ))
+                self.generic_visit(node)
+
+        V().visit(ctx.tree)
+        return findings

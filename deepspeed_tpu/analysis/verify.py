@@ -870,6 +870,115 @@ def verify_host_tier() -> List[CheckResult]:
     return results
 
 
+def verify_kv_transport() -> List[CheckResult]:
+    """Zero-copy KV handoff wire (``export_kv_blocks_windows`` +
+    ``import_kv_blocks_device``): the pipelined device transport must ride
+    the SAME compiled programs as the host-tier readmit path — a fixed
+    chunk-window export gather that traces once per plane family, and the
+    donated ``_kv_readmit_jit`` scatter (a lost alias would copy the whole
+    paged pool once per in-flight window, per handoff). The tp=2 leg
+    re-lays each window onto the decode replica's head-sharded mesh via
+    ``device_put`` before the scatter; the donated sharded import must
+    still alias and must not retrace per window."""
+    import jax
+    import jax.numpy as jnp
+
+    results: List[CheckResult] = []
+    engines = {}  # kv_dtype -> tp1 engine, reused as the tp2 leg's source
+    for kv_dtype, max_traces in (("bf16", 1), ("int8", 2)):
+        tag = "" if kv_dtype == "bf16" else f"[{kv_dtype}]"
+        _, eng = _tiny_v2_engine(kv_dtype=kv_dtype)
+        engines[kv_dtype] = eng
+        blocks = [1, 2, 3, 4, 5]  # 5 blocks @ chunk 2 -> 3 windows, padded tail
+        # round 1 traces; round 2 (with a covered prefix, redirected to the
+        # trash row — NOT a narrower scatter) must hit both caches
+        wins, ch = eng.export_kv_blocks_windows(blocks, chunk_blocks=2)
+        eng.import_kv_blocks_device(blocks, wins, ch)
+        wins, ch = eng.export_kv_blocks_windows(blocks, chunk_blocks=2)
+        eng.import_kv_blocks_device(blocks, wins, ch, skip_blocks=2)
+        gather = eng._kv_export_jit
+        if gather is None:
+            results.append(CheckResult(
+                f"engine_v2.kv_export{tag}", "recompile", False,
+                "windowed export never built the gather"))
+        else:
+            results.append(check_recompile(
+                f"engine_v2.kv_export{tag}", gather, max_traces=max_traces))
+        fn = eng._kv_readmit_jit
+        label = f"engine_v2.kv_device_import{tag}"
+        if fn is None:
+            results.append(CheckResult(
+                label, "donation", False,
+                "device import never built the readmit scatter"))
+            continue
+        pool = eng._k_cache
+        vals = jnp.zeros((pool.shape[0], 2) + tuple(pool.shape[2:]), pool.dtype)
+        results.append(check_donation(
+            label, fn, (pool, jnp.zeros((2,), jnp.int32), vals)))
+        results.append(check_recompile(label, fn, max_traces=max_traces))
+
+    # --- tp=2 decode replica: head-sharded import off a tp=1 export --------
+    if len(jax.devices()) < 8:
+        results.append(CheckResult("kv_transport[tp2]", "donation", True,
+                                   "needs 8 devices; skipped"))
+        return results
+
+    from deepspeed_tpu.inference.config import RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.models import get_config, init_params
+    from deepspeed_tpu.parallel.topology import (
+        Topology,
+        reset_topology,
+        set_topology,
+    )
+
+    reset_topology()
+    try:
+        set_topology(Topology(data=4, model=2))
+        for kv_dtype, max_traces in (("bf16", 1), ("int8", 2)):
+            tag = f"[tp2,{kv_dtype}]"
+            cfg = get_config("tiny", n_layers=2, dtype="float32",
+                             max_seq_len=512)
+            params = init_params(cfg, jax.random.key(0))
+            rc = RaggedInferenceEngineConfig.from_dict({
+                "dtype": "float32",
+                "tp_size": 2,
+                "decode_steps": 2,
+                "kv_cache": {"block_size": 4, "num_blocks": 128,
+                             "max_blocks_per_seq": 32,
+                             "kv_cache_dtype": kv_dtype},
+                "state_manager": {"max_tracked_sequences": 16,
+                                  "max_ragged_batch_size": 256,
+                                  "max_ragged_sequence_count": 4,
+                                  "max_context": 256},
+            })
+            dst = InferenceEngineV2(cfg, params, rc)
+            src = engines[kv_dtype]  # tp=1 exporter (the prefill side)
+            blocks = [1, 2, 3, 4, 5]
+            wins, ch = src.export_kv_blocks_windows(blocks, chunk_blocks=2)
+            dst.import_kv_blocks_device(blocks, wins, ch)
+            wins, ch = src.export_kv_blocks_windows(blocks, chunk_blocks=2)
+            dst.import_kv_blocks_device(blocks, wins, ch, skip_blocks=2)
+            fn = dst._kv_readmit_jit
+            label = f"engine_v2.kv_device_import{tag}"
+            if fn is None:
+                results.append(CheckResult(
+                    label, "donation", False,
+                    "sharded device import never built the readmit scatter"))
+                continue
+            pool = dst._k_cache
+            vals = jax.device_put(
+                jnp.zeros((pool.shape[0], 2) + tuple(pool.shape[2:]),
+                          pool.dtype),
+                dst._kv_sharding)
+            results.append(check_donation(
+                label, fn, (pool, jnp.zeros((2,), jnp.int32), vals)))
+            results.append(check_recompile(label, fn, max_traces=max_traces))
+    finally:
+        reset_topology()
+    return results
+
+
 def verify_elastic() -> List[CheckResult]:
     """Elastic serving: a warm spare's ``warm_trace`` must cover EVERY step
     program the serving loop drives, so post-warm serving traffic — prefill,
@@ -970,6 +1079,7 @@ def run_verify(verbose: bool = True) -> Tuple[List[CheckResult], bool]:
         (verify_tiled_overlap, "tiled_overlap"),
         (verify_disagg, "disagg"),
         (verify_host_tier, "host_tier"),
+        (verify_kv_transport, "kv_transport"),
         (verify_elastic, "elastic"),
     ):
         try:

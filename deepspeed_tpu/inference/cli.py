@@ -231,6 +231,14 @@ def serve_parse_args(argv=None):
                    choices=("slo", "round_robin", "least_loaded"),
                    help="decode-replica placement policy: slo ranks by "
                    "free-block headroom / queue depth / deadline slack")
+    p.add_argument("--kv-transport", default="host",
+                   choices=("host", "device", "in_process"),
+                   help="KV handoff wire for prefill->decode moves: host "
+                   "bounces blocks through portable numpy; device keeps "
+                   "exported blocks resident as device arrays and ships "
+                   "them in pipelined chunked windows (decode starts "
+                   "before the tail lands, no host round-trip); "
+                   "in_process is a plain same-process device copy")
     p.add_argument("--min-decode-replicas", type=int, default=0,
                    help="elastic serving floor: autoscaling never retires "
                    "below this (0 = elastic control plane off)")
@@ -435,6 +443,7 @@ def build_serving_stack(args, cfg=None, params=None, tok=None):
         decode_steps=args.decode_steps,
         spec_ngram=getattr(args, "spec_ngram", 3),
         placement=getattr(args, "placement", "slo"),
+        kv_transport=getattr(args, "kv_transport", "host"),
         elastic=elastic_cfg,
         spare_pool=spare_pool,
         resilience=resilience_cfg,

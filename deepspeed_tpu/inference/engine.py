@@ -34,11 +34,17 @@ def _bucket(n: int, buckets=(32, 64, 128, 256, 512, 1024, 2048, 4096)) -> int:
 
 
 def _sample(logits_row, rng, temperature, greedy):
-    """logits_row: [b, vocab] fp32."""
+    """logits_row: [b, vocab] fp32. Draws under the same scoped
+    threefry-partitionable lowering as sampling.sample_tokens — the two
+    paths must produce identical tokens for the same key, and the v2
+    path needs partitionable bits for tp-stable seeded streams."""
+    from deepspeed_tpu.inference.sampling import _partitionable_bits
+
+    with _partitionable_bits():
+        drawn = jax.random.categorical(
+            rng, logits_row / jnp.maximum(temperature, 1e-4))
     return jnp.where(
-        greedy,
-        jnp.argmax(logits_row, axis=-1),
-        jax.random.categorical(rng, logits_row / jnp.maximum(temperature, 1e-4)),
+        greedy, jnp.argmax(logits_row, axis=-1), drawn,
     ).astype(jnp.int32)
 
 

@@ -15,6 +15,24 @@ k. Temperature is traced.
 import jax
 import jax.numpy as jnp
 
+# Sharding-invariant random bits. The legacy threefry lowering lets GSPMD
+# partition the counter math differently per mesh, so the SAME
+# (seed, uid, position) key could sample different tokens on a tp=2 replica
+# than on a tp=1 engine — breaking the content-addressed-stream guarantee
+# that disaggregated placement relies on (a sequence must stream the same
+# bytes wherever it decodes). The partitionable implementation generates
+# bits as a pure per-element function of the key and counter, identical
+# under any partitioning, which makes seeded streams bit-stable across
+# tp layouts. It changes the raw stream vs the legacy lowering, so it is
+# scoped to THIS module's key derivation and sampling (eager calls and
+# jit traces alike — the context governs trace-time lowering), never set
+# globally: flipping the process-wide flag would silently shift every
+# other jax.random consumer's bits (training init, dropout, test data).
+# jax.threefry_partitionable returns a single-use context manager, so a
+# fresh one is minted per entry.
+def _partitionable_bits():
+    return jax.threefry_partitionable(True)
+
 NEG_INF = -1e30
 
 
@@ -44,9 +62,10 @@ def row_keys(rng, uids, positions):
     decode_steps partitioning, or whether a prefix-cache hit skipped part
     of prefill — so sampled streams are bit-identical across all of those
     execution choices."""
-    return jax.vmap(
-        lambda u, p: jax.random.fold_in(jax.random.fold_in(rng, u), p)
-    )(jnp.asarray(uids, jnp.int32), jnp.asarray(positions, jnp.int32))
+    with _partitionable_bits():
+        return jax.vmap(
+            lambda u, p: jax.random.fold_in(jax.random.fold_in(rng, u), p)
+        )(jnp.asarray(uids, jnp.int32), jnp.asarray(positions, jnp.int32))
 
 
 def _is_key_batch(rng) -> bool:
@@ -84,12 +103,13 @@ def sample_tokens(
         dist = filter_logits(
             logits / jnp.maximum(temperature, 1e-4), top_k=top_k, top_p=top_p
         )
-        if _is_key_batch(rng):
-            toks = jax.vmap(
-                lambda k, d: jax.random.categorical(k, d)
-            )(rng, dist).astype(jnp.int32)
-        else:
-            toks = jax.random.categorical(rng, dist).astype(jnp.int32)
+        with _partitionable_bits():
+            if _is_key_batch(rng):
+                toks = jax.vmap(
+                    lambda k, d: jax.random.categorical(k, d)
+                )(rng, dist).astype(jnp.int32)
+            else:
+                toks = jax.random.categorical(rng, dist).astype(jnp.int32)
     if not return_logprobs:
         return toks
     logp = jax.nn.log_softmax(dist, axis=-1)

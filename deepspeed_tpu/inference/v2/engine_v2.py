@@ -131,6 +131,8 @@ class InferenceEngineV2:
         # kernels are opaque to GSPMD's auto-partitioner.
         self._tp = int(getattr(self.config, "tp_size", 1) or 1)
         self._mesh = None
+        self._kv_sharding = None  # tp>1: head-sharded pool layout
+        self._kv_scale_sharding = None  # tp>1 + int8: scale planes ride along
         if self._tp > 1:
             from deepspeed_tpu.models import param_partition_specs
             from deepspeed_tpu.parallel.topology import MODEL_AXIS, get_topology
@@ -160,6 +162,12 @@ class InferenceEngineV2:
             )
             self._kv_sharding = NamedSharding(
                 self._mesh, P(None, None, None, MODEL_AXIS, None)
+            )
+            # the int8 scale planes drop the head_dim axis but shard the
+            # same kv-head dim; stored so the sharded handoff import can
+            # re-lay-out incoming scale windows without rebuilding specs
+            self._kv_scale_sharding = NamedSharding(
+                self._mesh, P(None, None, None, MODEL_AXIS)
             )
         # --- quantized TP collectives: "int8" replaces the implicit GSPMD
         # psum behind the attention-output and MLP down projections with an
@@ -239,15 +247,9 @@ class InferenceEngineV2:
             self._k_cache = zeros()
             self._v_cache = zeros()
             if self._kv_int8:
-                from jax.sharding import NamedSharding
-                from jax.sharding import PartitionSpec as P
-                from deepspeed_tpu.parallel.topology import MODEL_AXIS
-
                 zeros_s = jax.jit(
                     lambda: jnp.zeros(sshape, jnp.float32),
-                    out_shardings=NamedSharding(
-                        self._mesh, P(None, None, None, MODEL_AXIS)
-                    ),
+                    out_shardings=self._kv_scale_sharding,
                 )
                 self._ks_cache = zeros_s()
                 self._vs_cache = zeros_s()
@@ -266,6 +268,10 @@ class InferenceEngineV2:
         # chunked re-import: ONE fixed window shape (tail padded into the
         # trash row) so the donated scatter never recompiles in steady state
         self._kv_readmit_jit = None
+        # device-resident handoff export: fixed-window pool gather (the
+        # zero-copy wire's dual of _kv_readmit_jit) — one trace per plane
+        # family, never per block count
+        self._kv_export_jit = None
         # --- host block tier (host_tier.py, ROADMAP item 3): LRU-evicted
         # prefix-trie blocks demote their KV to a byte-budgeted host store
         # instead of vanishing; a trie miss the store covers re-imports
@@ -281,7 +287,8 @@ class InferenceEngineV2:
                 )
             from deepspeed_tpu.inference.v2.host_tier import HostBlockStore
 
-            self._host_tier = HostBlockStore(htb)
+            self._host_tier = HostBlockStore(
+                htb, validate=self._check_tier_entry)
             self.state_manager.prefix_cache.spill_fn = self._spill_block
             self.state_manager.host_readmit = self._host_readmit
         self._spec_rr = 0  # rotation cursor for budget-capped spec rounds
@@ -391,37 +398,24 @@ class InferenceEngineV2:
             planes["v_scale"] = self._vs_cache
         return planes
 
-    def _check_kv_payload(self, n: int, payload: Dict[str, np.ndarray]) -> None:
-        """Raise loudly on any payload/pool mismatch BEFORE scattering: a
-        malformed payload (wrong dtype, wrong trailing dims, missing or
-        stray scale planes) must never silently cast-and-scatter garbage
-        into live KV."""
-        pools = self._kv_pool_planes()
-        missing = sorted(set(pools) - set(payload))
-        extra = sorted(set(payload) - set(pools))
-        if missing or extra:
-            raise ValueError(
-                f"import_kv_blocks: payload planes {sorted(payload)} do not "
-                f"match the {self._kv_dtype} pool's {sorted(pools)}"
-                + (f"; missing {missing}" if missing else "")
-                + (f"; unexpected {extra}" if extra else "")
-            )
-        for name, pool in pools.items():
-            plane = payload[name]
-            expect = (pool.shape[0], n) + tuple(pool.shape[2:])
-            if tuple(plane.shape) != expect:
-                raise ValueError(
-                    f"import_kv_blocks: payload[{name!r}] shape "
-                    f"{tuple(plane.shape)} != {expect} expected for {n} "
-                    f"target blocks"
-                )
-            if np.dtype(plane.dtype) != np.dtype(pool.dtype):
-                raise ValueError(
-                    f"import_kv_blocks: payload[{name!r}] dtype "
-                    f"{np.dtype(plane.dtype)} != pool dtype "
-                    f"{np.dtype(pool.dtype)} (a silent cast would corrupt "
-                    "quantized codes/scales)"
-                )
+    def _kv_payload_spec(self) -> "KVPayloadSpec":
+        """The strict per-plane contract every KV mover validates against:
+        plane name -> ((n_layers, *per_block_tail), pool dtype)."""
+        return {
+            name: ((pool.shape[0],) + tuple(pool.shape[2:]),
+                   np.dtype(pool.dtype))
+            for name, pool in self._kv_pool_planes().items()
+        }
+
+    def _check_kv_payload(self, n: int, payload: Dict[str, np.ndarray],
+                          context: str = "import_kv_blocks") -> None:
+        """Validate a payload against the shared pool contract
+        (kv_pool.check_kv_payload) before any scatter touches live KV —
+        the same check the host-tier store and every handoff transport
+        run, so the contracts cannot drift."""
+        from deepspeed_tpu.inference.v2.kv_pool import check_kv_payload
+
+        check_kv_payload(self._kv_payload_spec(), n, payload, context=context)
 
     def import_kv_blocks(self, block_ids, payload: Dict[str, np.ndarray]) -> None:
         """Scatter an exported payload into THIS pool at ``block_ids`` (the
@@ -516,6 +510,115 @@ class InferenceEngineV2:
                 setattr(self, attr, scatter(getattr(self, attr), idx, vals[name]))
             staged = nxt
 
+    # -- device-resident handoff (zero-copy KV transport) ------------------
+    def export_kv_blocks_device(self, block_ids) -> Dict[str, "jnp.ndarray"]:
+        """``export_kv_blocks`` without the host round-trip: gather the
+        pool planes for ``block_ids`` into fresh DEVICE arrays. The gather
+        output owns its buffers, so the source sequence can release (and
+        its pool rows be re-written by later donated steps) while the
+        payload is still in flight to the importer. Shape varies with the
+        block count — the fixed-window pipelined path below is the one
+        steady-state handoffs ride."""
+        idx = jnp.asarray(np.asarray(list(block_ids), np.int32))
+        return {name: pool[:, idx]
+                for name, pool in self._kv_pool_planes().items()}
+
+    def export_kv_blocks_windows(self, block_ids, chunk_blocks: int = 0):
+        """Chunked pipelined device-resident export: the dual of
+        ``import_kv_blocks_chunked``. Returns ``(windows, chunk)`` where
+        each window maps plane name -> a device array of exactly
+        ``chunk`` block columns — the tail window's index vector is
+        padded with the pool's trash row, so the jitted gather compiles
+        once per plane family and never per block count (the warm-spare
+        zero-trace contract). All window gathers are dispatched
+        asynchronously up front: the importer can scatter (and the
+        decode replica can start its first round on the trie-covered
+        prefix) while the tail windows are still materializing."""
+        kv = self.config.kv_cache
+        chunk = int(chunk_blocks) or int(
+            getattr(kv, "host_tier_chunk_blocks", 8) or 8)
+        n = len(block_ids)
+        if n == 0:
+            return [], chunk
+        trash = kv.num_blocks
+        n_win = -(-n // chunk)
+        idx_host = np.full(n_win * chunk, trash, np.int32)
+        idx_host[:n] = np.asarray(list(block_ids), np.int32)
+        if self._kv_export_jit is None:
+            self._kv_export_jit = jax.jit(lambda pool, idx: pool[:, idx])
+        gather = self._kv_export_jit
+        planes = self._kv_pool_planes()
+        windows = []
+        for w in range(n_win):
+            idx = jnp.asarray(idx_host[w * chunk:(w + 1) * chunk])
+            windows.append({name: gather(pool, idx)
+                            for name, pool in planes.items()})
+        return windows, chunk
+
+    def import_kv_blocks_device(self, block_ids, windows,
+                                chunk_blocks: int, skip_blocks: int = 0):
+        """Scatter a windowed device-resident export into THIS pool at
+        ``block_ids`` (the full per-sequence destination table, in source
+        column order) without ever materializing a host copy. The first
+        ``skip_blocks`` destinations (prefix already covered by this
+        replica's trie/host tier) and the padded tail redirect to the
+        trash row instead of slicing the device arrays — every window
+        keeps the ONE compiled readmit-scatter shape. At tp>1 each
+        window is re-laid-out onto this replica's mesh (head-sharded KV,
+        scale planes riding along) by an async ``device_put`` before the
+        donated scatter, which is the per-shard import the TP>1 decode
+        placement rides. Same locking contract as ``import_kv_blocks``;
+        returns the number of block columns actually scattered."""
+        n = len(block_ids)
+        chunk = int(chunk_blocks)
+        if n == 0 or not windows:
+            return 0
+        if chunk <= 0:
+            raise ValueError(
+                f"import_kv_blocks_device: chunk_blocks={chunk_blocks} "
+                "must be positive (the exporter's window size)")
+        n_win = -(-n // chunk)
+        if len(windows) != n_win:
+            raise ValueError(
+                f"import_kv_blocks_device: {len(windows)} windows != "
+                f"{n_win} expected for {n} blocks at chunk {chunk}")
+        spec = self._kv_payload_spec()
+        for win in windows:
+            self._check_kv_payload(chunk, win,
+                                   context="import_kv_blocks_device")
+        kv = self.config.kv_cache
+        trash = kv.num_blocks
+        idx_host = np.full(n_win * chunk, trash, np.int32)
+        idx_host[:n] = np.asarray(list(block_ids), np.int32)
+        idx_host[:max(0, int(skip_blocks))] = trash
+        if self._kv_readmit_jit is None:
+            self._kv_readmit_jit = jax.jit(
+                lambda pool, idx, vals: pool.at[:, idx].set(vals),
+                donate_argnums=(0,),
+            )
+        scatter = self._kv_readmit_jit
+        attrs = {"k": "_k_cache", "v": "_v_cache",
+                 "k_scale": "_ks_cache", "v_scale": "_vs_cache"}
+        shardings = {}
+        if self._tp > 1:
+            shardings = {"k": self._kv_sharding, "v": self._kv_sharding,
+                         "k_scale": self._kv_scale_sharding,
+                         "v_scale": self._kv_scale_sharding}
+        # windows fully below the covered prefix carry nothing to keep
+        w0 = max(0, int(skip_blocks)) // chunk
+        copied = 0
+        for w in range(w0, n_win):
+            idx = jnp.asarray(idx_host[w * chunk:(w + 1) * chunk])
+            for name in sorted(spec):
+                vals = windows[w][name]
+                sh = shardings.get(name)
+                if sh is not None:
+                    vals = jax.device_put(vals, sh)
+                attr = attrs[name]
+                setattr(self, attr, scatter(getattr(self, attr), idx, vals))
+            copied += int(np.sum(idx_host[w * chunk:(w + 1) * chunk] != trash))
+        return copied
+
     # -- host block tier (HBM → host → peer, host_tier.py) -----------------
     @property
     def host_tier(self):
@@ -523,6 +626,17 @@ class InferenceEngineV2:
         is 0). Spill/readmit hooks are wired at construction; peers (the
         router's PrefixDirectory pull) inject entries directly."""
         return self._host_tier
+
+    def _check_tier_entry(self, payload: Dict[str, np.ndarray]) -> None:
+        """Host-tier entries are single-block columns of the export
+        payload ([L, block_size, kv_heads(, head_dim)] per plane); restore
+        the block axis and validate against the SAME shared pool contract
+        the handoff import uses — one contract, not two drifting copies.
+        Peer-pulled entries from the router's directory validate here too,
+        so a malformed wire payload fails at injection, not readmit."""
+        self._check_kv_payload(
+            1, {name: p[:, None] for name, p in payload.items()},
+            context="host_tier.put")
 
     def _spill_block(self, hkey: bytes, block: int) -> None:
         """Prefix-trie eviction hook: demote one idle cached block's KV to
@@ -625,7 +739,8 @@ class InferenceEngineV2:
         for name in ("_row_jit", "_split_jit", "_verify_jit"):
             for key, fn in getattr(self, name, {}).items():
                 sig[f"{name}[{key}]"] = _n(fn)
-        for name in ("_multistep_jit", "_kv_scatter_jit", "_kv_readmit_jit"):
+        for name in ("_multistep_jit", "_kv_scatter_jit", "_kv_readmit_jit",
+                     "_kv_export_jit"):
             fn = getattr(self, name, None)
             if fn is not None:
                 sig[name] = _n(fn)
@@ -692,6 +807,13 @@ class InferenceEngineV2:
                 self.import_kv_blocks_chunked(
                     blocks, self.export_kv_blocks(blocks), chunk_blocks=chunk
                 )
+                # ... and the device-resident wire (zero-copy handoff):
+                # the windowed gather + the same readmit scatter fed
+                # device windows, so a device-transport import on a warm
+                # spare traces nothing at admission time either
+                wins, ch = self.export_kv_blocks_windows(
+                    blocks, chunk_blocks=chunk)
+                self.import_kv_blocks_device(blocks, wins, ch)
         finally:
             if cache is not None:
                 try:

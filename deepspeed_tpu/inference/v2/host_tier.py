@@ -93,12 +93,16 @@ class HostBlockStore:
     stepping), so no internal lock is needed.
     """
 
-    def __init__(self, budget_bytes: int):
+    def __init__(self, budget_bytes: int, validate=None):
         if budget_bytes <= 0:
             raise ValueError(
                 f"HostBlockStore budget_bytes must be > 0, got {budget_bytes}"
             )
         self.budget_bytes = int(budget_bytes)
+        # optional per-entry contract check (kv_pool.check_kv_payload via
+        # the owning engine): router peer pulls inject entries from the
+        # wire, so a malformed plane must fail HERE, not at readmit time
+        self._validate = validate
         self._entries: "OrderedDict[bytes, Dict[str, np.ndarray]]" = OrderedDict()
         self._nbytes: Dict[bytes, int] = {}
         self.bytes_used = 0
@@ -129,6 +133,8 @@ class HostBlockStore:
         only when the single payload alone exceeds the whole budget.
         ``peer_pull`` marks entries injected by the router's directory
         pull rather than a local eviction spill (counter attribution)."""
+        if self._validate is not None:
+            self._validate(payload)
         nb = payload_nbytes(payload)
         if nb > self.budget_bytes:
             return False

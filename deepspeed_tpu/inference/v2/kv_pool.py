@@ -15,10 +15,56 @@ At head_dim=128 the int8 ratio is 2*128/(128+4) ≈ 1.94x — the ≥1.9x
 capacity bar the acceptance tests pin.
 """
 
-from typing import Dict
+from typing import Any, Dict, Tuple
+
+import numpy as np
 
 # payload bytes per element + scale bytes per head vector
 KV_DTYPES = ("bf16", "int8")
+
+# plane name -> ((n_layers, *per_block_tail), dtype) — the exact-contract
+# spec ``check_kv_payload`` validates against (engine_v2._kv_payload_spec
+# builds it from the live pools)
+KVPayloadSpec = Dict[str, Tuple[tuple, Any]]
+
+
+def check_kv_payload(spec: KVPayloadSpec, n: int, payload: Dict,
+                     context: str = "import_kv_blocks") -> None:
+    """ONE strict payload contract for every path that moves KV blocks
+    between pools — handoff import (all transports), host-tier readmit,
+    and router peer pulls validate here instead of keeping drifting
+    copies. Raises loudly on any mismatch BEFORE a scatter: a malformed
+    payload (wrong dtype, wrong trailing dims, missing or stray scale
+    planes) must never silently cast-and-scatter garbage into live KV.
+
+    ``spec`` maps each required plane to ``((n_layers, *per_block_tail),
+    dtype)``; ``payload[name]`` must be ``[n_layers, n, *per_block_tail]``
+    in exactly that dtype."""
+    missing = sorted(set(spec) - set(payload))
+    extra = sorted(set(payload) - set(spec))
+    if missing or extra:
+        raise ValueError(
+            f"{context}: payload planes {sorted(payload)} do not "
+            f"match the pool's {sorted(spec)}"
+            + (f"; missing {missing}" if missing else "")
+            + (f"; unexpected {extra}" if extra else "")
+        )
+    for name, (block_shape, dtype) in spec.items():
+        plane = payload[name]
+        expect = (block_shape[0], n) + tuple(block_shape[1:])
+        if tuple(plane.shape) != expect:
+            raise ValueError(
+                f"{context}: payload[{name!r}] shape "
+                f"{tuple(plane.shape)} != {expect} expected for {n} "
+                f"target blocks"
+            )
+        if np.dtype(plane.dtype) != np.dtype(dtype):
+            raise ValueError(
+                f"{context}: payload[{name!r}] dtype "
+                f"{np.dtype(plane.dtype)} != pool dtype "
+                f"{np.dtype(dtype)} (a silent cast would corrupt "
+                "quantized codes/scales)"
+            )
 
 
 def _check_dtype(kv_dtype: str) -> str:

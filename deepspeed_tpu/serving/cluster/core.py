@@ -123,6 +123,13 @@ class EngineCore:
         engine is a fake without one)."""
         return getattr(self.engine, "host_tier", None)
 
+    def tp_shards(self) -> int:
+        """Tensor-parallel width of this engine's mesh (1 for unsharded
+        engines and minimal fakes). A tp=N replica spreads each sequence's
+        KV and attention across N devices, so placement treats its pool
+        and compute as N-way aggregated capacity."""
+        return int(getattr(self.engine, "_tp", 1) or 1)
+
     # -- tiered prefix store (PrefixDirectory bridge) ---------------------
     def prefix_hashes(self) -> set:
         """Chain hashes this replica can seed a prefix from — device trie
@@ -509,6 +516,10 @@ class EngineCore:
             "kv_total_blocks": self.kv_total,
             "kv_blocks_in_use": max(0, self.kv_total - free),
             "active_requests": len(self.requests),
+            # tensor-parallel width of the engine's mesh (1 = unsharded):
+            # placement scoring divides KV/compute pressure by this, and
+            # the /metrics label row proves WHICH replicas are tp>1
+            "tp_shards": self.tp_shards(),
             "decode_tokens_total": self.decode_tokens,
             "handoffs_in_total": self.handoffs_in,
             "handoffs_out_total": self.handoffs_out,

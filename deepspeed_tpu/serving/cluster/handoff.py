@@ -1,4 +1,4 @@
-"""Cross-engine KV-block handoff.
+"""Cross-engine KV-block handoff behind a pluggable transport seam.
 
 The transfer unit is the paged ``BlockedAllocator`` block: a prefill
 worker that just produced a request's first token exports the sequence's
@@ -10,24 +10,40 @@ decode replica scatters the payload into freshly allocated blocks of its
 own pool. Engines without device pools (compute-free fakes) hand off with
 ``payload=None`` — the table/history bookkeeping is identical.
 
-Prefix replication rides the same path: the importer first seeds from the
-TARGET replica's token-block trie (a hit skips the payload copy for the
-covered blocks entirely), then registers the imported prefix into that
-trie — so a hot system prompt lands in every replica's cache after its
-first handoff there and subsequent requests hit locally. With a host
-tier live, the seed ALSO covers blocks resident in the target's host
-store (including blocks the router's PrefixDirectory pulled from a
-peer): those re-import through the double-buffered chunked scatter
-instead of riding the handoff payload — the uncovered tail is all the
-wire ever carries.
+HOW the payload moves is the ``KVTransport`` seam, chosen at handoff time
+instead of hard-coded host numpy:
+
+- ``host`` — the portable wire: ``export_kv_blocks`` host numpy, imported
+  through the double-buffered fixed-window scatter. The representation a
+  cross-host transport would serialize.
+- ``in_process`` — one device-resident gather of the whole table; the
+  import is a single plain donated scatter. No host copy, simplest wire;
+  retraces per distinct block count, so it suits low-rate handoffs.
+- ``device`` — the zero-copy production wire: chunked pipelined export
+  (fixed ``chunk_blocks``-wide device windows, tail padded into the trash
+  row, all gathers dispatched asynchronously up front) into the donated
+  fixed-window scatter. No host copy, zero steady-state retraces, and the
+  decode replica can seed the trie-covered prefix and run its first
+  decode round while tail windows are still in flight — the double
+  buffering mirrors the host-tier re-import scheme. At tp>1 the importer
+  re-lays each window onto its mesh (head-sharded KV) before scattering.
+
+Prefix replication rides every transport the same way: the importer first
+seeds from the TARGET replica's token-block trie (a hit skips the payload
+copy for the covered blocks entirely), then registers the imported prefix
+into that trie — so a hot system prompt lands in every replica's cache
+after its first handoff there and subsequent requests hit locally. With a
+host tier live, the seed ALSO covers blocks resident in the target's host
+store (including blocks the router's PrefixDirectory pulled from a peer).
 
 Bit-identity: the payload copy is bitwise, and sampling is
-content-addressed by (seed, uid, position) — so a sequence prefilled on
-worker A and decoded on replica B streams exactly the tokens the
-single-engine driver would have produced.
+content-addressed by (seed, uid, position) with sharding-invariant
+random bits — so a sequence prefilled on worker A and decoded on replica
+B (at tp=1 or tp>1) streams exactly the tokens the single-engine driver
+would have produced.
 """
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -49,13 +65,178 @@ class KVHandoff:
     pending_token: int  # first generated token; target feeds it back
     n_blocks: int
     payload: Optional[Dict[str, np.ndarray]]  # k/v (+ *_scale); None for fakes
+    # -- transport metadata (set by export_sequence) -----------------------
+    transport: str = "host"  # which KVTransport moved this payload
+    windows: Optional[List[Dict]] = field(default=None, repr=False)
+    chunk_blocks: int = 0  # window width of a pipelined (device) export
+    nbytes: int = 0  # bytes the wire carries (payload or window planes)
+    inflight_windows: int = 0  # windows dispatched ahead of the import
 
 
-def export_sequence(engine, uid: int, pending_token: int) -> KVHandoff:
+def _payload_nbytes(planes) -> int:
+    """Wire bytes of a plane dict — shape×itemsize, never a device sync."""
+    return int(sum(
+        int(np.prod(p.shape)) * np.dtype(p.dtype).itemsize
+        for p in planes.values()
+    ))
+
+
+class KVTransport:
+    """One payload representation for the prefill→decode handoff. The
+    exporter picks the transport; the importer replays whatever
+    representation the ``KVHandoff`` carries (``handoff.transport``), so
+    the two sides cannot disagree. Implementations fill the payload /
+    window fields of the handoff and scatter them into the target pool;
+    engines without pools (fakes) no-op through every transport."""
+
+    name = "?"
+
+    def export(self, engine, blocks: List[int], handoff: KVHandoff) -> None:
+        raise NotImplementedError
+
+    def import_payload(self, engine, handoff: KVHandoff, seq,
+                       n_cached: int, fresh: List[int]) -> None:
+        raise NotImplementedError
+
+
+class HostTransport(KVTransport):
+    """The original wire: a host-numpy payload imported through the
+    double-buffered FIXED-window scatter. Forcing the fixed windows even
+    below one chunk keeps every handoff/resume on the single-shape
+    readmit program, so an import never compiles at admission time (the
+    warm-spare zero-trace contract). The host bounce is the point: this
+    is the representation a cross-host transport serializes."""
+
+    name = "host"
+
+    def export(self, engine, blocks, handoff):
+        export = getattr(engine, "export_kv_blocks", None)
+        if export is None:
+            return
+        handoff.payload = export(blocks)
+        handoff.nbytes = _payload_nbytes(handoff.payload)
+
+    def import_payload(self, engine, handoff, seq, n_cached, fresh):
+        if handoff.payload is None or not fresh:
+            return
+        # payload columns are the SOURCE table in order; the first
+        # n_cached columns are covered by this replica's cache hit
+        # (device trie AND host-tier readmits — seed_from_cache counts both)
+        sliced = {k: v[:, n_cached:] for k, v in handoff.payload.items()}
+        chunked = getattr(engine, "import_kv_blocks_chunked", None)
+        plain = getattr(engine, "import_kv_blocks", None)
+        if chunked is not None:
+            kv = getattr(getattr(engine, "config", None), "kv_cache", None)
+            chunk = int(getattr(kv, "host_tier_chunk_blocks", 8) or 8)
+            chunked(fresh, sliced, chunk_blocks=chunk)
+        elif plain is not None:
+            plain(fresh, sliced)
+
+
+class InProcessTransport(KVTransport):
+    """Device-resident, single gather: the whole block table exports as
+    one device payload and imports through the plain donated scatter. No
+    host round-trip, but the shapes track the block count — each distinct
+    count traces a gather/scatter variant, so this transport suits
+    low-rate or fixed-length handoffs; ``device`` is the steady-state
+    wire."""
+
+    name = "in_process"
+
+    def export(self, engine, blocks, handoff):
+        export = getattr(engine, "export_kv_blocks_device", None)
+        if export is None:
+            return
+        handoff.payload = export(blocks)
+        handoff.nbytes = _payload_nbytes(handoff.payload)
+
+    def import_payload(self, engine, handoff, seq, n_cached, fresh):
+        if handoff.payload is None or not fresh:
+            return
+        plain = getattr(engine, "import_kv_blocks", None)
+        if plain is None:
+            return
+        # device-side column slice — a lazy view of the exported gather,
+        # never a host copy
+        sliced = {k: v[:, n_cached:] for k, v in handoff.payload.items()}
+        plain(fresh, sliced)
+
+
+class DeviceTransport(KVTransport):
+    """The zero-copy pipelined wire: fixed-width device windows exported
+    asynchronously up front, scattered window-by-window through the
+    donated readmit program. The importer redirects trie-covered and
+    padded-tail columns to the trash row instead of slicing, so every
+    window keeps the ONE compiled shape; at tp>1 each window is re-laid
+    onto the replica's mesh before the scatter. Because nothing here
+    blocks on the device, the target's first decode round dispatches
+    behind the in-flight tail windows — decode starts before the full
+    sequence lands."""
+
+    name = "device"
+
+    def export(self, engine, blocks, handoff):
+        export = getattr(engine, "export_kv_blocks_windows", None)
+        if export is None:
+            return
+        windows, chunk = export(blocks)
+        handoff.windows = windows
+        handoff.chunk_blocks = int(chunk)
+        handoff.inflight_windows = len(windows)
+        handoff.nbytes = int(sum(_payload_nbytes(w) for w in windows))
+
+    def import_payload(self, engine, handoff, seq, n_cached, fresh):
+        if not handoff.windows or not fresh:
+            return
+        imp = getattr(engine, "import_kv_blocks_device", None)
+        if imp is None:
+            raise HandoffError(
+                f"import({handoff.uid}): target engine has no "
+                "import_kv_blocks_device — device-transport handoffs "
+                "need an engine_v2 pool on both sides"
+            )
+        dest = [int(b) for b in seq.block_table]
+        if len(dest) != handoff.n_blocks:
+            raise HandoffError(
+                f"import({handoff.uid}): target table has {len(dest)} "
+                f"blocks for a {handoff.n_blocks}-block windowed export"
+            )
+        imp(dest, handoff.windows, handoff.chunk_blocks,
+            skip_blocks=n_cached)
+
+
+_TRANSPORTS: Dict[str, KVTransport] = {
+    t.name: t for t in (HostTransport(), InProcessTransport(),
+                        DeviceTransport())
+}
+
+KV_TRANSPORTS = tuple(sorted(_TRANSPORTS))
+
+
+def get_transport(name) -> KVTransport:
+    """Resolve a transport by name (or pass an instance through). A typo
+    raises here, at configuration time — never a silent host fallback."""
+    if isinstance(name, KVTransport):
+        return name
+    try:
+        return _TRANSPORTS[str(name)]
+    except KeyError:
+        raise ValueError(
+            f"kv_transport={name!r}: expected one of {sorted(_TRANSPORTS)} "
+            "(host = portable numpy wire, in_process = one device gather, "
+            "device = pipelined zero-copy windows)"
+        ) from None
+
+
+def export_sequence(engine, uid: int, pending_token: int,
+                    transport="host") -> KVHandoff:
     """Snapshot a finished-prefill sequence OFF ``engine``: token history,
-    KV cursor, and the pool payload for its block table. The payload is a
-    host copy, so the caller releases the source sequence (freeing its
-    blocks) immediately after. Caller holds the source core's step lock."""
+    KV cursor, and the pool payload for its block table in the chosen
+    transport's representation. Device-resident payloads are fresh gather
+    outputs (they own their buffers), so — like the host copy — the
+    caller releases the source sequence (freeing its blocks) immediately
+    after. Caller holds the source core's step lock."""
+    tr = get_transport(transport)
     faults = get_fault_injector()
     if faults.enabled:
         faults.check("handoff.export", replica=getattr(engine, "_trace_name", None))
@@ -63,26 +244,28 @@ def export_sequence(engine, uid: int, pending_token: int) -> KVHandoff:
     if seq is None or seq.finished:
         raise HandoffError(f"export({uid}): no live sequence")
     blocks = [int(b) for b in seq.block_table]
-    export = getattr(engine, "export_kv_blocks", None)
-    payload = export(blocks) if export is not None else None
-    return KVHandoff(
+    handoff = KVHandoff(
         uid=uid,
         tokens=list(seq.tokens),
         seen_tokens=int(seq.seen_tokens),
         pending_token=int(pending_token),
         n_blocks=len(blocks),
-        payload=payload,
+        payload=None,
+        transport=tr.name,
     )
+    tr.export(engine, blocks, handoff)
+    return handoff
 
 
 def import_sequence(engine, handoff: KVHandoff) -> int:
     """Materialize a handed-off sequence ON ``engine`` and resume it as a
     RUNNING decode row: seed shared blocks from this replica's prefix
     cache (replicated hot prefixes skip the copy), allocate private blocks
-    for the remainder, scatter the payload, register the prefix into this
-    replica's trie, and feed the pending first token back through the
-    scheduler. Returns the number of payload blocks actually copied.
-    Caller holds the target core's step lock."""
+    for the remainder, scatter the payload through the transport it was
+    exported with, register the prefix into this replica's trie, and feed
+    the pending first token back through the scheduler. Returns the number
+    of payload blocks actually copied. Caller holds the target core's
+    step lock."""
     mgr = engine.state_manager
     sched = engine.scheduler
     if mgr.get_sequence(handoff.uid) is not None:
@@ -107,25 +290,8 @@ def import_sequence(engine, handoff: KVHandoff) -> int:
         if faults.enabled:
             faults.check("handoff.import",
                          replica=getattr(engine, "_trace_name", None))
-        # prefer the double-buffered chunked scatter, and force its
-        # FIXED-size windows even below one chunk: every handoff/resume
-        # then rides the single-shape readmit program, so an import never
-        # compiles at admission time (the warm-spare zero-trace contract —
-        # the plain per-size scatter would retrace for every distinct
-        # block count)
-        chunked = getattr(engine, "import_kv_blocks_chunked", None)
-        plain = getattr(engine, "import_kv_blocks", None)
-        if handoff.payload is not None and fresh:
-            # payload columns are the SOURCE table in order; the first
-            # n_cached columns are covered by this replica's cache hit
-            # (device trie AND host-tier readmits — seed_from_cache counts both)
-            sliced = {k: v[:, n_cached:] for k, v in handoff.payload.items()}
-            if chunked is not None:
-                kv = getattr(getattr(engine, "config", None), "kv_cache", None)
-                chunk = int(getattr(kv, "host_tier_chunk_blocks", 8) or 8)
-                chunked(fresh, sliced, chunk_blocks=chunk)
-            elif plain is not None:
-                plain(fresh, sliced)
+        get_transport(handoff.transport).import_payload(
+            engine, handoff, seq, n_cached, fresh)
         # replicate the hot prefix into THIS replica's trie: the next
         # request sharing the prompt hits locally (full blocks only, so
         # decode writes never land in shared blocks — same discipline as

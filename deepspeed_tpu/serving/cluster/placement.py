@@ -35,7 +35,15 @@ class SLOPlacement(PlacementPolicy):
     worse headroom. With a prefix directory live, a replica that already
     holds a longer run of the request's prefix chain (device trie or host
     tier) earns an affinity bonus: seeding from resident blocks beats
-    recomputing them, and beats pulling them from a peer."""
+    recomputing them, and beats pulling them from a peer.
+
+    Tensor-parallel replicas: a tp=N decode replica spreads each step's
+    attention/MLP across N devices, so the same resident depth costs
+    roughly 1/N the per-step latency pressure of an unsharded replica —
+    the load term is divided by ``tp_shards()``. Headroom needs no
+    correction (``kv_total`` already counts logical blocks of the whole
+    sharded pool), so a tp=2 replica competes on depth-per-device, not
+    raw depth."""
 
     name = "slo"
     # affinity weight: full prefix coverage is worth a quarter of the
@@ -61,6 +69,7 @@ class SLOPlacement(PlacementPolicy):
             depth = len(core.requests) + reserved_seqs
             max_tracked = int(core._sm_cfg("max_tracked_sequences", 0) or 0)
             load = depth / max_tracked if max_tracked else depth * 1.0
+            load /= max(1, core.tp_shards())
             urgency = 0.0
             if req.deadline is not None:
                 slack = max(0.0, req.deadline - now)

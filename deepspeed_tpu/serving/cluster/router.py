@@ -84,6 +84,7 @@ class Router:
         spec_ngram: int = 3,
         proposer=None,
         placement: str = "slo",
+        kv_transport: str = "host",
         elastic=None,
         spare_pool=None,
         resilience: Optional[ResilienceConfig] = None,
@@ -121,6 +122,12 @@ class Router:
         self.monitor = monitor
         self.metrics = ServingMetrics()
         self._placement = get_placement(placement)
+        # KV handoff wire (handoff.get_transport): host = portable numpy,
+        # in_process = one device gather, device = pipelined zero-copy
+        # windows. Resolved here so a typo fails at construction.
+        from deepspeed_tpu.serving.cluster.handoff import get_transport
+
+        self._kv_transport = get_transport(kv_transport)
 
         colocated = not prefill_engines
         self.prefill = [
@@ -241,7 +248,7 @@ class Router:
         timeout_s: Optional[float] = None,
         stop_fn=None,
     ) -> Request:
-        prompt = np.asarray(prompt_tokens, np.int32).reshape(-1)
+        prompt = np.asarray(prompt_tokens, np.int32).reshape(-1)  # dstpu: noqa[kv-host-bounce] — prompt token ids from the client, host-born; not a KV payload
         params = params or SamplingParams()
         if len(prompt) == 0:
             self._reject("empty_prompt")
@@ -417,6 +424,16 @@ class Router:
                 "num_decode_replicas": len(self.decode),
                 "placement": self._placement.name,
                 "kv_handoffs": int(snap.get("kv_handoffs_total", 0)),
+                "kv_transport": {
+                    "transport": self._kv_transport.name,
+                    "inflight_windows": int(
+                        snap.get("kv_handoff_inflight_windows", 0)),
+                    "per_transport": self.metrics.handoff_snapshot(),
+                    "latency_mean_s": round(
+                        self.metrics.handoff_seconds.mean, 6),
+                    "latency_p95_s": round(
+                        self.metrics.handoff_seconds.quantile(0.95), 6),
+                },
                 "kv_host_tier": self._host_tier_health_locked(),
                 "prefix_peer_pulls": int(snap.get("prefix_peer_pulls_total", 0)),
                 "prefix_directory": self.directory.stats(),
@@ -1017,7 +1034,7 @@ class Router:
                 entry = tier.peek(key) if tier is not None else None
                 if entry is None and dev_payload is not None and key in dev_pos:
                     i = dev_pos[key]
-                    entry = {name: np.asarray(plane[:, i])  # dstpu: noqa[host-sync-in-loop] — per-block split of ONE batched device gather above; planes are already host numpy, no device sync here
+                    entry = {name: np.asarray(plane[:, i])  # dstpu: noqa[host-sync-in-loop,kv-host-bounce] — per-block split of ONE batched device gather above; planes are already host numpy (peer pulls feed the HOST tier by contract), no device sync here
                              for name, plane in dev_payload.items()}
                 if entry is None:
                     break  # advert went stale: keep the contiguous head only
@@ -1267,6 +1284,7 @@ class Router:
                 return
             tr = get_tracer()
             t0 = tr.now() if (tr.enabled and req.trace is not None) else None
+            ho_t0 = time.monotonic()
             try:
                 # safe to retry: a failed import_sequence unwinds its own
                 # allocations (sched.finish in its except), so every
@@ -1299,7 +1317,9 @@ class Router:
                 tr.complete("handoff.import", t0, key=req.uid,
                             parent=req.trace.phase,
                             args={"target": target.name,
-                                  "blocks": ho.n_blocks, "copied": copied})
+                                  "blocks": ho.n_blocks, "copied": copied,
+                                  "transport": ho.transport,
+                                  "chunks": ho.inflight_windows})
             with self._cond:
                 target.requests[req.uid] = req
                 self._owner[req.uid] = target
@@ -1308,6 +1328,12 @@ class Router:
                 self.metrics.inc("kv_handoffs_total")
                 self.metrics.inc("kv_handoff_blocks_total", ho.n_blocks)
                 self.metrics.inc("kv_handoff_blocks_copied_total", copied)
+                # latency from export dispatch (stamped in _worker_pass)
+                # through the import landing — the wire the transport owns
+                self.metrics.observe_handoff(
+                    ho.transport, nbytes=ho.nbytes,
+                    seconds=time.monotonic() - getattr(ho, "_t0", ho_t0),
+                    inflight_windows=ho.inflight_windows)
                 self._cond.notify_all()
 
     # -- elastic fleet (autoscaling) -------------------------------------
@@ -1598,12 +1624,14 @@ class Router:
                     continue
                 t0 = (tr.now()
                       if (tr.enabled and req.trace is not None) else None)
+                t_exp = time.monotonic()
                 try:
                     # export is a read-only gather, so attempts are
                     # free to repeat; uid/tok bind per iteration
                     ho = self._edge_retries(
                         lambda uid=req.uid, t=tok: export_sequence(
-                            core.engine, uid, t),
+                            core.engine, uid, t,
+                            transport=self._kv_transport),
                         "handoff_retries_total", "handoff.export",
                         f"{core.name}")
                 except Exception as e:
@@ -1625,11 +1653,14 @@ class Router:
                                 error=("handoff export: "
                                        f"{type(e).__name__}: {e}"))
                     continue
+                ho._t0 = t_exp  # handoff-latency clock: export → import
                 if t0 is not None:
                     tr.complete("handoff.export", t0, key=req.uid,
                                 parent=req.trace.phase,
                                 args={"source": core.name,
-                                      "blocks": ho.n_blocks})
+                                      "blocks": ho.n_blocks,
+                                      "transport": ho.transport,
+                                      "chunks": ho.inflight_windows})
                 core.release(req.uid)
                 with self._cond:
                     self._owner.pop(req.uid, None)

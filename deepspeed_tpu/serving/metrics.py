@@ -104,6 +104,10 @@ class ServingMetrics:
         self.e2e = Histogram(buckets)
         # accepted draft tokens per sequence per verify round (spec decode)
         self.spec_accepted = Histogram(SPEC_ACCEPT_BUCKETS)
+        # per-handoff wall time (export dispatch -> import landed), all
+        # transports folded into one histogram; the per-transport split
+        # lives in the labeled _handoffs family
+        self.handoff_seconds = Histogram(buckets)
         self.counters: Dict[str, float] = {
             "requests_submitted_total": 0,
             "requests_rejected_total": 0,
@@ -184,6 +188,10 @@ class ServingMetrics:
             "decode_replicas": 0,
             "warm_spares": 0,
             "shed_level": 0,
+            # KV handoff transport: in-flight export windows of the most
+            # recent pipelined (device-transport) handoff — 0 for host /
+            # in_process handoffs, which ship one monolithic payload
+            "kv_handoff_inflight_windows": 0,
         }
         # per-wire collective byte accounting (comm.quantized.wire_stats
         # via engine.comm_wire_info): tag -> {sites, wire_bytes_int8,
@@ -199,6 +207,11 @@ class ServingMetrics:
         # as tenant=/tier=-labeled dstpu_serving_tier_* samples so a burst
         # trace can prove WHO was shed and WHOSE latency was protected.
         self._tiers: Dict[Tuple[str, str], Dict[str, float]] = {}
+        # per-transport KV handoff accounting (disagg prefill->decode
+        # moves): transport -> {handoffs, bytes, chunks}; rendered as
+        # transport=-labeled dstpu_serving_kv_handoff_* samples so an A/B
+        # (host vs device wire) shows up as two label rows, not a reset
+        self._handoffs: Dict[str, Dict[str, float]] = {}
 
     # -- writers ---------------------------------------------------------
     def inc(self, name: str, delta: float = 1) -> None:
@@ -394,6 +407,28 @@ class ServingMetrics:
                 )
             self.gauges["spec_mean_accepted_per_round"] = self.spec_accepted.mean
 
+    def observe_handoff(self, transport: str, nbytes: int = 0,
+                        seconds: Optional[float] = None,
+                        inflight_windows: int = 0) -> None:
+        """Fold one completed KV handoff in: bytes moved over the chosen
+        transport, end-to-end wall time (export dispatch -> import
+        landed), and — for the pipelined device wire — how many chunked
+        export windows were in flight."""
+        with self._lock:
+            cell = self._handoffs.setdefault(
+                str(transport), {"handoffs": 0.0, "bytes": 0.0, "chunks": 0.0}
+            )
+            cell["handoffs"] += 1.0
+            cell["bytes"] += float(nbytes)
+            cell["chunks"] += float(inflight_windows)
+            if seconds is not None:
+                self.handoff_seconds.observe(float(seconds))
+            self.gauges["kv_handoff_inflight_windows"] = float(inflight_windows)
+
+    def handoff_snapshot(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {t: dict(cell) for t, cell in self._handoffs.items()}
+
     # -- readers ---------------------------------------------------------
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
@@ -405,6 +440,10 @@ class ServingMetrics:
             for tag, w in self._comm_wires.items():
                 out[f"comm_wire_{tag}_reduction"] = w.get("reduction", 0.0)
                 out[f"comm_wire_{tag}_tiles"] = w.get("tiles", 1)
+            for transport, cell in self._handoffs.items():
+                for key, value in cell.items():
+                    out[f"kv_handoff_{transport}_{key}"] = value
+            out["kv_handoff_seconds_mean"] = self.handoff_seconds.mean
             for name, (_role, st) in self._replicas.items():
                 for key, value in st.items():
                     out[f"replica_{name}_{key}"] = value
@@ -429,6 +468,12 @@ class ServingMetrics:
                 samples.append((f"{p}_comm_wire_bytes_fp", lbl, w.get("wire_bytes_fp", 0), "gauge"))
                 samples.append((f"{p}_comm_wire_reduction", lbl, w.get("reduction", 0.0), "gauge"))
                 samples.append((f"{p}_comm_wire_tiles", lbl, w.get("tiles", 1), "gauge"))
+            for transport in sorted(self._handoffs):
+                cell = self._handoffs[transport]
+                lbl = {"transport": transport}
+                samples.append((f"{p}_kv_handoff_total", lbl, cell["handoffs"], "counter"))
+                samples.append((f"{p}_kv_handoff_bytes", lbl, cell["bytes"], "counter"))
+                samples.append((f"{p}_kv_handoff_chunks_total", lbl, cell["chunks"], "counter"))
             for name in sorted(self._replicas):
                 role, st = self._replicas[name]
                 lbl = {"replica": name, "role": role}
@@ -445,6 +490,7 @@ class ServingMetrics:
                 ("tpot_seconds", self.tpot),
                 ("e2e_latency_seconds", self.e2e),
                 ("spec_accepted_per_round", self.spec_accepted),
+                ("kv_handoff_seconds", self.handoff_seconds),
             ):
                 samples.extend(hist.prom_samples(f"{p}_{hname}"))
         return render_prometheus_text(samples)
@@ -463,10 +509,15 @@ class ServingMetrics:
                 ("tpot_s", self.tpot),
                 ("e2e_s", self.e2e),
                 ("spec_accepted_per_round", self.spec_accepted),
+                ("kv_handoff_s", self.handoff_seconds),
             ):
                 if hist.count:
                     events.append((f"Serving/{hname}_mean", hist.mean, step))
                     events.append((f"Serving/{hname}_p95", hist.quantile(0.95), step))
+            for transport, cell in self._handoffs.items():
+                for key, value in cell.items():
+                    events.append(
+                        (f"Serving/kv_handoff_{transport}_{key}", value, step))
             # labeled families, flattened the same way snapshot() does, so
             # replica and tenant/tier telemetry reaches the file-backed
             # writers (CSV/TensorBoard/...) and not just /metrics

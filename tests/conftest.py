@@ -71,7 +71,7 @@ _SMOKE_FILES = {
     "test_qmatmul.py", "test_moe_gemm.py", "test_native_ops.py",
     "test_sparse_attention.py", "test_transformer_layer.py",
     "test_fused_ce.py", "test_misc_ops.py", "test_evoformer.py",
-    "test_sharded_attention.py",
+    "test_sharded_attention.py", "test_kv_transport.py",
 }
 
 

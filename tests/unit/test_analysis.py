@@ -169,6 +169,59 @@ class TestHostSyncInLoop:
 
 
 # ---------------------------------------------------------------------------
+# kv-host-bounce (serving/cluster/ only, loop or not)
+# ---------------------------------------------------------------------------
+_KV_BOUNCE = """
+    import numpy as np
+
+    def stage(payload):
+        return {k: np.asarray(v) for k, v in payload.items()}
+"""
+
+
+class TestKVHostBounce:
+    def test_flags_in_cluster_module(self, tmp_path):
+        found = _lint(tmp_path, _KV_BOUNCE, "kv-host-bounce",
+                      subdir="serving/cluster")
+        assert len(found) == 1 and found[0].severity == "warning"
+        assert "host copy" in found[0].message
+
+    def test_fires_outside_loops_too(self, tmp_path):
+        # unlike host-sync-in-loop, ONE bounce per handoff is already the
+        # regression — a straight-line device_get must trip it
+        found = _lint(tmp_path, """
+            import jax
+
+            def ship(planes):
+                return jax.device_get(planes)
+        """, "kv-host-bounce", subdir="serving/cluster")
+        assert len(found) == 1
+
+    def test_other_serving_modules_clean(self, tmp_path):
+        found = _lint(tmp_path, _KV_BOUNCE, "kv-host-bounce",
+                      subdir="serving")
+        assert found == []
+
+    def test_noqa_suppresses(self, tmp_path):
+        found = _lint(tmp_path, """
+            import numpy as np
+
+            def submit(prompt_tokens):
+                return np.asarray(prompt_tokens, np.int32)  # dstpu: noqa[kv-host-bounce]
+        """, "kv-host-bounce", subdir="serving/cluster")
+        assert found == []
+
+    def test_device_slice_clean(self, tmp_path):
+        # the device transport's own idiom — pure device-array slicing,
+        # no host materialization — must not trip the rule
+        found = _lint(tmp_path, """
+            def slice_windows(payload, n_cached):
+                return {k: v[:, n_cached:] for k, v in payload.items()}
+        """, "kv-host-bounce", subdir="serving/cluster")
+        assert found == []
+
+
+# ---------------------------------------------------------------------------
 # raw-collective-in-hot-path (wire-bound modules only)
 # ---------------------------------------------------------------------------
 _RAW_COLL = """
@@ -536,6 +589,7 @@ class TestFramework:
             "donate-arity",
             "host-sync-in-loop",
             "impure-jit",
+            "kv-host-bounce",
             "raw-collective-in-hot-path",
             "shard-map-axis-coverage",
             "swallowed-thread-exception",

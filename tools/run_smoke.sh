@@ -83,6 +83,22 @@ echo "== disaggregated-serving parity gate (router, 2 replicas) =="
 # runs the file unfiltered so the slow-marked int8 combo is included
 python -m pytest tests/unit/test_disagg.py -q -p no:cacheprovider
 
+echo "== KV-transport parity gate (host / in_process / device wires) =="
+# the transport seam: streams must be BIT-IDENTICAL across all three
+# payload representations and tp1 vs tp2 decode (greedy + seeded, bf16 +
+# int8 KV), the device wire must move KV without a host round-trip (no
+# np.ndarray payload, byte counters live) and compile nothing after a
+# warm_trace; payload-contract negatives per transport; runs the file
+# unfiltered so the slow-marked int8 combos are included
+python -m pytest tests/unit/test_kv_transport.py -q -p no:cacheprovider
+
+echo "== KV host-bounce gate (Tier A, serving/cluster hot path) =="
+# any host materialization (np.asarray / jax.device_get) on the cluster
+# handoff path must carry a reasoned 'dstpu: noqa[kv-host-bounce]' —
+# the device transport's zero-copy claim, enforced lexically
+./bin/dstpu lint deepspeed_tpu/serving/cluster \
+    --select kv-host-bounce --fail-on warning
+
 echo "== elastic-serving parity gate (preempt/resume + warm scale-up) =="
 # preempted-and-resumed streams must be BIT-IDENTICAL to uninterrupted
 # ones (greedy + seeded, bf16 + int8 KV), scale-up from a warm spare must
