@@ -895,6 +895,14 @@ def bench_kv_transport_ab(cfg=None, params=None, seed=0):
                     revisit_ttfts.append(r.ttft_s)
             kt = router.health()["kv_transport"]
             cell = kt["per_transport"].get(wire, {})
+            # remote wire only: per-endpoint socket-level accounting
+            # (payload bytes + framing tax, credit stalls) from the
+            # exporters' KVEndpoint stats
+            wire_stats = {}
+            for ep in kt.get("endpoints", {}).values():
+                for k in ("wire_bytes_sent", "frames_sent", "credit_stalls",
+                          "served"):
+                    wire_stats[k] = wire_stats.get(k, 0) + int(ep.get(k, 0))
         finally:
             router.shutdown(drain=True, timeout=60)
         handoffs = max(1.0, cell.get("handoffs", 0.0))
@@ -907,6 +915,7 @@ def bench_kv_transport_ab(cfg=None, params=None, seed=0):
             "bytes_per_handoff": cell.get("bytes", 0.0) / handoffs,
             "windows_per_handoff": cell.get("chunks", 0.0) / handoffs,
             "handoffs": int(cell.get("handoffs", 0.0)),
+            "wire_stats": wire_stats,
         }
 
     base = run("host")
@@ -923,7 +932,7 @@ def bench_kv_transport_ab(cfg=None, params=None, seed=0):
             f"{transport!r} wire — is the prefill worker routing?"
         )
     off_t, on_t = base["ttft_revisit_mean_s"], arm["ttft_revisit_mean_s"]
-    return {
+    out = {
         "transport": transport,
         "kv_dtype": kv_dtype,
         "handoffs_per_arm": arm["handoffs"],
@@ -944,6 +953,22 @@ def bench_kv_transport_ab(cfg=None, params=None, seed=0):
         "ttft_speedup": (round(off_t / on_t, 3) if off_t and on_t else None),
         "outputs_bit_identical": True,
     }
+    if transport == "remote" and arm["wire_stats"]:
+        ws = arm["wire_stats"]
+        payload = arm["bytes_per_handoff"] * arm["handoffs"]
+        out.update({
+            # socket-level bytes vs exported payload bytes: >1 is framing
+            # tax (headers + plane records), <1 means trie-covered prefix
+            # blocks never crossed the wire (the FETCH starts past them)
+            "wire_bytes_per_handoff": int(
+                ws["wire_bytes_sent"] / max(1, ws["served"])),
+            "wire_vs_payload_ratio": (round(
+                ws["wire_bytes_sent"] / payload, 4) if payload else None),
+            "wire_frames_per_handoff": round(
+                ws["frames_sent"] / max(1, ws["served"]), 2),
+            "wire_credit_stalls": ws["credit_stalls"],
+        })
+    return out
 
 
 def bench_comm_quant_ab(cfg=None, params=None, seed=0):

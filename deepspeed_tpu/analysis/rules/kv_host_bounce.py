@@ -9,12 +9,16 @@ donated scatter. A stray ``np.asarray``/``jax.device_get`` in
 the transport seam exists to remove, and nothing else would catch it: the
 payload still scatters correctly, just ~10x slower per handoff.
 
-The rule fires on every host-copy call in ``serving/cluster/`` modules,
-loop or not — ONE bounce per handoff is already the regression. Sites
-that deliberately touch host data (token staging, chain hashing over
-prompt tokens, the host transport itself) are annotated with
-``# dstpu: noqa[kv-host-bounce]``, which doubles as documentation of why
-the copy is not a KV payload.
+The rule fires on every host-copy call in ``serving/cluster/`` and
+``serving/net/`` modules, loop or not — ONE bounce per handoff is already
+the regression. The net wire moves the HOST representation by design, but
+its hot paths must stay zero-copy over that representation:
+``np.frombuffer`` decode views and ``tobytes`` of already-host planes are
+fine, while an ``np.asarray``/``device_get`` would mean a device sync
+snuck into the socket thread. Sites that deliberately touch host data
+(token staging, chain hashing over prompt tokens, the host transport
+itself) are annotated with ``# dstpu: noqa[kv-host-bounce]``, which
+doubles as documentation of why the copy is not a KV payload.
 """
 
 import ast
@@ -28,7 +32,7 @@ _BOUNCE_CALLS = {
     "jnp.asarray",
 }
 
-_CLUSTER_FRAGMENT = "serving/cluster/"
+_HOT_FRAGMENTS = ("serving/cluster/", "serving/net/")
 
 
 @register
@@ -37,13 +41,14 @@ class KVHostBounceRule(Rule):
     severity = "warning"
     description = (
         "host-copy call (np.asarray/np.array/jax.device_get) in a "
-        "serving/cluster/ module bounces KV payloads through host memory, "
-        "defeating the device handoff transport"
+        "serving/cluster/ or serving/net/ module bounces KV payloads "
+        "through host memory, defeating the device handoff transport "
+        "(or syncing the device inside a socket thread)"
     )
 
     def check(self, ctx):
         norm = ctx.path.replace("\\", "/")
-        if _CLUSTER_FRAGMENT not in norm:
+        if not any(f in norm for f in _HOT_FRAGMENTS):
             return []
         rule = self
         findings = []

@@ -232,13 +232,17 @@ def serve_parse_args(argv=None):
                    help="decode-replica placement policy: slo ranks by "
                    "free-block headroom / queue depth / deadline slack")
     p.add_argument("--kv-transport", default="host",
-                   choices=("host", "device", "in_process"),
+                   choices=("host", "device", "in_process", "remote"),
                    help="KV handoff wire for prefill->decode moves: host "
                    "bounces blocks through portable numpy; device keeps "
                    "exported blocks resident as device arrays and ships "
                    "them in pipelined chunked windows (decode starts "
                    "before the tail lands, no host round-trip); "
-                   "in_process is a plain same-process device copy")
+                   "in_process is a plain same-process device copy; "
+                   "remote stages the host representation at a per-engine "
+                   "KVEndpoint and pulls credit-flow-controlled chunk "
+                   "windows over a socket (cross-process/host disagg — "
+                   "see docs/NETWORKING.md)")
     p.add_argument("--min-decode-replicas", type=int, default=0,
                    help="elastic serving floor: autoscaling never retires "
                    "below this (0 = elastic control plane off)")

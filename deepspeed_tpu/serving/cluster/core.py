@@ -508,6 +508,14 @@ class EngineCore:
             self.step_lock.release()
 
     # -- observability ---------------------------------------------------
+    def kv_endpoint_address(self):
+        """``(host, port)`` of this engine's remote-KV listener, or None
+        when no ``KVEndpoint`` is attached (non-remote transports). Health
+        and placement metadata carry this so a cross-process importer can
+        discover where to FETCH a staged handoff from."""
+        ep = getattr(self.engine, "_kv_endpoint", None)
+        return ep.address if ep is not None else None
+
     def replica_stats(self) -> Dict[str, float]:
         """Per-replica gauge snapshot for the labeled /metrics samples."""
         free = self.free_blocks()

@@ -27,6 +27,13 @@ instead of hard-coded host numpy:
   decode round while tail windows are still in flight — the double
   buffering mirrors the host-tier re-import scheme. At tp>1 the importer
   re-lays each window onto its mesh (head-sharded KV) before scattering.
+- ``remote`` — the cross-process wire (``serving/net/``): the exporter
+  stages the ``host`` representation at its ``KVEndpoint`` and the
+  handoff carries only ``(endpoint, transfer_id)``; the importer pulls
+  credit-flow-controlled chunk windows over a socket and scatters each
+  through the same fixed-window donated readmit, so decode starts before
+  the tail lands. The only transport whose handoffs can cross a process
+  boundary (``serving.net.wire.encode_handoff_meta``).
 
 Prefix replication rides every transport the same way: the importer first
 seeds from the TARGET replica's token-block trie (a hit skips the payload
@@ -44,7 +51,7 @@ would have produced.
 """
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -71,6 +78,9 @@ class KVHandoff:
     chunk_blocks: int = 0  # window width of a pipelined (device) export
     nbytes: int = 0  # bytes the wire carries (payload or window planes)
     inflight_windows: int = 0  # windows dispatched ahead of the import
+    # -- remote-transport metadata (serving/net/) --------------------------
+    endpoint: Optional[Tuple[str, int]] = None  # exporter's KVEndpoint addr
+    transfer_id: Optional[str] = None  # staged-transfer id at that endpoint
 
 
 def _payload_nbytes(planes) -> int:
@@ -96,7 +106,29 @@ class KVTransport:
 
     def import_payload(self, engine, handoff: KVHandoff, seq,
                        n_cached: int, fresh: List[int]) -> None:
+        """Guarded entry: a handoff replayed through a DIFFERENT transport
+        than it was exported with fails here with a clear HandoffError
+        naming both — never downstream as a scatter shape error (a remote
+        export carries no payload at all, only an endpoint pointer)."""
+        if handoff.transport != self.name:
+            raise HandoffError(
+                f"import({handoff.uid}): handoff was exported via "
+                f"{handoff.transport!r} but is being replayed via "
+                f"{self.name!r} — the importer must use "
+                "get_transport(handoff.transport) (the exporter picks the "
+                "representation; the two sides cannot disagree)"
+            )
+        self._import_payload(engine, handoff, seq, n_cached, fresh)
+
+    def _import_payload(self, engine, handoff: KVHandoff, seq,
+                        n_cached: int, fresh: List[int]) -> None:
         raise NotImplementedError
+
+    def abort(self, engine, handoff: KVHandoff) -> None:
+        """Release transport-side resources of a handoff that will never
+        (re)import — e.g. a staged remote transfer. Default: nothing to
+        release (host/device payloads are plain arrays the GC owns)."""
+        return None
 
 
 class HostTransport(KVTransport):
@@ -116,7 +148,7 @@ class HostTransport(KVTransport):
         handoff.payload = export(blocks)
         handoff.nbytes = _payload_nbytes(handoff.payload)
 
-    def import_payload(self, engine, handoff, seq, n_cached, fresh):
+    def _import_payload(self, engine, handoff, seq, n_cached, fresh):
         if handoff.payload is None or not fresh:
             return
         # payload columns are the SOURCE table in order; the first
@@ -150,7 +182,7 @@ class InProcessTransport(KVTransport):
         handoff.payload = export(blocks)
         handoff.nbytes = _payload_nbytes(handoff.payload)
 
-    def import_payload(self, engine, handoff, seq, n_cached, fresh):
+    def _import_payload(self, engine, handoff, seq, n_cached, fresh):
         if handoff.payload is None or not fresh:
             return
         plain = getattr(engine, "import_kv_blocks", None)
@@ -185,7 +217,7 @@ class DeviceTransport(KVTransport):
         handoff.inflight_windows = len(windows)
         handoff.nbytes = int(sum(_payload_nbytes(w) for w in windows))
 
-    def import_payload(self, engine, handoff, seq, n_cached, fresh):
+    def _import_payload(self, engine, handoff, seq, n_cached, fresh):
         if not handoff.windows or not fresh:
             return
         imp = getattr(engine, "import_kv_blocks_device", None)
@@ -210,7 +242,9 @@ _TRANSPORTS: Dict[str, KVTransport] = {
                         DeviceTransport())
 }
 
-KV_TRANSPORTS = tuple(sorted(_TRANSPORTS))
+# "remote" registers lazily on first use (get_transport) so importing the
+# handoff seam never drags in the socket subsystem
+KV_TRANSPORTS = ("device", "host", "in_process", "remote")
 
 
 def get_transport(name) -> KVTransport:
@@ -218,13 +252,18 @@ def get_transport(name) -> KVTransport:
     raises here, at configuration time — never a silent host fallback."""
     if isinstance(name, KVTransport):
         return name
+    key = str(name)
+    if key == "remote" and key not in _TRANSPORTS:
+        from deepspeed_tpu.serving.net.transport import RemoteTransport
+        _TRANSPORTS[key] = RemoteTransport()
     try:
-        return _TRANSPORTS[str(name)]
+        return _TRANSPORTS[key]
     except KeyError:
         raise ValueError(
-            f"kv_transport={name!r}: expected one of {sorted(_TRANSPORTS)} "
+            f"kv_transport={name!r}: expected one of {sorted(KV_TRANSPORTS)} "
             "(host = portable numpy wire, in_process = one device gather, "
-            "device = pipelined zero-copy windows)"
+            "device = pipelined zero-copy windows, remote = cross-process "
+            "socket wire)"
         ) from None
 
 

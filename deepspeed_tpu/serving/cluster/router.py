@@ -47,7 +47,11 @@ from deepspeed_tpu.observability.tracing import (
     mark_resumed,
 )
 from deepspeed_tpu.serving.cluster.core import EngineCore
-from deepspeed_tpu.serving.cluster.handoff import export_sequence, import_sequence
+from deepspeed_tpu.serving.cluster.handoff import (
+    export_sequence,
+    get_transport,
+    import_sequence,
+)
 from deepspeed_tpu.serving.cluster.placement import get_placement
 from deepspeed_tpu.serving.cluster.prefix_directory import PrefixDirectory
 from deepspeed_tpu.serving.driver import RequestRejected
@@ -124,9 +128,8 @@ class Router:
         self._placement = get_placement(placement)
         # KV handoff wire (handoff.get_transport): host = portable numpy,
         # in_process = one device gather, device = pipelined zero-copy
-        # windows. Resolved here so a typo fails at construction.
-        from deepspeed_tpu.serving.cluster.handoff import get_transport
-
+        # windows, remote = cross-process socket wire. Resolved here so a
+        # typo fails at construction.
         self._kv_transport = get_transport(kv_transport)
 
         colocated = not prefill_engines
@@ -199,6 +202,17 @@ class Router:
         self._last_shed_level = 0
         self._decode_seq = len(self.decode)  # next dN replica name
         self._finish_times: deque = deque(maxlen=64)  # Retry-After drain rate
+
+        # remote transport: every exporting engine gets a KVEndpoint up
+        # front (registration) so its address is in placement/health
+        # metadata before the first handoff; fakes (no exportable pool)
+        # hand off bookkeeping-only and need no listener
+        self._kv_endpoints = []
+        if self._kv_transport.name == "remote":
+            from deepspeed_tpu.serving.net.transport import ensure_endpoint
+            for core in self.prefill:
+                if hasattr(core.engine, "export_kv_blocks"):
+                    self._kv_endpoints.append(ensure_endpoint(core.engine))
 
         self.metrics.counters.setdefault("kv_handoffs_total", 0)
         if self.decode[0].kv_info:
@@ -374,6 +388,9 @@ class Router:
         for t in self._threads:
             t.join(timeout=30)
         self._threads = []
+        for ep in self._kv_endpoints:
+            ep.close()
+        self._kv_endpoints = []
         self._flush_monitor()
 
     @property
@@ -408,6 +425,11 @@ class Router:
                 if t["tpot_n"]:
                     st["tpot_mean_s"] = round(t["tpot_sum"] / t["tpot_n"], 6)
                 st["health"] = core.health.snapshot()
+                # remote-KV discovery: where a cross-process importer
+                # FETCHes this replica's staged handoffs from
+                addr = core.kv_endpoint_address()
+                if addr is not None:
+                    st["kv_endpoint"] = list(addr)
                 replicas[core.name] = st
             kv_info = self.decode[0].kv_info
             spec = next((c.spec_ctl for c in self.decode), None)
@@ -428,11 +450,18 @@ class Router:
                     "transport": self._kv_transport.name,
                     "inflight_windows": int(
                         snap.get("kv_handoff_inflight_windows", 0)),
+                    "aborts": int(snap.get("kv_handoff_aborts_total", 0)),
                     "per_transport": self.metrics.handoff_snapshot(),
                     "latency_mean_s": round(
                         self.metrics.handoff_seconds.mean, 6),
                     "latency_p95_s": round(
                         self.metrics.handoff_seconds.quantile(0.95), 6),
+                    "endpoints": {
+                        c.name: {"address": list(c.kv_endpoint_address()),
+                                 **getattr(c.engine, "_kv_endpoint").stats()}
+                        for c in self.cores
+                        if c.kv_endpoint_address() is not None
+                    },
                 },
                 "kv_host_tier": self._host_tier_health_locked(),
                 "prefix_peer_pulls": int(snap.get("prefix_peer_pulls_total", 0)),
@@ -1274,13 +1303,31 @@ class Router:
                 self._cond.notify_all()
 
     # -- handoff ---------------------------------------------------------
-    def _complete_handoff(self, req: Request, ho):
+    def _abort_handoff(self, ho, source) -> None:
+        """Unwind a handoff that will never import: zero the inflight-
+        window gauge (the aborted import released its claim on every
+        window — satellite audit: a mid-chunk fault must not leak window
+        credits) and release transport-side state (a remote export's
+        staged payload at the source endpoint)."""
+        self.metrics.handoff_aborted(ho.transport)
+        if source is None:
+            return
+        try:
+            get_transport(ho.transport).abort(source.engine, ho)
+        except Exception as e:  # release is best-effort; never mask the abort
+            logger.warning(
+                f"serving: transport abort of uid={ho.uid} on "
+                f"{source.name} failed: {type(e).__name__}: {e}")
+
+    def _complete_handoff(self, req: Request, ho, source=None):
         with self._cond:
             target = self._target.get(req.uid)
         if target is None:  # terminated mid-flight
+            self._abort_handoff(ho, source)
             return
         with target.step_lock:
             if req.is_terminal:
+                self._abort_handoff(ho, source)
                 return
             tr = get_tracer()
             t0 = tr.now() if (tr.enabled and req.trace is not None) else None
@@ -1299,6 +1346,10 @@ class Router:
                 logger.warning(
                     f"serving: handoff import of uid={req.uid} onto "
                     f"{target.name} failed: {type(e).__name__}: {e}")
+                # exhausted retries: whatever windows this handoff claimed
+                # are no longer in flight — unwind the gauge and any staged
+                # remote transfer BEFORE replay re-enters admission
+                self._abort_handoff(ho, source)
                 with self._cond:
                     # resilience: the first token was already delivered and
                     # the prompt is intact — replay seats it elsewhere
@@ -1669,7 +1720,7 @@ class Router:
         # imports take each TARGET's own lock; source lock released so
         # the prefill worker never blocks a decode replica's step
         for req, ho in handoffs:
-            self._complete_handoff(req, ho)
+            self._complete_handoff(req, ho, source=core)
         with self._cond:
             if advert is not None and self._placeable(core):
                 self.directory.advertise(core.name, advert)

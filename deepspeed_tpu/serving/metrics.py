@@ -156,6 +156,10 @@ class ServingMetrics:
             "recovery_replays_total": 0,
             "handoff_retries_total": 0,
             "peer_pull_retries_total": 0,
+            # handoffs abandoned after export (retries exhausted or the
+            # request terminated mid-flight) — pairs with the inflight-
+            # window gauge unwind in handoff_aborted()
+            "kv_handoff_aborts_total": 0,
         }
         self.gauges: Dict[str, float] = {
             "queue_depth": 0,
@@ -425,6 +429,21 @@ class ServingMetrics:
                 self.handoff_seconds.observe(float(seconds))
             self.gauges["kv_handoff_inflight_windows"] = float(inflight_windows)
 
+    def handoff_aborted(self, transport: str) -> None:
+        """Unwind one handoff that will never land (import retries
+        exhausted, or the request died mid-flight). The inflight-window
+        gauge MUST return to zero here: an aborted import unwound its
+        pool blocks, so windows it claimed are no longer in flight — a
+        nonzero residue after an abort is the credit leak the resilience
+        suite asserts against."""
+        with self._lock:
+            self.counters["kv_handoff_aborts_total"] += 1
+            cell = self._handoffs.setdefault(
+                str(transport), {"handoffs": 0.0, "bytes": 0.0, "chunks": 0.0}
+            )
+            cell["aborts"] = cell.get("aborts", 0.0) + 1.0
+            self.gauges["kv_handoff_inflight_windows"] = 0.0
+
     def handoff_snapshot(self) -> Dict[str, Dict[str, float]]:
         with self._lock:
             return {t: dict(cell) for t, cell in self._handoffs.items()}
@@ -474,6 +493,7 @@ class ServingMetrics:
                 samples.append((f"{p}_kv_handoff_total", lbl, cell["handoffs"], "counter"))
                 samples.append((f"{p}_kv_handoff_bytes", lbl, cell["bytes"], "counter"))
                 samples.append((f"{p}_kv_handoff_chunks_total", lbl, cell["chunks"], "counter"))
+                samples.append((f"{p}_kv_handoff_aborts_total", lbl, cell.get("aborts", 0.0), "counter"))
             for name in sorted(self._replicas):
                 role, st = self._replicas[name]
                 lbl = {"replica": name, "role": role}

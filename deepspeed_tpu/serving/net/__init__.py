@@ -1,0 +1,27 @@
+"""Cross-process KV wire for disaggregated serving (docs/NETWORKING.md).
+
+Layers, bottom up: :mod:`.wire` (versioned checksummed binary frames),
+:mod:`.flow` (block-granular credit window), :mod:`.endpoint`
+(per-engine listener + chunk-fetch client), :mod:`.transport`
+(``RemoteTransport``, registered as ``--kv-transport remote``).
+"""
+
+from deepspeed_tpu.serving.net.wire import (  # noqa: F401
+    PROTOCOL_VERSION,
+    WireError,
+    decode_handoff_meta,
+    encode_handoff_meta,
+)
+from deepspeed_tpu.serving.net.flow import CreditWindow, CreditError  # noqa: F401
+from deepspeed_tpu.serving.net.endpoint import KVEndpoint, fetch_chunks  # noqa: F401
+
+__all__ = [
+    "PROTOCOL_VERSION",
+    "WireError",
+    "encode_handoff_meta",
+    "decode_handoff_meta",
+    "CreditWindow",
+    "CreditError",
+    "KVEndpoint",
+    "fetch_chunks",
+]

@@ -1,0 +1,336 @@
+"""Versioned length-prefixed binary frames for the remote KV wire.
+
+The remote KV transport serializes the ``host`` payload representation —
+the per-plane numpy arrays ``export_kv_blocks`` produces — into framed
+byte strings a stdlib socket can carry between processes/hosts. One frame
+is::
+
+    offset  size  field
+    0       4     magic  b"DSKV"
+    4       2     protocol version (u16 LE) == PROTOCOL_VERSION
+    6       2     frame type (u16 LE, one of F_*)
+    8       8     payload length (u64 LE)
+    16      4     CRC32 of the payload (u32 LE)
+    20      N     payload
+
+Decode is STRICT: a frame with foreign magic, a different protocol
+version, an unknown type, a length beyond ``MAX_FRAME_BYTES``, a payload
+shorter than its header promises, or a checksum mismatch raises
+:class:`WireError` naming exactly what was wrong — a corrupt or truncated
+frame must never scatter garbage into a live KV pool (the pool-side
+``check_kv_payload`` contract is the second fence, this is the first).
+
+Control frames (HELLO/FETCH/CREDIT/ERROR/META) carry JSON; CHUNK frames
+carry a binary plane dict — per plane: name, dtype string, shape, raw
+bytes — so quantized int8 codes and their fp32 scale planes cross the
+wire bit-exactly (no text re-encoding of array data ever).
+"""
+
+import json
+import struct
+import zlib
+from typing import Callable, Dict, Tuple
+
+import numpy as np
+
+__all__ = [
+    "PROTOCOL_VERSION",
+    "MAGIC",
+    "MAX_FRAME_BYTES",
+    "F_HELLO",
+    "F_FETCH",
+    "F_CHUNK",
+    "F_CREDIT",
+    "F_DONE",
+    "F_ERROR",
+    "F_META",
+    "FRAME_NAMES",
+    "WireError",
+    "encode_frame",
+    "decode_frame",
+    "read_frame",
+    "recv_exact",
+    "encode_json",
+    "decode_json",
+    "encode_planes",
+    "decode_planes",
+    "encode_chunk",
+    "decode_chunk",
+    "encode_handoff_meta",
+    "decode_handoff_meta",
+]
+
+MAGIC = b"DSKV"
+PROTOCOL_VERSION = 1
+# header: magic, version, frame type, payload length, payload crc32
+_HEADER = struct.Struct("<4sHHQI")
+HEADER_BYTES = _HEADER.size
+# one chunk window of KV blocks is at most a few hundred MB even at
+# production shapes; anything past this is a corrupt length field, not a
+# payload — reject before trying to allocate it
+MAX_FRAME_BYTES = 1 << 32
+
+F_HELLO = 1   # version handshake (both directions, empty payload)
+F_FETCH = 2   # importer -> exporter: {tid, start_block, credit_blocks}
+F_CHUNK = 3   # exporter -> importer: binary block-window planes
+F_CREDIT = 4  # importer -> exporter: {blocks} replenishing the window
+F_DONE = 5    # importer -> exporter: transfer landed, release the stage
+F_ERROR = 6   # either direction: {error} then close
+F_META = 7    # out-of-band handoff descriptor (cross-process bootstrap)
+
+FRAME_NAMES = {
+    F_HELLO: "HELLO", F_FETCH: "FETCH", F_CHUNK: "CHUNK",
+    F_CREDIT: "CREDIT", F_DONE: "DONE", F_ERROR: "ERROR", F_META: "META",
+}
+
+
+class WireError(RuntimeError):
+    """A frame failed the strict decode (truncated, corrupt, foreign
+    version/magic, unknown type) or the peer broke protocol."""
+
+
+def encode_frame(ftype: int, payload: bytes = b"") -> bytes:
+    """One framed message: header (magic, version, type, length, crc32)
+    followed by the payload bytes."""
+    if ftype not in FRAME_NAMES:
+        raise ValueError(f"unknown frame type {ftype}")
+    payload = bytes(payload)
+    return _HEADER.pack(MAGIC, PROTOCOL_VERSION, ftype, len(payload),
+                        zlib.crc32(payload) & 0xFFFFFFFF) + payload
+
+
+def _check_header(magic: bytes, version: int, ftype: int, length: int):
+    if magic != MAGIC:
+        raise WireError(
+            f"foreign frame: magic {magic!r} != {MAGIC!r} — peer is not a "
+            "dstpu KV endpoint")
+    if version != PROTOCOL_VERSION:
+        raise WireError(
+            f"protocol version skew: peer speaks v{version}, this build "
+            f"speaks v{PROTOCOL_VERSION} — refusing to guess at the frame "
+            "layout")
+    if ftype not in FRAME_NAMES:
+        raise WireError(f"unknown frame type {ftype} (v{PROTOCOL_VERSION} "
+                        f"knows {sorted(FRAME_NAMES)})")
+    if length > MAX_FRAME_BYTES:
+        raise WireError(
+            f"frame length {length} exceeds MAX_FRAME_BYTES "
+            f"{MAX_FRAME_BYTES} — corrupt length field")
+
+
+def _check_payload(payload: bytes, crc: int, ftype: int):
+    if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+        raise WireError(
+            f"checksum mismatch on {FRAME_NAMES[ftype]} frame: payload "
+            "corrupted in flight")
+
+
+def decode_frame(buf: bytes, offset: int = 0) -> Tuple[int, bytes, int]:
+    """Strictly decode one frame from ``buf`` at ``offset``. Returns
+    ``(frame_type, payload, next_offset)``; raises :class:`WireError` on
+    truncation, corruption, or version/magic skew."""
+    view = memoryview(buf)
+    if len(view) - offset < HEADER_BYTES:
+        raise WireError(
+            f"truncated frame: {len(view) - offset} bytes < "
+            f"{HEADER_BYTES}-byte header")
+    magic, version, ftype, length, crc = _HEADER.unpack_from(view, offset)
+    _check_header(magic, version, ftype, length)
+    start = offset + HEADER_BYTES
+    if len(view) - start < length:
+        raise WireError(
+            f"truncated {FRAME_NAMES[ftype]} frame: header promises "
+            f"{length} payload bytes, only {len(view) - start} present")
+    payload = bytes(view[start:start + length])
+    _check_payload(payload, crc, ftype)
+    return ftype, payload, start + length
+
+
+def recv_exact(sock, n: int) -> bytes:
+    """Read exactly ``n`` bytes from a socket; a peer that hangs up
+    mid-read surfaces as a :class:`WireError`, never a short buffer."""
+    chunks = []
+    remaining = n
+    while remaining > 0:
+        data = sock.recv(min(remaining, 1 << 20))
+        if not data:
+            raise WireError(
+                f"connection closed mid-frame: wanted {n} bytes, got "
+                f"{n - remaining} — peer crashed or hung up")
+        chunks.append(data)
+        remaining -= len(data)
+    return b"".join(chunks)
+
+
+def read_frame(read: Callable[[int], bytes]) -> Tuple[int, bytes]:
+    """Read one frame through ``read(n)`` (which must return exactly ``n``
+    bytes or raise). Returns ``(frame_type, payload)``."""
+    header = read(HEADER_BYTES)
+    magic, version, ftype, length, crc = _HEADER.unpack(header)
+    _check_header(magic, version, ftype, length)
+    payload = read(length) if length else b""
+    _check_payload(payload, crc, ftype)
+    return ftype, payload
+
+
+# -- JSON control payloads ---------------------------------------------------
+def encode_json(ftype: int, obj: Dict) -> bytes:
+    return encode_frame(ftype, json.dumps(obj, separators=(",", ":"),
+                                          sort_keys=True).encode("utf-8"))
+
+
+def decode_json(payload: bytes, ftype: int = 0) -> Dict:
+    try:
+        obj = json.loads(payload.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        name = FRAME_NAMES.get(ftype, ftype)
+        raise WireError(f"malformed JSON payload in {name} frame: {e}") from e
+    if not isinstance(obj, dict):
+        raise WireError(f"JSON payload must be an object, got {type(obj).__name__}")
+    return obj
+
+
+# -- binary plane dicts (CHUNK frames) ---------------------------------------
+_U8 = struct.Struct("<B")
+_U16 = struct.Struct("<H")
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+
+
+def encode_planes(planes: Dict[str, np.ndarray]) -> bytes:
+    """Binary-serialize a plane dict (name -> ndarray) preserving dtype,
+    shape, and every payload byte exactly. bf16 codes and fp32 scales
+    cross as raw bytes — there is no text round-trip to lose bits in."""
+    parts = [_U16.pack(len(planes))]
+    for name in sorted(planes):
+        arr = np.ascontiguousarray(planes[name])
+        nb = name.encode("utf-8")
+        db = str(np.dtype(arr.dtype)).encode("utf-8")
+        parts.append(_U16.pack(len(nb)))
+        parts.append(nb)
+        parts.append(_U16.pack(len(db)))
+        parts.append(db)
+        parts.append(_U8.pack(arr.ndim))
+        for dim in arr.shape:
+            parts.append(_U32.pack(dim))
+        raw = arr.tobytes()
+        parts.append(_U64.pack(len(raw)))
+        parts.append(raw)
+    return b"".join(parts)
+
+
+def decode_planes(payload: bytes, offset: int = 0
+                  ) -> Tuple[Dict[str, np.ndarray], int]:
+    """Strict inverse of :func:`encode_planes`; returns the plane dict and
+    the next offset. Truncated or inconsistent plane records raise
+    :class:`WireError` (shape/dtype validity against the live pool is the
+    importer's ``check_kv_payload`` contract, applied after this)."""
+    view = memoryview(payload)
+
+    def take(n: int, what: str) -> memoryview:
+        nonlocal offset
+        if len(view) - offset < n:
+            raise WireError(
+                f"truncated plane record: wanted {n} bytes for {what}, "
+                f"{len(view) - offset} left")
+        out = view[offset:offset + n]
+        offset += n
+        return out
+
+    (n_planes,) = _U16.unpack(take(2, "plane count"))
+    planes: Dict[str, np.ndarray] = {}
+    for _ in range(n_planes):
+        (name_len,) = _U16.unpack(take(2, "name length"))
+        name = bytes(take(name_len, "plane name")).decode("utf-8")
+        (dtype_len,) = _U16.unpack(take(2, "dtype length"))
+        dtype_s = bytes(take(dtype_len, "dtype string")).decode("utf-8")
+        try:
+            dtype = np.dtype(dtype_s)
+        except TypeError as e:
+            raise WireError(f"plane {name!r}: unknown dtype {dtype_s!r}") from e
+        (ndim,) = _U8.unpack(take(1, "ndim"))
+        shape = tuple(_U32.unpack(take(4, "dim"))[0] for _ in range(ndim))
+        (raw_len,) = _U64.unpack(take(8, "payload length"))
+        expect = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+        if raw_len != expect:
+            raise WireError(
+                f"plane {name!r}: {raw_len} payload bytes != {expect} for "
+                f"shape {shape} dtype {dtype_s}")
+        raw = take(raw_len, f"plane {name!r} data")
+        planes[name] = np.frombuffer(raw, dtype=dtype).reshape(shape)
+    return planes, offset
+
+
+def encode_chunk(lo: int, hi: int, planes: Dict[str, np.ndarray]) -> bytes:
+    """One block-granular chunk window: source block columns ``[lo, hi)``
+    plus the plane slices covering them."""
+    return encode_frame(
+        F_CHUNK, _U32.pack(lo) + _U32.pack(hi) + encode_planes(planes))
+
+
+def decode_chunk(payload: bytes) -> Tuple[int, int, Dict[str, np.ndarray]]:
+    if len(payload) < 8:
+        raise WireError("truncated CHUNK payload: missing block range")
+    (lo,) = _U32.unpack_from(payload, 0)
+    (hi,) = _U32.unpack_from(payload, 4)
+    if hi <= lo:
+        raise WireError(f"CHUNK block range [{lo}, {hi}) is empty or inverted")
+    planes, end = decode_planes(payload, 8)
+    if end != len(payload):
+        raise WireError(
+            f"CHUNK payload has {len(payload) - end} trailing bytes after "
+            "the plane records")
+    return lo, hi, planes
+
+
+# -- handoff descriptors (cross-process bootstrap) ---------------------------
+def encode_handoff_meta(handoff) -> bytes:
+    """Frame a :class:`KVHandoff`'s METADATA (no payload planes) so a
+    different process can import it: token history, cursors, and the
+    exporter endpoint + transfer id the remote wire fetches from."""
+    if handoff.endpoint is None or handoff.transfer_id is None:
+        raise WireError(
+            f"handoff {handoff.uid} has no endpoint/transfer_id — only "
+            "remote-transport exports can cross a process boundary")
+    return encode_json(F_META, {
+        "uid": int(handoff.uid),
+        "tokens": [int(t) for t in handoff.tokens],
+        "seen_tokens": int(handoff.seen_tokens),
+        "pending_token": int(handoff.pending_token),
+        "n_blocks": int(handoff.n_blocks),
+        "transport": handoff.transport,
+        "chunk_blocks": int(handoff.chunk_blocks),
+        "nbytes": int(handoff.nbytes),
+        "endpoint": [str(handoff.endpoint[0]), int(handoff.endpoint[1])],
+        "transfer_id": str(handoff.transfer_id),
+    })
+
+
+def decode_handoff_meta(data: bytes):
+    """Strictly decode a META frame back into a payload-less
+    :class:`KVHandoff` aimed at the exporter's endpoint."""
+    from deepspeed_tpu.serving.cluster.handoff import KVHandoff
+
+    ftype, payload, _ = decode_frame(data)
+    if ftype != F_META:
+        raise WireError(
+            f"expected META frame, got {FRAME_NAMES.get(ftype, ftype)}")
+    obj = decode_json(payload, F_META)
+    missing = [k for k in ("uid", "tokens", "seen_tokens", "pending_token",
+                           "n_blocks", "transport", "chunk_blocks",
+                           "endpoint", "transfer_id") if k not in obj]
+    if missing:
+        raise WireError(f"META frame missing fields {missing}")
+    return KVHandoff(
+        uid=int(obj["uid"]),
+        tokens=[int(t) for t in obj["tokens"]],
+        seen_tokens=int(obj["seen_tokens"]),
+        pending_token=int(obj["pending_token"]),
+        n_blocks=int(obj["n_blocks"]),
+        payload=None,
+        transport=str(obj["transport"]),
+        chunk_blocks=int(obj["chunk_blocks"]),
+        nbytes=int(obj.get("nbytes", 0)),
+        endpoint=(str(obj["endpoint"][0]), int(obj["endpoint"][1])),
+        transfer_id=str(obj["transfer_id"]),
+    )

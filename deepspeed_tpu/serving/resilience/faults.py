@@ -26,6 +26,12 @@ Sites (the full set — a spec naming anything else is a typo, loudly):
   * ``step.hang``        — sleeps ``hang_s`` inside the step window so
     the watchdog sees a wedged step (the spec's kind is forced to
     ``"hang"``)
+  * ``net.connect``      — remote KV importer dialing the exporter's
+    endpoint (before the socket opens)
+  * ``net.send``         — remote KV exporter about to send a chunk
+    window (one arrival per window, so nth selects which window dies)
+  * ``net.recv``         — remote KV importer about to read the next
+    frame off the wire (one arrival per frame)
 """
 
 import random
@@ -54,6 +60,9 @@ SITES = (
     "peer_pull",
     "worker.crash",
     "step.hang",
+    "net.connect",
+    "net.send",
+    "net.recv",
 )
 
 

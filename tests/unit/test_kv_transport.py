@@ -92,7 +92,7 @@ def _prefill_one(engine, uid, prompt):
 # ---------------------------------------------------------------------------
 class TestTransportSeam:
     def test_registry(self):
-        assert KV_TRANSPORTS == ("device", "host", "in_process")
+        assert KV_TRANSPORTS == ("device", "host", "in_process", "remote")
         for name in KV_TRANSPORTS:
             tr = get_transport(name)
             assert tr.name == name

@@ -758,6 +758,80 @@ class TestImportUnwind:
         for e in cluster:
             assert e.state_manager.free_blocks == 64
 
+    def test_abort_unwinds_inflight_window_gauge(self):
+        """Gauge conservation at the metrics layer: an aborted handoff
+        zeroes ``kv_handoff_inflight_windows`` (the aborted import's
+        windows are no longer on any wire) and counts into both the
+        global abort counter and the per-transport cell."""
+        from deepspeed_tpu.serving.metrics import ServingMetrics
+
+        m = ServingMetrics()
+        m.observe_handoff("device", nbytes=1024, seconds=0.01,
+                          inflight_windows=3)
+        snap = m.snapshot()
+        assert snap["kv_handoff_inflight_windows"] == 3
+        assert snap["kv_handoff_aborts_total"] == 0
+        m.handoff_aborted("device")
+        snap = m.snapshot()
+        assert snap["kv_handoff_inflight_windows"] == 0  # unwound
+        assert snap["kv_handoff_aborts_total"] == 1
+        assert m.handoff_snapshot()["device"]["aborts"] == 1.0
+        # completed-handoff accounting is untouched by the abort
+        assert m.handoff_snapshot()["device"]["handoffs"] == 1.0
+        text = m.prometheus_text()
+        assert 'dstpu_serving_kv_handoff_aborts_total{transport="device"} 1' \
+            in text
+        assert "dstpu_serving_kv_handoff_inflight_windows 0" in text
+
+    def test_router_exhausted_import_retries_abort_and_replay(
+            self, tiny_model):
+        """Every retry attempt of the first handoff's import faulted
+        (nth=1..retry_attempts): the router must ABORT that handoff —
+        count it, zero the inflight-window gauge, leak no window credit —
+        then replay the request to a bit-identical stream with every pool
+        drained to full."""
+        sampling = {"greedy": True}
+        prompts = [np.arange(1 + 3 * i, 25 + 3 * i, dtype=np.int32)
+                   for i in range(3)]
+        single = _real_engine(tiny_model, "bf16", sampling)
+        drv = ServingDriver(single).start()
+        want = [list(r.generated)
+                for r in _run_all(drv, prompts, 6, timeout=300)]
+        drv.shutdown()
+
+        cluster = [_real_engine(tiny_model, "bf16", sampling)
+                   for _ in range(3)]
+        cfg = _fast_cfg()
+        # the single prefill worker resolves handoffs sequentially, so
+        # arrivals 1..retry_attempts are exactly the first import's
+        # attempts — the abort path fires deterministically
+        specs = [FaultSpec("handoff.import", nth=n)
+                 for n in range(1, cfg.retry_attempts + 1)]
+        with inject(*specs) as inj:
+            router = Router(engines=cluster, num_prefill_workers=1,
+                            kv_transport="device",
+                            resilience=cfg).start()
+            try:
+                got = [list(r.generated)
+                       for r in _run_all(router, prompts, 6, timeout=300)]
+                h = router.health()
+                snap = router.metrics.snapshot()
+            finally:
+                router.shutdown()
+        assert got == want, "replayed stream diverged after aborted handoff"
+        assert len(inj.fired()) == cfg.retry_attempts
+        assert h["kv_transport"]["aborts"] == 1
+        assert snap["kv_handoff_aborts_total"] == 1
+        assert h["resilience"]["handoff_retries"] >= cfg.retry_attempts - 1
+        assert h["resilience"]["recoveries"] >= 1  # replayed, not failed
+        # the replay + remaining prompts all landed: completed handoffs
+        # exclude the aborted one (replay re-prefills, so its handoff is
+        # a fresh export, not the aborted descriptor)
+        assert h["kv_transport"]["per_transport"]["device"]["handoffs"] \
+            == len(prompts)
+        for e in cluster:
+            assert e.state_manager.free_blocks == 64
+
 
 def _recovery_parity_roundtrip(tiny_model, kv_dtype, sampling):
     """Acceptance on the real engine: the same workload with a replica

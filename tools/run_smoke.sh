@@ -92,12 +92,158 @@ echo "== KV-transport parity gate (host / in_process / device wires) =="
 # unfiltered so the slow-marked int8 combos are included
 python -m pytest tests/unit/test_kv_transport.py -q -p no:cacheprovider
 
-echo "== KV host-bounce gate (Tier A, serving/cluster hot path) =="
+echo "== KV host-bounce gate (Tier A, serving/cluster + serving/net) =="
 # any host materialization (np.asarray / jax.device_get) on the cluster
-# handoff path must carry a reasoned 'dstpu: noqa[kv-host-bounce]' —
-# the device transport's zero-copy claim, enforced lexically
-./bin/dstpu lint deepspeed_tpu/serving/cluster \
+# handoff path OR inside the remote wire's socket threads must carry a
+# reasoned 'dstpu: noqa[kv-host-bounce]' — the device transport's
+# zero-copy claim and the net subsystem's no-device-sync-in-socket-thread
+# claim, enforced lexically
+./bin/dstpu lint deepspeed_tpu/serving/cluster deepspeed_tpu/serving/net \
     --select kv-host-bounce --fail-on warning
+
+echo "== remote KV transport gate (wire protocol + loopback parity) =="
+# the serving/net/ subsystem: strict frame negatives (truncation,
+# checksum, version skew), credit-window accounting + leak audit,
+# exporter-crash-mid-window retry, and Router streams over
+# --kv-transport remote BIT-IDENTICAL to the single engine with chaos
+# at every net.* fault site; runs the file unfiltered so the
+# slow-marked int8 parity combo is included
+python -m pytest tests/unit/test_net_transport.py -q -p no:cacheprovider
+# cross-PROCESS acceptance: a prefill engine in a CHILD process exports
+# over the remote transport and ships ONE META frame (no payload) to the
+# parent; the parent's decode engine pulls the KV blocks over the
+# loopback wire — through a chaos-killed first dial — and must stream
+# bit-identical to its own single-engine reference, pools conserved and
+# the child's staged transfer released on both sides
+python - <<'EOF'
+import subprocess, sys
+import numpy as np
+
+CHILD = r'''
+import sys
+import numpy as np
+import jax
+from deepspeed_tpu.models import get_config, init_params
+from deepspeed_tpu.inference.config import RaggedInferenceEngineConfig
+from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+from deepspeed_tpu.serving.cluster.handoff import export_sequence
+from deepspeed_tpu.serving.net import encode_handoff_meta
+
+cfg = get_config("tiny", n_layers=2, dtype="float32", max_seq_len=512)
+params = init_params(cfg, jax.random.key(0))
+rc = RaggedInferenceEngineConfig.from_dict({
+    "dtype": "float32", "seed": 7,
+    "kv_cache": {"block_size": 16, "num_blocks": 64,
+                 "max_blocks_per_seq": 8, "host_tier_chunk_blocks": 1},
+    "state_manager": {"max_tracked_sequences": 8,
+                      "max_ragged_batch_size": 128,
+                      "max_ragged_sequence_count": 4, "max_context": 256},
+})
+eng = InferenceEngineV2(cfg, params, rc)
+uid = 41
+eng.scheduler.submit(uid, np.arange(1, 25, dtype=np.int32))
+tok = None
+for _ in range(8):
+    out = eng.step_tokens()
+    if uid in out:
+        tok = int(out[uid]); break
+ho = export_sequence(eng, uid, tok, transport="remote")
+eng.scheduler.finish(uid)
+assert eng.state_manager.free_blocks == 64, "child pool leaked"
+# the whole cross-process handoff is this one line of hex: a payload-less
+# META frame naming the endpoint + transfer id the parent FETCHes from
+print("META " + encode_handoff_meta(ho).hex(), flush=True)
+sys.stdin.readline()  # parent imported: hold the endpoint open until then
+import time
+deadline = time.monotonic() + 10
+while eng._kv_endpoint.staged_count() and time.monotonic() < deadline:
+    time.sleep(0.01)
+assert eng._kv_endpoint.staged_count() == 0, "stage never released"
+assert eng._kv_endpoint.stats()["served"] >= 1, "no transfer served"
+print("CHILD_OK", flush=True)
+'''
+
+child = subprocess.Popen([sys.executable, "-c", CHILD],
+                         stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                         text=True)
+
+def child_line(prefix):
+    # the engine logs INFO lines to stdout; protocol lines are prefixed
+    while True:
+        line = child.stdout.readline()
+        assert line, f"child exited before sending {prefix!r}"
+        if line.startswith(prefix):
+            return line.strip()
+
+try:
+    meta_line = child_line("META ")
+
+    import jax
+    from deepspeed_tpu.models import get_config, init_params
+    from deepspeed_tpu.inference.config import RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.serving.cluster.handoff import import_sequence
+    from deepspeed_tpu.serving.net import decode_handoff_meta
+    from deepspeed_tpu.serving.resilience import (
+        FaultSpec, RetryPolicy, inject, with_retries)
+
+    cfg = get_config("tiny", n_layers=2, dtype="float32", max_seq_len=512)
+    params = init_params(cfg, jax.random.key(0))
+    rc = RaggedInferenceEngineConfig.from_dict({
+        "dtype": "float32", "seed": 7,
+        "kv_cache": {"block_size": 16, "num_blocks": 64,
+                     "max_blocks_per_seq": 8, "host_tier_chunk_blocks": 1},
+        "state_manager": {"max_tracked_sequences": 8,
+                          "max_ragged_batch_size": 128,
+                          "max_ragged_sequence_count": 4,
+                          "max_context": 256},
+    })
+    prompt = np.arange(1, 25, dtype=np.int32)
+
+    def decode(eng, uid, n):
+        # the driver's continuation loop, inlined: each sampled token is
+        # fed back so the next step decodes it
+        toks = []
+        for _ in range(8 * n):
+            out = eng.step_tokens()
+            if uid in out:
+                toks.append(int(out[uid]))
+                if len(toks) == n:
+                    return toks
+                eng.scheduler.feedback(uid, toks[-1])
+        raise AssertionError(f"engine produced {len(toks)}/{n} tokens")
+
+    # single-engine reference: same params/seed, prefill + 6 greedy steps
+    ref = InferenceEngineV2(cfg, params, rc)
+    ref.scheduler.submit(77, prompt)
+    want = decode(ref, 77, 7)  # first token + 6 decode tokens
+    ref.scheduler.finish(77)
+
+    ho = decode_handoff_meta(bytes.fromhex(meta_line.split()[1]))
+    assert ho.payload is None and ho.endpoint is not None
+    tgt = InferenceEngineV2(cfg, params, rc)
+    # chaos: kill the first dial; the bounded retry must land the SAME
+    # staged transfer (the wire edge is idempotent)
+    with inject(FaultSpec("net.connect", nth=1)) as inj:
+        with_retries(lambda: import_sequence(tgt, ho),
+                     RetryPolicy(attempts=3, backoff_s=0.01),
+                     label="net.smoke")
+        assert [f["site"] for f in inj.fired()] == ["net.connect"]
+    got = [int(tgt.scheduler.peek_next_token(ho.uid))]
+    got += decode(tgt, ho.uid, 6)
+    assert got == want, f"cross-process stream diverged: {got} != {want}"
+    tgt.scheduler.finish(ho.uid)
+    assert tgt.state_manager.free_blocks == 64, "parent pool leaked"
+
+    child.stdin.write("done\n"); child.stdin.flush()
+    child_line("CHILD_OK")
+    assert child.wait(timeout=30) == 0
+    print("remote-transport gate: cross-process handoff bit-identical "
+          "through a chaos-killed dial, pools conserved in both processes")
+finally:
+    if child.poll() is None:
+        child.kill()
+EOF
 
 echo "== elastic-serving parity gate (preempt/resume + warm scale-up) =="
 # preempted-and-resumed streams must be BIT-IDENTICAL to uninterrupted
