@@ -276,6 +276,88 @@ def bench_long_context_cp(steps=3, warmup=1):
     return out
 
 
+def bench_splash_ab(steps=5, warmup=2):
+    """Splash scheduled sparse attention A/B (DSTPU_BENCH_SPLASH=1 rider).
+
+    Two legs:
+      * sparse-vs-dense at a fixed sequence with a local-window mask — on
+        CPU the speedup is COUNTED (kernel grid block-visits; interpret
+        wall-clock measures the emulator, not the machine), on TPU it is
+        wall-clock fwd+bwd of ``attention(impl='splash')`` vs the dense
+        flash kernel on the same shapes;
+      * dense long-context (s>=16k on TPU): the splash grid streams K/V one
+        [block, d] tile per step under ``vmem_limit_bytes`` — no full-K/V
+        VMEM residency — reported as achieved MFU against platform peak.
+    Knobs: DSTPU_BENCH_SPLASH_SEQ, DSTPU_BENCH_SPLASH_WINDOW,
+    DSTPU_BENCH_SPLASH_LONG_SEQ.
+    """
+    from deepspeed_tpu.ops.attention import attention
+    from deepspeed_tpu.ops.sparse_attention import LocalMask, schedule_from_mask
+
+    on_tpu = jax.default_backend() == "tpu"
+    seq = int(os.environ.get("DSTPU_BENCH_SPLASH_SEQ", 8192 if on_tpu else 2048))
+    window = int(os.environ.get("DSTPU_BENCH_SPLASH_WINDOW", max(256, seq // 8)))
+    block = 512 if on_tpu else 256
+    sched = schedule_from_mask(LocalMask((seq, seq), window), block)
+    dense_visits = sched.nq * sched.nk
+    out = {
+        "seq": seq, "window": window, "block": block,
+        "density": round(sched.density, 4),
+        "block_visits": {"dense": dense_visits, "splash": sched.num_active},
+        # the structural speedup — what the schedule provably prunes
+        "visit_speedup": round(dense_visits / max(sched.num_active, 1), 2),
+    }
+    if not on_tpu:
+        out["wall_clock"] = "skipped (interpret mode times the emulator)"
+        return out
+
+    b, h, d = 1, 8, 128
+    ks = jax.random.split(jax.random.key(0), 3)
+    q, k, v = (jax.random.normal(kk, (b, h, seq, d), jnp.bfloat16) for kk in ks)
+
+    def timed(fn):
+        g = jax.jit(jax.grad(lambda q: jnp.sum(fn(q).astype(jnp.float32))))
+        g(q).block_until_ready()
+        for _ in range(warmup):
+            g(q).block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            r = g(q)
+        r.block_until_ready()
+        return (time.perf_counter() - t0) / steps
+
+    t_splash = timed(lambda q: attention(q, k, v, causal=True, window=window,
+                                         impl="splash"))
+    t_dense = timed(lambda q: attention(q, k, v, causal=True, impl="flash"))
+    out["wall_clock"] = {
+        "splash_s": round(t_splash, 5), "dense_s": round(t_dense, 5),
+        "speedup": round(t_dense / t_splash, 2),
+    }
+
+    # dense long-context leg: causal splash at s>=16k — K/V stream block
+    # by block (the grid's kv index map), never resident whole in VMEM
+    ls = int(os.environ.get("DSTPU_BENCH_SPLASH_LONG_SEQ", 16384))
+    kq, kk_, kv_ = jax.random.split(jax.random.key(1), 3)
+    ql = jax.random.normal(kq, (1, h, ls, d), jnp.bfloat16)
+    kl = jax.random.normal(kk_, (1, h, ls, d), jnp.bfloat16)
+    vl = jax.random.normal(kv_, (1, h, ls, d), jnp.bfloat16)
+    g = jax.jit(jax.grad(lambda q: jnp.sum(attention(
+        q, kl, vl, causal=True, impl="splash").astype(jnp.float32))))
+    g(ql).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        r = g(ql)
+    r.block_until_ready()
+    dt = (time.perf_counter() - t0) / steps
+    # causal attention fwd+bwd: 3.5 * 4*h*s^2*d/2 matmul flops
+    flops = 3.5 * 2.0 * h * ls * ls * d
+    out["dense_16k"] = {
+        "seq": ls, "s_per_step": round(dt, 4),
+        "mfu_pct": round(100 * flops / dt / peak_flops("tpu"), 2),
+    }
+    return out
+
+
 def v5e64_projection():
     """Analytic feasibility of the north-star config (Llama-2-7B ZeRO-3 on
     v5e-64) from the autotuner's memory model — per-chip model-state +
@@ -411,6 +493,11 @@ def main():
             out["long_context_cp"] = bench_long_context_cp()
         except Exception as e:  # the headline metric must survive
             out["long_context_cp"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+    if os.environ.get("DSTPU_BENCH_SPLASH", "0") == "1":
+        try:
+            out["splash_ab"] = bench_splash_ab()
+        except Exception as e:  # the headline metric must survive
+            out["splash_ab"] = {"error": f"{type(e).__name__}: {e}"[:200]}
     if on_tpu and os.environ.get("DSTPU_BENCH_SKIP_SERVING", "0") != "1":
         # free the training engine's HBM residency (params + fp32 Adam state
         # ~12.7 GB) before the serving engine allocates its KV pool

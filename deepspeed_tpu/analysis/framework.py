@@ -31,7 +31,9 @@ from typing import Dict, Iterable, List, Optional, Sequence
 SEVERITIES = ("info", "warning", "error")
 
 #: path fragments marking latency-critical subtrees (host-sync rule scope)
-DEFAULT_HOT_PREFIXES = ("serving/", "inference/v2/", "runtime/zero/")
+DEFAULT_HOT_PREFIXES = (
+    "serving/", "inference/v2/", "runtime/zero/", "ops/sparse_attention/",
+)
 
 _NOQA_RE = re.compile(r"#\s*dstpu:\s*noqa(?:\[([^\]]*)\])?", re.IGNORECASE)
 

@@ -969,22 +969,40 @@ class InferenceEngineV2:
                     # gather the sequence's context and run masked attention
                     k_ctx = kc_l[block_table].reshape(S, nkv, d).transpose(1, 0, 2)[None]
                     v_ctx = vc_l[block_table].reshape(S, nkv, d).transpose(1, 0, 2)[None]
-                    kpos = jnp.arange(S, dtype=jnp.int32)
-                    mask = kpos[None, :] <= glob[:, None]  # [t, S] causal vs global pos
-                    if c.sliding_window:
-                        from deepspeed_tpu.ops.attention.core import window_too_far
-
-                        mask = jnp.logical_and(
-                            mask,
-                            jnp.logical_not(
-                                window_too_far(glob[:, None], kpos[None, :], c.sliding_window)
-                            ),
+                    if c.attention_impl == "splash" and c.sliding_window > 0:
+                        # scheduled prefill: the kv-block schedule is computed
+                        # IN-JIT from the traced chunk start (one compiled
+                        # program per (t, S) bucket, no host rebuild) and the
+                        # kernel visits ~(window + t)/block blocks, not all
+                        # S/block — out-of-band context blocks are never
+                        # streamed. window==0 configs keep the dense path
+                        # below (bit-identical streams vs pre-splash).
+                        from deepspeed_tpu.ops.sparse_attention import (
+                            splash_prefill_attention,
                         )
-                    bias = jnp.where(mask, 0.0, -1e30).astype(jnp.float32)[None, None]
-                    from deepspeed_tpu.ops.attention import mha_reference
 
-                    out = mha_reference(q, k_ctx, v_ctx, causal=False, bias=bias,
-                                        scale=c.attn_scale)
+                        out = splash_prefill_attention(
+                            q, k_ctx, v_ctx, start,
+                            window=c.sliding_window, block_kv=bs,
+                            scale=c.attn_scale,
+                        )
+                    else:
+                        kpos = jnp.arange(S, dtype=jnp.int32)
+                        mask = kpos[None, :] <= glob[:, None]  # [t, S] causal vs global pos
+                        if c.sliding_window:
+                            from deepspeed_tpu.ops.attention.core import window_too_far
+
+                            mask = jnp.logical_and(
+                                mask,
+                                jnp.logical_not(
+                                    window_too_far(glob[:, None], kpos[None, :], c.sliding_window)
+                                ),
+                            )
+                        bias = jnp.where(mask, 0.0, -1e30).astype(jnp.float32)[None, None]
+                        from deepspeed_tpu.ops.attention import mha_reference
+
+                        out = mha_reference(q, k_ctx, v_ctx, causal=False, bias=bias,
+                                            scale=c.attn_scale)
                     out = out.transpose(0, 2, 1, 3).reshape(1, t_, nh * d)
                 if self._tp_wire:
                     attn_out = self._tp_row_matmul(out[0], lp["wo"], "tp_attn_out")[None]

@@ -30,6 +30,7 @@ TPU-first design decisions:
 """
 
 import dataclasses
+import functools
 import math
 from contextlib import contextmanager
 from functools import partial
@@ -51,6 +52,35 @@ from deepspeed_tpu.parallel.topology import (
 )
 
 DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16}
+
+# attn_sparsity spec kinds → SparsityConfig families (ops/sparse_attention)
+_SPARSITY_KINDS = ("dense", "fixed", "bigbird", "bslongformer", "variable")
+
+
+@functools.lru_cache(maxsize=32)
+def _sparsity_schedule(spec, n_heads, seq_len, block, causal):
+    """attn_sparsity spec → compacted BlockSchedule. lru-cached so the
+    schedule is built once per (spec, seq_len) and reused across every
+    trace — a trace-time constant, never recomputed per step. The model's
+    causal flag is ANDed in: a bidirectional sparsity family under a
+    causal LM must not leak future positions."""
+    from deepspeed_tpu.ops.sparse_attention import config as sa_config
+    from deepspeed_tpu.ops.sparse_attention import schedule_from_layout
+
+    cls = {
+        "dense": sa_config.DenseSparsityConfig,
+        "fixed": sa_config.FixedSparsityConfig,
+        "bigbird": sa_config.BigBirdSparsityConfig,
+        "bslongformer": sa_config.BSLongformerSparsityConfig,
+        "variable": sa_config.VariableSparsityConfig,
+    }[spec[0]]
+    kwargs = dict(spec[1]) if len(spec) > 1 else {}
+    cfg = cls(num_heads=n_heads, **kwargs)
+    uni = getattr(cfg, "attention", "bidirectional") == "unidirectional"
+    return schedule_from_layout(
+        cfg.make_layout(seq_len), cfg.block, causal=causal or uni,
+        block_q=block or None, block_kv=block or None,
+    )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -172,8 +202,21 @@ class TransformerConfig:
     # attention backend seam (ops.attention.core dispatch): "auto" picks the
     # flash ring when the mesh's `context` axis is >1, else the platform
     # best; "flash_ring" / "flash_head_sharded" / "flash" / "reference"
-    # force a specific path (hard error when shapes/mesh don't support it)
+    # force a specific path (hard error when shapes/mesh don't support it);
+    # "splash" routes through the scheduled block-sparse kernel
+    # (ops/sparse_attention/splash_pallas.py) — masked kv blocks are never
+    # scheduled, cost scales with mask density not s²
     attention_impl: str = "auto"
+    # splash mask family as a hashable spec: (kind, ((kwarg, value), ...))
+    # with kind ∈ "fixed" | "bigbird" | "bslongformer" | "variable" |
+    # "dense" (the SparsityConfig families). The spec is compiled into a
+    # compacted per-q-block schedule at trace time (a Python constant —
+    # never rebuilt per step). None with attention_impl="splash" derives
+    # the schedule from attn_causal/sliding_window instead.
+    attn_sparsity: Optional[Tuple] = None
+    # kernel block edge for splash schedules; 0 → the op-layer default
+    # (DSTPU_SPLASH_BLOCK env or 512, shrunk to fit the sequence)
+    splash_block: int = 0
     # >1: compute the LM loss per sequence tile so [b, s, vocab] logits never
     # materialize (ALST TiledFusedLogitsLoss, ulysses_sp.py:960) — frees
     # ~b*s*vocab bytes of activations at the cost of recomputing the head
@@ -218,12 +261,47 @@ class TransformerConfig:
                 "(a typo would silently fall back to the wrong parallelism)"
             )
         if self.attention_impl not in (
-            "auto", "flash", "flash_head_sharded", "flash_ring", "reference"
+            "auto", "flash", "flash_head_sharded", "flash_ring", "reference",
+            "splash",
         ):
             raise ValueError(
                 f"attention_impl={self.attention_impl!r}: expected 'auto', "
-                "'flash', 'flash_head_sharded', 'flash_ring' or 'reference'"
+                "'flash', 'flash_head_sharded', 'flash_ring', 'reference' "
+                "or 'splash'"
             )
+        if self.attn_sparsity is not None:
+            if self.attention_impl not in ("auto", "splash"):
+                raise ValueError(
+                    f"attn_sparsity set with attention_impl="
+                    f"{self.attention_impl!r} — the sparsity schedule only "
+                    "routes through 'splash' (or 'auto' promotion)"
+                )
+            kind = self.attn_sparsity[0] if self.attn_sparsity else None
+            if kind not in _SPARSITY_KINDS:
+                raise ValueError(
+                    f"attn_sparsity kind {kind!r}: expected one of "
+                    f"{sorted(_SPARSITY_KINDS)}"
+                )
+            if self.sliding_window > 0:
+                raise ValueError(
+                    "attn_sparsity and sliding_window are mutually "
+                    "exclusive — the sparsity layout replaces the window "
+                    "band (silently ignoring the window would train a "
+                    "different mask than configured)"
+                )
+        if self.attention_impl == "splash" or self.attn_sparsity is not None:
+            if self.attn_layer_pattern is not None:
+                raise ValueError(
+                    "splash attention does not compose with "
+                    "attn_layer_pattern — the per-layer window flag is a "
+                    "traced scalar inside the layer scan, but splash "
+                    "schedules are trace-time constants"
+                )
+            if self.position == "alibi":
+                raise ValueError(
+                    "splash attention does not compose with alibi (the "
+                    "scheduled kernel takes no positional bias)"
+                )
         if self.attn_layer_pattern is not None:
             if self.sliding_window <= 0:
                 raise ValueError(
@@ -998,11 +1076,23 @@ def _attention_block(c: TransformerConfig, lp, x, positions, segment_ids, kv_cac
             # sliding windows ride the flash kernel (in-kernel band mask;
             # static windows — no attn_layer_pattern — additionally prune
             # out-of-band kv blocks, O(s·window) compute); window distance is
-            # the token index, packing composes via segment_ids
+            # the token index, packing composes via segment_ids.
+            # splash: the mask compiles into a compacted block schedule at
+            # trace time (lru-cached Python constant); masked blocks never
+            # enter the kernel grid. attn_sparsity promotes "auto" too.
+            schedule = None
+            if c.attn_sparsity is not None:
+                schedule = _sparsity_schedule(
+                    c.attn_sparsity, nh, s, c.splash_block, c.attn_causal)
+            elif impl == "splash" and c.splash_block:
+                from deepspeed_tpu.ops.attention.core import _derived_splash_schedule
+
+                schedule = _derived_splash_schedule(
+                    s, s, c.attn_causal, c.sliding_window, c.splash_block)
             out = attention_op(
                 q, k, v, causal=c.attn_causal, segment_ids=segment_ids,
                 scale=c.attn_scale, window=c.sliding_window,
-                window_flag=local_flag, impl=impl,
+                window_flag=local_flag, impl=impl, schedule=schedule,
             )
     out = out.transpose(0, 2, 1, 3).reshape(b, s, nh * d)
     out = _proj(c, out, lp["wo"])

@@ -55,9 +55,12 @@ class SparseAttnBuilder(PallasOpBuilder):
     NAME = "sparse_attn"
 
     def _build(self):
-        from deepspeed_tpu.ops import sparse_attention
+        # importlib: the package attribute `sparse_attention` is rebound to
+        # the kernel *function* by the re-export block below — the builder
+        # hands out the module (reference parity: sparse_attn is a package)
+        import importlib
 
-        return sparse_attention
+        return importlib.import_module("deepspeed_tpu.ops.sparse_attention")
 
 
 @register_op
@@ -103,6 +106,30 @@ class FPQuantizerBuilder(PallasOpBuilder):
 # Native (C++ host) ops register themselves on import of their modules.
 from deepspeed_tpu.ops import aio as _aio  # noqa: F401  (registers async_io)
 from deepspeed_tpu.ops.adam import cpu_adam as _cpu_adam  # noqa: F401  (registers cpu_adam)
+
+# Sparse attention is a first-class export, not just a builder target:
+# the scheduled splash kernel + its mask/schedule surface (reference
+# exposes these as deepspeed.ops.sparse_attention.*).
+from deepspeed_tpu.ops.sparse_attention import (  # noqa: F401
+    BigBirdSparsityConfig,
+    BlockSchedule,
+    BSLongformerSparsityConfig,
+    CausalMask,
+    DenseSparsityConfig,
+    DocumentMask,
+    FixedSparsityConfig,
+    LocalMask,
+    MultiHeadMask,
+    SparseSelfAttention,
+    SparsityConfig,
+    VariableSparsityConfig,
+    schedule_from_layout,
+    schedule_from_mask,
+    sparse_attention,
+    sparse_attention_reference,
+    splash_attention,
+    splash_prefill_attention,
+)
 
 # Compatibility table (reference deepspeed.ops.__compatible_ops__)
 __compatible_ops__ = {name: True for name in ALL_OPS}

@@ -108,6 +108,78 @@ def mha_reference(
 
 _warned_alibi_fallback = False
 _warned_window_fallback = False
+_warned_splash_fallback = False
+
+
+@functools.lru_cache(maxsize=64)
+def _derived_splash_schedule(sq: int, sk: int, causal: bool, window: int,
+                             block: int):
+    """Schedule for the mask implied by (causal, window) alone — the
+    impl='splash' path with no explicit mask configured. Cached: the
+    schedule is a trace-time constant, never rebuilt per step."""
+    from deepspeed_tpu.ops.sparse_attention.mask import (
+        CausalMask, FullMask, LocalMask,
+    )
+    from deepspeed_tpu.ops.sparse_attention.schedule import schedule_from_mask
+
+    if window and causal:
+        mask = LocalMask((sq, sk), window)
+    elif causal:
+        mask = CausalMask((sq, sk))
+    else:
+        mask = FullMask((sq, sk))
+    return schedule_from_mask(mask, block)
+
+
+def _splash_block(s: int) -> int:
+    import os
+
+    from deepspeed_tpu.ops.attention.flash_pallas import _pick_block
+
+    return _pick_block(s, int(os.environ.get("DSTPU_SPLASH_BLOCK", 512)))
+
+
+def _splash_dispatch(q, k, v, causal, segment_ids, bias, scale, window,
+                     window_flag, schedule, strict):
+    """impl='splash' (strict) or auto-promotion (a schedule was configured).
+    Returns None when the shapes/arguments cannot take the scheduled path
+    (the caller falls back to the dense dispatch chain); strict mode raises
+    instead, matching the other explicit impls."""
+    from deepspeed_tpu.ops.sparse_attention.splash_pallas import splash_attention
+
+    def bail(msg):
+        if strict:
+            raise ValueError(f"attention(impl='splash'): {msg}")
+        return None
+
+    if bias is not None:
+        return bail("dense bias is not supported on the scheduled path")
+    if window_flag is not None:
+        return bail("a traced per-layer window flag cannot alter a static "
+                    "schedule (use the dense/flash path for flag-gated "
+                    "local layers)")
+    sq, sk = q.shape[2], k.shape[2]
+    if schedule is None:
+        block = _splash_block(min(sq, sk))
+        if sq % block or sk % block:
+            return bail(f"seq ({sq}, {sk}) does not divide block {block}")
+        schedule = _derived_splash_schedule(sq, sk, bool(causal),
+                                            int(window or 0), block)
+    from deepspeed_tpu.parallel.topology import get_topology
+
+    if get_topology().world_size > 1:
+        from deepspeed_tpu.ops.attention.sharded import head_sharded_splash
+
+        out = head_sharded_splash(q, k, v, schedule, segment_ids=segment_ids,
+                                  scale=scale,
+                                  interpret=not _flash_available())
+        if out is not None:
+            return out
+        # shapes don't divide the mesh: run the kernel unsharded (GSPMD
+        # replicates the pallas_call) — scheduling still prunes, only the
+        # head parallelism is lost
+    return splash_attention(q, k, v, schedule, segment_ids=segment_ids,
+                            scale=scale, interpret=not _flash_available())
 
 
 @functools.lru_cache(maxsize=1)
@@ -184,11 +256,13 @@ def attention(
     alibi_positions: Optional[jax.Array] = None,
     window: int = 0,
     window_flag: Optional[jax.Array] = None,
+    schedule=None,
 ) -> jax.Array:
     """Dispatching attention entry point.
 
     ``impl`` selects the backend:
-      * None / 'auto' — flash when the platform/shapes allow (ring context
+      * None / 'auto' — splash when a block ``schedule`` (or sparse mask)
+        is configured, flash when the platform/shapes allow (ring context
         parallelism when the topology's ``context`` axis is >1 and the
         schedule supports it), else the jnp reference;
       * 'flash' — flash kernel, auto-sharded over batch/head axes;
@@ -196,12 +270,40 @@ def attention(
         shapes don't divide the mesh;
       * 'flash_ring' — context-parallel ring over the ``context`` mesh axis
         (causal only; hard error on unsupported schedules);
+      * 'splash' — the scheduled block-sparse kernel
+        (ops/sparse_attention/splash_pallas.py): ``schedule`` (a
+        BlockSchedule) or, absent that, the (causal, window) pair compiles
+        into a compacted active-block schedule — masked blocks are never
+        visited. Head-sharded automatically on multi-device meshes;
       * 'reference' — the jnp einsum.
     ALiBi and sliding windows ride the flash path (in-kernel masking; a
     static window additionally prunes out-of-band kv blocks from the grid);
     a dense ``bias`` forces the reference path."""
     d = q.shape[-1]
     sq, sk = q.shape[2], k.shape[2]
+    if alibi_slopes is not None and (impl == "splash" or schedule is not None):
+        raise ValueError("attention: ALiBi is not supported on the splash "
+                         "scheduled path")
+    if impl == "splash":
+        out = _splash_dispatch(q, k, v, causal, segment_ids, bias, scale,
+                               window, window_flag, schedule, strict=True)
+        if out is not None:
+            return out
+    elif impl in (None, "auto") and schedule is not None:
+        # auto promotion: a sparse mask/window schedule was configured
+        out = _splash_dispatch(q, k, v, causal, segment_ids, bias, scale,
+                               window, window_flag, schedule, strict=False)
+        if out is not None:
+            return out
+        global _warned_splash_fallback
+        if not _warned_splash_fallback:
+            _warned_splash_fallback = True
+            from deepspeed_tpu.utils.logging import logger
+
+            logger.warning(
+                "configured splash schedule fell back to the dense dispatch "
+                "chain (bias/window-flag/mesh constraints) — sparsity will "
+                "be masked, not pruned")
     if impl == "reference":
         return mha_reference(
             q, k, v, causal=causal, segment_ids=segment_ids, bias=bias,

@@ -157,6 +157,82 @@ def head_sharded_flash(q, k, v, causal=True, segment_ids=None, scale=None,
     return fn(q, k, v, *extra_ops)
 
 
+def head_sharded_splash(q, k, v, schedule, segment_ids=None, scale=None,
+                        interpret=False):
+    """Scheduled block-sparse (splash) attention with batch/head sharding.
+
+    Same placement contract as :func:`head_sharded_flash`. The schedule's
+    scalar-prefetch arrays ride INTO the manual region as operands: a
+    per-head schedule ([h, nq, w]) shards over the head axes with the
+    heads it drives, a shared one ([1, nq, w]) replicates. Returns ``None``
+    when the shapes don't divide the mesh (caller falls back).
+    """
+    from deepspeed_tpu.ops.sparse_attention.splash_pallas import (
+        _SplashParams, _splash_core, splash_attention,
+    )
+
+    topo = get_topology()
+    b, h, s, d = q.shape
+    h_kv = k.shape[1]
+    if topo.world_size == 1:
+        return splash_attention(q, k, v, schedule, segment_ids=segment_ids,
+                                scale=scale, interpret=interpret)
+    if not _divisible(topo, b, h, h_kv):
+        return None
+    head_div = topo.model_parallel_size * topo.sequence_parallel_size
+    per_head = schedule.num_heads > 1
+    if per_head and schedule.num_heads % head_div:
+        return None
+
+    spec = P(BATCH_AXES, HEAD_AXES, None, None)
+    sharding = NamedSharding(topo.mesh, spec)
+    q, k, v = (jax.lax.with_sharding_constraint(x, sharding) for x in (q, k, v))
+
+    seg_mode = "none"
+    seg = None
+    if schedule.segment_ids is not None:
+        if segment_ids is not None:
+            raise ValueError("schedule already carries segment ids")
+        seg_mode = "schedule"
+        seg = jnp.broadcast_to(
+            jnp.asarray(schedule.segment_ids, jnp.int32)[None], (b, s))
+    elif segment_ids is not None:
+        seg_mode = "all"
+        seg = jnp.asarray(segment_ids, jnp.int32)
+    params = _SplashParams(
+        bq=schedule.block_q, bk=schedule.block_kv,
+        causal=schedule.causal, window=schedule.window,
+        scale=float(scale if scale is not None else d ** -0.5),
+        has_partial=schedule.num_partial > 0, seg_mode=seg_mode,
+        interpret=interpret, vmem_limit=None,
+    )
+    sched_spec = P(HEAD_AXES, None, None) if per_head else P(None, None, None)
+    sched_ops = [jnp.asarray(a) for a in (
+        schedule.kv_index, schedule.step_kind,
+        schedule.q_index, schedule.step_kind_t)]
+    base = jnp.zeros((1,), jnp.int32)
+
+    has_seg = seg is not None
+    seg_specs = [P(BATCH_AXES, None)] if has_seg else []
+    seg_ops = [seg] if has_seg else []
+
+    def body(q_, k_, v_, kvi_, kind_, kvi_t_, kind_t_, base_, *rest):
+        seg_ = rest[0] if has_seg else None
+        return _splash_core(q_, k_, v_, seg_, kvi_, kind_, kvi_t_, kind_t_,
+                            base_, params)
+
+    fn = jax.shard_map(
+        body,
+        mesh=topo.mesh,
+        in_specs=(spec, spec, spec, sched_spec, sched_spec, sched_spec,
+                  sched_spec, P(None), *seg_specs),
+        out_specs=spec,
+        axis_names={*BATCH_AXES, *HEAD_AXES},
+        check_vma=False,
+    )
+    return fn(q, k, v, *sched_ops, base, *seg_ops)
+
+
 # ---------------------------------------------------------------------------
 # Ring (context-parallel) flash attention
 # ---------------------------------------------------------------------------

@@ -34,6 +34,21 @@ class SparsityConfig:
         layout[:] = 1
         return layout
 
+    def make_schedule(self, seq_len: int, block_q: Optional[int] = None,
+                      block_kv: Optional[int] = None):
+        """Compile this config's layout into a compacted BlockSchedule
+        (schedule.py) — the form the scheduled splash kernel consumes.
+        ``attention="unidirectional"`` configs get the causal predicate
+        composed in (diagonal blocks demote to partial, the strict upper
+        triangle is pruned before tril even sees it)."""
+        from deepspeed_tpu.ops.sparse_attention.schedule import schedule_from_layout
+
+        causal = getattr(self, "attention", "bidirectional") == "unidirectional"
+        return schedule_from_layout(
+            self.make_layout(seq_len), self.block, causal=causal,
+            block_q=block_q, block_kv=block_kv,
+        )
+
 
 DenseSparsityConfig = SparsityConfig
 

@@ -1,0 +1,162 @@
+"""Compacted block schedules: what the splash kernel actually iterates.
+
+``build_schedule`` turns a [h, nq, nk] block-status matrix (mask.py) into
+the scalar-prefetch arrays the scheduled kernel consumes:
+
+  * ``kv_index[h, nq, width]``  — for each q block, the kv-block indices it
+    visits, compacted left (EMPTY blocks are simply absent — never a grid
+    step, never an HBM stream).  ``width`` is the max active count over all
+    rows, so the fwd grid is (b, h, nq, width): it scales with the layout's
+    densest row, not with nk.
+  * ``step_kind[h, nq, width]`` — {0 skip, 1 partial, 2 full} per step.
+    Padding steps are ``skip`` and their kv_index REPEATS the row's last
+    real index, so the BlockSpec index map emits the same block twice and
+    Pallas elides the copy: a padded step costs neither DMA nor FLOPs.
+  * the transposed pair ``q_index`` / ``step_kind_t`` [h, nk, width_t] for
+    the dk/dv backward (per kv block: which q blocks touch it).
+
+Everything here is numpy at trace time: the schedule is a compile-time
+constant of the step program — there is no per-step host rebuild.
+"""
+
+from dataclasses import dataclass
+from typing import Optional, Tuple, Union
+
+import numpy as np
+
+from deepspeed_tpu.ops.sparse_attention.mask import (
+    EMPTY, FULL, PARTIAL, LayoutMask, Mask, MaskAnd, MultiHeadMask,
+)
+
+
+def _compact(status: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """[h, rows, cols] status -> (index [h, rows, width], kind [h, rows, width])."""
+    h, rows, cols = status.shape
+    width = max(1, int((status != EMPTY).sum(-1).max()))
+    index = np.zeros((h, rows, width), np.int32)
+    kind = np.zeros((h, rows, width), np.int32)
+    for hi in range(h):
+        for r in range(rows):
+            (act,) = np.nonzero(status[hi, r])
+            n = act.size
+            if n:
+                index[hi, r, :n] = act
+                kind[hi, r, :n] = status[hi, r, act]
+                index[hi, r, n:] = act[-1]  # repeat -> copy elided on pad steps
+            # rows with no active block keep index 0 / kind 0: the kernel
+            # still inits and flushes, emitting zeros for dead rows
+    return index, kind
+
+
+@dataclass(frozen=True, eq=False)  # identity hash: usable as a nondiff arg
+class BlockSchedule:
+    """Trace-time-constant schedule for one (mask, block-size) pairing."""
+
+    seq_q: int
+    seq_kv: int
+    block_q: int
+    block_kv: int
+    causal: bool
+    window: int                       # 0 = no band predicate
+    segment_ids: Optional[np.ndarray]  # static ids baked into the schedule
+    kv_index: np.ndarray              # [h, nq, width] int32
+    step_kind: np.ndarray             # [h, nq, width] int32
+    q_index: np.ndarray               # [h, nk, width_t] int32
+    step_kind_t: np.ndarray           # [h, nk, width_t] int32
+
+    @property
+    def num_heads(self) -> int:
+        return self.kv_index.shape[0]
+
+    @property
+    def nq(self) -> int:
+        return self.kv_index.shape[1]
+
+    @property
+    def nk(self) -> int:
+        return self.q_index.shape[1]
+
+    @property
+    def grid_width(self) -> int:
+        """Minor fwd grid dimension: max active kv blocks over any q row."""
+        return self.kv_index.shape[2]
+
+    @property
+    def grid_width_t(self) -> int:
+        return self.q_index.shape[2]
+
+    @property
+    def num_active(self) -> int:
+        """Total scheduled (non-skip) fwd steps across heads."""
+        return int((self.step_kind != EMPTY).sum())
+
+    @property
+    def num_partial(self) -> int:
+        return int((self.step_kind == PARTIAL).sum())
+
+    @property
+    def density(self) -> float:
+        return self.num_active / float(self.num_heads * self.nq * self.nk)
+
+
+def build_schedule(status: np.ndarray, *, seq_q: int, seq_kv: int,
+                   block_q: int, block_kv: int, causal: bool = False,
+                   window: int = 0,
+                   segment_ids: Optional[np.ndarray] = None) -> BlockSchedule:
+    """Compact a [h, nq, nk] (or [nq, nk]) status matrix into a schedule."""
+    status = np.asarray(status)
+    if status.ndim == 2:
+        status = status[None]
+    h, nq, nk = status.shape
+    if nq != seq_q // block_q or nk != seq_kv // block_kv:
+        raise ValueError(f"status grid {status.shape[1:]} != "
+                         f"{(seq_q // block_q, seq_kv // block_kv)}")
+    kv_index, step_kind = _compact(status)
+    q_index, step_kind_t = _compact(np.swapaxes(status, 1, 2))
+    return BlockSchedule(
+        seq_q=seq_q, seq_kv=seq_kv, block_q=block_q, block_kv=block_kv,
+        causal=bool(causal), window=int(window), segment_ids=segment_ids,
+        kv_index=kv_index, step_kind=step_kind,
+        q_index=q_index, step_kind_t=step_kind_t,
+    )
+
+
+def schedule_from_mask(mask: Union[Mask, MultiHeadMask], block_q: int,
+                       block_kv: Optional[int] = None) -> BlockSchedule:
+    """Compile a mask (mask.py) into its compacted schedule."""
+    block_kv = block_kv or block_q
+    status = mask.block_status(block_q, block_kv)
+    if status.ndim == 2:
+        status = status[None]
+    sq, sk = mask.shape
+    return build_schedule(
+        status, seq_q=sq, seq_kv=sk, block_q=block_q, block_kv=block_kv,
+        causal=mask.causal, window=mask.window, segment_ids=mask.segment_ids,
+    )
+
+
+def schedule_from_layout(layout: np.ndarray, block: int, causal: bool = False,
+                         block_q: Optional[int] = None,
+                         block_kv: Optional[int] = None) -> BlockSchedule:
+    """Route a SparsityConfig ``make_layout`` matrix [h, nq, nk] through the
+    schedule builder: layout blocks become FULL/EMPTY status, optionally
+    intersected with the causal predicate (which demotes diagonal blocks to
+    PARTIAL and prunes the strict upper triangle entirely)."""
+    layout = np.asarray(layout)
+    if layout.ndim == 2:
+        layout = layout[None]
+    bq = block_q or block
+    bk = block_kv or block
+    heads = []
+    for hl in layout:
+        m: Mask = LayoutMask(hl, block)
+        if causal:
+            m = MaskAnd(m, _causal_for(m.shape))
+        heads.append(m)
+    return schedule_from_mask(MultiHeadMask(heads), bq, bk)
+
+
+def _causal_for(shape):
+    from deepspeed_tpu.ops.sparse_attention.mask import CausalMask
+
+    return CausalMask(shape)

@@ -11,6 +11,11 @@ work — the compute cost scales with the number of active blocks, not s².
 Layout: int32 [h, nq, nk] (see config.py). Causal masking (within-block)
 composes with the layout; configs with attention="unidirectional" already
 zero the upper-triangular blocks so those are skipped entirely.
+
+NOTE: this is now the ``reference`` oracle. Inactive blocks here still
+cost a grid step and K/V streaming (full [s, d] VMEM residency); the
+production path is splash_pallas.py, whose compacted schedule never
+visits them at all. Parity tests pin the two against each other.
 """
 
 import functools
@@ -158,12 +163,25 @@ def _sparse_bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, col_ref,
     dv_ref[:] = dv.astype(dv_ref.dtype)
 
 
+def _reject_bias(bias, where):
+    if bias is not None:
+        raise NotImplementedError(
+            f"{where}: additive bias is not supported on the block-sparse "
+            "kernel path, and the oracle must match the kernel exactly — "
+            "use sparse_attention_with_bias (dense fallback) for rpe/"
+            "padding/attention masks")
+
+
 def sparse_attention(q, k, v, layout, block: int, causal: bool = False,
-                     scale: Optional[float] = None, interpret: bool = False):
+                     scale: Optional[float] = None, interpret: bool = False,
+                     bias=None):
     """Block-sparse attention. q/k/v: [b, h, s, d]; layout: [h, nq, nk] int32.
 
     ``block`` is the layout's block size; kernel blocks equal it (the layout
-    IS the tiling). Fully-masked q rows (no active block) produce zeros."""
+    IS the tiling). Fully-masked q rows (no active block) produce zeros.
+    ``bias`` raises: the kernel cannot honor it, and its oracle
+    (``sparse_attention_reference``) refuses it for the same reason."""
+    _reject_bias(bias, "sparse_attention")
     layout = jnp.asarray(layout, jnp.int32)
     return _sparse_core(q, k, v, layout, block, causal, scale, interpret)
 
@@ -270,9 +288,24 @@ _sparse_core.defvjp(_sparse_fwd, _sparse_bwd)
 
 
 def sparse_attention_reference(q, k, v, layout, block, causal=False, scale=None, bias=None):
-    """Dense jnp reference: expand the block layout to a token mask.
-    ``bias`` (broadcastable to [b, h, s, s]) carries rpe / padding / attention
-    masks for the fallback path."""
+    """Dense jnp oracle for the kernel path: expands the block layout to a
+    token mask. ``bias`` raises — the kernel cannot honor it, so accepting
+    it here would let oracle and kernel silently diverge; the biased dense
+    path lives in ``sparse_attention_with_bias``."""
+    _reject_bias(bias, "sparse_attention_reference")
+    return _sparse_dense(q, k, v, layout, block, causal, scale, None)
+
+
+def sparse_attention_with_bias(q, k, v, layout, block, causal=False,
+                               scale=None, bias=None):
+    """Dense block-masked attention WITH additive bias (broadcastable to
+    [b, h, s, s]) — the rpe / key-padding / attention-mask fallback used by
+    ``SparseSelfAttention``. Deliberately a separate entry point from the
+    kernel oracle so the no-bias pair stays bit-comparable."""
+    return _sparse_dense(q, k, v, layout, block, causal, scale, bias)
+
+
+def _sparse_dense(q, k, v, layout, block, causal, scale, bias):
     h, nq, nk = layout.shape
     mask = jnp.repeat(jnp.repeat(jnp.asarray(layout, bool), block, 1), block, 2)
     d = q.shape[-1]

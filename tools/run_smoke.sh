@@ -340,6 +340,21 @@ driver.shutdown(drain=False)
 print(f"trace gate: {len(doc['traceEvents'])} events, span set complete")
 EOF
 
+echo "== splash sparse-attention parity gate =="
+# scheduled block-sparse attention: mask->schedule builders, fwd+bwd kernel
+# parity per mask family (causal/local/document/BigBird/Longformer/GQA),
+# the attention(impl='splash') seam, model threading, and the serving
+# chunked-prefill stream parity (window=None must stay bit-identical dense)
+python -m pytest tests/unit/ops/test_splash_attention.py \
+    -q -m 'not slow' -p no:cacheprovider
+
+echo "== host-sync annotation gate (Tier A, sparse-attention kernels) =="
+# schedule builders run at TRACE time only; any host-sync copy inside a
+# loop in ops/sparse_attention must carry a reasoned noqa or it would sync
+# per training step
+./bin/dstpu lint deepspeed_tpu/ops/sparse_attention \
+    --select host-sync-in-loop --fail-on warning
+
 echo "== donation/recompile verifier (Tier B) =="
 # includes the disagg pass: decode replicas' donated step programs must
 # survive the extracted scheduler + KV-handoff import path
