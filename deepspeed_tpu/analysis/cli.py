@@ -61,6 +61,7 @@ def lint_main(argv=None) -> int:
         paths = [_default_lint_root()]
 
     findings = []
+    tree_ctx_out = []
     if paths:
         try:
             findings = framework.run_lint(
@@ -69,6 +70,7 @@ def lint_main(argv=None) -> int:
                 ignore=args.ignore,
                 hot_prefixes=tuple(args.hot_prefix) if args.hot_prefix
                 else framework.DEFAULT_HOT_PREFIXES,
+                tree_ctx_out=tree_ctx_out,
             )
         except KeyError as e:
             print(f"dstpu lint: {e.args[0]}", file=sys.stderr)
@@ -86,9 +88,14 @@ def lint_main(argv=None) -> int:
         verify_results, verify_ok = run_verify(verbose=(args.format == "text"))
 
     if args.format == "json":
+        # the lock model rides along for editor/CI integrations: lock
+        # registry, guarded attributes, and the acquisition graph
+        model_doc = (tree_ctx_out[0].lock_model.to_doc()
+                     if tree_ctx_out else None)
         print(framework.render_json(
             findings,
-            verify=[r.to_dict() for r in verify_results] if args.verify else None))
+            verify=[r.to_dict() for r in verify_results] if args.verify else None,
+            model=model_doc))
     elif paths:
         print(framework.render_text(findings))
 

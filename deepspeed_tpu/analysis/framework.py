@@ -46,6 +46,9 @@ class Finding:
     line: int
     col: int
     message: str
+    #: last line of the flagged statement (== line for single-line nodes);
+    #: lets editor integrations span highlights
+    end_line: int = 0
 
     def to_dict(self) -> dict:
         return {
@@ -54,6 +57,7 @@ class Finding:
             "path": self.path,
             "line": self.line,
             "col": self.col,
+            "end_line": self.end_line or self.line,
             "message": self.message,
         }
 
@@ -89,17 +93,43 @@ def register(rule_cls):
     return rule_cls
 
 
+class TreeContext:
+    """Whole-run state shared by every file's :class:`LintContext`: the
+    parsed ``(path, text, tree)`` triples and the lazily-built lock model
+    (``analysis/locks.py``). Building is deferred until a rule asks, so
+    runs that select no lock-discipline rule pay nothing."""
+
+    def __init__(self, files):
+        self.files = files  # List[Tuple[path, text, tree]]
+        self._lock_model = None
+
+    @property
+    def lock_model(self):
+        if self._lock_model is None:
+            from deepspeed_tpu.analysis import locks
+            self._lock_model = locks.build_model(self.files)
+        return self._lock_model
+
+
 class LintContext:
     """Everything a rule needs to analyze one file."""
 
     def __init__(self, path: str, text: str, tree: ast.AST,
-                 hot_prefixes: Sequence[str] = DEFAULT_HOT_PREFIXES):
+                 hot_prefixes: Sequence[str] = DEFAULT_HOT_PREFIXES,
+                 tree_ctx: Optional[TreeContext] = None):
         self.path = path
         self.text = text
         self.tree = tree
         norm = path.replace(os.sep, "/")
         self.hot_module = any(frag in norm for frag in hot_prefixes)
         self._noqa = _collect_noqa(text)
+        # standalone lint_file() calls get a single-file tree context so
+        # model-backed rules still work (cross-file edges just won't exist)
+        self.tree_ctx = tree_ctx or TreeContext([(path, text, tree)])
+
+    @property
+    def lock_model(self):
+        return self.tree_ctx.lock_model
 
     def suppressed(self, rule: str, line: int) -> bool:
         rules = self._noqa.get(line)
@@ -111,6 +141,8 @@ class LintContext:
         Returns None when suppressed."""
         line = node if isinstance(node, int) else getattr(node, "lineno", 0)
         col = 0 if isinstance(node, int) else getattr(node, "col_offset", 0)
+        end = line if isinstance(node, int) else (
+            getattr(node, "end_lineno", None) or line)
         if self.suppressed(rule.name, line):
             return None
         return Finding(
@@ -120,6 +152,7 @@ class LintContext:
             line=line,
             col=col,
             message=message,
+            end_line=end,
         )
 
 
@@ -182,19 +215,31 @@ def resolve_rules(select: Optional[Sequence[str]] = None,
     return [REGISTRY[n] for n in names]
 
 
-def lint_file(path: str, rules: Sequence[Rule],
-              hot_prefixes: Sequence[str] = DEFAULT_HOT_PREFIXES) -> List[Finding]:
+def _load_source(path: str):
+    """(text, tree, error Finding | None) for one file."""
     try:
         with open(path, "r", encoding="utf-8") as f:
             text = f.read()
     except OSError as e:
-        return [Finding("parse-error", "error", path, 0, 0, f"cannot read: {e}")]
+        return None, None, Finding("parse-error", "error", path, 0, 0,
+                                   f"cannot read: {e}")
     try:
         tree = ast.parse(text, filename=path)
     except SyntaxError as e:
-        return [Finding("parse-error", "error", path, e.lineno or 0, e.offset or 0,
-                        f"syntax error: {e.msg}")]
-    ctx = LintContext(path, text, tree, hot_prefixes=hot_prefixes)
+        return text, None, Finding("parse-error", "error", path,
+                                   e.lineno or 0, e.offset or 0,
+                                   f"syntax error: {e.msg}")
+    return text, tree, None
+
+
+def lint_file(path: str, rules: Sequence[Rule],
+              hot_prefixes: Sequence[str] = DEFAULT_HOT_PREFIXES,
+              tree_ctx: Optional[TreeContext] = None) -> List[Finding]:
+    text, tree, err = _load_source(path)
+    if err is not None:
+        return [err]
+    ctx = LintContext(path, text, tree, hot_prefixes=hot_prefixes,
+                      tree_ctx=tree_ctx)
     findings: List[Finding] = []
     for rule in rules:
         findings.extend(f for f in rule.check(ctx) if f is not None)
@@ -204,11 +249,27 @@ def lint_file(path: str, rules: Sequence[Rule],
 def run_lint(paths: Sequence[str],
              select: Optional[Sequence[str]] = None,
              ignore: Optional[Sequence[str]] = None,
-             hot_prefixes: Sequence[str] = DEFAULT_HOT_PREFIXES) -> List[Finding]:
+             hot_prefixes: Sequence[str] = DEFAULT_HOT_PREFIXES,
+             tree_ctx_out: Optional[list] = None) -> List[Finding]:
     rules = resolve_rules(select, ignore)
     findings: List[Finding] = []
+    # parse everything up front: whole-tree rules (lock discipline) need
+    # every class in the run visible before the first file is checked
+    sources = []
     for path in iter_py_files(paths):
-        findings.extend(lint_file(path, rules, hot_prefixes=hot_prefixes))
+        text, tree, err = _load_source(path)
+        if err is not None:
+            findings.append(err)
+        else:
+            sources.append((path, text, tree))
+    tree_ctx = TreeContext(sources)
+    if tree_ctx_out is not None:
+        tree_ctx_out.append(tree_ctx)
+    for path, text, tree in sources:
+        ctx = LintContext(path, text, tree, hot_prefixes=hot_prefixes,
+                          tree_ctx=tree_ctx)
+        for rule in rules:
+            findings.extend(f for f in rule.check(ctx) if f is not None)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 
@@ -233,7 +294,8 @@ def render_text(findings: Sequence[Finding]) -> str:
     return "\n".join(lines)
 
 
-def render_json(findings: Sequence[Finding], verify: Optional[list] = None) -> str:
+def render_json(findings: Sequence[Finding], verify: Optional[list] = None,
+                model: Optional[dict] = None) -> str:
     doc = {
         "version": 1,
         "findings": [f.to_dict() for f in findings],
@@ -241,6 +303,8 @@ def render_json(findings: Sequence[Finding], verify: Optional[list] = None) -> s
     }
     if verify is not None:
         doc["verify"] = verify
+    if model is not None:
+        doc["model"] = model
     return json.dumps(doc, indent=2, sort_keys=True)
 
 

@@ -1,0 +1,1016 @@
+"""Whole-tree lock model for the lock-discipline rules (Tier A).
+
+``dstpu lint``'s concurrency rules used to reason one class at a time.
+This module builds a model of the *entire lint run* — every file parsed
+once, cross-referenced — so rules can answer questions no per-file pass
+can:
+
+* **lock registry** — which classes own locks (``self._lock =
+  threading.Lock()``), which modules own global locks
+  (``_BUILD_LOCK = threading.Lock()``), and each lock's kind
+  (``Lock`` / ``RLock`` / ``Condition``; only ``RLock`` is reentrant).
+* **guarded attributes** — an attribute written under ``with
+  self._lock:`` anywhere in a class is shared state *everywhere* in that
+  class. Augmented assignment (``self.n += 1``), subscript stores
+  (``self.d[k] = v``) and in-place mutator calls (``self.q.append(x)``)
+  all count as writes. Explicit contracts come from
+  ``# dstpu: guarded-by[attr, lock]`` comments inside the class body,
+  and ``*_locked``-suffixed methods declare "caller holds the lock".
+* **acquisition graph** — who acquires what while holding what,
+  following ``self.x.method()`` calls across classes through inferred
+  attribute/parameter types (``Router._cond`` sites that call
+  ``self.metrics.inc`` add the edge ``Router._cond ->
+  ServingMetrics._lock``). Cycles in this graph are potential
+  deadlocks; ``analysis/lockwitness.py`` checks the *observed* runtime
+  graph against this static one.
+
+The model is pure AST — no imports of the analyzed code, no execution —
+so it runs anywhere the linter runs. It is deliberately unsound in the
+usual static-analysis ways (unresolvable receivers are skipped, not
+guessed), trading false negatives for a near-zero false-positive rate:
+every edge it reports comes with a concrete ``path:line`` witness.
+"""
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "LockDecl",
+    "LockModel",
+    "ClassModel",
+    "Site",
+    "build_model",
+    "build_model_from_paths",
+]
+
+#: constructor callees that create a lock object
+_LOCK_FACTORIES = {
+    "threading.Lock": "Lock",
+    "threading.RLock": "RLock",
+    "threading.Condition": "Condition",
+    "Lock": "Lock",
+    "RLock": "RLock",
+    "Condition": "Condition",
+}
+
+#: method names that mutate their receiver in place (list/dict/set/deque)
+_MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "remove",
+    "pop", "popleft", "clear", "update", "add", "discard", "setdefault",
+    "rotate", "sort", "reverse",
+}
+
+#: explicit guarded-by contract: ``# dstpu: guarded-by[attr, lock]``
+_GUARDED_BY_RE = re.compile(
+    r"#\s*dstpu:\s*guarded-by\[\s*([A-Za-z_]\w*)\s*,\s*([A-Za-z_]\w*)\s*\]")
+
+#: explicit return-type contract on a factory function whose annotation
+#: can't name one class (e.g. returns the null OR the real injector):
+#: ``def get_fault_injector():  # dstpu: returns[FaultInjector]``
+_RETURNS_RE = re.compile(r"#\s*dstpu:\s*returns\[\s*([A-Za-z_]\w*)\s*\]")
+
+#: calls that can block indefinitely; value = human reason
+_BLOCKING_CALLS = {
+    "time.sleep": "sleeps while holding the lock",
+    "subprocess.run": "spawns a subprocess while holding the lock",
+    "subprocess.call": "spawns a subprocess while holding the lock",
+    "subprocess.check_call": "spawns a subprocess while holding the lock",
+    "subprocess.check_output": "spawns a subprocess while holding the lock",
+    "subprocess.Popen": "spawns a subprocess while holding the lock",
+}
+
+#: method names that block on I/O or synchronization when called with no
+#: timeout argument (socket accept/recv, queue.get, thread/condition waits)
+_BLOCKING_METHODS = {
+    "accept": "blocks on socket accept",
+    "recv": "blocks on socket recv",
+    "recv_into": "blocks on socket recv",
+    "recvfrom": "blocks on socket recv",
+    "connect": "blocks on socket connect",
+    "block_until_ready": "synchronizes host with device",
+    "get": "blocks on queue.get",
+    "join": "blocks joining a thread",
+    "wait": "blocks waiting",
+    "wait_for": "blocks waiting",
+}
+
+#: ``.get``/``.join``/``.wait`` receivers must look synchronization-ish to
+#: count (plain dict ``.get(k)`` is not blocking)
+_BLOCKING_RECV_HINTS = re.compile(
+    r"(queue|q|thread|proc|process|pump|worker|event|evt|barrier|cond|"
+    r"condition|cv|done|ready|stop|listener)s?$",
+    re.IGNORECASE,
+)
+
+
+# ---------------------------------------------------------------------------
+# model dataclasses
+# ---------------------------------------------------------------------------
+@dataclass(frozen=True)
+class Site:
+    path: str
+    line: int
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}"
+
+
+@dataclass
+class LockDecl:
+    key: str            # "EngineCore.step_lock" or "op_builder._BUILD_LOCK"
+    kind: str           # "Lock" | "RLock" | "Condition" | "Condition(Lock)"
+    cls: Optional[str]  # owning class name, None for module-level locks
+    attr: str           # attribute / global name
+    site: Site = field(default=None)  # type: ignore[assignment]
+
+    @property
+    def reentrant(self) -> bool:
+        # Condition()'s default lock is an RLock; only an explicit plain
+        # Lock argument (kind "Condition(Lock)") makes it non-reentrant
+        return self.kind in ("RLock", "Condition")
+
+
+@dataclass
+class _TypeRef:
+    """A best-effort static type: a known class name, optionally wrapped
+    in one container layer (list/dict-values/deque), in which case
+    iterating or subscripting yields the element class."""
+    cls: str
+    container: bool = False
+
+
+@dataclass
+class _CallFact:
+    callee: Tuple[Optional[str], str]  # (class name | None, func/method name)
+    site: Site
+    held: Tuple[str, ...]
+    is_self_call: bool
+    recv: str  # rendered receiver, for messages
+
+
+@dataclass
+class _AcqFact:
+    lock: str
+    site: Site
+    held: Tuple[str, ...]
+    timeout: bool = False  # acquire(timeout=...) — bounded, not a deadlock
+
+
+@dataclass
+class _BlockFact:
+    site: Site
+    held: Tuple[str, ...]
+    desc: str
+    reason: str
+
+
+@dataclass
+class _AccessFact:
+    attr: str
+    site: Site
+    held: Tuple[str, ...]
+    kind: str  # "read" | "assign" | "augassign" | "subscript" | "mutator"
+
+
+@dataclass
+class _MethodFacts:
+    cls: Optional[str]
+    name: str
+    path: str
+    acquisitions: List[_AcqFact] = field(default_factory=list)
+    calls: List[_CallFact] = field(default_factory=list)
+    blocking: List[_BlockFact] = field(default_factory=list)
+    reads: List[_AccessFact] = field(default_factory=list)
+    writes: List[_AccessFact] = field(default_factory=list)
+
+    @property
+    def locked_contract(self) -> bool:
+        return self.name.endswith("_locked")
+
+
+@dataclass
+class ClassModel:
+    name: str
+    path: str
+    node: ast.ClassDef
+    locks: Dict[str, LockDecl] = field(default_factory=dict)
+    #: guarded attribute -> guarding lock attr (inferred + declared)
+    guarded: Dict[str, str] = field(default_factory=dict)
+    #: attr -> best-effort type
+    attr_types: Dict[str, _TypeRef] = field(default_factory=dict)
+    methods: Dict[str, ast.AST] = field(default_factory=dict)
+
+    def lock_key(self, attr: str) -> str:
+        return f"{self.name}.{attr}"
+
+
+@dataclass
+class _FuncInfo:
+    name: str
+    path: str
+    module: str
+    node: ast.AST
+    returns: Optional[str] = None  # annotated return class name
+
+
+class LockModel:
+    """The whole-tree model. Build with :func:`build_model`; rules consume
+    the derived fact lists (each entry carries a ``Site`` so per-file rules
+    can filter on ``ctx.path``)."""
+
+    def __init__(self):
+        self.classes: Dict[str, ClassModel] = {}
+        self.module_locks: Dict[str, LockDecl] = {}
+        self.functions: Dict[str, _FuncInfo] = {}
+        #: module stem -> {global name: _TypeRef}
+        self.module_globals: Dict[str, Dict[str, _TypeRef]] = {}
+        self.method_facts: Dict[Tuple[Optional[str], str], _MethodFacts] = {}
+        #: (held, acquired) -> witness sites
+        self.order_edges: Dict[Tuple[str, str], List[Site]] = {}
+        #: non-reentrant lock re-acquired while held: (lock, site, via)
+        self.reentrant_hazards: List[Tuple[str, Site, str]] = []
+        #: RLock/any lock observed acquired reentrantly (info for audits)
+        self.reentrant_acquires: List[Tuple[str, Site, str]] = []
+        self._may_acquire_memo: Dict[Tuple[Optional[str], str], Set[str]] = {}
+
+    # -- lock registry ----------------------------------------------------
+    def all_locks(self) -> Dict[str, LockDecl]:
+        out = dict(self.module_locks)
+        for cm in self.classes.values():
+            for decl in cm.locks.values():
+                out[decl.key] = decl
+        return out
+
+    def lock_decl(self, key: str) -> Optional[LockDecl]:
+        return self.all_locks().get(key)
+
+    # -- acquisition graph ------------------------------------------------
+    def add_edge(self, held: str, acquired: str, site: Site):
+        if held == acquired:
+            return
+        self.order_edges.setdefault((held, acquired), []).append(site)
+
+    def edge_closure(self) -> Set[Tuple[str, str]]:
+        """Transitive closure of the static order edges — the contract the
+        runtime witness checks observed acquisitions against."""
+        adj: Dict[str, Set[str]] = {}
+        for a, b in self.order_edges:
+            adj.setdefault(a, set()).add(b)
+        closure: Set[Tuple[str, str]] = set()
+        for start in adj:
+            seen: Set[str] = set()
+            stack = list(adj[start])
+            while stack:
+                n = stack.pop()
+                if n in seen:
+                    continue
+                seen.add(n)
+                closure.add((start, n))
+                stack.extend(adj.get(n, ()))
+        return closure
+
+    def cycles(self) -> List[List[str]]:
+        """Elementary cycles in the acquisition graph (each a lock-order
+        inversion). Returned as node lists without the closing repeat,
+        deduplicated by rotation."""
+        adj: Dict[str, List[str]] = {}
+        for a, b in sorted(self.order_edges):
+            adj.setdefault(a, []).append(b)
+        cycles: List[List[str]] = []
+        seen_keys: Set[Tuple[str, ...]] = set()
+
+        def dfs(start: str, node: str, trail: List[str], visiting: Set[str]):
+            for nxt in adj.get(node, ()):
+                if nxt == start:
+                    cyc = trail[:]
+                    i = cyc.index(min(cyc))
+                    key = tuple(cyc[i:] + cyc[:i])
+                    if key not in seen_keys:
+                        seen_keys.add(key)
+                        cycles.append(cyc)
+                elif nxt not in visiting and nxt > start:
+                    # only explore nodes > start: each cycle found once,
+                    # rooted at its smallest node
+                    visiting.add(nxt)
+                    dfs(start, nxt, trail + [nxt], visiting)
+                    visiting.discard(nxt)
+
+        for start in sorted(adj):
+            dfs(start, start, [start], {start})
+        return cycles
+
+    # -- interprocedural summaries -----------------------------------------
+    def may_acquire(self, key: Tuple[Optional[str], str],
+                    _depth: int = 0) -> Set[str]:
+        """Locks a method/function may acquire, transitively through
+        resolved calls (memoized, cycle-safe, depth-capped)."""
+        if key in self._may_acquire_memo:
+            return self._may_acquire_memo[key]
+        if _depth > 12:
+            return set()
+        self._may_acquire_memo[key] = set()  # cycle guard
+        facts = self.method_facts.get(key)
+        if facts is None:
+            return set()
+        out: Set[str] = set()
+        for acq in facts.acquisitions:
+            if not acq.timeout:
+                out.add(acq.lock)
+        for call in facts.calls:
+            out |= self.may_acquire(call.callee, _depth + 1)
+        self._may_acquire_memo[key] = out
+        return out
+
+    # -- JSON export --------------------------------------------------------
+    def to_doc(self) -> dict:
+        """The ``model`` section of ``render_json``: locks, guarded attrs,
+        acquisition edges (each edge with one witness site)."""
+        locks = [
+            {"key": d.key, "kind": d.kind, "class": d.cls, "attr": d.attr,
+             "path": d.site.path if d.site else None,
+             "line": d.site.line if d.site else None}
+            for d in sorted(self.all_locks().values(), key=lambda d: d.key)
+        ]
+        guarded = {
+            cm.name: {attr: cm.lock_key(lock)
+                      for attr, lock in sorted(cm.guarded.items())}
+            for cm in sorted(self.classes.values(), key=lambda c: c.name)
+            if cm.guarded
+        }
+        edges = [
+            {"held": a, "acquires": b,
+             "site": sites[0].render(), "sites": len(sites)}
+            for (a, b), sites in sorted(self.order_edges.items())
+        ]
+        return {"locks": locks, "guarded": guarded, "edges": edges}
+
+
+# ---------------------------------------------------------------------------
+# AST helpers
+# ---------------------------------------------------------------------------
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_self_attr(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name) and node.value.id == "self")
+
+
+def _annotation_class(node: Optional[ast.AST]) -> Optional[Tuple[str, bool]]:
+    """``EngineCore`` / ``Optional[EngineCore]`` / ``"EngineCore"`` ->
+    (name, container=False); ``List[EngineCore]`` / ``Dict[int, EngineCore]``
+    / ``Sequence[...]`` -> (elem name, container=True). None otherwise."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        try:
+            node = ast.parse(node.value, mode="eval").body
+        except SyntaxError:
+            return None
+    if isinstance(node, ast.Name):
+        return node.id, False
+    if isinstance(node, ast.Attribute):
+        return node.attr, False
+    if isinstance(node, ast.Subscript):
+        base = _dotted(node.value) or ""
+        base = base.split(".")[-1]
+        inner = node.slice
+        if base == "Optional":
+            return _annotation_class(inner)
+        if base in ("List", "list", "Sequence", "Set", "set",
+                    "FrozenSet", "Tuple", "tuple", "Deque", "deque",
+                    "Iterable", "Iterator"):
+            got = _annotation_class(inner)
+            if got:
+                return got[0], True
+        if base in ("Dict", "dict", "Mapping", "MutableMapping",
+                    "DefaultDict", "OrderedDict"):
+            if isinstance(inner, ast.Tuple) and len(inner.elts) == 2:
+                got = _annotation_class(inner.elts[1])
+                if got:
+                    return got[0], True
+    return None
+
+
+def _stmt_exprs(stmt: ast.stmt) -> List[ast.expr]:
+    """Expressions hanging directly off a statement (not the nested
+    statement bodies — those are walked with their own held-lock state)."""
+    out: List[ast.expr] = []
+    for name, value in ast.iter_fields(stmt):
+        if name in ("body", "orelse", "finalbody", "handlers", "items"):
+            continue
+        if isinstance(value, ast.expr):
+            out.append(value)
+        elif isinstance(value, list):
+            out.extend(v for v in value if isinstance(v, ast.expr))
+    return out
+
+
+def _sub_bodies(stmt: ast.stmt) -> List[List[ast.stmt]]:
+    bodies = []
+    for f in ("body", "orelse", "finalbody"):
+        b = getattr(stmt, f, None)
+        if b:
+            bodies.append(b)
+    for h in getattr(stmt, "handlers", []) or []:
+        bodies.append(h.body)
+    return bodies
+
+
+def _call_timeout_bounded(call: ast.Call) -> bool:
+    """True when the call passes a timeout (kwarg or any positional arg on
+    wait/get/join/acquire-style calls)."""
+    for kw in call.keywords:
+        if kw.arg == "timeout":
+            return not (isinstance(kw.value, ast.Constant)
+                        and kw.value.value is None)
+    func = call.func
+    if isinstance(func, ast.Attribute) and func.attr in (
+            "wait", "get", "join", "acquire") and call.args:
+        return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# builder
+# ---------------------------------------------------------------------------
+def build_model(files: Iterable[Tuple[str, str, ast.AST]]) -> LockModel:
+    """Build the model from ``(path, text, tree)`` triples (the lint run's
+    parsed files)."""
+    model = LockModel()
+    files = list(files)
+
+    # pass 1: registry — classes, module functions/locks/globals
+    for path, text, tree in files:
+        _collect_registry(model, path, text, tree)
+    # pass 2: per-class attribute types + lock attrs + declared contracts
+    for path, text, tree in files:
+        _collect_class_details(model, path, text, tree)
+    # pass 3: per-method facts (held-lock walk) + guarded inference
+    for path, text, tree in files:
+        _collect_method_facts(model, path, tree)
+    _infer_guarded(model)
+    # pass 4: derive the acquisition graph from facts + call summaries
+    _derive_edges(model)
+    return model
+
+
+def build_model_from_paths(paths: Sequence[str]) -> LockModel:
+    """Convenience: parse ``paths`` (files or directories) and build."""
+    from deepspeed_tpu.analysis.framework import iter_py_files
+    triples = []
+    for p in iter_py_files(paths):
+        try:
+            with open(p, "r", encoding="utf-8") as f:
+                text = f.read()
+            triples.append((p, text, ast.parse(text, filename=p)))
+        except (OSError, SyntaxError):
+            continue
+    return build_model(triples)
+
+
+def _module_stem(path: str) -> str:
+    return os.path.splitext(os.path.basename(path))[0]
+
+
+def _collect_registry(model: LockModel, path: str, text: str, tree: ast.AST):
+    stem = _module_stem(path)
+    lines = text.splitlines()
+    globals_ = model.module_globals.setdefault(stem, {})
+    for node in tree.body if isinstance(tree, ast.Module) else []:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            ret = _annotation_class(node.returns)
+            returns = ret[0] if ret and not ret[1] else None
+            if returns is None:
+                # `# dstpu: returns[Class]` on the def line stands in for
+                # an annotation the type system can't express cleanly
+                for i in range(node.lineno - 1,
+                               min(node.body[0].lineno, len(lines))):
+                    m = _RETURNS_RE.search(lines[i])
+                    if m:
+                        returns = m.group(1)
+                        break
+            model.functions.setdefault(node.name, _FuncInfo(
+                name=node.name, path=path, module=stem, node=node,
+                returns=returns))
+        elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            callee = _dotted(node.value.func)
+            for t in node.targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                if callee in _LOCK_FACTORIES:
+                    key = f"{stem}.{t.id}"
+                    model.module_locks[key] = LockDecl(
+                        key=key, kind=_lock_kind(node.value, callee),
+                        cls=None, attr=t.id, site=Site(path, node.lineno))
+                elif callee:
+                    globals_[t.id] = _TypeRef(callee.split(".")[-1])
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            prev = model.classes.get(node.name)
+            cm = ClassModel(name=node.name, path=path, node=node)
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    cm.methods[item.name] = item
+            # name collisions across modules: prefer the definition that
+            # owns locks (resolved in pass 2); for now first-seen wins and
+            # pass 2 may replace it
+            if prev is None:
+                model.classes[node.name] = cm
+            else:
+                prev_has = _defines_lock(prev.node)
+                if not prev_has and _defines_lock(node):
+                    model.classes[node.name] = cm
+
+
+def _lock_kind(call: ast.Call, callee: str) -> str:
+    """Resolve the lock kind, distinguishing ``Condition(Lock())`` (whose
+    lock is NOT reentrant) from the default ``Condition()`` (RLock)."""
+    kind = _LOCK_FACTORIES[callee]
+    if kind == "Condition" and call.args:
+        arg = call.args[0]
+        if isinstance(arg, ast.Call) and \
+                _LOCK_FACTORIES.get(_dotted(arg.func)) == "Lock":
+            return "Condition(Lock)"
+    return kind
+
+
+def _defines_lock(cls: ast.ClassDef) -> bool:
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if _dotted(node.value.func) in _LOCK_FACTORIES:
+                return True
+    return False
+
+
+def _collect_class_details(model: LockModel, path: str, text: str,
+                           tree: ast.AST):
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        cm = model.classes.get(node.name)
+        if cm is None or cm.path != path or cm.node is not node:
+            continue
+        _collect_locks_and_types(model, cm)
+        _collect_guarded_decls(cm, text)
+
+
+def _collect_locks_and_types(model: LockModel, cm: ClassModel):
+    # dataclass-style class-body annotations: `stream: Optional[TokenStream]`
+    for item in cm.node.body:
+        if isinstance(item, ast.AnnAssign) and isinstance(item.target, ast.Name):
+            got = _annotation_class(item.annotation)
+            if got:
+                cm.attr_types.setdefault(item.target.id, _TypeRef(got[0], got[1]))
+    for meth in cm.methods.values():
+        # parameter annotations feed self.attr = param inference
+        params: Dict[str, _TypeRef] = {}
+        args = meth.args
+        for a in list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs):
+            got = _annotation_class(a.annotation)
+            if got:
+                params[a.arg] = _TypeRef(got[0], got[1])
+        for node in ast.walk(meth):
+            if isinstance(node, ast.AnnAssign) and _is_self_attr(node.target):
+                got = _annotation_class(node.annotation)
+                if got:
+                    cm.attr_types.setdefault(
+                        node.target.attr, _TypeRef(got[0], got[1]))
+            if not isinstance(node, ast.Assign):
+                continue
+            tref = _infer_value_type(model, cm, node.value, params)
+            for t in node.targets:
+                if not _is_self_attr(t):
+                    continue
+                if isinstance(node.value, ast.Call):
+                    callee = _dotted(node.value.func)
+                    if callee in _LOCK_FACTORIES:
+                        key = cm.lock_key(t.attr)
+                        cm.locks[t.attr] = LockDecl(
+                            key=key, kind=_lock_kind(node.value, callee),
+                            cls=cm.name, attr=t.attr,
+                            site=Site(cm.path, node.lineno))
+                        continue
+                if tref is not None:
+                    cm.attr_types.setdefault(t.attr, tref)
+
+
+def _infer_value_type(model: LockModel, cm: ClassModel, value: ast.expr,
+                      env: Dict[str, _TypeRef]) -> Optional[_TypeRef]:
+    """Best-effort type of an assigned value (constructor calls, annotated
+    params, list comprehensions of constructors, ``a or Default()``,
+    ``self.x + self.y`` list concat)."""
+    if isinstance(value, ast.Call):
+        callee = _dotted(value.func)
+        if callee:
+            short = callee.split(".")[-1]
+            if short in model.classes:
+                return _TypeRef(short)
+            fn = model.functions.get(short)
+            if fn is not None and fn.returns and fn.returns in model.classes:
+                return _TypeRef(fn.returns)
+    if isinstance(value, ast.Name):
+        return env.get(value.id)
+    if isinstance(value, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+        if isinstance(value.elt, ast.Call):
+            callee = _dotted(value.elt.func)
+            if callee and callee.split(".")[-1] in model.classes:
+                return _TypeRef(callee.split(".")[-1], container=True)
+    if isinstance(value, ast.BoolOp):
+        for v in value.values:
+            got = _infer_value_type(model, cm, v, env)
+            if got:
+                return got
+    if isinstance(value, ast.BinOp) and isinstance(value.op, ast.Add):
+        left = _infer_value_type(model, cm, value.left, env)
+        right = _infer_value_type(model, cm, value.right, env)
+        if left and left.container and right and right.container \
+                and left.cls == right.cls:
+            return left
+    if isinstance(value, ast.Attribute) and _is_self_attr(value):
+        return cm.attr_types.get(value.attr)
+    return None
+
+
+def _collect_guarded_decls(cm: ClassModel, text: str):
+    """``# dstpu: guarded-by[attr, lock]`` comments inside the class body
+    declare the contract explicitly (for attrs whose locked writes live
+    behind helper methods the inference can't see through)."""
+    start = cm.node.lineno
+    end = getattr(cm.node, "end_lineno", start) or start
+    for i, line in enumerate(text.splitlines()[start - 1:end], start):
+        m = _GUARDED_BY_RE.search(line)
+        if m:
+            cm.guarded.setdefault(m.group(1), m.group(2))
+
+
+# ---------------------------------------------------------------------------
+# pass 3: the held-lock walk
+# ---------------------------------------------------------------------------
+class _MethodWalker:
+    """Walks one method/function body tracking the lexically-held lock set,
+    recording acquisitions, resolved calls, blocking calls, and attribute
+    accesses into a :class:`_MethodFacts`."""
+
+    def __init__(self, model: LockModel, cm: Optional[ClassModel],
+                 path: str, func: ast.AST):
+        self.model = model
+        self.cm = cm
+        self.path = path
+        self.func = func
+        self.facts = _MethodFacts(
+            cls=cm.name if cm else None,
+            name=getattr(func, "name", "<lambda>"), path=path)
+        self.env = self._param_env()
+
+    # -- type environment -------------------------------------------------
+    def _param_env(self) -> Dict[str, _TypeRef]:
+        env: Dict[str, _TypeRef] = {}
+        args = getattr(self.func, "args", None)
+        if args is None:
+            return env
+        for a in list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs):
+            got = _annotation_class(a.annotation)
+            if got:
+                env[a.arg] = _TypeRef(got[0], got[1])
+        # flow-insensitive local bindings: two passes so simple chains
+        # (x = self.cores; y = x[0]) resolve
+        for _ in range(2):
+            for node in ast.walk(self.func):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    got2 = self._expr_type(node.value, env)
+                    if got2:
+                        env.setdefault(node.targets[0].id, got2)
+                elif isinstance(node, (ast.For, ast.AsyncFor)) \
+                        and isinstance(node.target, ast.Name):
+                    src = self._expr_type(node.iter, env)
+                    if src and src.container:
+                        env.setdefault(node.target.id, _TypeRef(src.cls))
+                elif isinstance(node, ast.comprehension) \
+                        and isinstance(node.target, ast.Name):
+                    src = self._expr_type(node.iter, env)
+                    if src and src.container:
+                        env.setdefault(node.target.id, _TypeRef(src.cls))
+        return env
+
+    def _expr_type(self, expr: ast.expr,
+                   env: Optional[Dict[str, _TypeRef]] = None) -> Optional[_TypeRef]:
+        env = self.env if env is None else env
+        if isinstance(expr, ast.Name):
+            if expr.id == "self" and self.cm is not None:
+                return _TypeRef(self.cm.name)
+            if expr.id in env:
+                return env[expr.id]
+            stem = _module_stem(self.path)
+            return self.model.module_globals.get(stem, {}).get(expr.id)
+        if isinstance(expr, ast.Attribute):
+            base = self._expr_type(expr.value, env)
+            if base and not base.container:
+                owner = self.model.classes.get(base.cls)
+                if owner:
+                    return owner.attr_types.get(expr.attr)
+            return None
+        if isinstance(expr, ast.Subscript):
+            base = self._expr_type(expr.value, env)
+            if base and base.container:
+                return _TypeRef(base.cls)
+            return None
+        if isinstance(expr, ast.Call):
+            func = expr.func
+            if isinstance(func, ast.Attribute) and func.attr in (
+                    "get", "pop", "popleft", "setdefault"):
+                base = self._expr_type(func.value, env)
+                if base and base.container:
+                    return _TypeRef(base.cls)
+            if isinstance(func, ast.Attribute) and func.attr == "values":
+                base = self._expr_type(func.value, env)
+                if base and base.container:
+                    return base
+            callee = _dotted(func)
+            if callee:
+                short = callee.split(".")[-1]
+                if short in self.model.classes:
+                    return _TypeRef(short)
+                fn = self.model.functions.get(short)
+                if fn is not None and fn.returns \
+                        and fn.returns in self.model.classes:
+                    return _TypeRef(fn.returns)
+            return None
+        if isinstance(expr, ast.BoolOp):
+            for v in expr.values:
+                got = self._expr_type(v, env)
+                if got:
+                    return got
+        return None
+
+    # -- lock resolution ---------------------------------------------------
+    def _lock_key_of(self, expr: ast.expr) -> Optional[str]:
+        """``self._cond`` / ``vcore.step_lock`` / module ``_BUILD_LOCK`` ->
+        the model lock key, or None when the expression is not a known
+        lock."""
+        if isinstance(expr, ast.Attribute):
+            if _is_self_attr(expr) and self.cm is not None:
+                if expr.attr in self.cm.locks:
+                    return self.cm.lock_key(expr.attr)
+                return None
+            base = self._expr_type(expr.value)
+            if base and not base.container:
+                owner = self.model.classes.get(base.cls)
+                if owner and expr.attr in owner.locks:
+                    return owner.lock_key(expr.attr)
+            return None
+        if isinstance(expr, ast.Name):
+            stem = _module_stem(self.path)
+            key = f"{stem}.{expr.id}"
+            if key in self.model.module_locks:
+                return key
+        return None
+
+    # -- the walk -----------------------------------------------------------
+    def walk(self):
+        body = getattr(self.func, "body", [])
+        if isinstance(body, list):
+            self._walk_body(body, ())
+        return self.facts
+
+    def _walk_body(self, body: List[ast.stmt], held: Tuple[str, ...]):
+        for stmt in body:
+            self._walk_stmt(stmt, held)
+
+    def _walk_stmt(self, stmt: ast.stmt, held: Tuple[str, ...]):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            # nested defs run later, not under this lexical lock
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            acquired: List[str] = []
+            for item in stmt.items:
+                expr = item.context_expr
+                # `with self._lock:` and `with lock.acquire_timeout(..)`:
+                key = self._lock_key_of(expr)
+                if key is None and isinstance(expr, ast.Call):
+                    self._scan_expr(expr, held)
+                    continue
+                if key is not None:
+                    self.facts.acquisitions.append(_AcqFact(
+                        lock=key, site=Site(self.path, stmt.lineno),
+                        held=held + tuple(acquired)))
+                    acquired.append(key)
+                else:
+                    self._scan_expr(expr, held)
+            self._walk_body(stmt.body, held + tuple(acquired))
+            return
+        for expr in _stmt_exprs(stmt):
+            self._scan_expr(expr, held)
+        self._record_writes(stmt, held)
+        for body in _sub_bodies(stmt):
+            self._walk_body(body, held)
+
+    def _record_writes(self, stmt: ast.stmt, held: Tuple[str, ...]):
+        targets: List[Tuple[ast.expr, str]] = []
+        if isinstance(stmt, ast.Assign):
+            targets = [(t, "assign") for t in stmt.targets]
+        elif isinstance(stmt, ast.AugAssign):
+            targets = [(stmt.target, "augassign")]
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets = [(stmt.target, "assign")]
+        for t, kind in targets:
+            if isinstance(t, ast.Tuple):
+                for elt in t.elts:
+                    targets.append((elt, kind))
+                continue
+            if _is_self_attr(t):
+                self.facts.writes.append(_AccessFact(
+                    attr=t.attr, site=Site(self.path, stmt.lineno),
+                    held=held, kind=kind))
+            elif isinstance(t, ast.Subscript) and _is_self_attr(t.value):
+                self.facts.writes.append(_AccessFact(
+                    attr=t.value.attr, site=Site(self.path, stmt.lineno),
+                    held=held, kind="subscript"))
+
+    def _scan_expr(self, expr: ast.expr, held: Tuple[str, ...]):
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Lambda):
+                continue  # deferred execution
+            if isinstance(node, ast.Call):
+                self._scan_call(node, held)
+            elif isinstance(node, ast.Attribute) and _is_self_attr(node) \
+                    and isinstance(node.ctx, ast.Load):
+                self.facts.reads.append(_AccessFact(
+                    attr=node.attr, site=Site(self.path, node.lineno),
+                    held=held, kind="read"))
+
+    def _scan_call(self, call: ast.Call, held: Tuple[str, ...]):
+        func = call.func
+        dotted = _dotted(func)
+
+        # explicit acquire()/release() on a known lock
+        if isinstance(func, ast.Attribute) and func.attr == "acquire":
+            key = self._lock_key_of(func.value)
+            if key is not None:
+                self.facts.acquisitions.append(_AcqFact(
+                    lock=key, site=Site(self.path, call.lineno), held=held,
+                    timeout=_call_timeout_bounded(call)))
+                return
+
+        # in-place mutator on a self attribute: self.q.append(x)
+        if isinstance(func, ast.Attribute) and func.attr in _MUTATORS \
+                and _is_self_attr(func.value):
+            self.facts.writes.append(_AccessFact(
+                attr=func.value.attr, site=Site(self.path, call.lineno),
+                held=held, kind="mutator"))
+
+        if held:
+            self._scan_blocking(call, func, dotted, held)
+
+        # resolved calls for the interprocedural graph
+        if isinstance(func, ast.Attribute):
+            recv = func.value
+            if isinstance(recv, ast.Name) and recv.id == "self" \
+                    and self.cm is not None:
+                if func.attr in self.cm.methods:
+                    self.facts.calls.append(_CallFact(
+                        callee=(self.cm.name, func.attr),
+                        site=Site(self.path, call.lineno), held=held,
+                        is_self_call=True, recv="self"))
+                return
+            base = self._expr_type(recv)
+            if base and not base.container and base.cls in self.model.classes:
+                owner = self.model.classes[base.cls]
+                if func.attr in owner.methods:
+                    self.facts.calls.append(_CallFact(
+                        callee=(base.cls, func.attr),
+                        site=Site(self.path, call.lineno), held=held,
+                        is_self_call=False,
+                        recv=_dotted(recv) or base.cls.lower()))
+            return
+        if isinstance(func, ast.Name) and func.id in self.model.functions:
+            self.facts.calls.append(_CallFact(
+                callee=(None, func.id), site=Site(self.path, call.lineno),
+                held=held, is_self_call=False, recv=""))
+
+    def _scan_blocking(self, call: ast.Call, func: ast.expr,
+                       dotted: Optional[str], held: Tuple[str, ...]):
+        if dotted in _BLOCKING_CALLS:
+            self.facts.blocking.append(_BlockFact(
+                site=Site(self.path, call.lineno), held=held,
+                desc=f"{dotted}()", reason=_BLOCKING_CALLS[dotted]))
+            return
+        if not isinstance(func, ast.Attribute):
+            return
+        name = func.attr
+        if name not in _BLOCKING_METHODS:
+            return
+        if name == "block_until_ready":
+            self.facts.blocking.append(_BlockFact(
+                site=Site(self.path, call.lineno), held=held,
+                desc=".block_until_ready()",
+                reason=_BLOCKING_METHODS[name]))
+            return
+        # wait/wait_for on a lock we hold RELEASES it — that is the
+        # condition-variable protocol, not a blocking hazard
+        key = self._lock_key_of(func.value)
+        if name in ("wait", "wait_for") and key is not None and key in held:
+            return
+        if _call_timeout_bounded(call):
+            return
+        recv_name = None
+        if isinstance(func.value, ast.Attribute):
+            recv_name = func.value.attr
+        elif isinstance(func.value, ast.Name):
+            recv_name = func.value.id
+        if name in ("get", "join", "wait", "wait_for"):
+            # only synchronization-looking receivers; dict.get(k) is fine
+            if recv_name is None or not _BLOCKING_RECV_HINTS.search(recv_name):
+                return
+        rendered = _dotted(func) or f"<expr>.{name}"
+        self.facts.blocking.append(_BlockFact(
+            site=Site(self.path, call.lineno), held=held,
+            desc=f"{rendered}()", reason=_BLOCKING_METHODS[name]))
+
+
+def _collect_method_facts(model: LockModel, path: str, tree: ast.AST):
+    if not isinstance(tree, ast.Module):
+        return
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            facts = _MethodWalker(model, None, path, node).walk()
+            model.method_facts.setdefault((None, node.name), facts)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        cm = model.classes.get(node.name)
+        if cm is None or cm.node is not node:
+            continue
+        for meth in cm.methods.values():
+            facts = _MethodWalker(model, cm, path, meth).walk()
+            model.method_facts[(cm.name, facts.name)] = facts
+
+
+def _infer_guarded(model: LockModel):
+    """Attributes written under an own-class lock in any non-``__init__``
+    method become guarded class-wide (the lock attrs themselves are
+    excluded)."""
+    for cm in model.classes.values():
+        if not cm.locks:
+            cm.guarded.clear()  # guarded-by decls need a lock to mean anything
+            continue
+        own_keys = {cm.lock_key(a): a for a in cm.locks}
+        for (cls, mname), facts in model.method_facts.items():
+            if cls != cm.name or mname == "__init__":
+                continue
+            for w in facts.writes:
+                if w.attr in cm.locks or w.attr in cm.guarded:
+                    continue
+                for key in w.held:
+                    if key in own_keys:
+                        cm.guarded[w.attr] = own_keys[key]
+                        break
+        # declared guards must reference a real lock attr
+        for attr in list(cm.guarded):
+            if cm.guarded[attr] not in cm.locks:
+                del cm.guarded[attr]
+
+
+def _derive_edges(model: LockModel):
+    for (cls, mname), facts in model.method_facts.items():
+        for acq in facts.acquisitions:
+            if acq.lock in acq.held:
+                decl = model.lock_decl(acq.lock)
+                entry = (acq.lock, acq.site, "direct re-acquisition")
+                model.reentrant_acquires.append(entry)
+                if decl is not None and not decl.reentrant:
+                    model.reentrant_hazards.append(entry)
+                continue
+            for h in acq.held:
+                model.add_edge(h, acq.lock, acq.site)
+        for call in facts.calls:
+            if not call.held:
+                continue
+            inner = model.may_acquire(call.callee)
+            for lock in inner:
+                if lock in call.held:
+                    decl = model.lock_decl(lock)
+                    via = (f"call to "
+                           f"{call.callee[0] or call.callee[1]}"
+                           f"{'.' + call.callee[1] if call.callee[0] else ''}"
+                           f"() which acquires it")
+                    entry = (lock, call.site, via)
+                    model.reentrant_acquires.append(entry)
+                    if decl is not None and not decl.reentrant:
+                        model.reentrant_hazards.append(entry)
+                    continue
+                for h in call.held:
+                    model.add_edge(h, lock, call.site)

@@ -1,0 +1,284 @@
+"""Runtime lock-order witness — the dynamic half of the lock-discipline
+pass.
+
+The static model (``analysis/locks.py``) proves the *declared* order is
+acyclic; this module observes the order threads *actually* acquire locks
+in and checks the two agree. ``WitnessLock``/``WitnessCondition`` wrap
+the real primitives, recording per-thread acquisition stacks into a
+process-global order graph:
+
+* every acquisition of ``B`` while the thread holds ``A`` adds the edge
+  ``A -> B`` (reentrant re-acquisition of the same lock adds nothing);
+* the moment both ``A -> B`` and ``B -> A`` have been observed the graph
+  has an inversion — a real interleaving away from deadlock. In
+  ``raise_on_inversion`` mode the acquiring thread gets a
+  :class:`LockOrderViolation` on the spot (tests); otherwise the
+  inversion is recorded for the post-run assertion (verify runs, where
+  raising inside a router worker would wedge the scenario under test);
+* :meth:`WitnessState.assert_subgraph` checks every observed edge embeds
+  in the static model's transitive closure — the runtime scenario never
+  exercised an ordering the static contract does not declare.
+
+``witness_locks()`` is the drop-in: a context manager that wraps the
+serving classes' ``__init__`` so every ``threading.Lock`` / ``RLock`` /
+``Condition`` attribute created at construction is replaced with its
+witness wrapper, named ``ClassName.attr`` to match the static model's
+lock keys. Instances built *inside* the context are witnessed; existing
+instances can be added with :func:`wrap_instance`.
+"""
+
+import threading
+from contextlib import contextmanager
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+__all__ = [
+    "LockOrderViolation",
+    "WitnessCondition",
+    "WitnessLock",
+    "WitnessState",
+    "witness_locks",
+    "wrap_instance",
+]
+
+_LOCK_TYPE = type(threading.Lock())
+_RLOCK_TYPE = type(threading.RLock())
+
+
+class LockOrderViolation(RuntimeError):
+    """Two locks were observed acquired in both orders."""
+
+
+class WitnessState:
+    """Process-global observation state shared by every witness wrapper.
+
+    Thread-safe; the held-stack is thread-local, the order graph is
+    guarded by an internal mutex (which is never held while user code
+    runs, so the witness itself cannot deadlock the program under test).
+    """
+
+    def __init__(self, raise_on_inversion: bool = True):
+        self.raise_on_inversion = raise_on_inversion
+        self._mu = threading.Lock()
+        #: observed (held, acquired) -> acquisition count
+        self.edges: Dict[Tuple[str, str], int] = {}
+        #: inversions seen: (a, b) with both (a, b) and (b, a) observed
+        self.inversions: List[Tuple[str, str]] = []
+        self._tls = threading.local()
+
+    # -- per-thread stack ---------------------------------------------------
+    def _stack(self) -> List[str]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def held(self) -> Tuple[str, ...]:
+        """The current thread's held-lock names, outermost first."""
+        return tuple(self._stack())
+
+    # -- recording ----------------------------------------------------------
+    def on_acquired(self, name: str) -> None:
+        """Called by a wrapper AFTER its real acquire succeeded."""
+        stack = self._stack()
+        outer = [n for n in stack if n != name]
+        reentrant = name in stack
+        stack.append(name)
+        if reentrant or not outer:
+            return
+        inverted = None
+        with self._mu:
+            for h in dict.fromkeys(outer):  # dedupe, keep order
+                self.edges[(h, name)] = self.edges.get((h, name), 0) + 1
+                if (name, h) in self.edges:
+                    pair = (name, h)
+                    if pair not in self.inversions:
+                        self.inversions.append(pair)
+                    inverted = h
+        if inverted is not None and self.raise_on_inversion:
+            raise LockOrderViolation(
+                f"lock-order inversion: acquired {name} while holding "
+                f"{inverted}, but {inverted} has also been acquired while "
+                f"holding {name}")
+
+    def on_released(self, name: str) -> None:
+        stack = self._stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == name:
+                del stack[i]
+                return
+
+    # -- results ------------------------------------------------------------
+    def graph(self) -> Dict[Tuple[str, str], int]:
+        with self._mu:
+            return dict(self.edges)
+
+    def assert_no_inversion(self) -> None:
+        with self._mu:
+            inversions = list(self.inversions)
+        if inversions:
+            rendered = ", ".join(f"{a} <-> {b}" for a, b in inversions)
+            raise LockOrderViolation(
+                f"observed lock-order inversion(s): {rendered}")
+
+    def assert_subgraph(self, static_edges: Iterable[Tuple[str, str]],
+                        ignore: Iterable[str] = ()) -> None:
+        """Every observed edge must lie in ``static_edges`` (pass the
+        static model's ``edge_closure() | set(order_edges)``). Edges
+        touching a lock named in ``ignore`` are skipped (locks the static
+        model deliberately does not track, e.g. test doubles)."""
+        self.assert_no_inversion()
+        static = set(static_edges)
+        skip = set(ignore)
+        missing = sorted(
+            (a, b) for (a, b) in self.graph()
+            if (a, b) not in static and a not in skip and b not in skip)
+        if missing:
+            rendered = ", ".join(f"{a} -> {b}" for a, b in missing)
+            raise LockOrderViolation(
+                f"observed acquisition order not declared by the static "
+                f"lock model: {rendered}; either the model's inference "
+                f"misses the call path (annotate it) or the code violates "
+                f"the documented hierarchy (docs/ANALYSIS.md)")
+
+
+class WitnessLock:
+    """Drop-in wrapper for ``Lock``/``RLock`` reporting to a
+    :class:`WitnessState` under a stable name (``ClassName.attr``)."""
+
+    def __init__(self, inner, name: str, state: WitnessState):
+        self._inner = inner
+        self.name = name
+        self._state = state
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._state.on_acquired(self.name)
+        return got
+
+    def release(self) -> None:
+        self._state.on_released(self.name)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<WitnessLock {self.name} of {self._inner!r}>"
+
+
+class WitnessCondition(WitnessLock):
+    """Witness wrapper for ``threading.Condition``. ``wait``/``wait_for``
+    release the lock for their duration, so the held-stack drops the name
+    across the wait and re-enters on wakeup (re-adding edges against any
+    locks still held — correctly: waking up re-acquires)."""
+
+    def wait(self, timeout: Optional[float] = None):
+        self._state.on_released(self.name)
+        try:
+            return self._inner.wait(timeout)
+        finally:
+            self._state.on_acquired(self.name)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        self._state.on_released(self.name)
+        try:
+            return self._inner.wait_for(predicate, timeout)
+        finally:
+            self._state.on_acquired(self.name)
+
+    def notify(self, n: int = 1) -> None:
+        self._inner.notify(n)
+
+    def notify_all(self) -> None:
+        self._inner.notify_all()
+
+    def __repr__(self):
+        return f"<WitnessCondition {self.name} of {self._inner!r}>"
+
+
+def wrap_instance(obj, state: WitnessState,
+                  cls_name: Optional[str] = None) -> List[str]:
+    """Replace every lock/condition attribute of ``obj`` with its witness
+    wrapper (idempotent); returns the wrapped lock names."""
+    name = cls_name or type(obj).__name__
+    wrapped = []
+    for attr, val in list(vars(obj).items()):
+        key = f"{name}.{attr}"
+        if isinstance(val, threading.Condition):
+            setattr(obj, attr, WitnessCondition(val, key, state))
+        elif isinstance(val, (_LOCK_TYPE, _RLOCK_TYPE)):
+            setattr(obj, attr, WitnessLock(val, key, state))
+        else:
+            continue
+        wrapped.append(key)
+    return wrapped
+
+
+def _default_classes() -> List[type]:
+    """The serving control plane's lock-owning classes (mirrors the static
+    model's registry over ``deepspeed_tpu/serving`` + observability)."""
+    from deepspeed_tpu.observability.events import EventLog
+    from deepspeed_tpu.observability.tracing import SpanTracer
+    from deepspeed_tpu.serving.cluster.core import EngineCore
+    from deepspeed_tpu.serving.cluster.router import Router
+    from deepspeed_tpu.serving.driver import ServingDriver
+    from deepspeed_tpu.serving.elastic.spares import WarmSparePool
+    from deepspeed_tpu.serving.metrics import ServingMetrics
+    from deepspeed_tpu.serving.net.endpoint import KVEndpoint
+    from deepspeed_tpu.serving.net.flow import CreditWindow
+    from deepspeed_tpu.serving.resilience.faults import FaultInjector
+    from deepspeed_tpu.serving.resilience.health import ReplicaHealth
+    from deepspeed_tpu.serving.streaming import TokenStream
+
+    return [Router, EngineCore, ServingDriver, TokenStream, CreditWindow,
+            KVEndpoint, ServingMetrics, ReplicaHealth, FaultInjector,
+            WarmSparePool, SpanTracer, EventLog]
+
+
+@contextmanager
+def witness_locks(classes: Optional[Iterable[type]] = None,
+                  raise_on_inversion: bool = False,
+                  state: Optional[WitnessState] = None):
+    """Monkeypatch ``__init__`` of ``classes`` (default: the serving
+    control plane) so instances constructed inside the context get their
+    lock attributes replaced with witness wrappers. Yields the
+    :class:`WitnessState`; restores the classes on exit.
+
+    Default is record-only (``raise_on_inversion=False``): an inversion
+    raised inside a router worker thread would wedge the scenario under
+    test — call :meth:`WitnessState.assert_subgraph` (or
+    ``assert_no_inversion``) after the run instead. Pass
+    ``raise_on_inversion=True`` in unit tests that drive the locks
+    directly and want the raise at the faulty acquisition site.
+    """
+    st = state if state is not None else WitnessState(raise_on_inversion)
+    cls_list = list(classes) if classes is not None else _default_classes()
+    originals: Dict[type, object] = {}
+
+    def _make_init(cls, orig):
+        def __init__(self, *args, **kwargs):
+            orig(self, *args, **kwargs)
+            # named after the declaring class so keys match the static
+            # model even for subclass instances; wrap_instance is
+            # idempotent, so chained wrapped __init__s are safe
+            wrap_instance(self, st, cls.__name__)
+        __init__._witness_wrapped = True  # marker for debugging
+        return __init__
+
+    for cls in cls_list:
+        originals[cls] = cls.__init__
+        cls.__init__ = _make_init(cls, originals[cls])
+    try:
+        yield st
+    finally:
+        for cls, orig in originals.items():
+            cls.__init__ = orig

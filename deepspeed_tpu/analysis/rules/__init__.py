@@ -11,6 +11,7 @@ from deepspeed_tpu.analysis.rules import (  # noqa: F401
     host_sync,
     jit_purity,
     kv_host_bounce,
+    lock_discipline,
     raw_collective,
     shard_specs,
     swallowed_errors,

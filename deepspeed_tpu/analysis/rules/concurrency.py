@@ -11,19 +11,18 @@ re-check loop is the canonical correct shape.)
 another method WITHOUT the lock is a race. Methods named ``*_locked`` are
 exempt by convention (they document being called with the lock held), as
 is ``__init__`` (no concurrent access before construction completes).
+Backed by the whole-tree lock model (``analysis/locks.py``), so augmented
+assignment (``self.n += 1``), subscript stores (``self.d[k] = v``) and
+in-place mutator calls (``self.q.append(x)``) all count as writes, and
+``# dstpu: guarded-by[attr, lock]`` declarations are honored.
 """
 
 import ast
 import re
 
 from deepspeed_tpu.analysis.framework import Rule, register
-from deepspeed_tpu.analysis.rules._common import dotted_name
 
 _COND_NAME = re.compile(r"(cond|condition|cv)$", re.IGNORECASE)
-_LOCK_FACTORIES = {
-    "threading.Lock", "threading.RLock", "threading.Condition",
-    "Lock", "RLock", "Condition",
-}
 
 
 def _receiver_name(func: ast.AST):
@@ -82,92 +81,44 @@ class CondWaitNoPredicateRule(Rule):
         return findings
 
 
+_WRITE_VERB = {
+    "assign": "written",
+    "augassign": "updated in place",
+    "subscript": "mutated by subscript store",
+    "mutator": "mutated in place",
+}
+
+
 @register
 class UnlockedSharedMutationRule(Rule):
     name = "unlocked-shared-mutation"
     severity = "warning"
     description = (
         "attribute written under `with self.<lock>:` elsewhere in the class "
-        "is mutated here without the lock"
+        "is mutated here without the lock (plain/augmented assignment, "
+        "subscript store, or in-place mutator call)"
     )
 
     def check(self, ctx):
+        model = ctx.lock_model
         findings = []
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, ast.ClassDef):
-                findings.extend(self._check_class(ctx, node))
+        for cm in model.classes.values():
+            if cm.path != ctx.path or not cm.locks:
+                continue
+            for (cls, mname), facts in model.method_facts.items():
+                if cls != cm.name:
+                    continue
+                if mname == "__init__" or mname.endswith("_locked"):
+                    continue
+                for w in facts.writes:
+                    guard = cm.guarded.get(w.attr)
+                    if guard is None or cm.lock_key(guard) in w.held:
+                        continue
+                    verb = _WRITE_VERB.get(w.kind, "written")
+                    findings.append(ctx.finding(
+                        self, w.site.line,
+                        f"self.{w.attr} is written under the lock elsewhere "
+                        f"in {cm.name} but {verb} here without it; move "
+                        f"this write under `with self.{guard}:` (or rename "
+                        f"the method *_locked if the caller holds it)"))
         return findings
-
-    # -- per class ------------------------------------------------------
-    def _check_class(self, ctx, cls: ast.ClassDef):
-        methods = [n for n in cls.body
-                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
-        lock_attrs = set()
-        for m in methods:
-            for node in ast.walk(m):
-                if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
-                    if dotted_name(node.value.func) in _LOCK_FACTORIES:
-                        for t in node.targets:
-                            if self._self_attr(t):
-                                lock_attrs.add(t.attr)
-        if not lock_attrs:
-            return []
-
-        writes = []  # (method, attr, node, under_lock)
-        for m in methods:
-            self._collect_writes(m, m.body, lock_attrs, under=False, out=writes)
-
-        guarded = {attr for (_m, attr, _n, locked) in writes if locked}
-        guarded -= lock_attrs
-        out = []
-        for m, attr, node, locked in writes:
-            if locked or attr not in guarded:
-                continue
-            if m.name == "__init__" or m.name.endswith("_locked"):
-                continue
-            out.append(ctx.finding(
-                self, node,
-                f"self.{attr} is written under the lock elsewhere in "
-                f"{cls.name} but mutated here without it; move this write "
-                f"under `with self.{sorted(lock_attrs)[0]}:` (or rename the "
-                f"method *_locked if the caller holds it)"))
-        return out
-
-    @staticmethod
-    def _self_attr(node):
-        return (isinstance(node, ast.Attribute)
-                and isinstance(node.value, ast.Name) and node.value.id == "self")
-
-    def _collect_writes(self, method, body, lock_attrs, under, out):
-        for node in body:
-            locked_here = under
-            if isinstance(node, ast.With):
-                held = any(
-                    self._self_attr(item.context_expr) and item.context_expr.attr in lock_attrs
-                    for item in node.items
-                )
-                self._collect_writes(method, node.body, lock_attrs,
-                                     under or held, out)
-                continue
-            if isinstance(node, ast.Assign):
-                for t in node.targets:
-                    if self._self_attr(t):
-                        out.append((method, t.attr, node, locked_here))
-            elif isinstance(node, ast.AugAssign) and self._self_attr(node.target):
-                out.append((method, node.target.attr, node, locked_here))
-            # recurse into compound statements, but not nested defs
-            for child_body in _sub_bodies(node):
-                self._collect_writes(method, child_body, lock_attrs, locked_here, out)
-
-
-def _sub_bodies(node):
-    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)):
-        return []
-    bodies = []
-    for field in ("body", "orelse", "finalbody"):
-        b = getattr(node, field, None)
-        if b:
-            bodies.append(b)
-    for h in getattr(node, "handlers", []) or []:
-        bodies.append(h.body)
-    return bodies

@@ -1,0 +1,189 @@
+"""Lock-discipline rules, backed by the whole-tree lock model.
+
+These four rules consume ``ctx.lock_model`` (``analysis/locks.py``) —
+built once per lint run from every parsed file — so they can reason
+about lock *ordering* across classes and modules, which no per-file
+pass can:
+
+``lock-order-inversion``: a cycle in the cross-module lock-acquisition
+graph (thread 1 takes A then B, thread 2 takes B then A) is a potential
+deadlock the moment both paths run concurrently. The repo's documented
+order is ``EngineCore.step_lock -> Router._cond -> leaf locks``
+(docs/ANALYSIS.md "Lock discipline"); this rule proves no code path
+closes a cycle against it.
+
+``blocking-call-under-lock``: unbounded blocking (socket accept/recv,
+``queue.get()``/``join()``/``wait()`` without timeout, ``time.sleep``,
+``block_until_ready``, subprocess spawns) while holding a lock wedges
+every thread that needs that lock — the exact shape the serving hang
+watchdog (``EngineCore.probe``) exists to recover from at runtime.
+``Condition.wait`` on the *held* condition is exempt (the wait releases
+it: that is the CV protocol).
+
+``locked-call-to-locking-method``: calling a non-``*_locked`` method
+that (transitively) acquires a non-reentrant lock the caller already
+holds is a guaranteed self-deadlock. Fix: convert the lock to an
+``RLock`` with a comment, or split the callee into a ``*_locked``
+helper the lock-holding path calls directly.
+
+``guarded-read-unlocked``: an attribute the model proves is guarded
+(written under the class's lock somewhere, or declared via
+``# dstpu: guarded-by[attr, lock]``) read outside the lock in a
+non-``*_locked`` method sees torn/stale state. Deliberate lock-free
+reads (watchdog probes of a possibly-wedged peer) carry a reasoned
+``# dstpu: noqa[guarded-read-unlocked]``.
+"""
+
+from deepspeed_tpu.analysis.framework import Rule, register
+
+
+def _held_str(held) -> str:
+    return ", ".join(held)
+
+
+@register
+class LockOrderInversionRule(Rule):
+    name = "lock-order-inversion"
+    severity = "error"
+    description = (
+        "cycle in the cross-module lock-acquisition graph: two code paths "
+        "acquire the same locks in opposite orders (potential deadlock)"
+    )
+
+    def check(self, ctx):
+        model = ctx.lock_model
+        findings = []
+        for cycle in model.cycles():
+            rendered = " -> ".join(cycle + [cycle[0]])
+            edges = list(zip(cycle, cycle[1:] + [cycle[0]]))
+            for a, b in edges:
+                for site in model.order_edges.get((a, b), ()):
+                    if site.path != ctx.path:
+                        continue
+                    findings.append(ctx.finding(
+                        self, site.line,
+                        f"acquiring {b} while holding {a} closes the lock "
+                        f"cycle {rendered}; another path takes these locks "
+                        f"in the opposite order — pick one global order "
+                        f"(docs/ANALYSIS.md) and restructure this path"))
+        return findings
+
+
+@register
+class BlockingCallUnderLockRule(Rule):
+    name = "blocking-call-under-lock"
+    severity = "warning"
+    description = (
+        "unbounded blocking call (socket recv/accept, queue.get/join/wait "
+        "without timeout, time.sleep, block_until_ready, subprocess) while "
+        "holding a lock wedges every thread needing that lock"
+    )
+
+    def check(self, ctx):
+        model = ctx.lock_model
+        findings = []
+        for facts in model.method_facts.values():
+            if facts.path != ctx.path:
+                continue
+            for b in facts.blocking:
+                findings.append(ctx.finding(
+                    self, b.site.line,
+                    f"{b.desc} {b.reason} while holding "
+                    f"{_held_str(b.held)}; move the blocking call outside "
+                    f"the lock or bound it with a timeout"))
+        return findings
+
+
+@register
+class LockedCallToLockingMethodRule(Rule):
+    name = "locked-call-to-locking-method"
+    severity = "error"
+    description = (
+        "self-call to a non-*_locked method that re-acquires a held "
+        "non-reentrant lock: guaranteed self-deadlock"
+    )
+
+    def check(self, ctx):
+        model = ctx.lock_model
+        findings = []
+        for facts in model.method_facts.values():
+            if facts.path != ctx.path or facts.cls is None:
+                continue
+            cm = model.classes.get(facts.cls)
+            if cm is None:
+                continue
+            # direct nested re-acquisition of an own non-reentrant lock:
+            # `with self._lock:` inside a block already holding it
+            for acq in facts.acquisitions:
+                decl = model.lock_decl(acq.lock)
+                if (acq.lock in acq.held and decl is not None
+                        and decl.cls == facts.cls and not decl.reentrant):
+                    findings.append(ctx.finding(
+                        self, acq.site.line,
+                        f"re-acquiring non-reentrant {acq.lock} already "
+                        f"held on this path: self-deadlock; convert to "
+                        f"RLock or drop the inner acquisition"))
+            # self-calls whose callee (transitively) takes a held lock
+            for call in facts.calls:
+                if not call.is_self_call or not call.held:
+                    continue
+                _, callee_name = call.callee
+                if callee_name.endswith("_locked"):
+                    continue
+                for lock in sorted(model.may_acquire(call.callee)):
+                    decl = model.lock_decl(lock)
+                    if (lock in call.held and decl is not None
+                            and not decl.reentrant):
+                        findings.append(ctx.finding(
+                            self, call.site.line,
+                            f"self.{callee_name}() acquires non-reentrant "
+                            f"{lock} which this path already holds: "
+                            f"self-deadlock; call a *_locked variant or "
+                            f"convert the lock to RLock with a comment"))
+        return findings
+
+
+@register
+class GuardedReadUnlockedRule(Rule):
+    name = "guarded-read-unlocked"
+    severity = "warning"
+    description = (
+        "read of a lock-guarded attribute outside the lock in a "
+        "non-*_locked method: torn/stale state under concurrency"
+    )
+
+    def check(self, ctx):
+        model = ctx.lock_model
+        findings = []
+        for facts in model.method_facts.values():
+            if facts.path != ctx.path or facts.cls is None:
+                continue
+            if facts.name == "__init__" or facts.locked_contract:
+                continue
+            cm = model.classes.get(facts.cls)
+            if cm is None:
+                continue
+            # a read that is itself a flagged write site (e.g. the receiver
+            # of self.q.append) is unlocked-shared-mutation's finding, not
+            # a second one here
+            write_sites = {(w.attr, w.site.line) for w in facts.writes}
+            seen = set()
+            for r in facts.reads:
+                guard = cm.guarded.get(r.attr)
+                if guard is None:
+                    continue
+                key = cm.lock_key(guard)
+                if key in r.held:
+                    continue
+                if (r.attr, r.site.line) in write_sites:
+                    continue
+                if (r.attr, r.site.line) in seen:
+                    continue
+                seen.add((r.attr, r.site.line))
+                findings.append(ctx.finding(
+                    self, r.site.line,
+                    f"self.{r.attr} is guarded by self.{guard} "
+                    f"(written under it elsewhere in {facts.cls}) but read "
+                    f"here without the lock; take `with self.{guard}:` or "
+                    f"rename the method *_locked if the caller holds it"))
+        return findings

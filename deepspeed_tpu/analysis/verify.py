@@ -44,6 +44,7 @@ __all__ = [
     "verify_elastic",
     "verify_engine_v2",
     "verify_host_tier",
+    "verify_lock_order",
     "verify_quantized_comm",
     "verify_ring_train",
     "verify_splash",
@@ -1064,6 +1065,122 @@ def verify_elastic() -> List[CheckResult]:
     return results
 
 
+def verify_lock_order() -> List[CheckResult]:
+    """Lock discipline, both halves (see ``analysis/locks.py`` +
+    ``analysis/lockwitness.py``): the static whole-tree acquisition graph
+    must be acyclic with no reentrancy hazards, and the chaos smoke
+    scenario — the nastiest concurrent path the repo has (worker kill
+    mid-stream, faulted handoff import, faulted peer pull, recovery +
+    probation on a 2-replica router) — run under the runtime witness must
+    observe no inversion and no acquisition order the static model does
+    not declare. A subgraph failure means either the model's inference
+    misses a call path (annotate it) or the code broke the documented
+    hierarchy (docs/ANALYSIS.md)."""
+    import os
+    import sys
+
+    from deepspeed_tpu.analysis import locks
+    from deepspeed_tpu.analysis.lockwitness import (
+        LockOrderViolation,
+        witness_locks,
+    )
+
+    results: List[CheckResult] = []
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    model = locks.build_model_from_paths([pkg_dir])
+
+    cycles = model.cycles()
+    hazards = model.reentrant_hazards
+    static_ok = not cycles and not hazards
+    detail = (f"{len(model.order_edges)} acquisition edge(s), acyclic, "
+              f"no reentrancy hazards")
+    if cycles:
+        detail = "cycle(s): " + "; ".join(
+            " -> ".join(c + [c[0]]) for c in cycles)
+    elif hazards:
+        detail = "reentrancy hazard(s): " + "; ".join(
+            f"{key} at {site.path}:{site.line} ({why})"
+            for key, site, why in hazards)
+    results.append(CheckResult("lock_model.static", "lock-order",
+                               static_ok, detail))
+
+    # the runtime half replays the chaos gate's scenario; it needs the test
+    # fixtures importable (repo root on sys.path — true under run_smoke.sh
+    # and pytest, restored if this runs from an installed copy)
+    repo_root = os.path.dirname(pkg_dir)
+    added = repo_root not in sys.path
+    if added:
+        sys.path.insert(0, repo_root)
+    try:
+        import numpy as np
+
+        from deepspeed_tpu.serving import Router, SamplingParams
+        from deepspeed_tpu.serving.resilience import (
+            FaultSpec,
+            ResilienceConfig,
+            inject,
+        )
+        from tests.unit.test_serving import FakeEngine, _expected_tokens
+    except ImportError as e:
+        results.append(CheckResult(
+            "lock_witness.chaos_smoke", "lock-order", True,
+            f"test fixtures unavailable ({e}); witness run skipped"))
+        return results
+    finally:
+        if added:
+            sys.path.remove(repo_root)
+
+    prompts = [np.arange(1 + 10 * i, 6 + 10 * i, dtype=np.int32)
+               for i in range(6)]
+    want = [_expected_tokens(p, 20) for p in prompts]
+    schedule = (
+        FaultSpec("worker.crash", nth=10, replica="d0"),
+        FaultSpec("handoff.import", nth=2),
+        FaultSpec("peer_pull", nth=1),
+    )
+    cfg = ResilienceConfig(hung_step_s=2.0, probe_backoff_s=0.05,
+                           retry_backoff_s=0.001)
+    with witness_locks() as wit:  # record-only: assert after the run
+        with inject(*schedule):
+            router = Router(
+                engines=[FakeEngine(step_delay=0.001) for _ in range(2)],
+                num_prefill_workers=0, resilience=cfg).start()
+            try:
+                reqs = [router.submit(p, params=SamplingParams(
+                            max_new_tokens=20, ignore_eos=True))
+                        for p in prompts]
+                for r in reqs:
+                    if not r.wait(60):
+                        results.append(CheckResult(
+                            "lock_witness.chaos_smoke", "lock-order", False,
+                            f"scenario wedged: uid={r.uid} never finished "
+                            f"({r.state})"))
+                        return results
+                for r, w in zip(reqs, want):
+                    if list(r.generated) != w:
+                        results.append(CheckResult(
+                            "lock_witness.chaos_smoke", "lock-order", False,
+                            f"recovery diverged for uid={r.uid} — witness "
+                            "run is not the scenario it claims to cover"))
+                        return results
+            finally:
+                router.shutdown()
+
+    observed = wit.graph()
+    static_edges = model.edge_closure() | set(model.order_edges)
+    try:
+        wit.assert_subgraph(static_edges)
+        results.append(CheckResult(
+            "lock_witness.chaos_smoke", "lock-order", True,
+            f"{len(observed)} observed edge(s) across "
+            f"{sum(observed.values())} nested acquisition(s), no inversion, "
+            f"all within the static model"))
+    except LockOrderViolation as e:
+        results.append(CheckResult(
+            "lock_witness.chaos_smoke", "lock-order", False, str(e)))
+    return results
+
+
 # ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
@@ -1130,6 +1247,7 @@ def run_verify(verbose: bool = True) -> Tuple[List[CheckResult], bool]:
         (verify_kv_transport, "kv_transport"),
         (verify_elastic, "elastic"),
         (verify_splash, "splash"),
+        (verify_lock_order, "lock_order"),
     ):
         try:
             results.extend(fn())

@@ -18,7 +18,7 @@ class PlacementPolicy:
     name = "base"
 
     def admissible(self, core: EngineCore, req, router) -> bool:
-        reserved_blocks, reserved_seqs = router.reserved_for(core)
+        reserved_blocks, reserved_seqs = router.reserved_for_locked(core)
         return core.admissible(
             req, reserved_blocks=reserved_blocks, reserved_seqs=reserved_seqs
         )
@@ -62,7 +62,7 @@ class SLOPlacement(PlacementPolicy):
         for core in cores:
             if not self.admissible(core, req, router):
                 continue
-            reserved_blocks, reserved_seqs = router.reserved_for(core)
+            reserved_blocks, reserved_seqs = router.reserved_for_locked(core)
             free = core.free_blocks() - reserved_blocks
             total = max(1, core.kv_total)
             headroom = (free - core.blocks_needed(req)) / total
@@ -112,7 +112,7 @@ class LeastLoadedPlacement(PlacementPolicy):
         for core in cores:
             if not self.admissible(core, req, router):
                 continue
-            reserved_blocks, reserved_seqs = router.reserved_for(core)
+            reserved_blocks, reserved_seqs = router.reserved_for_locked(core)
             key = (len(core.requests) + reserved_seqs,
                    -(core.free_blocks() - reserved_blocks))
             if best_key is None or key < best_key:

@@ -234,16 +234,19 @@ class Router:
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "Router":
-        if self._threads:
-            raise RuntimeError("router already started")
-        self._threads.append(threading.Thread(
-            target=self._coordinate, name="serving-router", daemon=True))
-        for core in self.cores:
+        # under _cond: _threads doubles as the "started" latch that
+        # add_decode_replica checks before spawning a worker for a new core
+        with self._cond:
+            if self._threads:
+                raise RuntimeError("router already started")
             self._threads.append(threading.Thread(
-                target=self._worker, args=(core,),
-                name=f"serving-{core.name}", daemon=True))
-        for t in self._threads:
-            t.start()
+                target=self._coordinate, name="serving-router", daemon=True))
+            for core in self.cores:
+                self._threads.append(threading.Thread(
+                    target=self._worker, args=(core,),
+                    name=f"serving-{core.name}", daemon=True))
+            for t in self._threads:
+                t.start()
         if self._controller is not None:
             self._controller.start()
         return self
@@ -266,7 +269,7 @@ class Router:
         params = params or SamplingParams()
         if len(prompt) == 0:
             self._reject("empty_prompt")
-        max_ctx = self.decode[0]._sm_cfg("max_context", None)
+        max_ctx = self.decode[0]._sm_cfg("max_context", None)  # dstpu: noqa[guarded-read-unlocked] — snapshot read of a config template; scale-in never empties decode and admission re-checks live capacity under _cond
         if max_ctx is not None and len(prompt) >= max_ctx:
             self._reject(
                 "max_context",
@@ -276,7 +279,7 @@ class Router:
         # schedulable on at least one prefill-capable engine and one decode
         # replica (admission itself re-checks live per-replica free blocks
         # through the placement policy)
-        groups = ([self.prefill] if self.prefill else []) + [self.decode]
+        groups = ([self.prefill] if self.prefill else []) + [self.decode]  # dstpu: noqa[guarded-read-unlocked] — never-fits pre-check over a replica-list snapshot; the authoritative admission pass re-reads under _cond
         for cores in groups:
             err = None
             for core in cores:
@@ -368,7 +371,7 @@ class Router:
         with self._cond:
             self._draining = True
             self._cond.notify_all()
-        return self._idle.wait(timeout)
+        return self._idle.wait(timeout)  # dstpu: noqa[guarded-read-unlocked] — Event is internally synchronized; _cond only coordinates the set/clear with the coordinator's idle accounting
 
     def shutdown(self, drain: bool = True, timeout: Optional[float] = None) -> None:
         if self._controller is not None:
@@ -385,9 +388,11 @@ class Router:
                 self._queue.clear()
                 self._cancel_uids.update(self._by_uid.keys())
             self._cond.notify_all()
-        for t in self._threads:
+            # swap out the thread list under the lock; _stopping above
+            # keeps add_decode_replica from appending after the swap
+            threads, self._threads = self._threads, []
+        for t in threads:
             t.join(timeout=30)
-        self._threads = []
         for ep in self._kv_endpoints:
             ep.close()
         self._kv_endpoints = []
@@ -403,10 +408,11 @@ class Router:
         with self._cond:
             return len(self._owner)
 
-    def reserved_for(self, core: EngineCore):
+    def reserved_for_locked(self, core: EngineCore):
         """(blocks, sequences) the router has promised to in-flight
-        handoffs targeting ``core``. Called under ``_cond`` (placement runs
-        inside the coordinator's admission pass)."""
+        handoffs targeting ``core``. The ``_locked`` suffix is the
+        contract: placement calls this inside the coordinator's admission
+        pass, which holds ``_cond``."""
         r = self._reserved[core.name]
         return int(r[0]), int(r[1])
 
@@ -1421,8 +1427,8 @@ class Router:
             engine, baseline = self._spares.acquire()
         if engine is None:
             return None
-        tmpl = self.decode[0]
         with self._cond:
+            tmpl = self.decode[0]
             name = f"d{self._decode_seq}"
             self._decode_seq += 1
         core = EngineCore(

@@ -238,7 +238,7 @@ class ServingDriver:
         with self._cond:
             self._draining = True
             self._cond.notify_all()
-        return self._idle.wait(timeout)
+        return self._idle.wait(timeout)  # dstpu: noqa[guarded-read-unlocked] — Event is internally synchronized; _cond only coordinates the set/clear with the loop's idle accounting
 
     def shutdown(self, drain: bool = True, timeout: Optional[float] = None) -> None:
         """Stop the loop. ``drain=True`` completes accepted requests first;
@@ -341,7 +341,8 @@ class ServingDriver:
         """Terminal transition for an ACTIVE request: release its scheduler
         state (frees KV blocks + pending prompt chunks) and close out."""
         self.core.release(req.uid, scheduler_done=scheduler_done)
-        self._cancel_uids.discard(req.uid)
+        with self._cond:  # cancel() adds uids under _cond from client threads
+            self._cancel_uids.discard(req.uid)
         self._terminate(req, state, reason, error)
 
     # admission ---------------------------------------------------------

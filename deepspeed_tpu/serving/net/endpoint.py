@@ -119,7 +119,12 @@ class KVEndpoint:
             pass
         if self._accept_thread is not None:
             self._accept_thread.join(timeout=2.0)
-        for t in list(self._threads):
+        # snapshot under the lock (handler threads deregister themselves);
+        # the joins themselves must run unlocked or they would deadlock
+        # with a handler blocked on _lock
+        with self._lock:
+            threads = list(self._threads)
+        for t in threads:
             t.join(timeout=2.0)
 
     # -- staging -------------------------------------------------------------

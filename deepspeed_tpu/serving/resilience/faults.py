@@ -206,7 +206,10 @@ _NULL = NullFaultInjector()
 _INJECTOR = _NULL
 
 
-def get_fault_injector():
+def get_fault_injector():  # dstpu: returns[FaultInjector]
+    # the contract comment tells the static lock model which locks a
+    # `.check()` through this handle may take; the production
+    # NullFaultInjector is lock-free, so FaultInjector is the upper bound
     return _INJECTOR
 
 

@@ -148,7 +148,8 @@ class ReplicaHealth:
     def placeable(self) -> bool:
         """Whether this replica may receive placements: quarantined AND
         probation replicas are excluded — only a passed probe re-admits."""
-        return self.state in (HEALTHY, DEGRADED)
+        with self._lock:
+            return self.state in (HEALTHY, DEGRADED)
 
     # -- observations -----------------------------------------------------
     def note_success(self) -> None:

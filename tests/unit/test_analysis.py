@@ -578,18 +578,26 @@ class TestFramework:
         assert set(doc["counts"]) == {"info", "warning", "error"}
         assert doc["counts"]["error"] == 1
         (f,) = doc["findings"]
-        assert set(f) == {"rule", "severity", "path", "line", "col", "message"}
+        # the original keys are a stable contract for CI consumers;
+        # end_line rides along for editor span highlights
+        assert set(f) == {"rule", "severity", "path", "line", "col",
+                          "message", "end_line"}
         assert f["rule"] == "bare-assert" and f["line"] == 1
+        assert f["end_line"] >= f["line"]
 
     def test_rule_catalog_complete(self):
         names = {r.name for r in framework.resolve_rules()}
         assert names == {
             "bare-assert",
+            "blocking-call-under-lock",
             "cond-wait-no-predicate",
             "donate-arity",
+            "guarded-read-unlocked",
             "host-sync-in-loop",
             "impure-jit",
             "kv-host-bounce",
+            "lock-order-inversion",
+            "locked-call-to-locking-method",
             "raw-collective-in-hot-path",
             "shard-map-axis-coverage",
             "swallowed-thread-exception",

@@ -1,0 +1,746 @@
+"""Lock-discipline analysis: the whole-tree lock model (registry,
+guarded-attribute inference, cross-class acquisition graph), the four
+Tier-A lock rules on fixture snippets (positive / negative / noqa), the
+upgraded unlocked-shared-mutation blind-spot regressions, the runtime
+lock-order witness, and the serving-tree meta-gate."""
+
+import json
+import os
+import textwrap
+import threading
+
+import pytest
+
+from deepspeed_tpu.analysis import framework, locks
+from deepspeed_tpu.analysis.cli import lint_main
+from deepspeed_tpu.analysis.lockwitness import (
+    LockOrderViolation,
+    WitnessCondition,
+    WitnessLock,
+    WitnessState,
+    witness_locks,
+    wrap_instance,
+)
+
+
+def _lint(tmp_path, code, rule, name="snippet.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(code))
+    return framework.run_lint([str(p)], select=[rule])
+
+
+def _model(tmp_path, *codes):
+    for i, code in enumerate(codes):
+        (tmp_path / f"mod{i}.py").write_text(textwrap.dedent(code))
+    return locks.build_model_from_paths([str(tmp_path)])
+
+
+# ---------------------------------------------------------------------------
+# model construction
+# ---------------------------------------------------------------------------
+_LEAF = """
+    import threading
+
+    class Leaf:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.hits = 0
+
+        def hit(self):
+            with self._lock:
+                self.hits += 1
+"""
+
+
+class TestLockModel:
+    def test_registry_kinds(self, tmp_path):
+        model = _model(tmp_path, """
+            import threading
+
+            _BUILD_LOCK = threading.Lock()
+
+            class A:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._rl = threading.RLock()
+                    self._cond = threading.Condition()
+                    self._strict = threading.Condition(threading.Lock())
+        """)
+        decls = model.all_locks()
+        assert decls["mod0._BUILD_LOCK"].kind == "Lock"
+        assert decls["A._lock"].kind == "Lock"
+        assert decls["A._rl"].kind == "RLock"
+        # Condition()'s default lock is an RLock — reentrant; only the
+        # explicit plain-Lock form is not
+        assert decls["A._cond"].kind == "Condition"
+        assert decls["A._cond"].reentrant
+        assert decls["A._strict"].kind == "Condition(Lock)"
+        assert not decls["A._strict"].reentrant
+
+    def test_guarded_inference_all_write_shapes(self, tmp_path):
+        model = _model(tmp_path, """
+            import threading
+
+            class Table:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+                    self.d = {}
+                    self.q = []
+
+                def touch(self, k, v):
+                    with self._lock:
+                        self.n += 1        # augmented assign
+                        self.d[k] = v      # subscript store
+                        self.q.append(v)   # in-place mutator
+        """)
+        cm = model.classes["Table"]
+        assert cm.guarded == {"n": "_lock", "d": "_lock", "q": "_lock"}
+
+    def test_guarded_by_contract_comment(self, tmp_path):
+        model = _model(tmp_path, """
+            import threading
+
+            class Box:
+                # dstpu: guarded-by[payload, _lock]
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.payload = None
+        """)
+        assert model.classes["Box"].guarded == {"payload": "_lock"}
+
+    def test_cross_module_edge_via_annotation(self, tmp_path):
+        model = _model(tmp_path, _LEAF, """
+            import threading
+            from mod0 import Leaf
+
+            class Owner:
+                leaf: "Leaf"
+
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.leaf = Leaf()
+                    self.n = 0
+
+                def bump(self):
+                    with self._lock:
+                        self.n += 1
+                        self.leaf.hit()
+        """)
+        assert ("Owner._lock", "Leaf._lock") in model.order_edges
+        assert model.cycles() == []
+
+    def test_returns_contract_resolves_factory(self, tmp_path):
+        model = _model(tmp_path, _LEAF + """
+
+    def get_leaf():  # dstpu: returns[Leaf]
+        return Leaf()
+
+    class User:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.busy = False
+
+        def go(self):
+            with self._lock:
+                self.busy = True
+                get_leaf().hit()
+""")
+        assert ("User._lock", "Leaf._lock") in model.order_edges
+
+    def test_three_class_cycle_detected(self, tmp_path):
+        model = _model(tmp_path, """
+            import threading
+
+            class A:
+                b: "B"
+
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def ping(self):
+                    with self._lock:
+                        self.n += 1
+                        self.b.ping()
+
+            class B:
+                c: "C"
+
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def ping(self):
+                    with self._lock:
+                        self.n += 1
+                        self.c.ping()
+
+            class C:
+                a: "A"
+
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def ping(self):
+                    with self._lock:
+                        self.n += 1
+                        self.a.ping()
+        """)
+        cycles = model.cycles()
+        # transitive acquisition (A holds its lock through b.ping() into
+        # c.ping()) completes the graph, so the 2-node sub-cycles appear
+        # alongside the full 3-node cycle
+        assert {"A._lock", "B._lock", "C._lock"} in [set(c) for c in cycles]
+        # the closure contains every ordered pair of the cycle
+        closure = model.edge_closure()
+        assert ("A._lock", "C._lock") in closure
+
+    def test_to_doc_schema(self, tmp_path):
+        doc = _model(tmp_path, _LEAF).to_doc()
+        assert set(doc) == {"locks", "guarded", "edges"}
+        (decl,) = doc["locks"]
+        assert decl["key"] == "Leaf._lock" and decl["kind"] == "Lock"
+        assert doc["guarded"] == {"Leaf": {"hits": "Leaf._lock"}}
+
+
+# ---------------------------------------------------------------------------
+# lock-order-inversion
+# ---------------------------------------------------------------------------
+_TWO_CLASS_CYCLE = """
+    import threading
+
+    class A:
+        b: "B"
+
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0
+
+        def fwd(self):
+            with self._lock:
+                self.n += 1
+                self.b.leaf()
+
+        def leaf(self):
+            with self._lock:
+                self.n += 1
+
+    class B:
+        a: "A"
+
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0
+
+        def leaf(self):
+            with self._lock:
+                self.n += 1
+
+        def back(self):
+            with self._lock:
+                self.n += 1
+                self.a.leaf()
+"""
+
+
+class TestLockOrderInversion:
+    def test_opposite_orders_flagged(self, tmp_path):
+        found = _lint(tmp_path, _TWO_CLASS_CYCLE, "lock-order-inversion")
+        assert len(found) == 2  # one per direction's witness site
+        assert all(f.severity == "error" for f in found)
+        assert "opposite order" in found[0].message
+
+    def test_consistent_order_clean(self, tmp_path):
+        found = _lint(tmp_path, """
+            import threading
+
+            class A:
+                b: "B"
+
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def fwd(self):
+                    with self._lock:
+                        self.n += 1
+                        self.b.leaf()
+
+            class B:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def leaf(self):
+                    with self._lock:
+                        self.n += 1
+        """, "lock-order-inversion")
+        assert found == []
+
+    def test_noqa_suppresses(self, tmp_path):
+        code = _TWO_CLASS_CYCLE.replace(
+            "                self.b.leaf()",
+            "                self.b.leaf()  # dstpu: noqa[lock-order-inversion]"
+        ).replace(
+            "                self.a.leaf()",
+            "                self.a.leaf()  # dstpu: noqa[lock-order-inversion]"
+        )
+        assert _lint(tmp_path, code, "lock-order-inversion") == []
+
+
+# ---------------------------------------------------------------------------
+# blocking-call-under-lock
+# ---------------------------------------------------------------------------
+class TestBlockingCallUnderLock:
+    def test_sleep_and_untimed_get_flagged(self, tmp_path):
+        found = _lint(tmp_path, """
+            import threading
+            import time
+
+            class Pump:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.queue = None
+
+                def spin(self):
+                    with self._lock:
+                        time.sleep(0.5)
+                        return self.queue.get()
+        """, "blocking-call-under-lock")
+        assert len(found) == 2
+        assert all(f.severity == "warning" for f in found)
+        assert any("sleep" in f.message for f in found)
+        assert any("queue.get" in f.message for f in found)
+
+    def test_timeout_bounded_clean(self, tmp_path):
+        found = _lint(tmp_path, """
+            import threading
+
+            class Pump:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.queue = None
+
+                def spin(self):
+                    with self._lock:
+                        return self.queue.get(timeout=1.0)
+        """, "blocking-call-under-lock")
+        assert found == []
+
+    def test_cv_wait_on_held_condition_exempt(self, tmp_path):
+        # waiting on the condition you hold RELEASES it — the CV protocol,
+        # not a blocking call under the lock
+        found = _lint(tmp_path, """
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self.ready = False
+
+                def wait_ready(self):
+                    with self._cond:
+                        while not self.ready:
+                            self._cond.wait()
+        """, "blocking-call-under-lock")
+        assert found == []
+
+    def test_unlocked_sleep_clean(self, tmp_path):
+        found = _lint(tmp_path, """
+            import time
+
+            def nap():
+                time.sleep(0.1)
+        """, "blocking-call-under-lock")
+        assert found == []
+
+    def test_noqa_suppresses(self, tmp_path):
+        found = _lint(tmp_path, """
+            import threading
+            import time
+
+            class Pump:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def spin(self):
+                    with self._lock:
+                        self.n += 1
+                        time.sleep(0.5)  # dstpu: noqa[blocking-call-under-lock]
+        """, "blocking-call-under-lock")
+        assert found == []
+
+
+# ---------------------------------------------------------------------------
+# locked-call-to-locking-method
+# ---------------------------------------------------------------------------
+_SELF_DEADLOCK = """
+    import threading
+
+    class S:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0
+
+        def bump(self):
+            with self._lock:
+                self.n += 1
+
+        def bump_twice(self):
+            with self._lock:
+                self.bump()
+                self.bump()
+"""
+
+
+class TestLockedCallToLockingMethod:
+    def test_self_call_reacquires_lock(self, tmp_path):
+        found = _lint(tmp_path, _SELF_DEADLOCK,
+                      "locked-call-to-locking-method")
+        assert len(found) == 2 and all(f.severity == "error" for f in found)
+        assert "self-deadlock" in found[0].message
+
+    def test_transitive_self_deadlock(self, tmp_path):
+        found = _lint(tmp_path, """
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def bump(self):
+                    with self._lock:
+                        self.n += 1
+
+                def middle(self):
+                    self.bump()
+
+                def outer(self):
+                    with self._lock:
+                        self.middle()
+        """, "locked-call-to-locking-method")
+        assert len(found) == 1
+        assert "middle" in found[0].message
+
+    def test_direct_nested_reacquisition(self, tmp_path):
+        found = _lint(tmp_path, """
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def twice(self):
+                    with self._lock:
+                        with self._lock:
+                            pass
+        """, "locked-call-to-locking-method")
+        assert len(found) == 1 and "re-acquiring" in found[0].message
+
+    def test_rlock_clean(self, tmp_path):
+        code = _SELF_DEADLOCK.replace("threading.Lock()", "threading.RLock()")
+        assert _lint(tmp_path, code, "locked-call-to-locking-method") == []
+
+    def test_locked_helper_convention_clean(self, tmp_path):
+        found = _lint(tmp_path, """
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def _bump_locked(self):
+                    self.n += 1
+
+                def bump_twice(self):
+                    with self._lock:
+                        self._bump_locked()
+                        self._bump_locked()
+        """, "locked-call-to-locking-method")
+        assert found == []
+
+    def test_noqa_suppresses(self, tmp_path):
+        code = _SELF_DEADLOCK.replace(
+            "                self.bump()\n                self.bump()",
+            "                self.bump()  # dstpu: noqa[locked-call-to-locking-method]"
+        )
+        assert _lint(tmp_path, code, "locked-call-to-locking-method") == []
+
+
+# ---------------------------------------------------------------------------
+# guarded-read-unlocked
+# ---------------------------------------------------------------------------
+_GUARDED_READ = """
+    import threading
+
+    class G:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.state = "idle"
+
+        def set_state(self, s):
+            with self._lock:
+                self.state = s
+
+        def peek(self):
+            return self.state
+"""
+
+
+class TestGuardedReadUnlocked:
+    def test_unlocked_read_flagged(self, tmp_path):
+        found = _lint(tmp_path, _GUARDED_READ, "guarded-read-unlocked")
+        assert len(found) == 1 and found[0].severity == "warning"
+        assert "guarded by self._lock" in found[0].message
+
+    def test_read_under_lock_clean(self, tmp_path):
+        code = _GUARDED_READ.replace(
+            "        def peek(self):\n            return self.state",
+            "        def peek(self):\n            with self._lock:\n"
+            "                return self.state")
+        assert code != _GUARDED_READ
+        assert _lint(tmp_path, code, "guarded-read-unlocked") == []
+
+    def test_locked_suffix_clean(self, tmp_path):
+        code = _GUARDED_READ.replace("def peek(", "def peek_locked(")
+        assert _lint(tmp_path, code, "guarded-read-unlocked") == []
+
+    def test_declared_contract_flags_read(self, tmp_path):
+        # guarded-by[] declares the contract even when every locked write
+        # hides behind helpers the inference can't see through
+        found = _lint(tmp_path, """
+            import threading
+
+            class Box:
+                # dstpu: guarded-by[payload, _lock]
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.payload = None
+
+                def peek(self):
+                    return self.payload
+        """, "guarded-read-unlocked")
+        assert len(found) == 1
+
+    def test_noqa_suppresses(self, tmp_path):
+        code = _GUARDED_READ.replace(
+            "return self.state",
+            "return self.state  # dstpu: noqa[guarded-read-unlocked]")
+        assert _lint(tmp_path, code, "guarded-read-unlocked") == []
+
+
+# ---------------------------------------------------------------------------
+# unlocked-shared-mutation blind-spot regressions
+# ---------------------------------------------------------------------------
+class TestUnlockedSharedMutationUpgrade:
+    def test_subscript_and_mutator_writes_flagged(self, tmp_path):
+        found = _lint(tmp_path, """
+            import threading
+
+            class Table:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.d = {}
+                    self.q = []
+
+                def put(self, k, v):
+                    with self._lock:
+                        self.d[k] = v
+
+                def push(self, x):
+                    with self._lock:
+                        self.q.append(x)
+
+                def put_fast(self, k, v):
+                    self.d[k] = v
+
+                def push_fast(self, x):
+                    self.q.append(x)
+        """, "unlocked-shared-mutation")
+        assert len(found) == 2
+        assert any("subscript store" in f.message for f in found)
+        assert any("mutated in place" in f.message for f in found)
+
+    def test_augmented_assign_flagged(self, tmp_path):
+        found = _lint(tmp_path, """
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def inc(self):
+                    with self._lock:
+                        self.n += 1
+
+                def inc_fast(self):
+                    self.n += 1
+        """, "unlocked-shared-mutation")
+        assert len(found) == 1 and "updated in place" in found[0].message
+
+
+# ---------------------------------------------------------------------------
+# runtime witness
+# ---------------------------------------------------------------------------
+class TestLockWitness:
+    def test_inversion_raises(self):
+        st = WitnessState(raise_on_inversion=True)
+        a = WitnessLock(threading.Lock(), "A._lock", st)
+        b = WitnessLock(threading.Lock(), "B._lock", st)
+        with a:
+            with b:
+                pass
+        with pytest.raises(LockOrderViolation):
+            with b:
+                with a:
+                    pass
+        assert st.inversions == [("A._lock", "B._lock")]
+
+    def test_record_mode_defers_to_assertion(self):
+        st = WitnessState(raise_on_inversion=False)
+        a = WitnessLock(threading.Lock(), "A._lock", st)
+        b = WitnessLock(threading.Lock(), "B._lock", st)
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass  # recorded, not raised
+        with pytest.raises(LockOrderViolation, match="inversion"):
+            st.assert_no_inversion()
+
+    def test_subgraph_assertion(self):
+        st = WitnessState(raise_on_inversion=False)
+        a = WitnessLock(threading.Lock(), "A._lock", st)
+        b = WitnessLock(threading.Lock(), "B._lock", st)
+        with a:
+            with b:
+                pass
+        assert st.graph() == {("A._lock", "B._lock"): 1}
+        st.assert_subgraph({("A._lock", "B._lock")})
+        with pytest.raises(LockOrderViolation, match="not declared"):
+            st.assert_subgraph(set())
+        st.assert_subgraph(set(), ignore=["A._lock"])
+
+    def test_reentrant_reacquisition_adds_no_edge(self):
+        st = WitnessState()
+        a = WitnessLock(threading.RLock(), "A._rl", st)
+        with a:
+            with a:
+                pass
+        assert st.graph() == {}
+
+    def test_condition_wait_releases_held_name(self):
+        st = WitnessState(raise_on_inversion=True)
+        cond = WitnessCondition(threading.Condition(), "W._cond", st)
+        hit = []
+
+        def waiter():
+            with cond:
+                cond.wait_for(lambda: bool(hit), timeout=5)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        import time
+        time.sleep(0.05)
+        with cond:
+            hit.append(1)
+            cond.notify_all()
+        t.join(5)
+        assert not t.is_alive()
+        # the waiter's held-stack dropped the name across the wait; no
+        # self-edges, no inversions
+        assert st.inversions == []
+
+    def test_wrap_instance_idempotent(self):
+        class Holder:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition()
+                self.data = 7
+
+        st = WitnessState()
+        h = Holder()
+        assert sorted(wrap_instance(h, st)) == ["Holder._cond", "Holder._lock"]
+        assert isinstance(h._lock, WitnessLock)
+        assert isinstance(h._cond, WitnessCondition)
+        assert wrap_instance(h, st) == []  # second pass wraps nothing
+
+    def test_witness_locks_patches_and_restores(self):
+        from deepspeed_tpu.serving.metrics import ServingMetrics
+
+        orig_init = ServingMetrics.__init__
+        with witness_locks(classes=[ServingMetrics]) as st:
+            m = ServingMetrics()
+            assert isinstance(m._lock, WitnessLock)
+            m.inc("requests_submitted")
+        assert ServingMetrics.__init__ is orig_init
+        assert isinstance(ServingMetrics()._lock, type(threading.Lock()))
+        assert st.inversions == []
+
+
+# ---------------------------------------------------------------------------
+# JSON model section + serving meta-gate + serving fix regressions
+# ---------------------------------------------------------------------------
+class TestIntegration:
+    def test_json_model_section(self, tmp_path, capsys):
+        p = tmp_path / "s.py"
+        p.write_text(textwrap.dedent(_LEAF))
+        lint_main([str(p), "--format", "json", "--fail-on", "never"])
+        doc = json.loads(capsys.readouterr().out)
+        assert set(doc["model"]) == {"locks", "guarded", "edges"}
+        assert doc["model"]["locks"][0]["key"] == "Leaf._lock"
+        assert doc["model"]["guarded"] == {"Leaf": {"hits": "Leaf._lock"}}
+
+    def test_serving_tree_lock_rules_clean(self, capsys):
+        """The acceptance gate: the serving tree passes all four lock
+        rules at --fail-on warning (every suppression carries a reason)."""
+        import deepspeed_tpu
+
+        pkg = os.path.dirname(os.path.abspath(deepspeed_tpu.__file__))
+        assert lint_main([
+            os.path.join(pkg, "serving"),
+            "--select", "lock-order-inversion",
+            "--select", "blocking-call-under-lock",
+            "--select", "locked-call-to-locking-method",
+            "--select", "guarded-read-unlocked",
+            "--fail-on", "warning",
+        ]) == 0
+        capsys.readouterr()
+
+    def test_serving_model_hierarchy(self):
+        """The documented hierarchy (docs/ANALYSIS.md): coordinator locks
+        above leaf locks, acyclic, no reentrancy hazards."""
+        import deepspeed_tpu
+
+        pkg = os.path.dirname(os.path.abspath(deepspeed_tpu.__file__))
+        model = locks.build_model_from_paths([pkg])
+        assert model.cycles() == []
+        assert model.reentrant_hazards == []
+        edges = set(model.order_edges)
+        assert ("EngineCore.step_lock", "Router._cond") in edges
+        assert ("Router._cond", "ServingMetrics._lock") in edges
+        assert ("Router._cond", "TokenStream._cond") in edges
+        # leaf locks stay leaves: nothing is acquired while holding one
+        for leaf in ("ServingMetrics._lock", "EventLog._lock",
+                     "ReplicaHealth._lock", "FaultInjector._lock"):
+            assert not any(a == leaf for a, _ in edges), leaf
+
+    def test_router_reserved_for_locked(self):
+        """The reentrancy-proof restructure: reservation reads moved into a
+        ``*_locked`` helper called under the admission pass's ``_cond``."""
+        from deepspeed_tpu.serving.cluster import Router
+        from tests.unit.test_serving import FakeEngine
+
+        router = Router(engines=[FakeEngine() for _ in range(2)],
+                        num_prefill_workers=0)
+        assert not hasattr(router, "reserved_for")
+        with router._cond:
+            blocks, seqs = router.reserved_for_locked(router.decode[0])
+        assert (blocks, seqs) == (0, 0)

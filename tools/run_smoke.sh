@@ -300,6 +300,25 @@ print(f"chaos gate: {len(prompts)} streams bit-identical through "
       f"{len(inj.fired())} injected fault(s) ({sorted(fired)})")
 EOF
 
+echo "== lock-discipline gate (Tier A lock rules + runtime witness) =="
+# the whole-tree lock model: the four lock rules over the serving tree at
+# --fail-on warning (every suppression carries a reason), the fixture +
+# witness unit suite, then the chaos scenario above re-run under the
+# runtime lock-order witness — the observed acquisition order must embed
+# in the static model's transitive closure, with zero inversions
+./bin/dstpu lint deepspeed_tpu/serving \
+    --select lock-order-inversion --select blocking-call-under-lock \
+    --select locked-call-to-locking-method --select guarded-read-unlocked \
+    --fail-on warning
+python -m pytest tests/unit/test_lock_analysis.py -q -p no:cacheprovider
+python - <<'EOF'
+from deepspeed_tpu.analysis.verify import verify_lock_order
+results = verify_lock_order()
+for r in results:
+    print(r.render())
+assert all(r.ok for r in results), "lock-discipline verify failed"
+EOF
+
 echo "== request-tracing gate (span trees + Perfetto export) =="
 # span tracer semantics, capture policy, the driver/router span threading
 # (single rooted tree through placement/handoff/preempt), histogram
