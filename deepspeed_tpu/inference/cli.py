@@ -165,11 +165,29 @@ def generate_main(argv=None) -> int:
 
 
 def serve_parse_args(argv=None):
-    p = argparse.ArgumentParser(
+    p = _serve_parser(
         prog="dstpu serve",
         description="serve a local HF checkpoint dir over HTTP "
         "(continuous batching, streaming)",
     )
+    p.add_argument("--control-port", type=int, default=None, metavar="PORT",
+                   help="expose the multi-host control plane on this port "
+                   "(0 = ephemeral): remote decode replicas join with "
+                   "`dstpu serve-agent --join HOST:PORT`. Needs the "
+                   "multi-engine router (--num-decode-replicas > 1 or "
+                   "--num-prefill-workers >= 1); cross-process KV "
+                   "handoffs additionally need --kv-transport remote")
+    p.add_argument("--control-host", default="0.0.0.0",
+                   help="interface the control plane binds (agents on "
+                   "other machines must be able to reach it)")
+    return p.parse_args(argv)
+
+
+def _serve_parser(prog, description):
+    """The shared serve/serve-agent argument surface: everything an
+    engine build needs (model, KV pool, TP, spec decode, ...) plus the
+    router-side knobs serve-agent simply ignores."""
+    p = argparse.ArgumentParser(prog=prog, description=description)
     p.add_argument("--model", required=True, help="HF checkpoint directory")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8000, help="0 = ephemeral")
@@ -299,41 +317,34 @@ def serve_parse_args(argv=None):
     p.add_argument("--top-k", type=int, default=0)
     p.add_argument("--top-p", type=float, default=0.0)
     p.add_argument("--seed", type=int, default=0)
+    return p
+
+
+def serve_agent_parse_args(argv=None):
+    p = _serve_parser(
+        prog="dstpu serve-agent",
+        description="run one decode replica in this process and join a "
+        "router's multi-host control plane (dstpu serve must expose one "
+        "via Router.serve_control; KV handoffs require "
+        "--kv-transport remote on both sides)",
+    )
+    p.add_argument("--join", required=True, metavar="HOST:PORT",
+                   help="the router's control-plane address "
+                   "(Router.serve_control)")
+    p.add_argument("--name", default=None,
+                   help="replica name to register under (default: the "
+                   "router assigns the next dN; reusing a name re-joins "
+                   "a quarantined replica after a restart)")
     return p.parse_args(argv)
 
 
-def build_serving_stack(args, cfg=None, params=None, tok=None):
-    """Engine(s) + driver from parsed serve args (split out so tests can
-    build the stack without a socket). Pass cfg/params/tok to skip
-    checkpoint loading. One engine serves behind ``ServingDriver``; with
-    ``--num-decode-replicas`` > 1 or ``--num-prefill-workers`` >= 1 the
-    engines (sharing the read-only params, each with its own KV pool) go
-    behind the multi-engine ``Router``."""
+def engine_config_from_args(args, cfg):
+    """RaggedInferenceEngineConfig from parsed serve/serve-agent args —
+    the one place the CLI surface maps onto engine config, so the router
+    process and its remote agents build bit-identical engines from the
+    same flags."""
     from deepspeed_tpu.inference.config import RaggedInferenceEngineConfig
-    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
-    from deepspeed_tpu.serving.cluster import Router
-    from deepspeed_tpu.serving.driver import ServingDriver
 
-    if getattr(args, "trace", False):
-        from deepspeed_tpu.observability import configure_tracing
-
-        configure_tracing(
-            enabled=True,
-            max_events=int(getattr(args, "trace_buffer_events", 65536)),
-            capture=getattr(args, "trace_capture", "all"),
-        )
-    if cfg is None or params is None:
-        from deepspeed_tpu.models import load_hf_model
-
-        cfg, params = load_hf_model(args.model, dtype=args.dtype)
-    if tok is None and args.model:
-        from deepspeed_tpu.tokenizer import load_tokenizer
-
-        tok = load_tokenizer(args.model)
-    if args.tp > 1:
-        from deepspeed_tpu.parallel.topology import Topology, set_topology
-
-        set_topology(Topology(model=args.tp, data=0))
     kv_dtype = getattr(args, "kv_cache_dtype", "bf16")
     num_blocks = args.num_blocks
     if int(getattr(args, "kv_pool_bytes", 0) or 0):
@@ -346,7 +357,7 @@ def build_serving_stack(args, cfg=None, params=None, tok=None):
             int(args.kv_pool_bytes), args.block_size, cfg.kv_heads,
             cfg.head_dim, cfg.n_layers, kv_dtype,
         )
-    rc = RaggedInferenceEngineConfig.from_dict({
+    return RaggedInferenceEngineConfig.from_dict({
         "dtype": args.dtype, "tp_size": args.tp,
         "comm_quant": getattr(args, "comm_quant", "none"),
         "comm_overlap": getattr(args, "comm_overlap", "none"),
@@ -376,6 +387,40 @@ def build_serving_stack(args, cfg=None, params=None, tok=None):
             "max_context": args.max_context,
         },
     })
+
+
+def build_serving_stack(args, cfg=None, params=None, tok=None):
+    """Engine(s) + driver from parsed serve args (split out so tests can
+    build the stack without a socket). Pass cfg/params/tok to skip
+    checkpoint loading. One engine serves behind ``ServingDriver``; with
+    ``--num-decode-replicas`` > 1 or ``--num-prefill-workers`` >= 1 the
+    engines (sharing the read-only params, each with its own KV pool) go
+    behind the multi-engine ``Router``."""
+    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.serving.cluster import Router
+    from deepspeed_tpu.serving.driver import ServingDriver
+
+    if getattr(args, "trace", False):
+        from deepspeed_tpu.observability import configure_tracing
+
+        configure_tracing(
+            enabled=True,
+            max_events=int(getattr(args, "trace_buffer_events", 65536)),
+            capture=getattr(args, "trace_capture", "all"),
+        )
+    if cfg is None or params is None:
+        from deepspeed_tpu.models import load_hf_model
+
+        cfg, params = load_hf_model(args.model, dtype=args.dtype)
+    if tok is None and args.model:
+        from deepspeed_tpu.tokenizer import load_tokenizer
+
+        tok = load_tokenizer(args.model)
+    if args.tp > 1:
+        from deepspeed_tpu.parallel.topology import Topology, set_topology
+
+        set_topology(Topology(model=args.tp, data=0))
+    rc = engine_config_from_args(args, cfg)
     n_prefill = int(getattr(args, "num_prefill_workers", 0) or 0)
     n_decode = int(getattr(args, "num_decode_replicas", 1) or 1)
     if n_prefill < 0 or n_decode < 1:
@@ -461,6 +506,18 @@ def serve_main(argv=None) -> int:
     args = serve_parse_args(argv)
     driver, tok = build_serving_stack(args)
     driver.start()
+    if args.control_port is not None:
+        if not hasattr(driver, "serve_control"):
+            print("dstpu serve: --control-port needs the multi-engine "
+                  "router (--num-decode-replicas > 1 or "
+                  "--num-prefill-workers >= 1)", file=sys.stderr)
+            driver.shutdown()
+            return 2
+        chost, cport = driver.serve_control(args.control_host,
+                                            args.control_port)
+        print(f"dstpu serve: control plane on {chost}:{cport} "
+              f"(join with `dstpu serve-agent --join HOST:{cport}`)",
+              file=sys.stderr)
     server = start_server(driver, host=args.host, port=args.port, tokenizer=tok)
     host, port = server.server_address[:2]
     endpoints = "/generate, /health, /metrics"
@@ -481,10 +538,69 @@ def serve_main(argv=None) -> int:
     return 0
 
 
+def build_agent_core(args, cfg=None, params=None, tok=None):
+    """One decode ``EngineCore`` for ``dstpu serve-agent`` (split out so
+    tests can build an agent without a checkpoint). The engine comes from
+    the SAME flag->config mapping as the router's replicas — same seed,
+    same sampling keys, so the streams it decodes are bit-identical to a
+    local replica's."""
+    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.serving.cluster.core import EngineCore
+    from deepspeed_tpu.serving.metrics import ServingMetrics
+
+    if cfg is None or params is None:
+        from deepspeed_tpu.models import load_hf_model
+
+        cfg, params = load_hf_model(args.model, dtype=args.dtype)
+    if tok is None and args.model:
+        from deepspeed_tpu.tokenizer import load_tokenizer
+
+        tok = load_tokenizer(args.model)
+    if args.tp > 1:
+        from deepspeed_tpu.parallel.topology import Topology, set_topology
+
+        set_topology(Topology(model=args.tp, data=0))
+    rc = engine_config_from_args(args, cfg)
+    engine = InferenceEngineV2(cfg, params, rc)
+    core = EngineCore(
+        engine, name=args.name or "agent", role="decode",
+        decode_steps=args.decode_steps, kv_headroom=args.kv_headroom,
+        spec_k=int(getattr(args, "spec_k", 0) or 0),
+        spec_ngram=getattr(args, "spec_ngram", 3),
+        metrics=ServingMetrics(),
+    )
+    return core, tok
+
+
+def serve_agent_main(argv=None) -> int:
+    args = serve_agent_parse_args(argv)
+    host, _, port = str(args.join).rpartition(":")
+    if not port.isdigit():
+        print(f"dstpu serve-agent: --join must be HOST:PORT "
+              f"(got {args.join!r})", file=sys.stderr)
+        return 2
+    join = (host or "127.0.0.1", int(port))
+    from deepspeed_tpu.serving.cluster.agent import ReplicaAgent
+
+    core, _tok = build_agent_core(args)
+    agent = ReplicaAgent(core, join, name=args.name or None,
+                         metrics=core.metrics)
+    print(f"dstpu serve-agent: decode replica joining control plane at "
+          f"{join[0]}:{join[1]}", file=sys.stderr)
+    try:
+        return agent.run()
+    except KeyboardInterrupt:
+        print("dstpu serve-agent: shutting down...", file=sys.stderr)
+        agent.close()
+        return 0
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
     if argv and argv[0] == "serve":
         return serve_main(argv[1:])
+    if argv and argv[0] == "serve-agent":
+        return serve_agent_main(argv[1:])
     if argv and argv[0] == "generate":
         argv = argv[1:]
     return generate_main(argv)

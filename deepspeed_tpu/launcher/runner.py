@@ -277,6 +277,11 @@ def main(argv=None) -> int:
         from deepspeed_tpu.inference.cli import serve_main
 
         return serve_main(argv[1:])
+    if argv and argv[0] == "serve-agent":
+        # remote decode replica: dstpu serve-agent --model DIR --join H:P
+        from deepspeed_tpu.inference.cli import serve_agent_main
+
+        return serve_agent_main(argv[1:])
     if argv and argv[0] == "lint":
         # static analysis: dstpu lint deepspeed_tpu/ [--verify] [--fail-on error]
         from deepspeed_tpu.analysis.cli import lint_main

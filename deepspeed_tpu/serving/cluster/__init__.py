@@ -26,8 +26,12 @@ from deepspeed_tpu.serving.cluster.placement import (
     get_placement,
 )
 from deepspeed_tpu.serving.cluster.router import Router
+from deepspeed_tpu.serving.cluster.agent import ReplicaAgent
+from deepspeed_tpu.serving.cluster.remote_core import RemoteEngineHandle
 
 __all__ = [
+    "ReplicaAgent",
+    "RemoteEngineHandle",
     "EngineCore",
     "HandoffError",
     "KVHandoff",

@@ -516,6 +516,14 @@ class EngineCore:
         ep = getattr(self.engine, "_kv_endpoint", None)
         return ep.address if ep is not None else None
 
+    def kv_endpoint_stats(self) -> Dict:
+        """Stage/transfer counters of the attached ``KVEndpoint`` ({} when
+        none). Health metadata goes through this instead of reaching into
+        ``engine._kv_endpoint`` so remote handles (no local engine) can
+        answer with their agent-reported snapshot."""
+        ep = getattr(self.engine, "_kv_endpoint", None)
+        return dict(ep.stats()) if ep is not None else {}
+
     def replica_stats(self) -> Dict[str, float]:
         """Per-replica gauge snapshot for the labeled /metrics samples."""
         free = self.free_blocks()

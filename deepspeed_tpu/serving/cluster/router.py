@@ -213,6 +213,10 @@ class Router:
             for core in self.prefill:
                 if hasattr(core.engine, "export_kv_blocks"):
                     self._kv_endpoints.append(ensure_endpoint(core.engine))
+        # multi-host control plane: a ControlEndpoint (serve_control) that
+        # remote decode agents dial into; their RemoteEngineHandles join
+        # self.decode and take placements like any local replica
+        self._control = None
 
         self.metrics.counters.setdefault("kv_handoffs_total", 0)
         if self.decode[0].kv_info:
@@ -393,6 +397,14 @@ class Router:
             threads, self._threads = self._threads, []
         for t in threads:
             t.join(timeout=30)
+        # remote agents first (GOODBYE lets them exit their serve loops),
+        # then the listener, then the KV endpoints they may still dial
+        for core in list(self.decode):  # dstpu: noqa[guarded-read-unlocked] — shutdown path: coordinator threads are joined and _stopping bars new replicas, so the list is frozen
+            if getattr(core, "is_remote", False):
+                core.close("router shutdown")
+        if self._control is not None:
+            self._control.close()
+            self._control = None
         for ep in self._kv_endpoints:
             ep.close()
         self._kv_endpoints = []
@@ -436,6 +448,9 @@ class Router:
                 addr = core.kv_endpoint_address()
                 if addr is not None:
                     st["kv_endpoint"] = list(addr)
+                if getattr(core, "is_remote", False):
+                    st["remote"] = True
+                    st["connected"] = core.connected
                 replicas[core.name] = st
             kv_info = self.decode[0].kv_info
             spec = next((c.spec_ctl for c in self.decode), None)
@@ -464,9 +479,25 @@ class Router:
                         self.metrics.handoff_seconds.quantile(0.95), 6),
                     "endpoints": {
                         c.name: {"address": list(c.kv_endpoint_address()),
-                                 **getattr(c.engine, "_kv_endpoint").stats()}
+                                 **c.kv_endpoint_stats()}
                         for c in self.cores
                         if c.kv_endpoint_address() is not None
+                    },
+                },
+                "control_plane": {
+                    "enabled": self._control is not None,
+                    "address": (list(self._control.address)
+                                if self._control is not None else None),
+                    "remote_replicas": {
+                        c.name: {
+                            "connected": c.connected,
+                            "kv_endpoint": (
+                                list(c.kv_endpoint_address())
+                                if c.kv_endpoint_address() is not None
+                                else None),
+                        }
+                        for c in self.decode
+                        if getattr(c, "is_remote", False)
                     },
                 },
                 "kv_host_tier": self._host_tier_health_locked(),
@@ -539,6 +570,197 @@ class Router:
             for k, v in t.stats().items():
                 agg[k] = agg.get(k, 0) + v
         return agg
+
+    # -- multi-host control plane ----------------------------------------
+    def serve_control(self, host: str = "127.0.0.1", port: int = 0):
+        """Start (idempotently) the control listener that remote decode
+        agents (``dstpu serve-agent --join host:port``) dial into, and
+        return its bound ``(host, port)``. Each agent contributes one
+        :class:`RemoteEngineHandle` to ``self.decode``; tokens flow back
+        over its events channel, KV handoffs ride the remote KV wire."""
+        if self._control is None:
+            from deepspeed_tpu.serving.net.control import ControlEndpoint
+            self._control = ControlEndpoint(
+                host, port, name="router-ctl",
+                on_channel=self._on_control_channel,
+                metrics=self.metrics,
+            ).start()
+        return self._control.address
+
+    def _on_control_channel(self, meta: Dict, channel) -> Dict:
+        """ControlEndpoint bootstrap hook (accept thread, no router locks
+        held). Agents dial twice: the ``rpc`` channel registers/re-joins
+        the replica, the ``events`` channel carries its token pump."""
+        kind = str(meta.get("channel", "rpc"))
+        if kind == "rpc":
+            return self._agent_hello(meta, channel)
+        if kind == "events":
+            name = str(meta.get("name", ""))
+            with self._cond:
+                handle = next(
+                    (c for c in self.decode
+                     if c.name == name and getattr(c, "is_remote", False)),
+                    None)
+            if handle is None:
+                raise ValueError(
+                    f"events channel for unknown remote replica {name!r}")
+            handle.attach_events(channel)
+            log_event("agent_joined", replica=name,
+                      kv_blocks=handle.kv_total,
+                      tp_shards=handle.tp_shards(),
+                      kv_endpoint=(list(handle.kv_endpoint_address())
+                                   if handle.kv_endpoint_address() else None))
+            with self._cond:
+                self._cond.notify_all()  # placement may seat queued work now
+            return {"name": name}
+        raise ValueError(f"unknown control channel kind {kind!r}")
+
+    def _agent_hello(self, meta: Dict, channel) -> Dict:
+        """Register a remote decode replica from its bootstrap META (or
+        re-attach a known one after an agent restart — same name, fresh
+        channels and pool state; its probation probe re-admits it)."""
+        from deepspeed_tpu.serving.cluster.remote_core import RemoteEngineHandle
+        requested = str(meta.get("name") or "")
+        with self._cond:
+            existing = (next((c for c in self.decode if c.name == requested),
+                             None) if requested else None)
+            if existing is not None and not getattr(existing, "is_remote", False):
+                raise ValueError(
+                    f"replica name {requested!r} is taken by a local engine")
+            if existing is None:
+                name = requested or f"d{self._decode_seq}"
+                if not requested:
+                    self._decode_seq += 1
+        if existing is not None:
+            existing.update_meta(meta)
+            existing.attach_rpc(channel)
+            log_event("agent_rejoined", replica=existing.name,
+                      health=existing.health.state)
+            with self._cond:
+                self._cond.notify_all()
+            return {"name": existing.name}
+        handle = RemoteEngineHandle(name, meta, self, metrics=self.metrics,
+                                    resilience=self._resilience)
+        handle.attach_rpc(channel)
+        self.add_remote_replica(handle)
+        return {"name": name}
+
+    def add_remote_replica(self, handle) -> None:
+        """Wire a :class:`RemoteEngineHandle` into the decode fleet: the
+        same bookkeeping as :meth:`add_decode_replica`, minus the engine
+        (it lives in the agent's process)."""
+        with self._cond:
+            self.decode.append(handle)
+            self.cores.append(handle)
+            self._reserved[handle.name] = [0, 0]
+            self._tally[handle.name] = {"finished": 0, "ttft_sum": 0.0,
+                                        "ttft_n": 0, "tpot_sum": 0.0,
+                                        "tpot_n": 0}
+            if self._threads and not self._stopping:
+                t = threading.Thread(target=self._worker, args=(handle,),
+                                     name=f"serving-{handle.name}",
+                                     daemon=True)
+                self._threads.append(t)
+                t.start()
+            self.metrics.set_gauge("decode_replicas", len(self.decode))
+            self.metrics.update_replica(handle.name, handle.replica_stats(),
+                                        role=handle.role, remote=True)
+            self._cond.notify_all()
+
+    def _remote_token(self, core, obj: Dict) -> None:
+        """Events-channel TOKEN frame (pump thread): route into the same
+        sink path a local ``step_once`` would have called. ``feedback``
+        already happened agent-side. Frames racing a finish/recovery are
+        dropped by the residency check — the agent's stream is stale."""
+        uid = int(obj.get("uid", -1))
+        with self._cond:
+            req = self._by_uid.get(uid)
+            if req is None or req.is_terminal or core.requests.get(uid) is not req:
+                return
+            if "tok" in obj:
+                self.deliver(core, req, int(obj["tok"]), feedback=False)
+            elif obj.get("fin") == "length_cap":
+                self.finish_capped(core, req)
+
+    def _remote_stats(self, core, obj: Dict) -> None:
+        """Events-channel STATS push: the handle already folded it into
+        its admission caches; roll it up into /metrics and the prefix
+        directory, then wake the coordinator (freed blocks may seat the
+        queue head)."""
+        with self._cond:
+            st = core.replica_stats()
+            r = self._reserved.get(core.name)
+            if r is not None:
+                st["reserved_blocks"] = r[0]
+            t = self._tally.get(core.name)
+            if t is not None:
+                st["requests_finished_total"] = t["finished"]
+            self.metrics.update_replica(core.name, st, role=core.role,
+                                        remote=True)
+            if self._placeable(core):
+                self.directory.advertise(core.name, core.prefix_hashes())
+            self._cond.notify_all()
+
+    def _remote_event(self, core, obj: Dict) -> None:
+        """Events-channel EVENT frame. ``engine_failed`` mirrors the local
+        sink's ``engine_failed`` — except the agent already dropped its
+        residents (its sink released them), so recovery detaches only."""
+        event = str(obj.get("event", ""))
+        if event != "engine_failed":
+            log_event(f"agent_{event or 'event'}", replica=core.name,
+                      **{k: v for k, v in obj.items() if k != "event"})
+            return
+        error = str(obj.get("error", ""))
+        core.health.note_error(error)
+        log_event("engine_failed", replica=core.name, error=error,
+                  in_flight=len(core.requests), health=core.health.state)
+        with self._cond:
+            if self._resilience is None:
+                for req in list(core.requests.values()):
+                    self._finish_on_locked(core, req, RequestState.FAILED,
+                                           "engine_error", error=error,
+                                           scheduler_done=True)
+            else:
+                self.metrics.inc("replica_failures_total")
+                self._note_quarantine_locked(core)
+                for req in list(core.requests.values()):
+                    self._recover_resident_locked(
+                        core, req, pool_readable=False,
+                        cause=f"agent engine step: {error}",
+                        detach_only=True)
+            self._cond.notify_all()
+
+    def _agent_lost(self, core, err: str) -> None:
+        """The control wire to an agent died (pump EOF, RPC failure, or an
+        explicit GOODBYE): quarantine the replica and recover its residents
+        by replay — the agent's pool is unreachable, but every stream is
+        re-derivable from its delivered tokens. ``mark_disconnected`` makes
+        this idempotent across the pump/flusher race. A restarted agent
+        re-joins under the same name and probation re-admits it."""
+        if not core.mark_disconnected():
+            return
+        err = str(err)
+        state = core.health.note_crash(err)
+        logger.warning(f"serving[{core.name}]: agent lost: {err}")
+        self.metrics.inc("replica_failures_total")
+        with core.step_lock:
+            with self._cond:
+                self._handoff_out.pop(core.name, None)
+                self._note_quarantine_locked(core)
+                log_event("agent_lost", replica=core.name, error=err,
+                          health=state, in_flight=len(core.requests))
+                for req in list(core.requests.values()):
+                    if self._resilience is not None:
+                        # detach_only: the agent is gone — there is no
+                        # scheduler to finish, nothing to CANCEL
+                        self._recover_resident_locked(
+                            core, req, pool_readable=False,
+                            cause=f"agent lost: {err}", detach_only=True)
+                    else:
+                        self._finish_on_locked(core, req, RequestState.FAILED,
+                                               "engine_error", error=err,
+                                               scheduler_done=True)
+                self._cond.notify_all()
 
     # -- internals -------------------------------------------------------
     def _reject(self, reason: str, message: str = "",
@@ -929,8 +1151,18 @@ class Router:
         t_place = tr.now() if (tr.enabled and req.trace is not None) else None
         # quarantined/probation replicas take no placements (the identity
         # filter when resilience is off — legacy placement is untouched)
-        dcore = self._placement.choose(
-            [c for c in self.decode if self._placeable(c)], req, self)
+        candidates = [c for c in self.decode if self._placeable(c)]
+        if req._checkpoint is not None:
+            # a preemption checkpoint is a local device/host payload; it
+            # cannot cross a process boundary onto a remote replica
+            candidates = [c for c in candidates
+                          if not getattr(c, "is_remote", False)]
+        elif self.prefill and self._kv_transport.name != "remote":
+            # a disaggregated handoff only reaches a remote replica over
+            # the remote KV wire — other transports can't cross processes
+            candidates = [c for c in candidates
+                          if not getattr(c, "is_remote", False)]
+        dcore = self._placement.choose(candidates, req, self)
         if dcore is None:
             plan = self._plan_preemption_locked(req)
             if plan is not None:
@@ -987,6 +1219,8 @@ class Router:
         for core in self.decode:
             if core.retired or not self._placeable(core):
                 continue
+            if getattr(core, "is_remote", False):
+                continue  # checkpoints can't be exported across processes
             bs = int(core._kv_cfg("block_size", 1))
             cap = int(core._kv_cfg("max_blocks_per_seq", 1 << 30))
             need = core.blocks_needed(req)
@@ -1201,6 +1435,8 @@ class Router:
         from deepspeed_tpu.serving.elastic.preemption import (
             preempt_sequence, preemptible,
         )
+        if getattr(vcore, "is_remote", False):
+            return False  # no checkpoint export across a process boundary
         with vcore.step_lock:
             with self._cond:
                 if victim.is_terminal or self._owner.get(victim.uid) is not vcore:
@@ -1339,13 +1575,22 @@ class Router:
             t0 = tr.now() if (tr.enabled and req.trace is not None) else None
             ho_t0 = time.monotonic()
             try:
-                # safe to retry: a failed import_sequence unwinds its own
-                # allocations (sched.finish in its except), so every
-                # attempt starts from a clean target
-                copied = self._edge_retries(
-                    lambda: import_sequence(target.engine, ho),
-                    "handoff_retries_total", "handoff.import",
-                    f"{target.name}")
+                if getattr(target, "is_remote", False):
+                    # remote adopt: only the META descriptor crosses the
+                    # control wire — the agent FETCHes the staged payload
+                    # from the source's KVEndpoint over the remote KV wire
+                    copied = self._edge_retries(
+                        lambda: target.adopt(req, ho),
+                        "handoff_retries_total", "handoff.import",
+                        f"{target.name}")
+                else:
+                    # safe to retry: a failed import_sequence unwinds its
+                    # own allocations (sched.finish in its except), so
+                    # every attempt starts from a clean target
+                    copied = self._edge_retries(
+                        lambda: import_sequence(target.engine, ho),
+                        "handoff_retries_total", "handoff.import",
+                        f"{target.name}")
             except Exception as e:
                 log_event("handoff_failed", uid=req.uid, target=target.name,
                           error=f"{type(e).__name__}: {e}")
@@ -1476,6 +1721,8 @@ class Router:
             for core in reversed(self.decode):
                 if core.retired or core.requests:
                     continue
+                if getattr(core, "is_remote", False):
+                    continue  # a facade has no engine to pool as a spare
                 if any(self._reserved[core.name]):
                     continue
                 if any(t is core for t in self._target.values()):
@@ -1566,7 +1813,8 @@ class Router:
         st = core.replica_stats()
         st["reserved_blocks"] = self._reserved[core.name][0]
         st["requests_finished_total"] = self._tally[core.name]["finished"]
-        self.metrics.update_replica(core.name, st, role=core.role)
+        self.metrics.update_replica(core.name, st, role=core.role,
+                                    remote=getattr(core, "is_remote", False))
         self.metrics.set_gauge("active_requests", len(self._owner))
 
     def _maybe_idle_locked(self):

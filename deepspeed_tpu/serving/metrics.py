@@ -299,23 +299,26 @@ class ServingMetrics:
             }
 
     def update_replica(
-        self, name: str, stats: Dict[str, float], role: str = "both"
+        self, name: str, stats: Dict[str, float], role: str = "both",
+        remote: bool = False,
     ) -> None:
         """Per-replica gauge snapshot (disaggregated serving): KV blocks,
         resident requests, handoff/decode tallies for ONE engine, labeled
-        ``replica=name`` / ``role=...`` in the exposition. Non-numeric
-        entries are dropped (labels carry the strings)."""
+        ``replica=name`` / ``role=...`` / ``remote=...`` in the exposition
+        (``remote="1"`` marks a replica served by a cross-process agent).
+        Non-numeric entries are dropped (labels carry the strings)."""
         clean = {}
         for k, v in stats.items():
             if isinstance(v, bool) or not isinstance(v, (int, float)):
                 continue
             clean[k] = v * 1.0
         with self._lock:
-            self._replicas[name] = (str(role), clean)
+            self._replicas[name] = (str(role), bool(remote), clean)
 
     def replica_snapshot(self) -> Dict[str, Dict[str, float]]:
         with self._lock:
-            return {name: dict(st) for name, (_role, st) in self._replicas.items()}
+            return {name: dict(st)
+                    for name, (_role, _remote, st) in self._replicas.items()}
 
     def _tier_cell(self, tenant: str, tier: str) -> Dict[str, float]:
         """Caller holds the lock."""
@@ -463,7 +466,7 @@ class ServingMetrics:
                 for key, value in cell.items():
                     out[f"kv_handoff_{transport}_{key}"] = value
             out["kv_handoff_seconds_mean"] = self.handoff_seconds.mean
-            for name, (_role, st) in self._replicas.items():
+            for name, (_role, _remote, st) in self._replicas.items():
                 for key, value in st.items():
                     out[f"replica_{name}_{key}"] = value
             for (tenant, tier), cell in self._tiers.items():
@@ -495,8 +498,9 @@ class ServingMetrics:
                 samples.append((f"{p}_kv_handoff_chunks_total", lbl, cell["chunks"], "counter"))
                 samples.append((f"{p}_kv_handoff_aborts_total", lbl, cell.get("aborts", 0.0), "counter"))
             for name in sorted(self._replicas):
-                role, st = self._replicas[name]
-                lbl = {"replica": name, "role": role}
+                role, remote, st = self._replicas[name]
+                lbl = {"replica": name, "role": role,
+                       "remote": "1" if remote else "0"}
                 for key in sorted(st):
                     samples.append((f"{p}_replica_{key}", lbl, st[key], "gauge"))
             for tenant, tier in sorted(self._tiers):
@@ -541,7 +545,7 @@ class ServingMetrics:
             # labeled families, flattened the same way snapshot() does, so
             # replica and tenant/tier telemetry reaches the file-backed
             # writers (CSV/TensorBoard/...) and not just /metrics
-            for name, (_role, st) in self._replicas.items():
+            for name, (_role, _remote, st) in self._replicas.items():
                 for key, value in st.items():
                     events.append((f"Serving/replica_{name}_{key}", value, step))
             for (tenant, tier), cell in self._tiers.items():

@@ -1,9 +1,13 @@
-"""Cross-process KV wire for disaggregated serving (docs/NETWORKING.md).
+"""Cross-process KV + control wire for disaggregated serving
+(docs/NETWORKING.md).
 
 Layers, bottom up: :mod:`.wire` (versioned checksummed binary frames),
 :mod:`.flow` (block-granular credit window), :mod:`.endpoint`
 (per-engine listener + chunk-fetch client), :mod:`.transport`
-(``RemoteTransport``, registered as ``--kv-transport remote``).
+(``RemoteTransport``, registered as ``--kv-transport remote``), and
+:mod:`.control` (the multi-host control plane's RPC/events channels —
+SUBMIT/TOKEN/CANCEL/HEALTH/ADOPT/STATS/EVENT/GOODBYE frames on the same
+wire format).
 """
 
 from deepspeed_tpu.serving.net.wire import (  # noqa: F401
@@ -14,6 +18,12 @@ from deepspeed_tpu.serving.net.wire import (  # noqa: F401
 )
 from deepspeed_tpu.serving.net.flow import CreditWindow, CreditError  # noqa: F401
 from deepspeed_tpu.serving.net.endpoint import KVEndpoint, fetch_chunks  # noqa: F401
+from deepspeed_tpu.serving.net.control import (  # noqa: F401
+    ControlChannel,
+    ControlEndpoint,
+    ControlRefused,
+    dial_control,
+)
 
 __all__ = [
     "PROTOCOL_VERSION",
@@ -24,4 +34,8 @@ __all__ = [
     "CreditError",
     "KVEndpoint",
     "fetch_chunks",
+    "ControlChannel",
+    "ControlEndpoint",
+    "ControlRefused",
+    "dial_control",
 ]

@@ -66,7 +66,8 @@ class KVEndpoint:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
                  name: Optional[str] = None, max_staged: int = 64,
-                 io_timeout_s: float = DEFAULT_IO_TIMEOUT_S):
+                 io_timeout_s: float = DEFAULT_IO_TIMEOUT_S,
+                 advertise_host: Optional[str] = None):
         self.name = name or "kv-endpoint"
         self._io_timeout_s = float(io_timeout_s)
         self._max_staged = int(max_staged)
@@ -83,13 +84,25 @@ class KVEndpoint:
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((host, port))
         self._listener.listen(16)
-        self._address = self._listener.getsockname()[:2]
+        self._bind_address = self._listener.getsockname()[:2]
+        # multi-host discovery: the address handed to IMPORTERS (health
+        # metadata, handoff descriptors) may differ from the bind address —
+        # a pod-facing endpoint binds 0.0.0.0/127.0.0.1 but must advertise
+        # a host other machines can dial
+        self._advertise_host = advertise_host or self._bind_address[0]
         self._accept_thread: Optional[threading.Thread] = None
 
     # -- lifecycle -----------------------------------------------------------
     @property
     def address(self) -> Tuple[str, int]:
-        return (self._address[0], int(self._address[1]))
+        """The ADVERTISED ``(host, port)`` — what goes into handoff
+        descriptors and /health metadata for remote importers to dial."""
+        return (self._advertise_host, int(self._bind_address[1]))
+
+    @property
+    def bind_address(self) -> Tuple[str, int]:
+        """The local ``(host, port)`` the listener socket is bound to."""
+        return (self._bind_address[0], int(self._bind_address[1]))
 
     def start(self) -> "KVEndpoint":
         if self._accept_thread is None:
@@ -107,9 +120,10 @@ class KVEndpoint:
             self._staged.clear()
         # Closing the listener fd does NOT wake a thread blocked in accept()
         # on Linux — dial it once so the accept loop observes _closed and
-        # exits instead of eating the full join timeout below.
+        # exits instead of eating the full join timeout below. Dial the
+        # BIND address: the advertised host may only resolve off-box.
         try:
-            with socket.create_connection(self.address, timeout=0.5):
+            with socket.create_connection(self.bind_address, timeout=0.5):
                 pass
         except OSError:
             pass
@@ -198,12 +212,15 @@ class KVEndpoint:
         try:
             conn.settimeout(self._io_timeout_s)
             read = lambda n: wire.recv_exact(conn, n)
-            # handshake: both sides announce their version before any data
-            ftype, _ = wire.read_frame(read)
+            # handshake: both sides announce their version SPAN before any
+            # data; skew inside the supported range downgrades, no overlap
+            # (or foreign magic) raises out of the negotiation
+            ftype, payload = wire.read_frame(read)
             if ftype != wire.F_HELLO:
                 raise wire.WireError(
                     f"expected HELLO, got {wire.FRAME_NAMES.get(ftype, ftype)}")
-            self._send(conn, wire.encode_frame(wire.F_HELLO))
+            wire.negotiate_version(wire.decode_hello(payload))
+            self._send(conn, wire.encode_hello())
             ftype, payload = wire.read_frame(read)
             if ftype != wire.F_FETCH:
                 raise wire.WireError(
@@ -352,11 +369,12 @@ def fetch_chunks(
             (address[0], int(address[1])), timeout=io_timeout_s) as conn:
         conn.settimeout(io_timeout_s)
         read = lambda n: wire.recv_exact(conn, n)
-        conn.sendall(wire.encode_frame(wire.F_HELLO))
-        ftype, _ = wire.read_frame(read)
+        conn.sendall(wire.encode_hello())
+        ftype, payload = wire.read_frame(read)
         if ftype != wire.F_HELLO:
             raise wire.WireError(
                 f"expected HELLO, got {wire.FRAME_NAMES.get(ftype, ftype)}")
+        wire.negotiate_version(wire.decode_hello(payload))
         conn.sendall(wire.encode_json(wire.F_FETCH, {
             "tid": str(transfer_id),
             "start_block": int(start_block),

@@ -45,13 +45,21 @@ def ensure_endpoint(engine, host: Optional[str] = None
     port 0 = ephemeral). Created on first remote export so a bare
     ``export_sequence(..., transport="remote")`` works without a Router;
     the Router reads/creates the same attribute for health metadata and
-    closes it at shutdown."""
+    closes it at shutdown.
+
+    Binding and discovery are separate concerns: ``DSTPU_KV_BIND_HOST``
+    (default 127.0.0.1) picks the interface the listener binds, while
+    ``DSTPU_KV_ENDPOINT_HOST`` is the ADVERTISED host — the address
+    handoff descriptors and /health metadata hand to importers on other
+    machines. Unset, the endpoint advertises its bind address (the
+    single-host behavior)."""
     ep = getattr(engine, "_kv_endpoint", None)
     if ep is None:
-        host = host or os.environ.get("DSTPU_KV_ENDPOINT_HOST", "127.0.0.1")
+        bind = host or os.environ.get("DSTPU_KV_BIND_HOST", "127.0.0.1")
         ep = net_endpoint.KVEndpoint(
-            host=host,
+            host=bind,
             name=str(getattr(engine, "_trace_name", None) or "engine"),
+            advertise_host=os.environ.get("DSTPU_KV_ENDPOINT_HOST"),
         ).start()
         engine._kv_endpoint = ep
     return ep

@@ -13,28 +13,39 @@ is::
     16      4     CRC32 of the payload (u32 LE)
     20      N     payload
 
-Decode is STRICT: a frame with foreign magic, a different protocol
-version, an unknown type, a length beyond ``MAX_FRAME_BYTES``, a payload
-shorter than its header promises, or a checksum mismatch raises
-:class:`WireError` naming exactly what was wrong — a corrupt or truncated
-frame must never scatter garbage into a live KV pool (the pool-side
-``check_kv_payload`` contract is the second fence, this is the first).
+Decode is STRICT: a frame with foreign magic, a protocol version outside
+the supported range, an unknown type, a length beyond
+``MAX_FRAME_BYTES``, a payload shorter than its header promises, or a
+checksum mismatch raises :class:`WireError` naming exactly what was
+wrong — a corrupt or truncated frame must never scatter garbage into a
+live KV pool (the pool-side ``check_kv_payload`` contract is the second
+fence, this is the first).
 
-Control frames (HELLO/FETCH/CREDIT/ERROR/META) carry JSON; CHUNK frames
-carry a binary plane dict — per plane: name, dtype string, shape, raw
-bytes — so quantized int8 codes and their fp32 scale planes cross the
-wire bit-exactly (no text re-encoding of array data ever).
+Version negotiation: HELLO payloads carry the sender's
+``min_version``/``max_version`` span (an EMPTY payload is a legacy v1
+peer) and :func:`negotiate_version` picks the highest common version —
+skew inside ``[MIN_PROTOCOL_VERSION, PROTOCOL_VERSION]`` downgrades
+instead of disconnecting. Truly foreign peers still fail strictly:
+wrong magic, or a version span with no overlap.
+
+Control frames (HELLO/FETCH/CREDIT/ERROR/META and the cluster
+control-plane vocabulary SUBMIT/TOKEN/CANCEL/HEALTH/ADOPT/STATS/EVENT/
+GOODBYE) carry JSON; CHUNK frames carry a binary plane dict — per plane:
+name, dtype string, shape, raw bytes — so quantized int8 codes and their
+fp32 scale planes cross the wire bit-exactly (no text re-encoding of
+array data ever).
 """
 
 import json
 import struct
 import zlib
-from typing import Callable, Dict, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 
 __all__ = [
     "PROTOCOL_VERSION",
+    "MIN_PROTOCOL_VERSION",
     "MAGIC",
     "MAX_FRAME_BYTES",
     "F_HELLO",
@@ -44,6 +55,14 @@ __all__ = [
     "F_DONE",
     "F_ERROR",
     "F_META",
+    "F_SUBMIT",
+    "F_TOKEN",
+    "F_CANCEL",
+    "F_HEALTH",
+    "F_ADOPT",
+    "F_STATS",
+    "F_EVENT",
+    "F_GOODBYE",
     "FRAME_NAMES",
     "WireError",
     "encode_frame",
@@ -52,6 +71,9 @@ __all__ = [
     "recv_exact",
     "encode_json",
     "decode_json",
+    "encode_hello",
+    "decode_hello",
+    "negotiate_version",
     "encode_planes",
     "decode_planes",
     "encode_chunk",
@@ -61,7 +83,12 @@ __all__ = [
 ]
 
 MAGIC = b"DSKV"
-PROTOCOL_VERSION = 1
+# v1: KV fetch wire (HELLO..META). v2 adds the cluster control-plane
+# vocabulary (SUBMIT..GOODBYE). The span [MIN_PROTOCOL_VERSION,
+# PROTOCOL_VERSION] is what this build can SPEAK; HELLO negotiation picks
+# the highest version both spans share.
+PROTOCOL_VERSION = 2
+MIN_PROTOCOL_VERSION = 1
 # header: magic, version, frame type, payload length, payload crc32
 _HEADER = struct.Struct("<4sHHQI")
 HEADER_BYTES = _HEADER.size
@@ -70,17 +97,29 @@ HEADER_BYTES = _HEADER.size
 # payload — reject before trying to allocate it
 MAX_FRAME_BYTES = 1 << 32
 
-F_HELLO = 1   # version handshake (both directions, empty payload)
+F_HELLO = 1   # version handshake (both directions; {min_version, max_version})
 F_FETCH = 2   # importer -> exporter: {tid, start_block, credit_blocks}
 F_CHUNK = 3   # exporter -> importer: binary block-window planes
 F_CREDIT = 4  # importer -> exporter: {blocks} replenishing the window
 F_DONE = 5    # importer -> exporter: transfer landed, release the stage
 F_ERROR = 6   # either direction: {error} then close
 F_META = 7    # out-of-band handoff descriptor (cross-process bootstrap)
+# -- control plane (v2): router <-> replica agent -----------------------------
+F_SUBMIT = 8    # router -> agent: {uid, prompt, params} new resident request
+F_TOKEN = 9     # agent -> router: {uid, tok} / {uid, fin} token pump
+F_CANCEL = 10   # router -> agent: {uid} release a resident (cancel/finish)
+F_HEALTH = 11   # router -> agent: probation probe; reply {ok} or ERROR
+F_ADOPT = 12    # router -> agent: {req, meta} import a KV handoff and decode
+F_STATS = 13    # agent -> router: replica stats + prefix advertisement
+F_EVENT = 14    # agent -> router: lifecycle/control-plane event mirror
+F_GOODBYE = 15  # either direction: clean teardown of a control channel
 
 FRAME_NAMES = {
     F_HELLO: "HELLO", F_FETCH: "FETCH", F_CHUNK: "CHUNK",
     F_CREDIT: "CREDIT", F_DONE: "DONE", F_ERROR: "ERROR", F_META: "META",
+    F_SUBMIT: "SUBMIT", F_TOKEN: "TOKEN", F_CANCEL: "CANCEL",
+    F_HEALTH: "HEALTH", F_ADOPT: "ADOPT", F_STATS: "STATS",
+    F_EVENT: "EVENT", F_GOODBYE: "GOODBYE",
 }
 
 
@@ -89,13 +128,20 @@ class WireError(RuntimeError):
     version/magic, unknown type) or the peer broke protocol."""
 
 
-def encode_frame(ftype: int, payload: bytes = b"") -> bytes:
+def encode_frame(ftype: int, payload: bytes = b"",
+                 version: int = PROTOCOL_VERSION) -> bytes:
     """One framed message: header (magic, version, type, length, crc32)
-    followed by the payload bytes."""
+    followed by the payload bytes. ``version`` defaults to this build's
+    newest; a channel that negotiated a downgrade passes the agreed
+    version so the peer's strict decode accepts every frame."""
     if ftype not in FRAME_NAMES:
         raise ValueError(f"unknown frame type {ftype}")
+    if not (MIN_PROTOCOL_VERSION <= int(version) <= PROTOCOL_VERSION):
+        raise ValueError(
+            f"cannot encode v{version} frames (this build speaks "
+            f"v{MIN_PROTOCOL_VERSION}..v{PROTOCOL_VERSION})")
     payload = bytes(payload)
-    return _HEADER.pack(MAGIC, PROTOCOL_VERSION, ftype, len(payload),
+    return _HEADER.pack(MAGIC, int(version), ftype, len(payload),
                         zlib.crc32(payload) & 0xFFFFFFFF) + payload
 
 
@@ -104,11 +150,11 @@ def _check_header(magic: bytes, version: int, ftype: int, length: int):
         raise WireError(
             f"foreign frame: magic {magic!r} != {MAGIC!r} — peer is not a "
             "dstpu KV endpoint")
-    if version != PROTOCOL_VERSION:
+    if not (MIN_PROTOCOL_VERSION <= version <= PROTOCOL_VERSION):
         raise WireError(
             f"protocol version skew: peer speaks v{version}, this build "
-            f"speaks v{PROTOCOL_VERSION} — refusing to guess at the frame "
-            "layout")
+            f"speaks v{MIN_PROTOCOL_VERSION}..v{PROTOCOL_VERSION} — "
+            "refusing to guess at the frame layout")
     if ftype not in FRAME_NAMES:
         raise WireError(f"unknown frame type {ftype} (v{PROTOCOL_VERSION} "
                         f"knows {sorted(FRAME_NAMES)})")
@@ -188,6 +234,48 @@ def decode_json(payload: bytes, ftype: int = 0) -> Dict:
     if not isinstance(obj, dict):
         raise WireError(f"JSON payload must be an object, got {type(obj).__name__}")
     return obj
+
+
+# -- HELLO version negotiation ------------------------------------------------
+def encode_hello(extra: Optional[Dict] = None) -> bytes:
+    """A HELLO frame carrying this build's speakable version span (plus
+    optional channel metadata, e.g. the control plane's bootstrap role)."""
+    obj = dict(extra or {})
+    obj["min_version"] = MIN_PROTOCOL_VERSION
+    obj["max_version"] = PROTOCOL_VERSION
+    return encode_json(F_HELLO, obj)
+
+
+def decode_hello(payload: bytes) -> Dict:
+    """Decode a HELLO payload into its announcement dict. An EMPTY payload
+    is a legacy v1 peer (v1 HELLOs carried nothing) — it reads as the
+    span {1, 1} so negotiation downgrades instead of disconnecting."""
+    if not payload:
+        return {"min_version": 1, "max_version": 1}
+    obj = decode_json(payload, F_HELLO)
+    obj.setdefault("min_version", 1)
+    obj.setdefault("max_version", obj["min_version"])
+    return obj
+
+
+def negotiate_version(hello: Dict) -> int:
+    """Highest protocol version both the local build and the peer's HELLO
+    span can speak. No overlap is a truly foreign peer — strict
+    :class:`WireError`, exactly like bad magic."""
+    try:
+        peer_min = int(hello.get("min_version", 1))
+        peer_max = int(hello.get("max_version", peer_min))
+    except (TypeError, ValueError) as e:
+        raise WireError(f"malformed HELLO version span: {e}") from e
+    if peer_min > peer_max:
+        raise WireError(
+            f"malformed HELLO version span: min {peer_min} > max {peer_max}")
+    agreed = min(PROTOCOL_VERSION, peer_max)
+    if agreed < max(MIN_PROTOCOL_VERSION, peer_min):
+        raise WireError(
+            f"no common protocol version: peer speaks v{peer_min}..v{peer_max}, "
+            f"this build speaks v{MIN_PROTOCOL_VERSION}..v{PROTOCOL_VERSION}")
+    return agreed
 
 
 # -- binary plane dicts (CHUNK frames) ---------------------------------------

@@ -153,6 +153,8 @@ def test_gqa_grads():
         )
 
 
+@pytest.mark.slow  # ~35s of interpret-mode 16k scan; the no-VMEM-residency
+# property is the scale leg — test_long_seq_grads_4k keeps it tier-1 at 4k
 def test_dense_16k_forward():
     """The kv-pipelined kernel has no sequence-length VMEM residency: a 16k
     dense causal sequence (impossible with whole-K/V-resident programs) must

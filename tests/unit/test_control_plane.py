@@ -1,0 +1,321 @@
+"""Control-plane wire tests (serving/net/control.py + the v2 frames).
+
+The multi-host control plane speaks the SAME versioned checksummed frame
+protocol as the KV data wire: one strict layout under both control and
+data traffic. These tests pin the v2 vocabulary (SUBMIT/TOKEN/CANCEL/
+HEALTH/ADOPT/STATS/EVENT/GOODBYE) — roundtrips AND strict-decode
+rejections for every type — the HELLO version-skew matrix (a v1-only
+peer downgrades on the KV wire but is REFUSED a control channel), and
+the ControlEndpoint/dial_control bootstrap including refusal, retrying
+dials through the ``net.connect`` chaos seam, and RPC error mapping.
+"""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from deepspeed_tpu.serving.metrics import ServingMetrics
+from deepspeed_tpu.serving.net import wire
+from deepspeed_tpu.serving.net.control import (
+    CONTROL_MIN_VERSION,
+    ControlChannel,
+    ControlEndpoint,
+    dial_control,
+)
+from deepspeed_tpu.serving.resilience import FaultSpec, inject
+from deepspeed_tpu.serving.resilience.retry import RetryPolicy
+
+CONTROL_FRAMES = (
+    wire.F_SUBMIT, wire.F_TOKEN, wire.F_CANCEL, wire.F_HEALTH,
+    wire.F_ADOPT, wire.F_STATS, wire.F_EVENT, wire.F_GOODBYE,
+)
+
+
+# ---------------------------------------------------------------------------
+# v2 frame vocabulary: roundtrips + strict-decode negatives
+# ---------------------------------------------------------------------------
+class TestControlFrames:
+    def test_vocabulary_is_v2(self):
+        """The control vocabulary exists from v2 on, named, and disjoint
+        from the v1 data frames."""
+        assert wire.PROTOCOL_VERSION >= 2
+        assert CONTROL_MIN_VERSION == 2
+        v1 = {wire.F_HELLO, wire.F_FETCH, wire.F_CHUNK, wire.F_CREDIT,
+              wire.F_DONE, wire.F_ERROR, wire.F_META}
+        for ftype in CONTROL_FRAMES:
+            assert ftype in wire.FRAME_NAMES
+            assert ftype not in v1
+        assert len(set(CONTROL_FRAMES)) == len(CONTROL_FRAMES)
+
+    @pytest.mark.parametrize("ftype", CONTROL_FRAMES)
+    def test_roundtrip(self, ftype):
+        obj = {"uid": 7, "tok": 123, "nested": {"prefix": [1, 2, 3]},
+               "name": "d2", "event": "engine_failed"}
+        buf = wire.encode_json(ftype, obj)
+        got_type, payload, consumed = wire.decode_frame(buf)
+        assert got_type == ftype and consumed == len(buf)
+        assert wire.decode_json(payload, ftype) == obj
+
+    @pytest.mark.parametrize("ftype", CONTROL_FRAMES)
+    def test_payload_corruption_rejected(self, ftype):
+        """Flipping any payload byte fails the CRC — never a half-parsed
+        control message."""
+        buf = bytearray(wire.encode_json(ftype, {"uid": 9}))
+        buf[-1] ^= 0xFF
+        with pytest.raises(wire.WireError, match="checksum"):
+            wire.decode_frame(bytes(buf))
+
+    @pytest.mark.parametrize("ftype", CONTROL_FRAMES)
+    def test_truncated_frame_rejected(self, ftype):
+        buf = wire.encode_json(ftype, {"uid": 9})
+        with pytest.raises(wire.WireError):
+            wire.decode_frame(buf[: len(buf) - 3])
+
+    def test_unknown_type_and_version_skew_rejected(self):
+        buf = bytearray(wire.encode_json(wire.F_TOKEN, {"uid": 1}))
+        bad_type = bytearray(buf)
+        bad_type[6] = 99  # type field (u16 at offset 6): not in FRAME_NAMES
+        with pytest.raises(wire.WireError, match="unknown frame type"):
+            wire.decode_frame(bytes(bad_type))
+        bad_ver = bytearray(buf)
+        bad_ver[4] = wire.PROTOCOL_VERSION + 1  # above the speakable span
+        with pytest.raises(wire.WireError, match="version skew"):
+            wire.decode_frame(bytes(bad_ver))
+        bad_ver[4] = 0  # below MIN_PROTOCOL_VERSION
+        with pytest.raises(wire.WireError, match="version skew"):
+            wire.decode_frame(bytes(bad_ver))
+
+    def test_non_json_payload_rejected(self):
+        frame = wire.encode_frame(wire.F_STATS, b"\x00\x01not-json")
+        _, payload, _ = wire.decode_frame(frame)
+        with pytest.raises(wire.WireError, match="malformed JSON"):
+            wire.decode_json(payload, wire.F_STATS)
+
+
+# ---------------------------------------------------------------------------
+# HELLO negotiation: the version-skew matrix
+# ---------------------------------------------------------------------------
+class TestHelloNegotiation:
+    def test_hello_announces_span(self):
+        buf = wire.encode_hello({"channel": "rpc"})
+        ftype, payload, _ = wire.decode_frame(buf)
+        assert ftype == wire.F_HELLO
+        hello = wire.decode_hello(payload)
+        assert hello["min_version"] == wire.MIN_PROTOCOL_VERSION
+        assert hello["max_version"] == wire.PROTOCOL_VERSION
+        assert hello["channel"] == "rpc"
+
+    def test_empty_hello_reads_as_legacy_v1(self):
+        """v1 HELLOs carried no payload: an empty payload is the span
+        {1, 1}, so the KV wire downgrades instead of disconnecting."""
+        assert wire.decode_hello(b"") == {"min_version": 1, "max_version": 1}
+
+    @pytest.mark.parametrize("span,want", [
+        ((1, 1), 1),                             # legacy peer: downgrade
+        ((1, wire.PROTOCOL_VERSION), wire.PROTOCOL_VERSION),
+        ((2, 5), wire.PROTOCOL_VERSION),         # newer peer: their floor ok
+        ((wire.PROTOCOL_VERSION, wire.PROTOCOL_VERSION),
+         wire.PROTOCOL_VERSION),
+    ])
+    def test_skew_matrix(self, span, want):
+        lo, hi = span
+        assert wire.negotiate_version(
+            {"min_version": lo, "max_version": hi}) == want
+
+    def test_no_overlap_is_strict(self):
+        with pytest.raises(wire.WireError, match="no common protocol"):
+            wire.negotiate_version({"min_version": wire.PROTOCOL_VERSION + 1,
+                                    "max_version": wire.PROTOCOL_VERSION + 3})
+
+    def test_malformed_span_is_strict(self):
+        with pytest.raises(wire.WireError, match="malformed HELLO"):
+            wire.negotiate_version({"min_version": 3, "max_version": 1})
+        with pytest.raises(wire.WireError, match="malformed HELLO"):
+            wire.negotiate_version({"min_version": "x"})
+
+
+# ---------------------------------------------------------------------------
+# ControlChannel over a socketpair: framing, RPC, error mapping
+# ---------------------------------------------------------------------------
+def _channel_pair(metrics=None):
+    a, b = socket.socketpair()
+    return (ControlChannel(a, name="left", metrics=metrics),
+            ControlChannel(b, name="right"))
+
+
+class TestControlChannel:
+    def test_send_recv_roundtrip(self):
+        left, right = _channel_pair()
+        try:
+            left.send(wire.F_TOKEN, {"uid": 4, "tok": 99})
+            assert right.recv() == (wire.F_TOKEN, {"uid": 4, "tok": 99})
+            right.send(wire.F_GOODBYE, {"reason": "done"})
+            assert left.recv() == (wire.F_GOODBYE, {"reason": "done"})
+        finally:
+            left.close()
+            right.close()
+
+    def test_call_echo_counts_metrics(self):
+        metrics = ServingMetrics()
+        left, right = _channel_pair(metrics)
+        server = threading.Thread(
+            target=lambda: right.send(*right.recv()), daemon=True)
+        server.start()
+        try:
+            reply = left.call(wire.F_HEALTH, {"probe": True}, timeout_s=5)
+            assert reply == {"probe": True}
+            snap = metrics.snapshot()
+            assert snap["control_rpcs_total"] == 1
+            assert snap["control_frames_total"] >= 2  # send + recv counted
+            assert snap["control_rpc_seconds"] >= 0.0
+        finally:
+            server.join(timeout=2)
+            left.close()
+            right.close()
+
+    def test_error_reply_raises_with_agent_message(self):
+        left, right = _channel_pair()
+
+        def server():
+            right.recv()
+            right.send(wire.F_ERROR, {"error": "KeyError: 13"})
+
+        t = threading.Thread(target=server, daemon=True)
+        t.start()
+        try:
+            with pytest.raises(wire.WireError, match="KeyError: 13"):
+                left.call(wire.F_CANCEL, {"uid": 13}, timeout_s=5)
+        finally:
+            t.join(timeout=2)
+            left.close()
+            right.close()
+
+    def test_reply_type_mismatch_is_strict(self):
+        left, right = _channel_pair()
+
+        def server():
+            right.recv()
+            right.send(wire.F_STATS, {"free_blocks": 1})
+
+        t = threading.Thread(target=server, daemon=True)
+        t.start()
+        try:
+            with pytest.raises(wire.WireError, match="mismatch"):
+                left.call(wire.F_HEALTH, {"probe": True}, timeout_s=5)
+        finally:
+            t.join(timeout=2)
+            left.close()
+            right.close()
+
+    def test_dead_wire_surfaces_and_goodbye_never_raises(self):
+        left, right = _channel_pair()
+        right.close()
+        with pytest.raises((wire.WireError, OSError)):
+            left.recv(timeout_s=2)
+        left.goodbye("late")  # best-effort: must not raise on a dead wire
+        left.close()
+        assert left.closed
+
+
+# ---------------------------------------------------------------------------
+# ControlEndpoint bootstrap + dial_control
+# ---------------------------------------------------------------------------
+class TestControlBootstrap:
+    def test_dial_and_ack(self):
+        got = {}
+
+        def on_channel(meta, channel):
+            got["meta"] = meta
+            return {"name": "d7"}
+
+        ep = ControlEndpoint(on_channel=on_channel, name="test-ctl").start()
+        try:
+            chan, ack = dial_control(ep.address,
+                                     {"channel": "rpc", "name": "agent"})
+            try:
+                assert ack["name"] == "d7"
+                assert ack["version"] == wire.PROTOCOL_VERSION
+                assert chan.version == wire.PROTOCOL_VERSION
+                assert got["meta"]["name"] == "agent"
+                assert got["meta"]["channel"] == "rpc"
+            finally:
+                chan.close()
+        finally:
+            ep.close()
+
+    def test_on_channel_exception_refuses_with_error_frame(self):
+        def on_channel(meta, channel):
+            raise ValueError("name 'd0' is taken by a local engine")
+
+        ep = ControlEndpoint(on_channel=on_channel).start()
+        try:
+            with pytest.raises(wire.WireError,
+                               match="refused channel.*d0.*taken"):
+                dial_control(ep.address, {"channel": "rpc", "name": "d0"})
+        finally:
+            ep.close()
+
+    def test_v1_only_peer_refused_a_control_channel(self):
+        """A peer whose HELLO tops out at v1 has no control vocabulary:
+        the handshake refuses it (the KV wire would have downgraded)."""
+        ep = ControlEndpoint(on_channel=lambda m, c: {}).start()
+        try:
+            with socket.create_connection(ep.address, timeout=5) as conn:
+                conn.sendall(wire.encode_frame(wire.F_HELLO, b""))  # v1 style
+                # server refuses before HELLO-ack: EOF (or RST) on read
+                with pytest.raises((wire.WireError, OSError)):
+                    ftype, _ = wire.read_frame(
+                        lambda n: wire.recv_exact(conn, n))
+                    if ftype == wire.F_HELLO:  # ack arrived anyway: fail
+                        raise AssertionError("v1 peer was acked")
+        finally:
+            ep.close()
+
+    def test_dial_retries_through_connect_chaos(self):
+        ep = ControlEndpoint(on_channel=lambda m, c: {"name": "d1"}).start()
+        try:
+            with inject(FaultSpec("net.connect", nth=1)) as inj:
+                chan, ack = dial_control(
+                    ep.address, {"channel": "rpc"},
+                    retry_policy=RetryPolicy(attempts=3, backoff_s=0.001),
+                    replica="agent")
+                chan.close()
+            assert ack["name"] == "d1"
+            assert len(inj.fired()) == 1  # first dial died, retry landed
+        finally:
+            ep.close()
+
+    def test_refusal_is_final_even_under_a_retry_policy(self):
+        """A router F_ERROR verdict (name collision, version floor) is a
+        protocol rejection, not a wire fault: the dial must surface
+        ControlRefused on the FIRST attempt instead of burning the whole
+        backoff ladder re-asking the same question."""
+        from deepspeed_tpu.serving.net.control import ControlRefused
+
+        calls = []
+
+        def on_channel(meta, channel):
+            calls.append(meta)
+            raise ValueError("name 'd0' is taken by a local engine")
+
+        ep = ControlEndpoint(on_channel=on_channel).start()
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(ControlRefused, match="taken by a local"):
+                dial_control(
+                    ep.address, {"channel": "rpc", "name": "d0"},
+                    retry_policy=RetryPolicy(attempts=5, backoff_s=10.0,
+                                             max_backoff_s=10.0))
+            assert time.monotonic() - t0 < 5.0  # no 10s backoff burned
+            assert len(calls) == 1  # one bootstrap, one verdict
+        finally:
+            ep.close()
+
+    def test_endpoint_close_is_idempotent_and_wakes_accept(self):
+        ep = ControlEndpoint(on_channel=lambda m, c: {}).start()
+        ep.close()
+        ep.close()
+        with pytest.raises(OSError):
+            socket.create_connection(ep.address, timeout=0.5).close()

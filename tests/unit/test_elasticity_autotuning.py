@@ -352,6 +352,8 @@ class TestExtendedAutotuner:
         assert val is None
 
 
+@pytest.mark.slow  # ~47s: the heaviest single tier-1 test; the subprocess
+# scheduler path stays tier-1 via TestExtendedAutotuner (end_to_end + crash)
 def test_tune_serving_cpu_smoke():
     """The serving tuner runs isolated experiments and returns a best config
     (tiny shape on CPU; VERDICT r4 next-step #8 — v2 knobs against the

@@ -275,6 +275,9 @@ class TestResidualMoE:
         expected = base + (gate * shared).reshape(x.shape)
         np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=1e-5)
 
+    @pytest.mark.slow  # ~27s 8-device train loop; residual-MoE math stays
+    # tier-1 via the block-level parity test above, MoE training via
+    # test_pipe / test_hf_archs[qwen2_moe]
     def test_residual_moe_trains(self, devices8):
         cfg = get_config("mixtral-tiny", moe_residual=True)
         params = init_params(cfg, jax.random.key(0))

@@ -245,6 +245,21 @@ finally:
         child.kill()
 EOF
 
+echo "== multi-host control-plane gate (serve-agent + SIGKILL chaos) =="
+# the v2 control vocabulary on the same wire: frame roundtrips + strict
+# negatives + HELLO version negotiation (test_control_plane), then the
+# ReplicaAgent contract against a live Router control listener — join /
+# remote placement / cancel flush / wire-loss quarantine + bit-identical
+# replay + re-join probation — and the cross-process acceptance: real
+# `python -m tests.unit.test_multihost agent` children join over
+# loopback, stream BIT-IDENTICAL to the single engine (greedy + seeded,
+# plus the slow-marked int8 combo), and one child is SIGKILLed
+# mid-decode: streams must replay bit-identical on the survivor and a
+# restarted child must re-admit through probation; runs both files
+# unfiltered so the slow combos are included
+python -m pytest tests/unit/test_control_plane.py tests/unit/test_multihost.py \
+    -q -p no:cacheprovider
+
 echo "== elastic-serving parity gate (preempt/resume + warm scale-up) =="
 # preempted-and-resumed streams must be BIT-IDENTICAL to uninterrupted
 # ones (greedy + seeded, bf16 + int8 KV), scale-up from a warm spare must
